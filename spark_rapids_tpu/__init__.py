@@ -1,0 +1,35 @@
+"""spark-rapids-tpu: a TPU-native columnar SQL acceleration framework.
+
+A from-scratch re-design of the capabilities of the RAPIDS Accelerator for
+Apache Spark (reference: tgravescs/spark-rapids) targeting TPUs through
+JAX/XLA/Pallas instead of NVIDIA GPUs through cuDF/RMM/UCX.
+
+Architecture (bottom-up), mirroring the reference's layer map (SURVEY.md section 1):
+
+  L0  jax/XLA/pallas kernels            (reference: external cuDF/RMM/UCX)
+  L2  memory & device runtime           (reference: GpuDeviceManager/GpuSemaphore/
+                                         RapidsBufferCatalog + spill stores)
+  L3  I/O + exchange                    (reference: GpuParquetScan, shuffle)
+  L4  columnar operators & expressions  (reference: Gpu*Exec / Gpu* expressions)
+  L5  plan-rewrite engine               (reference: GpuOverrides + RapidsMeta +
+                                         GpuTransitionOverrides)
+  L6/L7 session front-end & conf        (reference: Plugin.scala / RapidsConf.scala)
+
+The reference is a plugin into Apache Spark; this framework carries its own
+Spark-like front-end (session/DataFrame/logical plan) because it is standalone,
+but the heart of the design is the same: a CPU physical plan is *tagged*
+node-by-node for TPU support (with human-readable reasons) and *converted* into
+TPU columnar operators, with explicit host<->device transition operators and
+CPU fallback for anything unsupported.
+
+64-bit note: SQL semantics require int64/float64; we enable jax x64 at import.
+TPU executes s64/f64 via XLA emulation; hot paths can opt into 32-bit via conf.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from spark_rapids_tpu.config.conf import TpuConf, conf_entries  # noqa: E402,F401

@@ -1,0 +1,7 @@
+from spark_rapids_tpu.columnar import dtype as dtypes  # noqa: F401
+from spark_rapids_tpu.columnar.batch import (  # noqa: F401
+    DeviceBatch,
+    Schema,
+    bucket_capacity,
+)
+from spark_rapids_tpu.columnar.column import DeviceColumn  # noqa: F401

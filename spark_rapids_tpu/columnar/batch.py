@@ -1,0 +1,248 @@
+"""Device-resident columnar batches and the host (pandas) twin.
+
+``DeviceBatch`` is the TPU analogue of a Spark ``ColumnarBatch`` of
+``GpuColumnVector``s; ``HostBatch`` is the twin used on the CPU side after a
+``DeviceToHost`` transition (reference: RapidsHostColumnVector.java).
+
+Capacity bucketing: batches are padded to a bucketed capacity (default
+power-of-two) so that the set of XLA programs compiled for any query is
+bounded by O(#operators x log(max batch rows)) rather than one per distinct
+row count. This replaces cuDF's fully-dynamic shapes (SURVEY.md section 7
+hard-part 1/3).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtype as dtypes
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.columnar.dtype import DType
+
+MIN_CAPACITY = 8
+
+
+def bucket_capacity(n: int, growth: float = 2.0, minimum: int = MIN_CAPACITY) -> int:
+    """Smallest capacity bucket >= n. growth=2.0 -> power-of-two buckets."""
+    cap = minimum
+    while cap < n:
+        cap = int(np.ceil(cap * growth))
+    return cap
+
+
+class Schema:
+    """Ordered (name, dtype) pairs."""
+
+    def __init__(self, names: Sequence[str], dtypes_: Sequence[DType]):
+        assert len(names) == len(dtypes_)
+        self.names: Tuple[str, ...] = tuple(names)
+        self.dtypes: Tuple[DType, ...] = tuple(dtypes_)
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Schema) and self.names == other.names
+                and self.dtypes == other.dtypes)
+
+    def __hash__(self) -> int:
+        return hash((self.names, self.dtypes))
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}: {d}" for n, d in zip(self.names, self.dtypes))
+        return f"Schema({cols})"
+
+    def index_of(self, name: str) -> int:
+        return self.names.index(name)
+
+    def dtype_of(self, name: str) -> DType:
+        return self.dtypes[self.index_of(name)]
+
+    @staticmethod
+    def from_pandas(df: pd.DataFrame) -> "Schema":
+        names, dts = [], []
+        for name in df.columns:
+            names.append(str(name))
+            dts.append(_pandas_col_dtype(df[name]))
+        return Schema(names, dts)
+
+
+@jax.tree_util.register_pytree_node_class
+class DeviceBatch:
+    """Columns + a device scalar row count; static capacity.
+
+    ``num_rows`` is an int32 *device scalar* so it can flow through traced
+    code (a filter's output count is data, not shape). ``num_rows_host()``
+    syncs it to the host when operator orchestration needs the value.
+    """
+
+    def __init__(self, schema: Schema, columns: List[DeviceColumn],
+                 num_rows: jnp.ndarray):
+        self.schema = schema
+        self.columns = columns
+        self.num_rows = num_rows
+        self._host_rows: Optional[int] = None
+
+    def tree_flatten(self):
+        return (self.columns, self.num_rows), self.schema
+
+    @classmethod
+    def tree_unflatten(cls, schema, children):
+        columns, num_rows = children
+        return cls(schema, list(columns), num_rows)
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else 0
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    def column(self, name: str) -> DeviceColumn:
+        return self.columns[self.schema.index_of(name)]
+
+    def num_rows_host(self) -> int:
+        if self._host_rows is None:
+            self._host_rows = int(self.num_rows)
+        return self._host_rows
+
+    def row_mask(self) -> jnp.ndarray:
+        """bool (capacity,): True for live rows (the leading num_rows)."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+
+    def device_memory_size(self) -> int:
+        """Bytes of device storage held by this batch."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self):
+            total += leaf.size * leaf.dtype.itemsize
+        return total
+
+    def __repr__(self) -> str:
+        return (f"DeviceBatch(rows~{self._host_rows}, capacity={self.capacity}, "
+                f"schema={self.schema})")
+
+    # --- conversion --------------------------------------------------------
+    @staticmethod
+    def from_pandas(df: pd.DataFrame, capacity: Optional[int] = None,
+                    schema: Optional[Schema] = None) -> "DeviceBatch":
+        """Host -> device transition (reference: GpuRowToColumnarExec /
+        HostColumnarToGpu, GpuRowToColumnarExec.scala:45-502)."""
+        if schema is None:
+            schema = Schema.from_pandas(df)
+        n = len(df)
+        cap = capacity if capacity is not None else bucket_capacity(n)
+        cols: List[DeviceColumn] = []
+        for name, dt in zip(schema.names, schema.dtypes):
+            values, validity = _pandas_to_numpy(df[name], dt)
+            cols.append(DeviceColumn.from_numpy(values, validity, dt, cap))
+        return DeviceBatch(schema, cols, jnp.asarray(n, dtype=jnp.int32))
+
+    def to_pandas(self) -> pd.DataFrame:
+        """Device -> host transition (reference: GpuColumnarToRowExec)."""
+        n = self.num_rows_host()
+        out: Dict[str, pd.Series] = {}
+        for name, dt, col in zip(self.schema.names, self.schema.dtypes,
+                                 self.columns):
+            values, validity = col.to_numpy(n)
+            out[name] = _numpy_to_pandas(values, validity, dt)
+        df = pd.DataFrame(out, columns=list(self.schema.names))
+        if len(df) != n:  # all-column-less batch
+            df = df.reindex(range(n))
+        return df
+
+    @staticmethod
+    def empty(schema: Schema, capacity: int = MIN_CAPACITY) -> "DeviceBatch":
+        cols = []
+        for dt in schema.dtypes:
+            cols.append(DeviceColumn.from_numpy(
+                np.empty(0, dtype=object if dt.is_string else dt.np_dtype),
+                None, dt, capacity))
+        return DeviceBatch(schema, cols, jnp.asarray(0, dtype=jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# pandas <-> numpy(+mask) helpers
+# ---------------------------------------------------------------------------
+
+def _pandas_col_dtype(s: pd.Series) -> DType:
+    dt = s.dtype
+    name = str(dt)
+    mapping = {
+        "boolean": dtypes.BOOL, "bool": dtypes.BOOL,
+        "Int8": dtypes.INT8, "int8": dtypes.INT8,
+        "Int16": dtypes.INT16, "int16": dtypes.INT16,
+        "Int32": dtypes.INT32, "int32": dtypes.INT32,
+        "Int64": dtypes.INT64, "int64": dtypes.INT64,
+        "Float32": dtypes.FLOAT32, "float32": dtypes.FLOAT32,
+        "Float64": dtypes.FLOAT64, "float64": dtypes.FLOAT64,
+    }
+    if name in mapping:
+        return mapping[name]
+    if name.startswith("datetime64"):
+        return dtypes.TIMESTAMP_US
+    if name in ("object", "str", "string"):
+        return dtypes.STRING
+    raise TypeError(f"unsupported pandas dtype: {name}")
+
+
+def _pandas_to_numpy(s: pd.Series, dt: DType) -> Tuple[np.ndarray, np.ndarray]:
+    """Null discipline: numpy-backed numeric/bool columns cannot represent
+    missing (float NaN is a *value*, like SQL NaN, not NULL) so they are
+    all-valid; nullable extension dtypes (Int64/Float64/boolean) use their
+    mask; datetime64 NaT and object-column None are NULL."""
+    if (not dt.is_string and isinstance(s.dtype, np.dtype)
+            and s.dtype.kind in "biuf"):
+        validity = np.ones(len(s), dtype=np.bool_)
+        return s.to_numpy(dtype=dt.np_dtype), validity
+    validity = (~s.isna()).to_numpy(dtype=np.bool_)
+    if dt.is_string:
+        vals = s.to_numpy(dtype=object)
+        if not validity.all():
+            vals = vals.copy()
+            vals[~validity] = None  # replace NaN placeholders with None
+        return vals, validity
+    if dt == dtypes.DATE32:
+        if str(s.dtype).startswith("datetime64") or str(s.dtype) == "object":
+            vals = pd.to_datetime(s).to_numpy(dtype="datetime64[D]")
+            return vals.astype(np.int64).astype(np.int32), validity
+        return s.to_numpy(dtype=np.int32, na_value=0), validity
+    if dt == dtypes.TIMESTAMP_US:
+        if str(s.dtype).startswith("datetime64") or str(s.dtype) == "object":
+            vals = pd.to_datetime(s).to_numpy(dtype="datetime64[us]")
+            out = vals.astype(np.int64)
+            out = np.where(validity, out, 0)
+            return out, validity
+        return s.to_numpy(dtype=np.int64, na_value=0), validity
+    fill = dtypes.null_fill_value(dt)
+    return s.to_numpy(dtype=dt.np_dtype, na_value=fill), validity
+
+
+def _numpy_to_pandas(values: np.ndarray, validity: np.ndarray,
+                     dt: DType) -> pd.Series:
+    has_nulls = not bool(validity.all()) if len(validity) else False
+    if dt.is_string:
+        s = pd.Series(values, dtype="str")
+        return s
+    if dt == dtypes.DATE32:
+        out = values.astype("datetime64[D]").astype("datetime64[s]")
+        s = pd.Series(out)
+        if has_nulls:
+            s = s.mask(~validity)
+        return s
+    if dt == dtypes.TIMESTAMP_US:
+        out = values.astype("datetime64[us]")
+        s = pd.Series(out)
+        if has_nulls:
+            s = s.mask(~validity)
+        return s
+    if has_nulls:
+        s = pd.Series(values, dtype=dt.pandas_nullable)
+        return s.mask(~validity)
+    # keep plain numpy dtype when no nulls: fast path and exact CPU parity
+    return pd.Series(values)

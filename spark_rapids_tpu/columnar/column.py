@@ -1,0 +1,144 @@
+"""Device-resident column vectors.
+
+The TPU analogue of the reference's ``GpuColumnVector``
+(sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java:41-199):
+a column whose storage is XLA device buffers (jax arrays) rather than cuDF
+device memory. Registered as a jax pytree so whole batches can flow through
+``jax.jit``-traced operator stages.
+
+Shape discipline (the core TPU-first design decision): every column has a
+static ``capacity`` (padded to a bucketed size, see batch.py) while the number
+of *valid leading rows* is carried as data (the batch's ``num_rows`` scalar).
+This keeps every XLA program shape-static while allowing dynamic result sizes
+(filters, joins) without recompilation — the mitigation SURVEY.md section 7
+"hard parts" items 1 and 3 call for.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtype as dtypes
+from spark_rapids_tpu.columnar.dtype import DType
+
+
+@jax.tree_util.register_pytree_node_class
+class DeviceColumn:
+    """One column on the device.
+
+    Fixed-width: ``data`` has shape (capacity,) with physical dtype.
+    String: ``data`` is uint8 chars of shape (char_capacity,), ``offsets`` is
+    int32 of shape (capacity + 1,). Invalid/padding rows have empty extents.
+    ``validity`` is bool (capacity,), True = valid. Padding rows are invalid.
+    """
+
+    def __init__(self, dtype: DType, data: jnp.ndarray,
+                 validity: jnp.ndarray,
+                 offsets: Optional[jnp.ndarray] = None):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+        self.offsets = offsets
+
+    # --- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        if self.dtype.is_string:
+            return (self.data, self.validity, self.offsets), self.dtype
+        return (self.data, self.validity), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, dtype, children):
+        if dtype.is_string:
+            data, validity, offsets = children
+            return cls(dtype, data, validity, offsets)
+        data, validity = children
+        return cls(dtype, data, validity)
+
+    # --- properties --------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        if self.dtype.is_string:
+            return int(self.offsets.shape[0]) - 1
+        return int(self.data.shape[0])
+
+    @property
+    def char_capacity(self) -> int:
+        assert self.dtype.is_string
+        return int(self.data.shape[0])
+
+    def __repr__(self) -> str:
+        return f"DeviceColumn({self.dtype}, capacity={self.capacity})"
+
+    # --- construction ------------------------------------------------------
+    @staticmethod
+    def from_numpy(values: np.ndarray, validity: Optional[np.ndarray],
+                   dtype: DType, capacity: int,
+                   char_capacity: Optional[int] = None) -> "DeviceColumn":
+        """Build a device column from host data, padding to ``capacity``.
+
+        The host-side build-then-upload mirrors the reference's
+        ``GpuColumnarBatchBuilder`` (GpuColumnVector.java:43-132).
+        """
+        n = len(values)
+        assert n <= capacity, (n, capacity)
+        if validity is None:
+            validity = np.ones(n, dtype=np.bool_)
+        vpad = np.zeros(capacity, dtype=np.bool_)
+        vpad[:n] = validity
+
+        if dtype.is_string:
+            # values: numpy object/str array
+            encoded = [b"" if (values[i] is None or not validity[i])
+                       else str(values[i]).encode("utf-8") for i in range(n)]
+            lengths = np.fromiter((len(e) for e in encoded), dtype=np.int32,
+                                  count=n)
+            offsets = np.zeros(capacity + 1, dtype=np.int32)
+            np.cumsum(lengths, out=offsets[1:n + 1])
+            total = int(offsets[n])
+            offsets[n + 1:] = total
+            if char_capacity is None:
+                char_capacity = _char_bucket(total)
+            assert total <= char_capacity, (total, char_capacity)
+            chars = np.zeros(char_capacity, dtype=np.uint8)
+            if total:
+                chars[:total] = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+            return DeviceColumn(dtype, jnp.asarray(chars), jnp.asarray(vpad),
+                                jnp.asarray(offsets))
+
+        fill = dtypes.null_fill_value(dtype)
+        dpad = np.full(capacity, fill, dtype=dtype.np_dtype)
+        vals = np.asarray(values, dtype=dtype.np_dtype)
+        # canonicalize nulls to the fill value so device math is deterministic
+        vals = np.where(validity[:n], vals, np.asarray(fill, dtype=dtype.np_dtype))
+        dpad[:n] = vals
+        return DeviceColumn(dtype, jnp.asarray(dpad), jnp.asarray(vpad))
+
+    # --- host access -------------------------------------------------------
+    def to_numpy(self, num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Copy the leading ``num_rows`` to host. Returns (values, validity).
+        String columns return an object array of python str (None if null)."""
+        validity = np.asarray(self.validity[:num_rows])
+        if self.dtype.is_string:
+            offsets = np.asarray(self.offsets[:num_rows + 1])
+            chars = np.asarray(self.data)
+            out = np.empty(num_rows, dtype=object)
+            for i in range(num_rows):
+                if validity[i]:
+                    out[i] = bytes(chars[offsets[i]:offsets[i + 1]]).decode(
+                        "utf-8", errors="replace")
+                else:
+                    out[i] = None
+            return out, validity
+        return np.asarray(self.data[:num_rows]), validity
+
+
+def _char_bucket(n: int, minimum: int = 16) -> int:
+    """Round a char-buffer size up to a power-of-two bucket."""
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    return cap

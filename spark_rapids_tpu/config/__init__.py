@@ -1,0 +1,6 @@
+from spark_rapids_tpu.config.conf import (  # noqa: F401
+    ConfEntry,
+    TpuConf,
+    conf_entries,
+    register,
+)

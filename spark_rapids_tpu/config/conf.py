@@ -1,0 +1,380 @@
+"""Typed, self-documenting configuration registry.
+
+Design mirrors the reference's ``RapidsConf`` (reference:
+sql-plugin/src/main/scala/com/nvidia/spark/rapids/RapidsConf.scala:30-866):
+every knob is a registered ``ConfEntry`` with a key, a type, a default, a doc
+string and an optional validator; ``TpuConf`` wraps a plain dict of settings
+with typed accessors; ``help_text()`` generates the docs table the same way
+``RapidsConf.help`` does (reference: RapidsConf.scala:133-146).
+
+Key names intentionally keep the ``spark.rapids.*`` namespace so a user of the
+reference finds the same switches here.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class ConfEntry:
+    key: str
+    conv: Callable[[str], Any]
+    default: Any
+    doc: str
+    internal: bool = False
+    validator: Optional[Callable[[Any], Optional[str]]] = None
+
+    def convert(self, raw: Any) -> Any:
+        if isinstance(raw, str):
+            value = self.conv(raw)
+        else:
+            value = raw
+        if self.validator is not None:
+            err = self.validator(value)
+            if err:
+                raise ValueError(f"invalid value for {self.key}: {err}")
+        return value
+
+
+_REGISTRY: Dict[str, ConfEntry] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def _to_bool(s: str) -> bool:
+    low = s.strip().lower()
+    if low in ("true", "1", "yes"):
+        return True
+    if low in ("false", "0", "no"):
+        return False
+    raise ValueError(f"not a boolean: {s!r}")
+
+
+def _to_bytes(s: str) -> int:
+    """Parse '1g', '512m', '16k' or raw integers into bytes."""
+    s = s.strip().lower()
+    mults = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40, "b": 1}
+    if s and s[-1] in mults:
+        return int(float(s[:-1]) * mults[s[-1]])
+    return int(s)
+
+
+def register(key: str, conv: Callable[[str], Any], default: Any, doc: str,
+             internal: bool = False,
+             validator: Optional[Callable[[Any], Optional[str]]] = None) -> ConfEntry:
+    entry = ConfEntry(key, conv, default, doc, internal, validator)
+    with _REGISTRY_LOCK:
+        if key in _REGISTRY and _REGISTRY[key].doc != doc:
+            raise ValueError(f"conf key registered twice: {key}")
+        _REGISTRY[key] = entry
+    return entry
+
+
+def conf_entries() -> Dict[str, ConfEntry]:
+    return dict(_REGISTRY)
+
+
+def _fraction(lo: float, hi: float) -> Callable[[Any], Optional[str]]:
+    def check(v: Any) -> Optional[str]:
+        if not (lo <= float(v) <= hi):
+            return f"must be within [{lo}, {hi}], got {v}"
+        return None
+    return check
+
+
+def _positive(v: Any) -> Optional[str]:
+    return None if v > 0 else f"must be positive, got {v}"
+
+
+# ---------------------------------------------------------------------------
+# Entry definitions. Groups mirror RapidsConf.scala:241-604.
+# ---------------------------------------------------------------------------
+
+# --- general / top level ---------------------------------------------------
+SQL_ENABLED = register(
+    "spark.rapids.sql.enabled", _to_bool, True,
+    "Enable (true) or disable (false) TPU acceleration of SQL plans. When "
+    "disabled every operator executes on the CPU path.")
+
+EXPLAIN = register(
+    "spark.rapids.sql.explain", str, "NONE",
+    "Explain why some parts of a query were or were not placed on the TPU. "
+    "Possible values: NONE (default), ALL (full tag tree), NOT_ON_TPU "
+    "(only nodes that did not make it).")
+
+# --- memory pool & spill (ref RapidsConf.scala:241-307) --------------------
+ALLOC_FRACTION = register(
+    "spark.rapids.memory.tpu.allocFraction", float, 0.9,
+    "Fraction of per-chip HBM the framework budgets for columnar buffers. The "
+    "device store spills to host once the budget is exceeded.",
+    validator=_fraction(0.0, 1.0))
+
+HBM_DEBUG = register(
+    "spark.rapids.memory.tpu.debug", _to_bool, False,
+    "If true, log every device-store allocation/free for leak hunting.")
+
+HOST_SPILL_STORAGE_SIZE = register(
+    "spark.rapids.memory.host.spillStorageSize", _to_bytes, 1 << 30,
+    "Amount of host memory used to cache spilled device buffers before "
+    "spilling them further to disk.")
+
+PINNED_POOL_SIZE = register(
+    "spark.rapids.memory.pinnedPool.size", _to_bytes, 0,
+    "Size of the aligned host staging pool used for device transfers. 0 "
+    "disables pooling and allocates on demand.")
+
+# --- batch sizing (ref RapidsConf.scala:309-328) ---------------------------
+BATCH_SIZE_ROWS = register(
+    "spark.rapids.sql.batchSizeRows", int, 1 << 20,
+    "Target number of rows per columnar batch. Batches are padded up to a "
+    "power-of-two capacity bucket to bound XLA recompilation.",
+    validator=_positive)
+
+MAX_READER_BATCH_SIZE_ROWS = register(
+    "spark.rapids.sql.reader.batchSizeRows", int, 1 << 21,
+    "Maximum rows a file reader materializes per batch.",
+    validator=_positive)
+
+CAPACITY_GROWTH = register(
+    "spark.rapids.sql.batchCapacityGrowth", float, 2.0,
+    "Growth factor between consecutive batch capacity buckets. 2.0 means "
+    "power-of-two bucketing; smaller values trade recompiles for padding.",
+    validator=_fraction(1.1, 4.0))
+
+# --- op enable/disable incl. incompat (ref RapidsConf.scala:339-430) -------
+INCOMPATIBLE_OPS = register(
+    "spark.rapids.sql.incompatibleOps.enabled", _to_bool, False,
+    "Enable operators that produce results that differ from standard CPU "
+    "semantics in corner cases (e.g. float aggregation ordering).")
+
+IMPROVED_FLOAT_OPS = register(
+    "spark.rapids.sql.improvedFloatOps.enabled", _to_bool, False,
+    "Use TPU-optimized float operations that may not be bit-identical to the "
+    "CPU implementations.")
+
+ALLOW_FLOAT32_EXEC = register(
+    "spark.rapids.sql.fast32BitFloat.enabled", _to_bool, False,
+    "Execute float64 expressions in float32 on the TPU for speed. Results are "
+    "approximate; off by default.")
+
+HAS_NANS = register(
+    "spark.rapids.sql.hasNans", _to_bool, True,
+    "If float data may contain NaN; some ops tag themselves off the TPU when "
+    "NaNs are possible and the kernel cannot match CPU NaN semantics.")
+
+ENABLE_CAST_STRING_TO_NUMERIC = register(
+    "spark.rapids.sql.castStringToInteger.enabled", _to_bool, False,
+    "Enable casting strings to integral types on the TPU. Disabled by default "
+    "because overflow corner cases differ from the CPU.")
+
+ENABLE_CAST_STRING_TO_FLOAT = register(
+    "spark.rapids.sql.castStringToFloat.enabled", _to_bool, False,
+    "Enable casting strings to floating point on the TPU.")
+
+ENABLE_CAST_FLOAT_TO_STRING = register(
+    "spark.rapids.sql.castFloatToString.enabled", _to_bool, False,
+    "Enable casting floating point to strings on the TPU; formatting differs "
+    "from Java's in corner cases.")
+
+# --- file formats (ref RapidsConf.scala:433-474) ---------------------------
+PARQUET_ENABLED = register(
+    "spark.rapids.sql.format.parquet.enabled", _to_bool, True,
+    "Enable Parquet input/output acceleration.")
+PARQUET_READ_ENABLED = register(
+    "spark.rapids.sql.format.parquet.read.enabled", _to_bool, True,
+    "Enable accelerated Parquet scans.")
+PARQUET_WRITE_ENABLED = register(
+    "spark.rapids.sql.format.parquet.write.enabled", _to_bool, True,
+    "Enable accelerated Parquet writes.")
+CSV_ENABLED = register(
+    "spark.rapids.sql.format.csv.enabled", _to_bool, True,
+    "Enable CSV input acceleration.")
+CSV_READ_ENABLED = register(
+    "spark.rapids.sql.format.csv.read.enabled", _to_bool, True,
+    "Enable accelerated CSV scans.")
+ORC_ENABLED = register(
+    "spark.rapids.sql.format.orc.enabled", _to_bool, True,
+    "Enable ORC input/output acceleration.")
+
+# --- test hooks (ref RapidsConf.scala:476-501) -----------------------------
+TEST_ENABLED = register(
+    "spark.rapids.sql.test.enabled", _to_bool, False,
+    "Intended for framework tests only. When true a query fails if any "
+    "operator not in the allowed list runs on the CPU "
+    "(the reference's assertIsOnTheGpu behavior, "
+    "GpuTransitionOverrides.scala:225-263).")
+
+TEST_ALLOWED_NONTPU = register(
+    "spark.rapids.sql.test.allowedNonTpu", str, "",
+    "Comma-separated list of operator class names allowed on the CPU when "
+    "test mode is enabled.")
+
+# --- hashAgg (ref RapidsConf.scala:503-518) --------------------------------
+HASH_AGG_REPLACE_MODE = register(
+    "spark.rapids.sql.hashAgg.replaceMode", str, "all",
+    "Which aggregation modes to replace: 'all', 'partial', or 'final'.")
+
+# --- execution -------------------------------------------------------------
+CONCURRENT_TPU_TASKS = register(
+    "spark.rapids.sql.concurrentTpuTasks", int, 1,
+    "Number of concurrent tasks admitted to the TPU at once (the reference's "
+    "GpuSemaphore admission model, GpuSemaphore.scala:101-161).",
+    validator=_positive)
+
+NUM_TASK_THREADS = register(
+    "spark.rapids.sql.taskThreads", int, 4,
+    "Host-side worker threads executing partitions (Spark task equivalent).",
+    validator=_positive)
+
+SHUFFLE_PARTITIONS = register(
+    "spark.rapids.sql.shuffle.partitions", int, 8,
+    "Default number of shuffle output partitions (spark.sql.shuffle.partitions "
+    "equivalent).", validator=_positive)
+
+STAGE_FUSION = register(
+    "spark.rapids.sql.stageFusion.enabled", _to_bool, True,
+    "Trace chains of narrow operators (project/filter/partial-agg) into a "
+    "single XLA executable so the compiler fuses them. TPU-first feature with "
+    "no reference equivalent: cuDF dispatches one kernel per op.")
+
+# --- shuffle transport (ref RapidsConf.scala:520-601) ----------------------
+SHUFFLE_TRANSPORT_ENABLED = register(
+    "spark.rapids.shuffle.transport.enabled", _to_bool, False,
+    "Enable the accelerated shuffle manager: shuffle blocks stay in device "
+    "memory (spilling through the store framework) and move between workers "
+    "over the mesh interconnect instead of the host serializer path.")
+
+SHUFFLE_MAX_INFLIGHT = register(
+    "spark.rapids.shuffle.maxMetadataFetchesInFlight", int, 128,
+    "Bound on simultaneous in-flight shuffle fetches per task.",
+    validator=_positive)
+
+SHUFFLE_BOUNCE_BUFFER_SIZE = register(
+    "spark.rapids.shuffle.bounceBuffers.size", _to_bytes, 4 << 20,
+    "Size of each staging (bounce) buffer used when moving shuffle data "
+    "between tiers or peers.")
+
+SHUFFLE_BOUNCE_BUFFER_COUNT = register(
+    "spark.rapids.shuffle.bounceBuffers.count", int, 16,
+    "Number of staging buffers per direction.", validator=_positive)
+
+EXPORT_COLUMNAR_RDD = register(
+    "spark.rapids.sql.exportColumnarRdd", _to_bool, False,
+    "Expose query output as device-resident columnar data for ML frameworks "
+    "(the reference's ColumnarRdd zero-copy export, ColumnarRdd.scala:41-50).")
+
+
+class TpuConf:
+    """Immutable snapshot of settings, with typed accessors.
+
+    Mirrors the accessor style of the reference's ``RapidsConf`` class.
+    """
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings: Dict[str, Any] = {}
+        if settings:
+            for k, v in settings.items():
+                self.set(k, v)
+
+    def set(self, key: str, value: Any) -> "TpuConf":
+        entry = _REGISTRY.get(key)
+        if entry is not None:
+            self._settings[key] = entry.convert(value)
+        else:
+            # Unregistered keys are allowed (per-op enable keys are generated
+            # dynamically, GpuOverrides.scala:122-130) and treated as strings.
+            self._settings[key] = value
+        return self
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._settings:
+            return self._settings[key]
+        entry = _REGISTRY.get(key)
+        if entry is not None:
+            return entry.default
+        return default
+
+    def get_bool(self, key: str, default: bool) -> bool:
+        v = self.get(key, default)
+        return _to_bool(v) if isinstance(v, str) else bool(v)
+
+    def copy(self) -> "TpuConf":
+        c = TpuConf()
+        c._settings = dict(self._settings)
+        return c
+
+    # Typed accessors -------------------------------------------------------
+    @property
+    def sql_enabled(self) -> bool: return self.get(SQL_ENABLED.key)
+    @property
+    def explain(self) -> str: return str(self.get(EXPLAIN.key)).upper()
+    @property
+    def alloc_fraction(self) -> float: return self.get(ALLOC_FRACTION.key)
+    @property
+    def hbm_debug(self) -> bool: return self.get(HBM_DEBUG.key)
+    @property
+    def host_spill_storage_size(self) -> int: return self.get(HOST_SPILL_STORAGE_SIZE.key)
+    @property
+    def pinned_pool_size(self) -> int: return self.get(PINNED_POOL_SIZE.key)
+    @property
+    def batch_size_rows(self) -> int: return self.get(BATCH_SIZE_ROWS.key)
+    @property
+    def max_reader_batch_size_rows(self) -> int: return self.get(MAX_READER_BATCH_SIZE_ROWS.key)
+    @property
+    def capacity_growth(self) -> float: return self.get(CAPACITY_GROWTH.key)
+    @property
+    def incompatible_ops_enabled(self) -> bool: return self.get(INCOMPATIBLE_OPS.key)
+    @property
+    def improved_float_ops(self) -> bool: return self.get(IMPROVED_FLOAT_OPS.key)
+    @property
+    def has_nans(self) -> bool: return self.get(HAS_NANS.key)
+    @property
+    def test_enabled(self) -> bool: return self.get(TEST_ENABLED.key)
+    @property
+    def test_allowed_nontpu(self) -> List[str]:
+        raw = str(self.get(TEST_ALLOWED_NONTPU.key) or "")
+        return [s.strip() for s in raw.split(",") if s.strip()]
+    @property
+    def hash_agg_replace_mode(self) -> str: return self.get(HASH_AGG_REPLACE_MODE.key)
+    @property
+    def concurrent_tpu_tasks(self) -> int: return self.get(CONCURRENT_TPU_TASKS.key)
+    @property
+    def num_task_threads(self) -> int: return self.get(NUM_TASK_THREADS.key)
+    @property
+    def shuffle_partitions(self) -> int: return self.get(SHUFFLE_PARTITIONS.key)
+    @property
+    def stage_fusion_enabled(self) -> bool: return self.get(STAGE_FUSION.key)
+    @property
+    def shuffle_transport_enabled(self) -> bool: return self.get(SHUFFLE_TRANSPORT_ENABLED.key)
+    @property
+    def shuffle_bounce_buffer_size(self) -> int: return self.get(SHUFFLE_BOUNCE_BUFFER_SIZE.key)
+    @property
+    def shuffle_bounce_buffer_count(self) -> int: return self.get(SHUFFLE_BOUNCE_BUFFER_COUNT.key)
+    @property
+    def export_columnar_rdd(self) -> bool: return self.get(EXPORT_COLUMNAR_RDD.key)
+
+    def is_operator_enabled(self, key: str, incompat: bool = False,
+                            disabled_by_default: bool = False) -> bool:
+        """Per-operator enable check with the incompat/disabled taxonomy
+        (reference: GpuOverrides.scala:122-130, RapidsMeta.scala:185-200)."""
+        if key in self._settings:
+            return self.get_bool(key, True)
+        if disabled_by_default:
+            return False
+        if incompat and not self.incompatible_ops_enabled:
+            return False
+        return True
+
+
+def help_text(include_internal: bool = False) -> str:
+    """Generate the configs doc table (reference: RapidsConf.scala:133-146
+    writes docs/configs.md the same way)."""
+    lines = ["Name | Description | Default", "-----|-------------|--------"]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.internal and not include_internal:
+            continue
+        lines.append(f"{e.key} | {e.doc} | {e.default}")
+    return "\n".join(lines)

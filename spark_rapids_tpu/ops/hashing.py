@@ -1,0 +1,97 @@
+"""Device hashing kernels.
+
+Used for: hash partitioning (reference: GpuHashPartitioning.scala — cuDF
+murmur3), hash-based group keys, and join keys. Variable-length strings are
+reduced to a pair of independent 64-bit polynomial hashes — 128 bits of
+discrimination — so exact comparison of arbitrary-length strings becomes
+fixed-width integer comparison, which is the shape XLA wants (SURVEY.md
+section 7 hard-part 1).
+
+All arithmetic is uint64 with natural wraparound.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+_U64 = jnp.uint64
+
+# FNV-64 prime and a second independent odd multiplier
+P1 = 1099511628211
+P2 = 6364136223846793005
+SALT1 = 14695981039346656037  # FNV offset basis
+SALT2 = 9600629759793949339
+
+
+def splitmix64(x):
+    """splitmix64 finalizer: a strong 64-bit mixer."""
+    x = x.astype(_U64)
+    x = (x + jnp.asarray(0x9E3779B97F4A7C15, _U64))
+    x = (x ^ (x >> jnp.asarray(30, _U64))) * jnp.asarray(0xBF58476D1CE4E5B9, _U64)
+    x = (x ^ (x >> jnp.asarray(27, _U64))) * jnp.asarray(0x94D049BB133111EB, _U64)
+    return x ^ (x >> jnp.asarray(31, _U64))
+
+
+def hash_fixed_width(data: jnp.ndarray, validity: jnp.ndarray) -> jnp.ndarray:
+    """64-bit hash of a fixed-width column; nulls hash to a distinct value."""
+    if data.dtype == jnp.bool_:
+        bits = data.astype(_U64)
+    elif jnp.issubdtype(data.dtype, jnp.floating):
+        # normalize -0.0 == 0.0 and all NaN bit patterns before hashing so
+        # grouping matches CPU equality semantics
+        # (reference: NormalizeFloatingNumbers.scala)
+        f64 = data.astype(jnp.float64)
+        f64 = jnp.where(f64 == 0.0, 0.0, f64)
+        f64 = jnp.where(jnp.isnan(f64), jnp.nan, f64)
+        bits = f64.view(jnp.uint64)
+    else:
+        bits = data.astype(jnp.int64).view(jnp.uint64) if data.dtype != jnp.uint64 else data
+    h = splitmix64(bits)
+    null_h = jnp.asarray(0x7E57AB1E5EED5EED, _U64)
+    return jnp.where(validity, h, null_h)
+
+
+def string_poly_hashes(offsets: jnp.ndarray, chars: jnp.ndarray,
+                       validity: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Two independent 64-bit polynomial hashes per row of a string column.
+
+    h_p(row) = salt + sum_j chars[j] * p^(len-1-j)  (mod 2^64), mixed with the
+    row length via splitmix64. Computed with segment ops: O(chars) work.
+    """
+    capacity = offsets.shape[0] - 1
+    nchars = chars.shape[0]
+    total = offsets[capacity]
+    i = jnp.arange(nchars, dtype=jnp.int32)
+    # row of each char
+    row_ids = jnp.searchsorted(offsets, i, side="right").astype(jnp.int32) - 1
+    row_ids = jnp.clip(row_ids, 0, capacity - 1)
+    # distance from the end of the row = exponent
+    ends = offsets[row_ids + 1]
+    exp = (ends - 1 - i).astype(jnp.int32)
+    exp = jnp.clip(exp, 0, nchars - 1)
+    live = i < total
+
+    lengths = (offsets[1:] - offsets[:-1]).astype(_U64)
+
+    import jax
+    hashes = []
+    for p, salt in ((P1, SALT1), (P2, SALT2)):
+        # pows[k] = p^k (mod 2^64)
+        pows = jnp.concatenate([jnp.ones((1,), _U64),
+                                jnp.cumprod(jnp.full((nchars - 1,), p, dtype=_U64))])
+        term = jnp.where(live, chars.astype(_U64) * pows[exp], jnp.asarray(0, _U64))
+        acc = jax.ops.segment_sum(term, row_ids, num_segments=capacity)
+        h = splitmix64(acc + jnp.asarray(salt, _U64) + lengths)
+        null_h = jnp.asarray(0x7E57AB1E5EED5EED, _U64)
+        hashes.append(jnp.where(validity, h, null_h))
+    return hashes[0], hashes[1]
+
+
+def combine_hashes(hs: List[jnp.ndarray]) -> jnp.ndarray:
+    """Combine per-column 64-bit hashes into one row hash."""
+    out = jnp.asarray(0x243F6A8885A308D3, _U64)
+    for h in hs:
+        out = splitmix64(out ^ h)
+    return out

@@ -1,0 +1,215 @@
+"""Device string kernels over the (offsets, chars) layout.
+
+The TPU replacement for cuDF's string kernels (reference call sites:
+sql/rapids/stringFunctions.scala, 698 LoC). Patterns used:
+
+  * per-row fixed-length literal compare: a (capacity, m) gather where m is
+    the *static* literal length — XLA unrolls/fuses it;
+  * variable-length column-vs-column equality: double 64-bit polynomial hash
+    (ops/hashing.py) + length equality — fixed-width compare;
+  * per-char segment ops (row id of each char via searchsorted on offsets)
+    for contains/length/case mapping.
+
+Unicode note: kernels are byte-oriented; case mapping is ASCII-only (cuDF is
+also ASCII-limited for some ops). Multi-byte-aware variants are tracked as
+incompat.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.ops import hashing
+from spark_rapids_tpu.sql.exprs.core import DevCol, DevScalar, DevValue, EvalContext
+
+
+def lengths_of(col: DevCol) -> jnp.ndarray:
+    return (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+
+
+def _validity(ctx: EvalContext, v: DevValue) -> jnp.ndarray:
+    if isinstance(v, DevScalar):
+        return jnp.full((ctx.capacity,), v.valid, dtype=jnp.bool_)
+    return v.validity
+
+
+def string_equal_literal(ctx: EvalContext, col: DevCol,
+                         lit: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """col == literal. Returns (eq bool vec, validity)."""
+    pat = lit.encode("utf-8")
+    m = len(pat)
+    lens = lengths_of(col)
+    if m == 0:
+        return lens == 0, col.validity
+    eq = _match_at(col, jnp.asarray(col.offsets[:-1]), pat) & (lens == m)
+    return eq, col.validity
+
+
+def _match_at(col: DevCol, starts: jnp.ndarray, pat: bytes) -> jnp.ndarray:
+    """For each row, do the chars starting at ``starts[r]`` equal ``pat``?
+    (no length checking; out-of-bounds reads are masked)"""
+    m = len(pat)
+    nchars = col.data.shape[0]
+    idx = starts[:, None].astype(jnp.int32) + jnp.arange(m, dtype=jnp.int32)[None, :]
+    in_bounds = idx < nchars
+    gathered = col.data[jnp.clip(idx, 0, nchars - 1)]
+    patv = jnp.asarray(bytearray(pat), dtype=jnp.uint8)
+    return jnp.all((gathered == patv[None, :]) & in_bounds, axis=1)
+
+
+def starts_with(ctx: EvalContext, col: DevCol, lit: str):
+    pat = lit.encode("utf-8")
+    m = len(pat)
+    lens = lengths_of(col)
+    if m == 0:
+        return jnp.ones((ctx.capacity,), dtype=jnp.bool_), col.validity
+    eq = _match_at(col, jnp.asarray(col.offsets[:-1]), pat) & (lens >= m)
+    return eq, col.validity
+
+
+def ends_with(ctx: EvalContext, col: DevCol, lit: str):
+    pat = lit.encode("utf-8")
+    m = len(pat)
+    lens = lengths_of(col)
+    if m == 0:
+        return jnp.ones((ctx.capacity,), dtype=jnp.bool_), col.validity
+    starts = jnp.maximum(col.offsets[1:] - m, 0)
+    eq = _match_at(col, starts, pat) & (lens >= m)
+    return eq, col.validity
+
+
+def contains(ctx: EvalContext, col: DevCol, lit: str):
+    pat = lit.encode("utf-8")
+    m = len(pat)
+    lens = lengths_of(col)
+    if m == 0:
+        return jnp.ones((ctx.capacity,), dtype=jnp.bool_), col.validity
+    chars = col.data
+    nchars = chars.shape[0]
+    capacity = ctx.capacity
+    # position i matches if chars[i:i+m] == pat
+    pos_match = jnp.ones((nchars,), dtype=jnp.bool_)
+    for j, c in enumerate(pat):
+        shifted = jnp.roll(chars, -j) if j else chars
+        # mask rolled-around tail
+        ok = (jnp.arange(nchars) + j) < nchars
+        pos_match = pos_match & (shifted == c) & ok
+    # a match at i counts for row r iff i >= off[r] and i + m <= off[r+1]
+    i = jnp.arange(nchars, dtype=jnp.int32)
+    row_ids = jnp.clip(
+        jnp.searchsorted(col.offsets, i, side="right").astype(jnp.int32) - 1,
+        0, capacity - 1)
+    fits = (i + m) <= col.offsets[row_ids + 1]
+    total = col.offsets[capacity]
+    contrib = (pos_match & fits & (i < total)).astype(jnp.int32)
+    row_any = jax.ops.segment_max(contrib, row_ids, num_segments=capacity)
+    return (row_any > 0) & (lens >= m), col.validity
+
+
+def string_equal(ctx: EvalContext, lv: DevValue, rv: DevValue):
+    """General string equality (column/column or column/literal)."""
+    if isinstance(rv, DevScalar) and isinstance(lv, DevCol):
+        eq, _ = string_equal_literal(ctx, lv, str(rv.value))
+        validity = lv.validity & _validity(ctx, rv)
+        return eq, validity
+    if isinstance(lv, DevScalar) and isinstance(rv, DevCol):
+        eq, _ = string_equal_literal(ctx, rv, str(lv.value))
+        validity = rv.validity & _validity(ctx, lv)
+        return eq, validity
+    if isinstance(lv, DevScalar) and isinstance(rv, DevScalar):
+        eq = jnp.full((ctx.capacity,), lv.value == rv.value, dtype=jnp.bool_)
+        return eq, _validity(ctx, lv) & _validity(ctx, rv)
+    # column vs column: double-hash + length equality. With two independent
+    # 64-bit hashes a false positive needs a 2^-128 event.
+    lh1, lh2 = hashing.string_poly_hashes(lv.offsets, lv.data, lv.validity)
+    rh1, rh2 = hashing.string_poly_hashes(rv.offsets, rv.data, rv.validity)
+    eq = (lh1 == rh1) & (lh2 == rh2) & (lengths_of(lv) == lengths_of(rv))
+    return eq, lv.validity & rv.validity
+
+
+def upper_ascii(col: DevCol) -> DevCol:
+    c = col.data
+    is_lower = (c >= 97) & (c <= 122)
+    return DevCol(col.dtype, jnp.where(is_lower, c - 32, c), col.validity,
+                  col.offsets)
+
+
+def lower_ascii(col: DevCol) -> DevCol:
+    c = col.data
+    is_upper = (c >= 65) & (c <= 90)
+    return DevCol(col.dtype, jnp.where(is_upper, c + 32, c), col.validity,
+                  col.offsets)
+
+
+def substring(ctx: EvalContext, col: DevCol, pos: int, length: int) -> DevCol:
+    """Spark substring: 1-based ``pos``; negative counts from the end;
+    ``length`` < 0 means to-the-end. Byte-oriented (ASCII-exact)."""
+    lens = lengths_of(col)
+    if pos > 0:
+        start = jnp.minimum(jnp.asarray(pos - 1, jnp.int32), lens)
+    elif pos == 0:
+        start = jnp.zeros_like(lens)
+    else:
+        start = jnp.maximum(lens + pos, 0)
+    if length < 0:
+        new_len = lens - start
+    else:
+        new_len = jnp.minimum(jnp.asarray(length, jnp.int32), lens - start)
+    new_len = jnp.maximum(new_len, 0)
+    return _gather_substrings(ctx, col, col.offsets[:-1] + start, new_len)
+
+
+def _gather_substrings(ctx: EvalContext, col: DevCol, src_start: jnp.ndarray,
+                       new_len: jnp.ndarray) -> DevCol:
+    """Build a new string column taking new_len[r] bytes from src_start[r]."""
+    capacity = ctx.capacity
+    nchars = col.data.shape[0]
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(new_len).astype(jnp.int32)])
+    total_new = new_offsets[capacity]
+    k = jnp.arange(nchars, dtype=jnp.int32)
+    out_row = jnp.clip(
+        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
+        0, capacity - 1)
+    src_idx = src_start[out_row].astype(jnp.int32) + (k - new_offsets[out_row])
+    gathered = col.data[jnp.clip(src_idx, 0, nchars - 1)]
+    new_chars = jnp.where(k < total_new, gathered, 0).astype(jnp.uint8)
+    return DevCol(dtypes.STRING, new_chars, col.validity, new_offsets)
+
+
+def concat_columns(ctx: EvalContext, cols) -> DevCol:
+    """concat(s1, s2, ...): NULL if any input is NULL (Spark semantics)."""
+    capacity = ctx.capacity
+    lens = [lengths_of(c) for c in cols]
+    validity = cols[0].validity
+    for c in cols[1:]:
+        validity = validity & c.validity
+    total_len = sum(lens[1:], lens[0])
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(total_len).astype(jnp.int32)])
+    out_cap = sum(int(c.data.shape[0]) for c in cols)
+    k = jnp.arange(out_cap, dtype=jnp.int32)
+    out_row = jnp.clip(
+        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
+        0, capacity - 1)
+    # position within the concatenated row
+    rel = k - new_offsets[out_row]
+    # walk the parts: select source column and index per char
+    out = jnp.zeros((out_cap,), dtype=jnp.uint8)
+    part_start = jnp.zeros((capacity,), dtype=jnp.int32)
+    for c, ln in zip(cols, lens):
+        in_part = (rel >= part_start[out_row]) & (rel < part_start[out_row] + ln[out_row])
+        src = c.offsets[:-1][out_row].astype(jnp.int32) + (rel - part_start[out_row])
+        nc = c.data.shape[0]
+        vals = c.data[jnp.clip(src, 0, nc - 1)]
+        out = jnp.where(in_part, vals, out)
+        part_start = part_start + ln
+    total_new = new_offsets[capacity]
+    out = jnp.where(k < total_new, out, 0).astype(jnp.uint8)
+    return DevCol(dtypes.STRING, out, validity, new_offsets)

@@ -1,0 +1,13 @@
+from spark_rapids_tpu.sql.exprs.core import (  # noqa: F401
+    Alias,
+    BoundRef,
+    Col,
+    DevCol,
+    DevScalar,
+    EvalContext,
+    Expression,
+    Literal,
+    bind_references,
+    first_unsupported,
+    walk,
+)

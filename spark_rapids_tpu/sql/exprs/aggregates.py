@@ -1,0 +1,230 @@
+"""Aggregate functions (reference: sql/rapids/AggregateFunctions.scala:69-502).
+
+Each aggregate declares itself as *update* reductions over input expressions,
+*merge* reductions over intermediate columns, and a *finalize* expression —
+exactly the reference's ``CudfAggregate`` update/merge pair design, which is
+what makes partial/final (two-phase, shuffle-separated) aggregation work.
+
+Reduction kinds understood by the device groupby kernel (ops/groupby.py) and
+the host path: 'sum', 'min', 'max', 'count_valid', 'first', 'last', 'any'.
+
+SQL null semantics: aggregates skip NULLs; sum/min/max/avg of an all-NULL (or
+empty) group is NULL; count is 0.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType
+from spark_rapids_tpu.sql.exprs.core import Expression
+
+
+class AggregateFunction(Expression):
+    """Children are the input value expressions."""
+
+    is_aggregate = True
+
+    def dtype(self, schema: Schema) -> DType:
+        raise NotImplementedError
+
+    # --- the CudfAggregate-style decomposition -----------------------------
+    def update_ops(self) -> List[Tuple[str, int]]:
+        """[(reduction_kind, child_index)] producing intermediate columns."""
+        raise NotImplementedError
+
+    def merge_ops(self) -> List[str]:
+        """reduction kinds merging intermediates across batches/partitions."""
+        raise NotImplementedError
+
+    def intermediate_dtypes(self, schema: Schema) -> List[DType]:
+        raise NotImplementedError
+
+    def finalize(self, refs: List[Expression], schema: Schema) -> Expression:
+        """Expression over intermediate refs computing the final value."""
+        raise NotImplementedError
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        for c in self.children:
+            if c.dtype(schema).is_string:
+                return f"{self.pretty_name} over strings is not supported on TPU"
+        return None
+
+
+def _sum_result_dtype(t: DType) -> DType:
+    if t.is_integral or t == dtypes.BOOL:
+        return dtypes.INT64
+    return dtypes.FLOAT64
+
+
+class Sum(AggregateFunction):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return _sum_result_dtype(self.children[0].dtype(schema))
+
+    def sql_name(self, schema=None) -> str:
+        return f"sum({self.children[0].sql_name(schema)})"
+
+    def update_ops(self): return [("sum", 0)]
+    def merge_ops(self): return ["sum"]
+
+    def intermediate_dtypes(self, schema):
+        return [self.dtype(schema)]
+
+    def finalize(self, refs, schema):
+        return refs[0]
+
+
+class Count(AggregateFunction):
+    """count(expr): counts non-NULL rows. count(lit(1)) == count(*)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT64
+
+    def sql_name(self, schema=None) -> str:
+        return f"count({self.children[0].sql_name(schema)})"
+
+    def update_ops(self): return [("count_valid", 0)]
+    def merge_ops(self): return ["sum"]
+
+    def intermediate_dtypes(self, schema):
+        return [dtypes.INT64]
+
+    def finalize(self, refs, schema):
+        return refs[0]
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        return None  # count works for any input type incl. strings
+
+
+class Min(AggregateFunction):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return self.children[0].dtype(schema)
+
+    def sql_name(self, schema=None) -> str:
+        return f"min({self.children[0].sql_name(schema)})"
+
+    def update_ops(self): return [("min", 0)]
+    def merge_ops(self): return ["min"]
+
+    def intermediate_dtypes(self, schema):
+        return [self.dtype(schema)]
+
+    def finalize(self, refs, schema):
+        return refs[0]
+
+
+class Max(AggregateFunction):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return self.children[0].dtype(schema)
+
+    def sql_name(self, schema=None) -> str:
+        return f"max({self.children[0].sql_name(schema)})"
+
+    def update_ops(self): return [("max", 0)]
+    def merge_ops(self): return ["max"]
+
+    def intermediate_dtypes(self, schema):
+        return [self.dtype(schema)]
+
+    def finalize(self, refs, schema):
+        return refs[0]
+
+
+class Average(AggregateFunction):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.FLOAT64
+
+    def sql_name(self, schema=None) -> str:
+        return f"avg({self.children[0].sql_name(schema)})"
+
+    def update_ops(self): return [("sum", 0), ("count_valid", 0)]
+    def merge_ops(self): return ["sum", "sum"]
+
+    def intermediate_dtypes(self, schema):
+        return [dtypes.FLOAT64, dtypes.INT64]
+
+    def finalize(self, refs, schema):
+        from spark_rapids_tpu.sql.exprs.arithmetic import Divide
+        # Divide yields NULL on zero count — matching avg(empty) = NULL
+        return Divide(refs[0], refs[1])
+
+
+class First(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__([child])
+        self.ignore_nulls = ignore_nulls
+
+    def dtype(self, schema: Schema) -> DType:
+        return self.children[0].dtype(schema)
+
+    def sql_name(self, schema=None) -> str:
+        return f"first({self.children[0].sql_name(schema)})"
+
+    def update_ops(self):
+        return [("first_valid" if self.ignore_nulls else "first", 0)]
+
+    def merge_ops(self):
+        return ["first_valid" if self.ignore_nulls else "first"]
+
+    def intermediate_dtypes(self, schema):
+        return [self.dtype(schema)]
+
+    def finalize(self, refs, schema):
+        return refs[0]
+
+
+class Last(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__([child])
+        self.ignore_nulls = ignore_nulls
+
+    def dtype(self, schema: Schema) -> DType:
+        return self.children[0].dtype(schema)
+
+    def sql_name(self, schema=None) -> str:
+        return f"last({self.children[0].sql_name(schema)})"
+
+    def update_ops(self):
+        return [("last_valid" if self.ignore_nulls else "last", 0)]
+
+    def merge_ops(self):
+        return ["last_valid" if self.ignore_nulls else "last"]
+
+    def intermediate_dtypes(self, schema):
+        return [self.dtype(schema)]
+
+    def finalize(self, refs, schema):
+        return refs[0]
+
+
+def find_aggregates(expr: Expression) -> List[AggregateFunction]:
+    out = []
+    if isinstance(expr, AggregateFunction):
+        out.append(expr)
+        return out
+    for c in expr.children:
+        out.extend(find_aggregates(c))
+    return out
+
+
+def has_aggregate(expr: Expression) -> bool:
+    return len(find_aggregates(expr)) > 0

@@ -1,0 +1,207 @@
+"""Arithmetic expressions (reference: sql/rapids/arithmetic.scala, 227 LoC).
+
+Semantics follow Spark SQL non-ANSI mode:
+  * integer overflow wraps (Java semantics — numpy/jax match);
+  * ``/`` (Divide) always produces double; divide-by-zero yields NULL;
+  * ``%`` (Remainder) takes the sign of the dividend (Java), NULL on zero
+    divisor; ``pmod`` is always non-negative.
+
+Each op's formula is written once against an array namespace (numpy on the
+host path, jax.numpy on the device path) so CPU and TPU results are computed
+by the same code — differential parity by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType, common_type
+from spark_rapids_tpu.sql.exprs.core import (
+    DevCol, DevScalar, DevValue, EvalContext, Expression, data_of, valid_and,
+)
+from spark_rapids_tpu.sql.exprs.hostutil import (
+    host_binary_values, host_unary_values, rebuild_series,
+)
+
+
+class BinaryArithmetic(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    def dtype(self, schema: Schema) -> DType:
+        return common_type(self.children[0].dtype(schema),
+                           self.children[1].dtype(schema))
+
+    def sql_name(self, schema=None) -> str:
+        return (f"({self.children[0].sql_name(schema)} {self.symbol} "
+                f"{self.children[1].sql_name(schema)})")
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        for c in self.children:
+            if c.dtype(schema).is_string:
+                return "string operands are not supported for arithmetic"
+        return None
+
+    # formula over the array namespace; result (data, extra_null_mask|None)
+    def compute(self, xp, a, b, out_dt: DType):
+        raise NotImplementedError
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = self.children[0].eval_device(ctx)
+        rv = self.children[1].eval_device(ctx)
+        out_dt = self.dtype_from_children(lv.dtype, rv.dtype)
+        a = data_of(ctx, lv).astype(out_dt.np_dtype)
+        b = data_of(ctx, rv).astype(out_dt.np_dtype)
+        data, extra_null = self.compute(jnp, a, b, out_dt)
+        validity = valid_and(ctx, lv, rv)
+        if extra_null is not None:
+            validity = validity & ~extra_null
+            data = jnp.where(extra_null, dtypes.null_fill_value(out_dt), data)
+        return DevCol(out_dt, data, validity)
+
+    def dtype_from_children(self, lt: DType, rt: DType) -> DType:
+        return common_type(lt, rt)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        ls = self.children[0].eval_host(df)
+        rs = self.children[1].eval_host(df)
+        (a, b), validity, index = host_binary_values(ls, rs)
+        out_dt = self.dtype_from_children(dtypes.from_numpy(a.dtype),
+                                          dtypes.from_numpy(b.dtype))
+        a = a.astype(out_dt.np_dtype)
+        b = b.astype(out_dt.np_dtype)
+        with np.errstate(all="ignore"):
+            data, extra_null = self.compute(np, a, b, out_dt)
+        if extra_null is not None:
+            validity = validity & ~extra_null
+        return rebuild_series(data, validity, out_dt, index)
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+    def compute(self, xp, a, b, out_dt):
+        return a + b, None
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+    def compute(self, xp, a, b, out_dt):
+        return a - b, None
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+    def compute(self, xp, a, b, out_dt):
+        return a * b, None
+
+
+class Divide(BinaryArithmetic):
+    """Spark Divide: inputs coerced to double; x/0 -> NULL."""
+    symbol = "/"
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.FLOAT64
+
+    def dtype_from_children(self, lt: DType, rt: DType) -> DType:
+        return dtypes.FLOAT64
+
+    def compute(self, xp, a, b, out_dt):
+        zero = b == 0.0
+        safe = xp.where(zero, 1.0, b)
+        return a / safe, zero
+
+
+class IntegralDivide(BinaryArithmetic):
+    """Spark ``div``: long division truncating toward zero; x div 0 -> NULL."""
+    symbol = "div"
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT64
+
+    def dtype_from_children(self, lt: DType, rt: DType) -> DType:
+        return dtypes.INT64
+
+    def compute(self, xp, a, b, out_dt):
+        zero = b == 0
+        safe = xp.where(zero, 1, b)
+        # trunc toward zero, unlike // which floors
+        q = xp.sign(a) * xp.sign(safe) * (abs(a) // abs(safe))
+        return q.astype(out_dt.np_dtype), zero
+
+
+class Remainder(BinaryArithmetic):
+    """Java-style %: sign of the dividend; x % 0 -> NULL."""
+    symbol = "%"
+
+    def compute(self, xp, a, b, out_dt):
+        zero = b == 0
+        safe = xp.where(zero, 1, b)
+        return xp.fmod(a, safe), zero
+
+
+class Pmod(BinaryArithmetic):
+    symbol = "pmod"
+
+    def sql_name(self, schema=None) -> str:
+        return (f"pmod({self.children[0].sql_name(schema)}, "
+                f"{self.children[1].sql_name(schema)})")
+
+    def compute(self, xp, a, b, out_dt):
+        zero = b == 0
+        safe = xp.where(zero, 1, b)
+        # ((a % b) + b) % b — result takes the sign of the divisor
+        return xp.fmod(xp.fmod(a, safe) + safe, safe), zero
+
+
+class UnaryMinus(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return self.children[0].dtype(schema)
+
+    def sql_name(self, schema=None) -> str:
+        return f"(- {self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        if isinstance(v, DevScalar):
+            return DevScalar(v.dtype, -v.value, v.valid)
+        return v.with_(data=-v.data)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        s = self.children[0].eval_host(df)
+        values, validity, index = host_unary_values(s)
+        return rebuild_series(-values, validity,
+                              dtypes.from_numpy(values.dtype), index)
+
+
+class Abs(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return self.children[0].dtype(schema)
+
+    def sql_name(self, schema=None) -> str:
+        return f"abs({self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        if isinstance(v, DevScalar):
+            return DevScalar(v.dtype, jnp.abs(v.value), v.valid)
+        return v.with_(data=jnp.abs(v.data))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        s = self.children[0].eval_host(df)
+        values, validity, index = host_unary_values(s)
+        return rebuild_series(np.abs(values), validity,
+                              dtypes.from_numpy(values.dtype), index)

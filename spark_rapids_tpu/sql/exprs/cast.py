@@ -1,0 +1,130 @@
+"""Cast (reference: GpuCast.scala:240-877 — the dtype x dtype matrix).
+
+Non-ANSI Spark semantics, Java-style conversions:
+  * int -> narrower int truncates (wraps) like Java;
+  * float -> int: NaN -> 0, +/-inf and out-of-range clamp to min/max, else
+    truncate toward zero ((int) in Java);
+  * bool <-> numeric; timestamp <-> long is *seconds*; date <-> timestamp;
+  * string casts are gated behind conf flags like the reference
+    (RapidsConf.scala:393-423) and tag the plan off-device when disabled.
+
+One generic formula evaluated under numpy (host) or jax.numpy (device).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType
+from spark_rapids_tpu.sql.exprs.core import (
+    DevCol, DevScalar, DevValue, EvalContext, Expression,
+)
+from spark_rapids_tpu.sql.exprs.hostutil import host_unary_values, rebuild_series
+
+_INT_RANGE = {
+    "int8": (-128, 127),
+    "int16": (-(1 << 15), (1 << 15) - 1),
+    "int32": (-(1 << 31), (1 << 31) - 1),
+    "int64": (-(1 << 63), (1 << 63) - 1),
+}
+
+MICROS_PER_SEC = 1_000_000
+MICROS_PER_DAY = 86_400 * MICROS_PER_SEC
+
+
+def cast_data(xp, data, src: DType, dst: DType):
+    """Cast raw (already null-canonicalized) data. Returns (data, extra_null)
+    where extra_null marks rows that become NULL."""
+    if src == dst:
+        return data, None
+    if src == dtypes.BOOL:
+        return data.astype(dst.np_dtype), None
+    if dst == dtypes.BOOL:
+        return (data != 0), None
+    if src.is_integral and dst.is_integral:
+        return data.astype(dst.np_dtype), None  # wraps like Java
+    if src.is_integral and dst.is_floating:
+        return data.astype(dst.np_dtype), None
+    if src.is_floating and dst.is_integral:
+        lo, hi = _INT_RANGE[dst.name]
+        d64 = data.astype(np.float64)
+        out = xp.where(xp.isnan(d64), 0.0, d64)
+        out = xp.clip(xp.trunc(out), float(lo), float(hi))
+        return out.astype(dst.np_dtype), None
+    if src.is_floating and dst.is_floating:
+        return data.astype(dst.np_dtype), None
+    if src == dtypes.TIMESTAMP_US and dst.is_integral:
+        # cast timestamp -> long yields seconds (floor)
+        secs = xp.floor_divide(data, MICROS_PER_SEC)
+        return secs.astype(dst.np_dtype), None
+    if src.is_integral and dst == dtypes.TIMESTAMP_US:
+        return (data.astype(np.int64) * MICROS_PER_SEC), None
+    if src == dtypes.TIMESTAMP_US and dst == dtypes.DATE32:
+        days = xp.floor_divide(data, MICROS_PER_DAY)
+        return days.astype(np.int32), None
+    if src == dtypes.DATE32 and dst == dtypes.TIMESTAMP_US:
+        return data.astype(np.int64) * MICROS_PER_DAY, None
+    if src == dtypes.TIMESTAMP_US and dst.is_floating:
+        return (data.astype(np.float64) / MICROS_PER_SEC).astype(dst.np_dtype), None
+    raise NotImplementedError(f"cast {src} -> {dst}")
+
+
+def _castable(src: DType, dst: DType) -> bool:
+    try:
+        probe = np.zeros(1, dtype=src.np_dtype) if not src.is_string else None
+        if src.is_string or dst.is_string:
+            return False
+        cast_data(np, probe, src, dst)
+        return True
+    except NotImplementedError:
+        return False
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: DType):
+        super().__init__([child])
+        self.to = to
+
+    def dtype(self, schema: Schema) -> DType:
+        return self.to
+
+    def sql_name(self, schema=None) -> str:
+        return f"CAST({self.children[0].sql_name(schema)} AS {self.to.name})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        src = self.children[0].dtype(schema)
+        if src == self.to:
+            return None
+        if src.is_string or self.to.is_string:
+            return (f"cast {src} -> {self.to} involves strings and is gated "
+                    "off by default (see spark.rapids.sql.castStringTo*)")
+        if not _castable(src, self.to):
+            return f"cast {src} -> {self.to} is not supported"
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        if isinstance(v, DevScalar):
+            data, extra = cast_data(jnp, jnp.asarray(v.value), v.dtype, self.to)
+            return DevScalar(self.to, data, v.valid)
+        data, extra = cast_data(jnp, v.data, v.dtype, self.to)
+        validity = v.validity if extra is None else v.validity & ~extra
+        return DevCol(self.to, data, validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        s = self.children[0].eval_host(df)
+        values, validity, index = host_unary_values(s)
+        src = (dtypes.from_numpy(values.dtype) if values.dtype != object
+               else dtypes.STRING)
+        # the host twin stores timestamps as datetime64 -> int64 micros already
+        with np.errstate(all="ignore"):
+            data, extra = cast_data(np, values, src, self.to)
+        if extra is not None:
+            validity = validity & ~extra
+        return rebuild_series(data, validity, self.to, index)

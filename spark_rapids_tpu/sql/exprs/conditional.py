@@ -1,0 +1,274 @@
+"""Conditional and null-handling expressions (reference:
+sql/rapids/conditionalExpressions.scala, 251 LoC and nullExpressions.scala,
+297 LoC): if/case-when, coalesce, nanvl."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType, common_type
+from spark_rapids_tpu.sql.exprs.core import (
+    DevCol, DevScalar, DevValue, EvalContext, Expression,
+)
+from spark_rapids_tpu.sql.exprs.hostutil import host_unary_values, rebuild_series
+
+
+def _result_type(schema: Schema, exprs: List[Expression]) -> DType:
+    out = exprs[0].dtype(schema)
+    for e in exprs[1:]:
+        t = e.dtype(schema)
+        if t != out:
+            out = common_type(out, t)
+    return out
+
+
+def _as_pair(ctx: EvalContext, v: DevValue, dt: DType):
+    """(data, validity) at batch capacity, cast to dt."""
+    c = ctx.broadcast(v)
+    data = c.data if dt.is_string else c.data.astype(dt.np_dtype)
+    return data, c.validity, c.offsets
+
+
+class If(Expression):
+    def __init__(self, pred: Expression, then: Expression, other: Expression):
+        super().__init__([pred, then, other])
+
+    def dtype(self, schema: Schema) -> DType:
+        return _result_type(schema, self.children[1:])
+
+    def sql_name(self, schema=None) -> str:
+        p, t, f = (c.sql_name(schema) for c in self.children)
+        return f"if({p}, {t}, {f})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if any(c.dtype(schema).is_string for c in self.children[1:]):
+            return "string-typed branches are not supported on TPU yet"
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        dt = None
+        pv = ctx.broadcast(self.children[0].eval_device(ctx))
+        tv = self.children[1].eval_device(ctx)
+        fv = self.children[2].eval_device(ctx)
+        dt = tv.dtype if tv.dtype == fv.dtype else common_type(tv.dtype, fv.dtype)
+        tdata, tval, _ = _as_pair(ctx, tv, dt)
+        fdata, fval, _ = _as_pair(ctx, fv, dt)
+        # NULL predicate chooses the else branch (Spark semantics)
+        cond = pv.data & pv.validity
+        data = jnp.where(cond, tdata, fdata)
+        validity = jnp.where(cond, tval, fval)
+        return DevCol(dt, data, validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        p, pval, index = host_unary_values(self.children[0].eval_host(df))
+        t, tval, _ = host_unary_values(self.children[1].eval_host(df))
+        f, fval, _ = host_unary_values(self.children[2].eval_host(df))
+        cond = p.astype(np.bool_) & pval
+        if t.dtype == object or f.dtype == object:
+            data = np.where(cond, t, f)
+            dt = dtypes.STRING
+        else:
+            dt = common_type(dtypes.from_numpy(t.dtype), dtypes.from_numpy(f.dtype))
+            data = np.where(cond, t.astype(dt.np_dtype), f.astype(dt.np_dtype))
+        validity = np.where(cond, tval, fval)
+        return rebuild_series(data, validity, dt, index)
+
+
+class CaseWhen(Expression):
+    """CASE WHEN p1 THEN v1 ... [ELSE ve] END."""
+
+    def __init__(self, branches: List[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        flat: List[Expression] = []
+        for p, v in branches:
+            flat += [p, v]
+        if else_value is not None:
+            flat.append(else_value)
+        super().__init__(flat)
+        self.n_branches = len(branches)
+        self.has_else = else_value is not None
+
+    def _branches(self):
+        return [(self.children[2 * i], self.children[2 * i + 1])
+                for i in range(self.n_branches)]
+
+    def _else(self) -> Optional[Expression]:
+        return self.children[-1] if self.has_else else None
+
+    def dtype(self, schema: Schema) -> DType:
+        values = [v for _, v in self._branches()]
+        if self.has_else:
+            values.append(self._else())
+        return _result_type(schema, values)
+
+    def sql_name(self, schema=None) -> str:
+        parts = ["CASE"]
+        for p, v in self._branches():
+            parts.append(f"WHEN {p.sql_name(schema)} THEN {v.sql_name(schema)}")
+        if self.has_else:
+            parts.append(f"ELSE {self._else().sql_name(schema)}")
+        parts.append("END")
+        return " ".join(parts)
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if self.dtype(schema).is_string:
+            return "string-typed CASE WHEN is not supported on TPU yet"
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        # fold from the last branch backwards
+        vals = [v for _, v in self._branches()]
+        dt = vals[0].dtype(None) if False else None
+        # compute common type from actual evaluated dtypes
+        evaluated = [(ctx.broadcast(p.eval_device(ctx)), v.eval_device(ctx))
+                     for p, v in self._branches()]
+        dts = [v.dtype for _, v in evaluated]
+        ev = self._else().eval_device(ctx) if self.has_else else None
+        if ev is not None:
+            dts.append(ev.dtype)
+        dt = dts[0]
+        for t in dts[1:]:
+            if t != dt:
+                dt = common_type(dt, t)
+        if ev is not None:
+            data, validity, _ = _as_pair(ctx, ev, dt)
+        else:
+            data = jnp.full((ctx.capacity,), dtypes.null_fill_value(dt),
+                            dtype=dt.np_dtype)
+            validity = jnp.zeros((ctx.capacity,), dtype=jnp.bool_)
+        taken = jnp.zeros((ctx.capacity,), dtype=jnp.bool_)
+        for p, v in evaluated:
+            cond = p.data & p.validity & ~taken
+            vdata, vval, _ = _as_pair(ctx, v, dt)
+            data = jnp.where(cond, vdata, data)
+            validity = jnp.where(cond, vval, validity)
+            taken = taken | (p.data & p.validity)
+        return DevCol(dt, data, validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        evaluated = []
+        for p, v in self._branches():
+            pv, pval, index = host_unary_values(p.eval_host(df))
+            vv, vval, _ = host_unary_values(v.eval_host(df))
+            evaluated.append((pv.astype(np.bool_) & pval, vv, vval))
+        dts = [dtypes.from_numpy(vv.dtype) if vv.dtype != object else dtypes.STRING
+               for _, vv, _ in evaluated]
+        if self.has_else:
+            ev, eval_, index = host_unary_values(self._else().eval_host(df))
+            dts.append(dtypes.from_numpy(ev.dtype) if ev.dtype != object
+                       else dtypes.STRING)
+        dt = dts[0]
+        for t in dts[1:]:
+            if t != dt:
+                dt = common_type(dt, t)
+        n = len(df)
+        if self.has_else:
+            data = ev if dt.is_string else ev.astype(dt.np_dtype)
+            validity = eval_
+        else:
+            data = np.full(n, dtypes.null_fill_value(dt) if not dt.is_string
+                           else None, dtype=object if dt.is_string else dt.np_dtype)
+            validity = np.zeros(n, dtype=np.bool_)
+        taken = np.zeros(n, dtype=np.bool_)
+        for cond, vv, vval in evaluated:
+            use = cond & ~taken
+            vv2 = vv if dt.is_string else vv.astype(dt.np_dtype)
+            data = np.where(use, vv2, data)
+            validity = np.where(use, vval, validity)
+            taken = taken | cond
+        index = df.index
+        return rebuild_series(data, validity, dt, index)
+
+
+class Coalesce(Expression):
+    def __init__(self, children: List[Expression]):
+        super().__init__(children)
+
+    def dtype(self, schema: Schema) -> DType:
+        return _result_type(schema, self.children)
+
+    def sql_name(self, schema=None) -> str:
+        return f"coalesce({', '.join(c.sql_name(schema) for c in self.children)})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if self.dtype(schema).is_string:
+            return "string-typed coalesce is not supported on TPU yet"
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        evaluated = [c.eval_device(ctx) for c in self.children]
+        dt = evaluated[0].dtype
+        for v in evaluated[1:]:
+            if v.dtype != dt:
+                dt = common_type(dt, v.dtype)
+        data = jnp.full((ctx.capacity,), dtypes.null_fill_value(dt),
+                        dtype=dt.np_dtype)
+        validity = jnp.zeros((ctx.capacity,), dtype=jnp.bool_)
+        for v in evaluated:
+            vdata, vval, _ = _as_pair(ctx, v, dt)
+            take = ~validity & vval
+            data = jnp.where(take, vdata, data)
+            validity = validity | vval
+        return DevCol(dt, data, validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        pairs = [host_unary_values(c.eval_host(df)) for c in self.children]
+        dts = [dtypes.from_numpy(v.dtype) if v.dtype != object else dtypes.STRING
+               for v, _, _ in pairs]
+        dt = dts[0]
+        for t in dts[1:]:
+            if t != dt:
+                dt = common_type(dt, t)
+        n = len(df)
+        data = np.full(n, None if dt.is_string else dtypes.null_fill_value(dt),
+                       dtype=object if dt.is_string else dt.np_dtype)
+        validity = np.zeros(n, dtype=np.bool_)
+        for v, vval, _ in pairs:
+            take = ~validity & vval
+            v2 = v if dt.is_string else v.astype(dt.np_dtype)
+            data = np.where(take, v2, data)
+            validity = validity | vval
+        return rebuild_series(data, validity, dt, df.index)
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN, else a."""
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    def dtype(self, schema: Schema) -> DType:
+        return common_type(self.children[0].dtype(schema),
+                           self.children[1].dtype(schema))
+
+    def sql_name(self, schema=None) -> str:
+        return (f"nanvl({self.children[0].sql_name(schema)}, "
+                f"{self.children[1].sql_name(schema)})")
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = ctx.broadcast(self.children[0].eval_device(ctx))
+        rv = ctx.broadcast(self.children[1].eval_device(ctx))
+        dt = common_type(lv.dtype, rv.dtype)
+        a = lv.data.astype(dt.np_dtype)
+        b = rv.data.astype(dt.np_dtype)
+        use_b = jnp.isnan(a) & lv.validity
+        data = jnp.where(use_b, b, a)
+        validity = jnp.where(use_b, rv.validity, lv.validity)
+        return DevCol(dt, data, validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        b, bv, _ = host_unary_values(self.children[1].eval_host(df))
+        dt = common_type(dtypes.from_numpy(a.dtype), dtypes.from_numpy(b.dtype))
+        a = a.astype(dt.np_dtype)
+        b = b.astype(dt.np_dtype)
+        use_b = np.isnan(a) & av
+        data = np.where(use_b, b, a)
+        validity = np.where(use_b, bv, av)
+        return rebuild_series(data, validity, dt, index)

@@ -1,0 +1,42 @@
+"""Bridge between DeviceBatch and expression evaluation contexts."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.sql.exprs.core import (
+    DevCol, DevScalar, DevValue, EvalContext, Expression,
+)
+
+
+def make_context(batch: DeviceBatch) -> EvalContext:
+    cols = [DevCol(c.dtype, c.data, c.validity, c.offsets)
+            for c in batch.columns]
+    mask = jnp.arange(batch.capacity, dtype=jnp.int32) < batch.num_rows
+    return EvalContext(cols, mask, batch.num_rows, batch.capacity)
+
+
+def to_device_column(ctx: EvalContext, v: DevValue) -> DeviceColumn:
+    c = ctx.broadcast(v)
+    # mask out padding rows so stale values never leak past num_rows
+    validity = c.validity & ctx.row_mask
+    return DeviceColumn(c.dtype, c.data, validity, c.offsets)
+
+
+def eval_projection(batch: DeviceBatch, exprs: List[Expression],
+                    names: List[str]) -> DeviceBatch:
+    """Evaluate bound expressions into a new DeviceBatch (traceable)."""
+    ctx = make_context(batch)
+    out_cols = []
+    out_dtypes = []
+    for e in exprs:
+        v = e.eval_device(ctx)
+        col = to_device_column(ctx, v)
+        out_cols.append(col)
+        out_dtypes.append(col.dtype)
+    schema = Schema(names, out_dtypes)
+    return DeviceBatch(schema, out_cols, batch.num_rows)

@@ -1,0 +1,173 @@
+"""Math expressions (reference: sql/rapids/mathExpressions.scala, 378 LoC).
+
+Unary math follows Spark: inputs coerce to double, domain errors yield NaN
+(not NULL) matching java.lang.Math. One formula for host (numpy) and device
+(jax.numpy).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType
+from spark_rapids_tpu.sql.exprs.core import (
+    DevCol, DevValue, EvalContext, Expression,
+)
+from spark_rapids_tpu.sql.exprs.hostutil import host_unary_values, rebuild_series
+
+
+class UnaryMath(Expression):
+    fname = "?"
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.FLOAT64
+
+    def sql_name(self, schema=None) -> str:
+        return f"{self.fname}({self.children[0].sql_name(schema)})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if self.children[0].dtype(schema).is_string:
+            return "string input"
+        return None
+
+    def compute(self, xp, x):
+        raise NotImplementedError
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        x = v.data.astype(jnp.float64)
+        return DevCol(dtypes.FLOAT64, self.compute(jnp, x), v.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        with np.errstate(all="ignore"):
+            data = self.compute(np, values.astype(np.float64))
+        return rebuild_series(data, validity, dtypes.FLOAT64, index)
+
+
+def _make_unary(name: str, fn: Callable) -> type:
+    cls = type(name.capitalize(), (UnaryMath,), {
+        "fname": name,
+        "compute": staticmethod(lambda xp, x, _fn=fn: _fn(xp, x)),
+    })
+    # staticmethod on compute loses self; wrap properly:
+    def compute(self, xp, x, _fn=fn):
+        return _fn(xp, x)
+    cls.compute = compute
+    return cls
+
+
+Sqrt = _make_unary("sqrt", lambda xp, x: xp.sqrt(x))
+Exp = _make_unary("exp", lambda xp, x: xp.exp(x))
+Expm1 = _make_unary("expm1", lambda xp, x: xp.expm1(x))
+Log = _make_unary("ln", lambda xp, x: xp.log(x))
+Log2 = _make_unary("log2", lambda xp, x: xp.log2(x))
+Log10 = _make_unary("log10", lambda xp, x: xp.log10(x))
+Log1p = _make_unary("log1p", lambda xp, x: xp.log1p(x))
+Sin = _make_unary("sin", lambda xp, x: xp.sin(x))
+Cos = _make_unary("cos", lambda xp, x: xp.cos(x))
+Tan = _make_unary("tan", lambda xp, x: xp.tan(x))
+Asin = _make_unary("asin", lambda xp, x: xp.arcsin(x))
+Acos = _make_unary("acos", lambda xp, x: xp.arccos(x))
+Atan = _make_unary("atan", lambda xp, x: xp.arctan(x))
+Sinh = _make_unary("sinh", lambda xp, x: xp.sinh(x))
+Cosh = _make_unary("cosh", lambda xp, x: xp.cosh(x))
+Tanh = _make_unary("tanh", lambda xp, x: xp.tanh(x))
+Cbrt = _make_unary("cbrt", lambda xp, x: xp.cbrt(x))
+Rint = _make_unary("rint", lambda xp, x: xp.rint(x))
+Signum = _make_unary("signum", lambda xp, x: xp.sign(x))
+ToDegrees = _make_unary("degrees", lambda xp, x: xp.degrees(x))
+ToRadians = _make_unary("radians", lambda xp, x: xp.radians(x))
+
+
+class Floor(Expression):
+    """floor/ceil return LongType in Spark."""
+    fname = "floor"
+    _fn = staticmethod(np.floor)
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT64
+
+    def sql_name(self, schema=None) -> str:
+        return f"{self.fname}({self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        x = v.data.astype(jnp.float64)
+        fn = jnp.floor if self.fname == "floor" else jnp.ceil
+        return DevCol(dtypes.INT64, fn(x).astype(jnp.int64), v.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        fn = np.floor if self.fname == "floor" else np.ceil
+        with np.errstate(all="ignore"):
+            data = fn(values.astype(np.float64)).astype(np.int64)
+        return rebuild_series(data, validity, dtypes.INT64, index)
+
+
+class Ceil(Floor):
+    fname = "ceil"
+
+
+class Pow(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.FLOAT64
+
+    def sql_name(self, schema=None) -> str:
+        return (f"pow({self.children[0].sql_name(schema)}, "
+                f"{self.children[1].sql_name(schema)})")
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = ctx.broadcast(self.children[0].eval_device(ctx))
+        rv = ctx.broadcast(self.children[1].eval_device(ctx))
+        data = jnp.power(lv.data.astype(jnp.float64),
+                         rv.data.astype(jnp.float64))
+        return DevCol(dtypes.FLOAT64, data, lv.validity & rv.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        b, bv, _ = host_unary_values(self.children[1].eval_host(df))
+        with np.errstate(all="ignore"):
+            data = np.power(a.astype(np.float64), b.astype(np.float64))
+        return rebuild_series(data, av & bv, dtypes.FLOAT64, index)
+
+
+class Atan2(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.FLOAT64
+
+    def sql_name(self, schema=None) -> str:
+        return (f"atan2({self.children[0].sql_name(schema)}, "
+                f"{self.children[1].sql_name(schema)})")
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = ctx.broadcast(self.children[0].eval_device(ctx))
+        rv = ctx.broadcast(self.children[1].eval_device(ctx))
+        data = jnp.arctan2(lv.data.astype(jnp.float64),
+                           rv.data.astype(jnp.float64))
+        return DevCol(dtypes.FLOAT64, data, lv.validity & rv.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        b, bv, _ = host_unary_values(self.children[1].eval_host(df))
+        with np.errstate(all="ignore"):
+            data = np.arctan2(a.astype(np.float64), b.astype(np.float64))
+        return rebuild_series(data, av & bv, dtypes.FLOAT64, index)

@@ -1,0 +1,376 @@
+"""Predicates and boolean logic (reference: sql/rapids/predicates.scala,
+621 LoC): comparisons, Kleene AND/OR, NOT, IsNull/IsNotNull/IsNan, In/InSet.
+
+SQL three-valued logic is computed explicitly on (data, validity) pairs with
+one shared formula for both the host and device paths.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType, common_type
+from spark_rapids_tpu.sql.exprs.core import (
+    DevCol, DevScalar, DevValue, EvalContext, Expression, data_of, valid_and,
+)
+from spark_rapids_tpu.sql.exprs.hostutil import (
+    host_binary_values, host_unary_values, rebuild_series,
+)
+
+
+class BinaryComparison(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.BOOL
+
+    def sql_name(self, schema=None) -> str:
+        return (f"({self.children[0].sql_name(schema)} {self.symbol} "
+                f"{self.children[1].sql_name(schema)})")
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        lt = self.children[0].dtype(schema)
+        rt = self.children[1].dtype(schema)
+        if lt.is_string or rt.is_string:
+            from spark_rapids_tpu.sql.exprs.core import Literal
+            # string vs string-literal comparisons have device kernels;
+            # general string ordering comparisons do not (yet)
+            if type(self) in (Eq, Neq) :
+                return None
+            if not isinstance(self.children[1], Literal):
+                return ("ordering comparison on two string columns is not "
+                        "supported on TPU")
+        return None
+
+    def compute(self, xp, a, b):
+        raise NotImplementedError
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = self.children[0].eval_device(ctx)
+        rv = self.children[1].eval_device(ctx)
+        if lv.dtype.is_string or rv.dtype.is_string:
+            return self._eval_device_string(ctx, lv, rv)
+        ct = common_type(lv.dtype, rv.dtype) if lv.dtype != rv.dtype else lv.dtype
+        a = data_of(ctx, lv).astype(ct.np_dtype)
+        b = data_of(ctx, rv).astype(ct.np_dtype)
+        data = self.compute(jnp, a, b)
+        return DevCol(dtypes.BOOL, data, valid_and(ctx, lv, rv))
+
+    def _eval_device_string(self, ctx: EvalContext, lv, rv) -> DevValue:
+        from spark_rapids_tpu.ops import strings as string_ops
+        if not isinstance(self, (Eq, Neq)):
+            raise NotImplementedError("string ordering comparison on device")
+        eq, validity = string_ops.string_equal(ctx, lv, rv)
+        data = eq if isinstance(self, Eq) else ~eq
+        return DevCol(dtypes.BOOL, data, validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        ls = self.children[0].eval_host(df)
+        rs = self.children[1].eval_host(df)
+        (a, b), validity, index = host_binary_values(ls, rs)
+        if a.dtype == object or b.dtype == object:  # strings
+            a = np.asarray(a, dtype=object)
+            b = np.asarray(b, dtype=object)
+            if isinstance(self, Eq):
+                data = np.array([x == y for x, y in zip(a, b)], dtype=np.bool_)
+            elif isinstance(self, Neq):
+                data = np.array([x != y for x, y in zip(a, b)], dtype=np.bool_)
+            else:
+                fill = ""
+                a2 = np.where(validity, a, fill)
+                b2 = np.where(validity, b, fill)
+                data = np.array(
+                    [self.compute(np, x, y) for x, y in zip(a2, b2)],
+                    dtype=np.bool_)
+        else:
+            ct = common_type(dtypes.from_numpy(a.dtype), dtypes.from_numpy(b.dtype))
+            data = self.compute(np, a.astype(ct.np_dtype), b.astype(ct.np_dtype))
+        return rebuild_series(data, validity, dtypes.BOOL, index)
+
+
+class Eq(BinaryComparison):
+    symbol = "="
+    def compute(self, xp, a, b): return a == b
+
+
+class Neq(BinaryComparison):
+    symbol = "!="
+    def compute(self, xp, a, b): return a != b
+
+
+class Lt(BinaryComparison):
+    symbol = "<"
+    def compute(self, xp, a, b): return a < b
+
+
+class Le(BinaryComparison):
+    symbol = "<="
+    def compute(self, xp, a, b): return a <= b
+
+
+class Gt(BinaryComparison):
+    symbol = ">"
+    def compute(self, xp, a, b): return a > b
+
+
+class Ge(BinaryComparison):
+    symbol = ">="
+    def compute(self, xp, a, b): return a >= b
+
+
+class EqNullSafe(BinaryComparison):
+    """<=> : never NULL; NULL <=> NULL is TRUE."""
+    symbol = "<=>"
+
+    def compute(self, xp, a, b): return a == b
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = self.children[0].eval_device(ctx)
+        rv = self.children[1].eval_device(ctx)
+        if lv.dtype.is_string or rv.dtype.is_string:
+            from spark_rapids_tpu.ops import strings as string_ops
+            eq, validity = string_ops.string_equal(ctx, lv, rv)
+            lval = _validity_vec(ctx, lv)
+            rval = _validity_vec(ctx, rv)
+            data = (lval & rval & eq) | (~lval & ~rval)
+            return DevCol(dtypes.BOOL, data,
+                          jnp.ones((ctx.capacity,), dtype=jnp.bool_))
+        ct = common_type(lv.dtype, rv.dtype) if lv.dtype != rv.dtype else lv.dtype
+        a = data_of(ctx, lv).astype(ct.np_dtype)
+        b = data_of(ctx, rv).astype(ct.np_dtype)
+        lval = _validity_vec(ctx, lv)
+        rval = _validity_vec(ctx, rv)
+        data = (lval & rval & (a == b)) | (~lval & ~rval)
+        return DevCol(dtypes.BOOL, data,
+                      jnp.ones((ctx.capacity,), dtype=jnp.bool_))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        ls = self.children[0].eval_host(df)
+        rs = self.children[1].eval_host(df)
+        av, amask, index = host_unary_values(ls)
+        bv, bmask, _ = host_unary_values(rs)
+        if av.dtype == object or bv.dtype == object:
+            eq = np.array([x == y for x, y in zip(av, bv)], dtype=np.bool_)
+        else:
+            ct = common_type(dtypes.from_numpy(av.dtype),
+                             dtypes.from_numpy(bv.dtype))
+            eq = av.astype(ct.np_dtype) == bv.astype(ct.np_dtype)
+        data = (amask & bmask & eq) | (~amask & ~bmask)
+        return rebuild_series(data, np.ones(len(data), np.bool_), dtypes.BOOL,
+                              index)
+
+
+def _validity_vec(ctx: EvalContext, v: DevValue):
+    if isinstance(v, DevScalar):
+        return jnp.full((ctx.capacity,), v.valid, dtype=jnp.bool_)
+    return v.validity
+
+
+class And(Expression):
+    """Kleene AND: FALSE AND NULL = FALSE."""
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.BOOL
+
+    def sql_name(self, schema=None) -> str:
+        return (f"({self.children[0].sql_name(schema)} AND "
+                f"{self.children[1].sql_name(schema)})")
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = ctx.broadcast(self.children[0].eval_device(ctx))
+        rv = ctx.broadcast(self.children[1].eval_device(ctx))
+        a, av = lv.data, lv.validity
+        b, bv = rv.data, rv.validity
+        # invalid slots hold False so a&b is correct whenever result is valid
+        data = a & b
+        validity = (av & bv) | (av & ~a) | (bv & ~b)
+        return DevCol(dtypes.BOOL, data, validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        b, bv, _ = host_unary_values(self.children[1].eval_host(df))
+        a = a.astype(np.bool_) & av  # canonicalize null slots to False
+        b = b.astype(np.bool_) & bv
+        data = a & b
+        validity = (av & bv) | (av & ~a) | (bv & ~b)
+        return rebuild_series(data, validity, dtypes.BOOL, index)
+
+
+class Or(Expression):
+    """Kleene OR: TRUE OR NULL = TRUE."""
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.BOOL
+
+    def sql_name(self, schema=None) -> str:
+        return (f"({self.children[0].sql_name(schema)} OR "
+                f"{self.children[1].sql_name(schema)})")
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = ctx.broadcast(self.children[0].eval_device(ctx))
+        rv = ctx.broadcast(self.children[1].eval_device(ctx))
+        a, av = lv.data, lv.validity
+        b, bv = rv.data, rv.validity
+        data = a | b
+        validity = (av & bv) | (av & a) | (bv & b)
+        return DevCol(dtypes.BOOL, data, validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        b, bv, _ = host_unary_values(self.children[1].eval_host(df))
+        a = a.astype(np.bool_) & av
+        b = b.astype(np.bool_) & bv
+        data = a | b
+        validity = (av & bv) | (av & a) | (bv & b)
+        return rebuild_series(data, validity, dtypes.BOOL, index)
+
+
+class Not(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.BOOL
+
+    def sql_name(self, schema=None) -> str:
+        return f"(NOT {self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        return DevCol(dtypes.BOOL, ~v.data & v.validity, v.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        data = ~a.astype(np.bool_) & av
+        return rebuild_series(data, av, dtypes.BOOL, index)
+
+
+class IsNull(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.BOOL
+
+    def sql_name(self, schema=None) -> str:
+        return f"({self.children[0].sql_name(schema)} IS NULL)"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        validity = _validity_vec(ctx, v)
+        return DevCol(dtypes.BOOL, ~validity,
+                      jnp.ones((ctx.capacity,), dtype=jnp.bool_))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        _, validity, index = host_unary_values(self.children[0].eval_host(df))
+        return pd.Series(~validity, index=index)
+
+
+class IsNotNull(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.BOOL
+
+    def sql_name(self, schema=None) -> str:
+        return f"({self.children[0].sql_name(schema)} IS NOT NULL)"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        validity = _validity_vec(ctx, v)
+        return DevCol(dtypes.BOOL, validity,
+                      jnp.ones((ctx.capacity,), dtype=jnp.bool_))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        _, validity, index = host_unary_values(self.children[0].eval_host(df))
+        return pd.Series(validity.copy(), index=index)
+
+
+class IsNan(Expression):
+    """Spark IsNaN is never NULL: isnan(NULL) = false."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.BOOL
+
+    def sql_name(self, schema=None) -> str:
+        return f"isnan({self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        data = jnp.isnan(v.data) & v.validity
+        return DevCol(dtypes.BOOL, data,
+                      jnp.ones((ctx.capacity,), dtype=jnp.bool_))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        data = np.isnan(a.astype(np.float64)) & av
+        return pd.Series(data, index=index)
+
+
+class In(Expression):
+    """value IN (<literals>). NULL value -> NULL; a NULL in the list turns
+    non-matches into NULL (SQL semantics)."""
+
+    def __init__(self, child: Expression, values: Sequence):
+        super().__init__([child])
+        self.values: List = list(values)
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.BOOL
+
+    def sql_name(self, schema=None) -> str:
+        return f"({self.children[0].sql_name(schema)} IN {tuple(self.values)})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        has_null_lit = any(x is None for x in self.values)
+        vals = [x for x in self.values if x is not None]
+        if isinstance(v, DevScalar):
+            v = ctx.broadcast(v)
+        if v.dtype.is_string:
+            from spark_rapids_tpu.ops import strings as string_ops
+            match = jnp.zeros((ctx.capacity,), dtype=jnp.bool_)
+            for x in vals:
+                eq, _ = string_ops.string_equal_literal(ctx, v, str(x))
+                match = match | eq
+        else:
+            match = jnp.zeros((ctx.capacity,), dtype=jnp.bool_)
+            for x in vals:
+                match = match | (v.data == jnp.asarray(x, dtype=v.dtype.np_dtype))
+        validity = v.validity
+        if has_null_lit:
+            validity = validity & match  # non-match becomes NULL
+        return DevCol(dtypes.BOOL, match & v.validity, validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        has_null_lit = any(x is None for x in self.values)
+        vals = [x for x in self.values if x is not None]
+        if a.dtype == object:
+            match = np.array([x in vals for x in a], dtype=np.bool_)
+        else:
+            match = np.isin(a, np.asarray(vals, dtype=a.dtype))
+        validity = av & match if has_null_lit else av
+        return rebuild_series(match & av, validity, dtypes.BOOL, index)

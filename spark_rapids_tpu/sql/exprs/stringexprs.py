@@ -1,0 +1,303 @@
+"""String expressions (reference: sql/rapids/stringFunctions.scala, 698 LoC).
+
+Device kernels live in ops/strings.py. Like the reference, complex regex is
+restricted: LIKE patterns that reduce to prefix/suffix/contains run on
+device, anything else tags the plan off (GpuOverrides.scala:334-379 applies
+the same restriction)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType
+from spark_rapids_tpu.ops import strings as string_ops
+from spark_rapids_tpu.sql.exprs.core import (
+    DevCol, DevScalar, DevValue, EvalContext, Expression, Literal,
+)
+from spark_rapids_tpu.sql.exprs.hostutil import host_unary_values, rebuild_series
+
+
+class StringLength(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT32
+
+    def sql_name(self, schema=None) -> str:
+        return f"length({self.children[0].sql_name(schema)})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        # byte-length == char-length only for ASCII; see ops/strings.py note
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        assert isinstance(v, DevCol)
+        return DevCol(dtypes.INT32, string_ops.lengths_of(v), v.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        data = np.array([len(x.encode("utf-8")) if x is not None else 0
+                         for x in values], dtype=np.int32)
+        return rebuild_series(data, validity, dtypes.INT32, index)
+
+
+class _CaseMap(Expression):
+    upper = True
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        fn = "upper" if self.upper else "lower"
+        return f"{fn}({self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        assert isinstance(v, DevCol)
+        return (string_ops.upper_ascii(v) if self.upper
+                else string_ops.lower_ascii(v))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        # ASCII-only to match the device kernel
+        fn = str.upper if self.upper else str.lower
+        data = np.array([_ascii_case(x, self.upper) if x is not None else None
+                         for x in values], dtype=object)
+        return rebuild_series(data, validity, dtypes.STRING, index)
+
+
+def _ascii_case(s: str, upper: bool) -> str:
+    out = []
+    for ch in s:
+        o = ord(ch)
+        if upper and 97 <= o <= 122:
+            out.append(chr(o - 32))
+        elif not upper and 65 <= o <= 90:
+            out.append(chr(o + 32))
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+class Upper(_CaseMap):
+    upper = True
+
+
+class Lower(_CaseMap):
+    upper = False
+
+
+class Substring(Expression):
+    def __init__(self, child: Expression, pos: int, length: int = -1):
+        super().__init__([child])
+        self.pos = pos
+        self.length = length
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return (f"substring({self.children[0].sql_name(schema)}, {self.pos}, "
+                f"{self.length})")
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        assert isinstance(v, DevCol)
+        return string_ops.substring(ctx, v, self.pos, self.length)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        out = np.empty(len(values), dtype=object)
+        for i, x in enumerate(values):
+            if x is None:
+                out[i] = None
+                continue
+            b = x.encode("utf-8")
+            if self.pos > 0:
+                start = self.pos - 1
+            elif self.pos == 0:
+                start = 0
+            else:
+                start = max(len(b) + self.pos, 0)
+            end = len(b) if self.length < 0 else min(start + self.length, len(b))
+            out[i] = b[start:end].decode("utf-8", errors="replace")
+        return rebuild_series(out, validity, dtypes.STRING, index)
+
+
+class _LiteralPatternPredicate(Expression):
+    """Base for startswith/endswith/contains with a literal pattern."""
+    fn_name = "?"
+
+    def __init__(self, child: Expression, pattern: str):
+        super().__init__([child])
+        self.pattern = pattern
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.BOOL
+
+    def sql_name(self, schema=None) -> str:
+        return f"{self.fn_name}({self.children[0].sql_name(schema)}, {self.pattern!r})"
+
+    def device_kernel(self, ctx, col):
+        raise NotImplementedError
+
+    def host_match(self, s: str) -> bool:
+        raise NotImplementedError
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        assert isinstance(v, DevCol)
+        data, validity = self.device_kernel(ctx, v)
+        return DevCol(dtypes.BOOL, data, validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        data = np.array([self.host_match(x) if x is not None else False
+                         for x in values], dtype=np.bool_)
+        return rebuild_series(data, validity, dtypes.BOOL, index)
+
+
+class StartsWith(_LiteralPatternPredicate):
+    fn_name = "startswith"
+    def device_kernel(self, ctx, col):
+        return string_ops.starts_with(ctx, col, self.pattern)
+    def host_match(self, s: str) -> bool:
+        return s.startswith(self.pattern)
+
+
+class EndsWith(_LiteralPatternPredicate):
+    fn_name = "endswith"
+    def device_kernel(self, ctx, col):
+        return string_ops.ends_with(ctx, col, self.pattern)
+    def host_match(self, s: str) -> bool:
+        return s.endswith(self.pattern)
+
+
+class Contains(_LiteralPatternPredicate):
+    fn_name = "contains"
+    def device_kernel(self, ctx, col):
+        return string_ops.contains(ctx, col, self.pattern)
+    def host_match(self, s: str) -> bool:
+        return self.pattern in s
+
+
+class Like(Expression):
+    """SQL LIKE with literal pattern. Patterns reducible to
+    prefix/suffix/contains/exact run on device; others tag off (the
+    reference restricts regex the same way, GpuOverrides.scala:334-379)."""
+
+    def __init__(self, child: Expression, pattern: str):
+        super().__init__([child])
+        self.pattern = pattern
+        self._kind, self._needle = _classify_like(pattern)
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.BOOL
+
+    def sql_name(self, schema=None) -> str:
+        return f"({self.children[0].sql_name(schema)} LIKE {self.pattern!r})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if self._kind is None:
+            return (f"LIKE pattern {self.pattern!r} needs general regex, "
+                    "which is not supported on TPU")
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        assert isinstance(v, DevCol)
+        if self._kind == "exact":
+            data, validity = string_ops.string_equal_literal(ctx, v, self._needle)
+        elif self._kind == "prefix":
+            data, validity = string_ops.starts_with(ctx, v, self._needle)
+        elif self._kind == "suffix":
+            data, validity = string_ops.ends_with(ctx, v, self._needle)
+        elif self._kind == "contains":
+            data, validity = string_ops.contains(ctx, v, self._needle)
+        else:
+            raise RuntimeError(self._kind)
+        return DevCol(dtypes.BOOL, data, validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        import re
+        regex = re.compile(_like_to_regex(self.pattern), re.DOTALL)
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        data = np.array([bool(regex.fullmatch(x)) if x is not None else False
+                         for x in values], dtype=np.bool_)
+        return rebuild_series(data, validity, dtypes.BOOL, index)
+
+
+def _classify_like(p: str):
+    """Map a LIKE pattern to (kind, needle) if it avoids general regex."""
+    if "_" in p:
+        return None, None
+    body = p.strip("%")
+    if "%" in body:
+        return None, None  # interior wildcard
+    starts = p.startswith("%")
+    ends = p.endswith("%")
+    if starts and ends:
+        return "contains", body
+    if ends:
+        return "prefix", body
+    if starts:
+        return "suffix", body
+    return "exact", body
+
+
+def _like_to_regex(p: str) -> str:
+    import re
+    out = []
+    for ch in p:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "".join(out)
+
+
+class ConcatStrings(Expression):
+    def __init__(self, children: List[Expression]):
+        super().__init__(children)
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return f"concat({', '.join(c.sql_name(schema) for c in self.children)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        cols = []
+        for c in self.children:
+            v = c.eval_device(ctx)
+            if isinstance(v, DevScalar):
+                raise NotImplementedError("concat with scalar operand")
+            cols.append(v)
+        return string_ops.concat_columns(ctx, cols)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        parts = [host_unary_values(c.eval_host(df)) for c in self.children]
+        n = len(df)
+        validity = parts[0][1].copy()
+        for _, v, _ in parts[1:]:
+            validity &= v
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if validity[i]:
+                out[i] = "".join(p[0][i] for p in parts)
+            else:
+                out[i] = None
+        return rebuild_series(out, validity, dtypes.STRING, parts[0][2])

@@ -1,0 +1,207 @@
+"""User-facing column functions, pyspark.sql.functions-style surface."""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.sql.exprs import aggregates as agg
+from spark_rapids_tpu.sql.exprs import arithmetic as ar
+from spark_rapids_tpu.sql.exprs import conditional as cond
+from spark_rapids_tpu.sql.exprs import datetimeexprs as dt
+from spark_rapids_tpu.sql.exprs import mathexprs as m
+from spark_rapids_tpu.sql.exprs import predicates as pred
+from spark_rapids_tpu.sql.exprs import stringexprs as st
+from spark_rapids_tpu.sql.exprs.cast import Cast
+from spark_rapids_tpu.sql.exprs.core import Alias, Col, Expression, Literal
+
+ColumnOrName = Union["Column", str]
+
+
+class Column:
+    """Thin user-facing wrapper over an Expression with operator overloads."""
+
+    def __init__(self, expr: Expression):
+        self.expr = expr
+
+    # arithmetic
+    def __add__(self, other): return Column(ar.Add(self.expr, _expr(other)))
+    def __radd__(self, other): return Column(ar.Add(_expr(other), self.expr))
+    def __sub__(self, other): return Column(ar.Subtract(self.expr, _expr(other)))
+    def __rsub__(self, other): return Column(ar.Subtract(_expr(other), self.expr))
+    def __mul__(self, other): return Column(ar.Multiply(self.expr, _expr(other)))
+    def __rmul__(self, other): return Column(ar.Multiply(_expr(other), self.expr))
+    def __truediv__(self, other): return Column(ar.Divide(self.expr, _expr(other)))
+    def __rtruediv__(self, other): return Column(ar.Divide(_expr(other), self.expr))
+    def __mod__(self, other): return Column(ar.Remainder(self.expr, _expr(other)))
+    def __neg__(self): return Column(ar.UnaryMinus(self.expr))
+
+    # comparisons
+    def __eq__(self, other): return Column(pred.Eq(self.expr, _expr(other)))  # type: ignore[override]
+    def __ne__(self, other): return Column(pred.Neq(self.expr, _expr(other)))  # type: ignore[override]
+    def __lt__(self, other): return Column(pred.Lt(self.expr, _expr(other)))
+    def __le__(self, other): return Column(pred.Le(self.expr, _expr(other)))
+    def __gt__(self, other): return Column(pred.Gt(self.expr, _expr(other)))
+    def __ge__(self, other): return Column(pred.Ge(self.expr, _expr(other)))
+    def eqNullSafe(self, other): return Column(pred.EqNullSafe(self.expr, _expr(other)))
+
+    # boolean
+    def __and__(self, other): return Column(pred.And(self.expr, _expr(other)))
+    def __or__(self, other): return Column(pred.Or(self.expr, _expr(other)))
+    def __invert__(self): return Column(pred.Not(self.expr))
+
+    # misc
+    def alias(self, name: str): return Column(Alias(self.expr, name))
+    def cast(self, to): return Column(Cast(self.expr, _dtype(to)))
+    def isNull(self): return Column(pred.IsNull(self.expr))
+    def isNotNull(self): return Column(pred.IsNotNull(self.expr))
+    def isin(self, *values):
+        vals = values[0] if len(values) == 1 and isinstance(values[0], (list, tuple)) else values
+        return Column(pred.In(self.expr, list(vals)))
+    def startswith(self, p: str): return Column(st.StartsWith(self.expr, p))
+    def endswith(self, p: str): return Column(st.EndsWith(self.expr, p))
+    def contains(self, p: str): return Column(st.Contains(self.expr, p))
+    def like(self, p: str): return Column(st.Like(self.expr, p))
+    def substr(self, pos: int, length: int = -1):
+        return Column(st.Substring(self.expr, pos, length))
+
+    def asc(self): return SortOrder(self.expr, ascending=True)
+    def desc(self): return SortOrder(self.expr, ascending=False)
+
+    def __hash__(self):
+        return id(self.expr)
+
+    def __repr__(self):
+        return f"Column<{self.expr!r}>"
+
+
+class SortOrder:
+    """Sort key with direction and null ordering (Spark defaults: asc ->
+    nulls first, desc -> nulls last)."""
+
+    def __init__(self, expr: Expression, ascending: bool = True,
+                 nulls_first: bool = None):
+        self.expr = expr
+        self.ascending = ascending
+        self.nulls_first = ascending if nulls_first is None else nulls_first
+
+    def __repr__(self):
+        d = "ASC" if self.ascending else "DESC"
+        n = "NULLS FIRST" if self.nulls_first else "NULLS LAST"
+        return f"{self.expr!r} {d} {n}"
+
+
+def _expr(x: Any) -> Expression:
+    if isinstance(x, Column):
+        return x.expr
+    if isinstance(x, Expression):
+        return x
+    return Literal(x)
+
+
+def _dtype(t):
+    if isinstance(t, str):
+        aliases = {"long": "int64", "bigint": "int64", "int": "int32",
+                   "integer": "int32", "short": "int16", "byte": "int8",
+                   "double": "float64", "float": "float32",
+                   "boolean": "bool", "date": "date32",
+                   "timestamp": "timestamp_us"}
+        return dtypes.by_name(aliases.get(t, t))
+    return t
+
+
+# --- constructors ----------------------------------------------------------
+
+def col(name: str) -> Column:
+    return Column(Col(name))
+
+
+def lit(value: Any) -> Column:
+    return Column(Literal(value))
+
+
+def expr_col(e: Expression) -> Column:
+    return Column(e)
+
+
+# --- scalar functions ------------------------------------------------------
+
+def abs(c: ColumnOrName) -> Column: return Column(ar.Abs(_c(c)))  # noqa: A001
+def sqrt(c): return Column(m.Sqrt(_c(c)))
+def exp(c): return Column(m.Exp(_c(c)))
+def log(c): return Column(m.Log(_c(c)))
+def log2(c): return Column(m.Log2(_c(c)))
+def log10(c): return Column(m.Log10(_c(c)))
+def sin(c): return Column(m.Sin(_c(c)))
+def cos(c): return Column(m.Cos(_c(c)))
+def tan(c): return Column(m.Tan(_c(c)))
+def asin(c): return Column(m.Asin(_c(c)))
+def acos(c): return Column(m.Acos(_c(c)))
+def atan(c): return Column(m.Atan(_c(c)))
+def tanh(c): return Column(m.Tanh(_c(c)))
+def floor(c): return Column(m.Floor(_c(c)))
+def ceil(c): return Column(m.Ceil(_c(c)))
+def signum(c): return Column(m.Signum(_c(c)))
+def pow(b, e): return Column(m.Pow(_c(b), _expr(e)))  # noqa: A001
+def atan2(y, x): return Column(m.Atan2(_c(y), _expr(x)))
+def pmod(a, b): return Column(ar.Pmod(_c(a), _expr(b)))
+
+def isnan(c): return Column(pred.IsNan(_c(c)))
+def isnull(c): return Column(pred.IsNull(_c(c)))
+def coalesce(*cs): return Column(cond.Coalesce([_c(c) for c in cs]))
+def nanvl(a, b): return Column(cond.NaNvl(_c(a), _c(b)))
+
+def when(condition: Column, value) -> "WhenBuilder":
+    return WhenBuilder([(condition.expr, _expr(value))])
+
+
+class WhenBuilder(Column):
+    def __init__(self, branches):
+        self._branches = branches
+        super().__init__(cond.CaseWhen(branches))
+
+    def when(self, condition: Column, value) -> "WhenBuilder":
+        return WhenBuilder(self._branches + [(condition.expr, _expr(value))])
+
+    def otherwise(self, value) -> Column:
+        return Column(cond.CaseWhen(self._branches, _expr(value)))
+
+
+def length(c): return Column(st.StringLength(_c(c)))
+def upper(c): return Column(st.Upper(_c(c)))
+def lower(c): return Column(st.Lower(_c(c)))
+def substring(c, pos: int, length_: int): return Column(st.Substring(_c(c), pos, length_))
+def concat(*cs): return Column(st.ConcatStrings([_c(c) for c in cs]))
+
+def year(c): return Column(dt.Year(_c(c)))
+def month(c): return Column(dt.Month(_c(c)))
+def dayofmonth(c): return Column(dt.DayOfMonth(_c(c)))
+def dayofweek(c): return Column(dt.DayOfWeek(_c(c)))
+def hour(c): return Column(dt.Hour(_c(c)))
+def minute(c): return Column(dt.Minute(_c(c)))
+def second(c): return Column(dt.Second(_c(c)))
+def unix_timestamp(c): return Column(dt.UnixTimestampFromTs(_c(c)))
+def date_add(c, days): return Column(dt.DateAdd(_c(c), _expr(days)))
+
+
+# --- aggregate functions ---------------------------------------------------
+
+def sum(c) -> Column: return Column(agg.Sum(_c(c)))  # noqa: A001
+def count(c) -> Column:
+    if isinstance(c, str) and c == "*":
+        return Column(agg.Count(Literal(1)))
+    return Column(agg.Count(_c(c)))
+def min(c) -> Column: return Column(agg.Min(_c(c)))  # noqa: A001
+def max(c) -> Column: return Column(agg.Max(_c(c)))  # noqa: A001
+def avg(c) -> Column: return Column(agg.Average(_c(c)))
+mean = avg
+def first(c, ignorenulls: bool = False) -> Column:
+    return Column(agg.First(_c(c), ignorenulls))
+def last(c, ignorenulls: bool = False) -> Column:
+    return Column(agg.Last(_c(c), ignorenulls))
+
+
+def _c(x: ColumnOrName) -> Expression:
+    if isinstance(x, str):
+        return Col(x)
+    return _expr(x)

@@ -1,0 +1,39 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding/collective paths are
+validated on ``xla_force_host_platform_device_count=8`` exactly as the driver's
+dryrun does. Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment's TPU plugin (axon) force-updates jax_platforms at
+# interpreter start via sitecustomize; env vars alone do not win. Tests must
+# run on the virtual CPU mesh, so override the config explicitly before any
+# backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def session():
+    from spark_rapids_tpu.session import TpuSparkSession
+    s = TpuSparkSession.builder().app_name("test").get_or_create()
+    yield s
+    s.reset_conf()

@@ -1,0 +1,71 @@
+"""Differential expression test harness.
+
+The analogue of the reference's ``GpuExpressionTestSuite`` /
+``GpuUnitTests.scala``: evaluate an expression on the device path (jax, via a
+DeviceBatch) and on the host path (pandas) over the same data and compare,
+with NaN-aware and -0.0-bit-aware comparison like
+SparkQueryCompareTestSuite.scala:167-205.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+from spark_rapids_tpu.sql.exprs.core import Expression, bind_references
+from spark_rapids_tpu.sql.exprs.evalbridge import eval_projection
+from spark_rapids_tpu.sql.functions import Column
+
+
+def _as_expr(e) -> Expression:
+    return e.expr if isinstance(e, Column) else e
+
+
+def eval_device(df: pd.DataFrame, expr) -> pd.Series:
+    expr = _as_expr(expr)
+    schema = Schema.from_pandas(df)
+    batch = DeviceBatch.from_pandas(df, schema=schema)
+    bound = bind_references(expr, schema)
+    out = eval_projection(batch, [bound], ["out"])
+    return out.to_pandas()["out"]
+
+
+def eval_host(df: pd.DataFrame, expr) -> pd.Series:
+    return _as_expr(expr).eval_host(df).rename("out")
+
+
+def assert_series_equal(device: pd.Series, host: pd.Series,
+                        approx: bool = False):
+    assert len(device) == len(host), (len(device), len(host))
+    dn = device.isna().to_numpy()
+    hn = host.isna().to_numpy()
+    np.testing.assert_array_equal(dn, hn, err_msg="null masks differ")
+    dv = device[~dn].to_numpy()
+    hv = host[~hn].to_numpy()
+    if len(dv) == 0:
+        return
+    if dv.dtype == object or str(device.dtype) in ("str", "string", "object"):
+        assert list(dv) == list(hv)
+        return
+    dv = np.asarray(dv)
+    hv = np.asarray(hv)
+    if dv.dtype.kind == "f" or hv.dtype.kind == "f":
+        # XLA float division/transcendentals are not bit-identical to numpy
+        # (~1 ulp; reciprocal-based division) — same reality as GPU vs CPU in
+        # the reference, which uses approximate float comparison modes.
+        rtol = 1e-6 if approx else 1e-12
+        # atol at the subnormal boundary: XLA flushes denormals to zero
+        np.testing.assert_allclose(dv.astype(np.float64),
+                                   hv.astype(np.float64),
+                                   rtol=rtol, atol=5e-308, equal_nan=True)
+    else:
+        np.testing.assert_array_equal(dv, hv)
+
+
+def check_expr(df: pd.DataFrame, expr, approx: bool = False) -> pd.Series:
+    """Run both paths and compare; returns the device result."""
+    d = eval_device(df, expr)
+    h = eval_host(df, expr)
+    assert_series_equal(d, h, approx=approx)
+    return d

@@ -1,0 +1,82 @@
+"""Columnar core round-trip tests (reference analogue: GpuColumnVector tests
+and the build-then-upload path of GpuColumnarBatchBuilder)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.columnar import DeviceBatch, DeviceColumn, Schema, dtypes
+from spark_rapids_tpu.columnar.batch import bucket_capacity
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 8
+    assert bucket_capacity(8) == 8
+    assert bucket_capacity(9) == 16
+    assert bucket_capacity(1000) == 1024
+
+
+def test_numeric_roundtrip():
+    df = pd.DataFrame({
+        "i": np.array([1, 2, 3, 4, 5], dtype=np.int64),
+        "f": np.array([1.5, -2.5, 0.0, 3.25, -0.0], dtype=np.float64),
+        "b": np.array([True, False, True, True, False]),
+    })
+    batch = DeviceBatch.from_pandas(df)
+    assert batch.num_rows_host() == 5
+    assert batch.capacity == 8
+    out = batch.to_pandas()
+    pd.testing.assert_frame_equal(out, df)
+
+
+def test_null_roundtrip():
+    df = pd.DataFrame({
+        "i": pd.array([1, None, 3], dtype="Int64"),
+        "f": pd.array([None, 2.5, None], dtype="Float64"),
+    })
+    batch = DeviceBatch.from_pandas(df)
+    out = batch.to_pandas()
+    assert out["i"].isna().tolist() == [False, True, False]
+    assert out["f"].isna().tolist() == [True, False, True]
+    assert out["i"][0] == 1 and out["i"][2] == 3
+    assert out["f"][1] == 2.5
+
+
+def test_string_roundtrip():
+    df = pd.DataFrame({"s": ["hello", None, "", "wörld", "a" * 100]})
+    batch = DeviceBatch.from_pandas(df)
+    out = batch.to_pandas()
+    assert out["s"][0] == "hello"
+    assert out["s"].isna()[1]
+    assert out["s"][2] == ""
+    assert out["s"][3] == "wörld"
+    assert out["s"][4] == "a" * 100
+
+
+def test_timestamp_roundtrip():
+    df = pd.DataFrame({
+        "t": pd.to_datetime(["2020-01-01 12:34:56.789", None, "1969-12-31"],
+                            format="mixed"),
+    })
+    batch = DeviceBatch.from_pandas(df)
+    assert batch.schema.dtypes[0] == dtypes.TIMESTAMP_US
+    out = batch.to_pandas()
+    assert out["t"][0] == pd.Timestamp("2020-01-01 12:34:56.789")
+    assert pd.isna(out["t"][1])
+    assert out["t"][2] == pd.Timestamp("1969-12-31")
+
+
+def test_empty_batch():
+    schema = Schema(["x", "s"], [dtypes.INT32, dtypes.STRING])
+    batch = DeviceBatch.empty(schema)
+    assert batch.num_rows_host() == 0
+    out = batch.to_pandas()
+    assert len(out) == 0
+    assert list(out.columns) == ["x", "s"]
+
+
+def test_device_memory_size():
+    df = pd.DataFrame({"i": np.arange(100, dtype=np.int64)})
+    batch = DeviceBatch.from_pandas(df)
+    # 128 capacity * 8 bytes + 128 validity bytes + 4 num_rows
+    assert batch.device_memory_size() >= 128 * 8
