@@ -1,0 +1,75 @@
+"""Physical plan base classes.
+
+Execution model: a physical operator produces a list of *partitions*, each a
+zero-arg callable returning an iterator of batches (the Spark
+``RDD.mapPartitions`` shape the reference's operators use, e.g.
+aggregate.scala:259-286). Two payload kinds flow through a mixed plan:
+
+  * CPU operators:   pandas DataFrames          (the fallback path)
+  * TPU operators:   columnar DeviceBatch       (the accelerated path)
+
+Explicit transition operators convert between them
+(exec/transitions.py — the analogue of GpuRowToColumnarExec /
+GpuColumnarToRowExec / HostColumnarToGpu).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence
+
+from spark_rapids_tpu.columnar.batch import Schema
+
+Partition = Callable[[], Iterator]  # yields pd.DataFrame or DeviceBatch
+
+
+class PhysicalPlan:
+    """Base physical operator."""
+
+    # True if this operator's output is device columnar (TPU path)
+    columnar_output = False
+
+    def __init__(self, children: Sequence["PhysicalPlan"] = ()):  # noqa: D401
+        self.children: List[PhysicalPlan] = list(children)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def output_schema(self) -> Schema:
+        raise NotImplementedError
+
+    def partitions(self, ctx: "ExecContext") -> List[Partition]:
+        raise NotImplementedError
+
+    def map_children(self, fn) -> "PhysicalPlan":
+        import copy
+        new = copy.copy(self)
+        new.children = [fn(c) for c in self.children]
+        return new
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + f"{self.describe()}"]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return self.name
+
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+
+class ExecContext:
+    """Per-query execution context: conf, session services, metrics."""
+
+    def __init__(self, conf, session=None):
+        self.conf = conf
+        self.session = session
+        self.metrics: dict = {}
+
+    def metric_add(self, op: str, name: str, value):
+        self.metrics.setdefault(op, {}).setdefault(name, 0)
+        self.metrics[op][name] += value

@@ -1,0 +1,491 @@
+"""CPU physical operators (the fallback path and differential-test oracle).
+
+These play the role Spark's own row-based operators play for the reference:
+anything the TPU cannot run falls back here, and the test harness compares
+TPU results against them (SparkQueryCompareTestSuite pattern). Payload:
+pandas DataFrames per partition.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema, _numpy_to_pandas
+from spark_rapids_tpu.exec.aggutil import AggPlan
+from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
+from spark_rapids_tpu.exec.hostagg import grouped_aggregate
+from spark_rapids_tpu.sql.exprs.core import Expression
+from spark_rapids_tpu.sql.exprs.hostutil import host_unary_values
+from spark_rapids_tpu.sql.functions import SortOrder
+
+
+def _concat_parts(it: Iterator[pd.DataFrame], schema: Schema) -> pd.DataFrame:
+    dfs = [df for df in it]
+    if not dfs:
+        return _empty_df(schema)
+    if len(dfs) == 1:
+        return dfs[0]
+    return pd.concat(dfs, ignore_index=True)
+
+
+def _empty_df(schema: Schema) -> pd.DataFrame:
+    cols = {}
+    for name, dt in zip(schema.names, schema.dtypes):
+        if dt.is_string:
+            cols[name] = pd.Series(np.empty(0, dtype=object), dtype="str")
+        elif dt.is_datetime:
+            cols[name] = pd.Series(np.empty(0, dtype="datetime64[us]"))
+        else:
+            cols[name] = pd.Series(np.empty(0, dtype=dt.np_dtype))
+    return pd.DataFrame(cols)
+
+
+class CpuScanExec(PhysicalPlan):
+    """Scan over an in-memory or file source (source yields partitions of
+    pandas DataFrames)."""
+
+    def __init__(self, source, schema: Schema):
+        super().__init__()
+        self.source = source
+        self._schema = schema
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return f"CpuScanExec({self.source.describe()})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        return self.source.cpu_partitions(ctx)
+
+
+class CpuProjectExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan,
+                 exprs: Sequence[Tuple[str, Expression]]):
+        super().__init__([child])
+        self.exprs = list(exprs)
+
+    def output_schema(self) -> Schema:
+        cs = self.children[0].output_schema()
+        return Schema([n for n, _ in self.exprs],
+                      [e.dtype(cs) for _, e in self.exprs])
+
+    def describe(self) -> str:
+        return f"CpuProjectExec([{', '.join(n for n, _ in self.exprs)}])"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run():
+                for df in part():
+                    out = {}
+                    for name, e in self.exprs:
+                        out[name] = e.eval_host(df).reset_index(drop=True)
+                    yield pd.DataFrame(out, columns=[n for n, _ in self.exprs])
+            return run
+        return [make(p) for p in child_parts]
+
+
+class CpuFilterExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, condition: Expression):
+        super().__init__([child])
+        self.condition = condition
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"CpuFilterExec({self.condition!r})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run():
+                for df in part():
+                    pred = self.condition.eval_host(df)
+                    vals, validity, _ = host_unary_values(pred)
+                    keep = vals.astype(np.bool_) & validity
+                    yield df[keep].reset_index(drop=True)
+            return run
+        return [make(p) for p in child_parts]
+
+
+class CpuHashAggregateExec(PhysicalPlan):
+    """mode 'partial': group by key exprs, emit keys + update intermediates.
+    mode 'final': group by leading key columns, merge intermediates, emit
+    finalize projection."""
+
+    def __init__(self, child: PhysicalPlan, plan: AggPlan, mode: str):
+        super().__init__([child])
+        self.plan = plan
+        self.mode = mode
+
+    def output_schema(self) -> Schema:
+        return (self.plan.partial_schema if self.mode == "partial"
+                else self.plan.output_schema)
+
+    def describe(self) -> str:
+        keys = ", ".join(n for n, _ in self.plan.grouping)
+        return f"CpuHashAggregateExec(mode={self.mode}, keys=[{keys}])"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run():
+                df = _concat_parts(part(), self.children[0].output_schema())
+                yield self._aggregate(df)
+            return run
+        return [make(p) for p in child_parts]
+
+    def _aggregate(self, df: pd.DataFrame) -> pd.DataFrame:
+        plan = self.plan
+        if self.mode == "partial":
+            keys = [host_unary_values(e.eval_host(df))[:2]
+                    for _, e in plan.grouping]
+            reductions = []
+            inputs = [host_unary_values(e.eval_host(df))[:2]
+                      for e in plan.update_inputs]
+            for ops in plan.update_plan:
+                for kind, input_idx, idt in ops:
+                    v, m = inputs[input_idx]
+                    reductions.append((kind, v, m, idt))
+            key_out, red_out = grouped_aggregate(keys, reductions)
+            out = {}
+            schema = plan.partial_schema
+            for i, (name, dt) in enumerate(zip(schema.names, schema.dtypes)):
+                if i < plan.num_keys:
+                    v, m = key_out[i]
+                else:
+                    v, m = red_out[i - plan.num_keys]
+                out[name] = _numpy_to_pandas(np.asarray(v), np.asarray(m), dt)
+            return pd.DataFrame(out, columns=list(schema.names))
+        # final: group by leading key cols of the partial schema
+        schema = plan.partial_schema
+        keys = [host_unary_values(df.iloc[:, i])[:2]
+                for i in range(plan.num_keys)]
+        reductions = []
+        for merged in plan.merge_plan:
+            for kind, col, idt in merged:
+                v, m = host_unary_values(df.iloc[:, col])[:2]
+                reductions.append((kind, v, m, idt))
+        key_out, red_out = grouped_aggregate(keys, reductions)
+        # rebuild merged partial frame, then run finalize projection
+        merged_cols = {}
+        ri = 0
+        for i, (name, dt) in enumerate(zip(schema.names, schema.dtypes)):
+            if i < plan.num_keys:
+                if key_out:
+                    v, m = key_out[i]
+                else:
+                    v, m = np.zeros(0), np.zeros(0, np.bool_)
+                merged_cols[name] = _numpy_to_pandas(np.asarray(v),
+                                                     np.asarray(m), dt)
+            else:
+                v, m = red_out[ri]
+                ri += 1
+                merged_cols[name] = _numpy_to_pandas(np.asarray(v),
+                                                     np.asarray(m), dt)
+        mdf = pd.DataFrame(merged_cols, columns=list(schema.names))
+        out = {}
+        for name, e in plan.finalize_exprs():
+            out[name] = e.eval_host(mdf).reset_index(drop=True)
+        return pd.DataFrame(out, columns=[n for n, _ in plan.results])
+
+
+class CpuShuffleExchangeExec(PhysicalPlan):
+    """Materialization barrier repartitioning child output.
+
+    partitioning: ('hash', [col indices], n) | ('single',) |
+    ('roundrobin', n) | ('range', [SortOrder], n)."""
+
+    def __init__(self, child: PhysicalPlan, partitioning):
+        super().__init__([child])
+        self.partitioning = partitioning
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"CpuShuffleExchangeExec({self.partitioning[0]})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+        schema = self.children[0].output_schema()
+        kind = self.partitioning[0]
+        if kind == "single":
+            def single():
+                dfs = [df for p in child_parts for df in p()]
+                yield (pd.concat(dfs, ignore_index=True) if dfs
+                       else _empty_df(schema))
+            return [single]
+        if kind in ("hash", "roundrobin"):
+            n = self.partitioning[-1]
+            buckets: List[List[pd.DataFrame]] = [[] for _ in range(n)]
+            for p in child_parts:
+                for df in p():
+                    if kind == "hash":
+                        idx = self.partitioning[1]
+                        if idx:
+                            h = pd.util.hash_pandas_object(
+                                df.iloc[:, list(idx)], index=False).to_numpy()
+                        else:
+                            h = np.zeros(len(df), dtype=np.uint64)
+                        pids = (h % n).astype(np.int64)
+                    else:
+                        pids = np.arange(len(df), dtype=np.int64) % n
+                    for pid in range(n):
+                        sel = df[pids == pid]
+                        if len(sel):
+                            buckets[pid].append(sel.reset_index(drop=True))
+
+            def make(pid: int) -> Partition:
+                def run():
+                    if buckets[pid]:
+                        yield pd.concat(buckets[pid], ignore_index=True)
+                    else:
+                        yield _empty_df(schema)
+                return run
+            return [make(i) for i in range(n)]
+        raise ValueError(f"unknown partitioning {kind}")
+
+
+def sort_key_arrays(df: pd.DataFrame, orders: Sequence[SortOrder]):
+    """Numpy lexsort keys implementing Spark ordering: per-key null
+    flag + order-preserving image (floats: NaN largest, -0.0 == 0.0;
+    strings: exact lexicographic via factorize-of-sorted-uniques)."""
+    keys = []  # most significant first
+    for so in orders:
+        vals, validity, _ = host_unary_values(so.expr.eval_host(df))
+        if vals.dtype == object:
+            filled = np.where(validity, vals, "")
+            uniq, inv = np.unique(filled.astype(str), return_inverse=True)
+            img = inv.astype(np.int64)
+        elif vals.dtype.kind == "f":
+            f = vals.astype(np.float64)
+            f = np.where(f == 0.0, 0.0, f)
+            f = np.where(np.isnan(f), np.nan, f)
+            bits = f.view(np.uint64)
+            sign = bits >> np.uint64(63)
+            img = np.where(sign == 1, ~bits,
+                           bits | (np.uint64(1) << np.uint64(63))).astype(np.uint64)
+        elif vals.dtype == np.bool_:
+            img = vals.astype(np.int64)
+        else:
+            img = vals.astype(np.int64)
+        if not so.ascending:
+            img = img.max(initial=0) - img if img.dtype != np.uint64 else ~img
+            if img.dtype == np.int64:
+                pass
+        null_flag = np.where(validity, 1, 0) if so.nulls_first else \
+            np.where(validity, 0, 1)
+        keys.append((null_flag, img))
+    return keys
+
+
+def host_sort_indices(df: pd.DataFrame, orders: Sequence[SortOrder]) -> np.ndarray:
+    keys = sort_key_arrays(df, orders)
+    # np.lexsort: last key is primary -> reverse
+    lex = []
+    for null_flag, img in reversed(keys):
+        lex.append(img)
+        lex.append(null_flag)
+    if not lex:
+        return np.arange(len(df))
+    return np.lexsort(lex)
+
+
+class CpuSortExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder]):
+        super().__init__([child])
+        self.orders = list(orders)
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"CpuSortExec({self.orders})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run():
+                df = _concat_parts(part(), self.children[0].output_schema())
+                idx = host_sort_indices(df, self.orders)
+                yield df.iloc[idx].reset_index(drop=True)
+            return run
+        return [make(p) for p in child_parts]
+
+
+class CpuLocalLimitExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, limit: int):
+        super().__init__([child])
+        self.limit = limit
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run():
+                remaining = self.limit
+                for df in part():
+                    if remaining <= 0:
+                        break
+                    take = df.head(remaining)
+                    remaining -= len(take)
+                    yield take
+            return run
+        return [make(p) for p in child_parts]
+
+
+class CpuGlobalLimitExec(CpuLocalLimitExec):
+    pass
+
+
+class CpuUnionExec(PhysicalPlan):
+    def __init__(self, children: Sequence[PhysicalPlan]):
+        super().__init__(children)
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        out: List[Partition] = []
+        for c in self.children:
+            out.extend(c.partitions(ctx))
+        return out
+
+
+class CpuRangeExec(PhysicalPlan):
+    """Spark's Range source (reference analogue: GpuRangeExec,
+    basicPhysicalOperators.scala:181)."""
+
+    def __init__(self, start: int, end: int, step: int, num_partitions: int,
+                 name: str = "id"):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+        self.col_name = name
+
+    def output_schema(self) -> Schema:
+        return Schema([self.col_name], [dtypes.INT64])
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self.num_partitions) if total else 0
+
+        def make(i: int) -> Partition:
+            def run():
+                lo = i * per
+                hi = min(total, (i + 1) * per)
+                vals = self.start + np.arange(lo, hi, dtype=np.int64) * self.step
+                yield pd.DataFrame({self.col_name: vals})
+            return run
+        return [make(i) for i in range(self.num_partitions)]
+
+
+class CpuJoinExec(PhysicalPlan):
+    """Equi-join via pandas merge with SQL null-key semantics (null keys
+    never match). join_type: inner, left, right, full, leftsemi, leftanti,
+    cross."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 join_type: str, left_keys: List[int], right_keys: List[int]):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+
+    def output_schema(self) -> Schema:
+        ls = self.children[0].output_schema()
+        rs = self.children[1].output_schema()
+        if self.join_type in ("leftsemi", "leftanti"):
+            return ls
+        return Schema(list(ls.names) + list(rs.names),
+                      list(ls.dtypes) + list(rs.dtypes))
+
+    def describe(self) -> str:
+        return f"CpuJoinExec({self.join_type})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        left_parts = self.children[0].partitions(ctx)
+        right_parts = self.children[1].partitions(ctx)
+        assert len(left_parts) == len(right_parts), \
+            "join children must be co-partitioned"
+
+        def make(lp: Partition, rp: Partition) -> Partition:
+            def run():
+                ldf = _concat_parts(lp(), self.children[0].output_schema())
+                rdf = _concat_parts(rp(), self.children[1].output_schema())
+                yield self._join(ldf, rdf)
+            return run
+        return [make(lp, rp) for lp, rp in zip(left_parts, right_parts)]
+
+    def _join(self, ldf: pd.DataFrame, rdf: pd.DataFrame) -> pd.DataFrame:
+        ls = self.children[0].output_schema()
+        rs = self.children[1].output_schema()
+        # unique working column names
+        lwork = ldf.copy()
+        rwork = rdf.copy()
+        lwork.columns = [f"_l{i}" for i in range(len(ldf.columns))]
+        rwork.columns = [f"_r{i}" for i in range(len(rdf.columns))]
+        lkeys = [f"_l{i}" for i in self.left_keys]
+        rkeys = [f"_r{i}" for i in self.right_keys]
+        lvalid = np.ones(len(lwork), np.bool_)
+        for k in lkeys:
+            lvalid &= host_unary_values(lwork[k])[1]
+        rvalid = np.ones(len(rwork), np.bool_)
+        for k in rkeys:
+            rvalid &= host_unary_values(rwork[k])[1]
+
+        jt = self.join_type
+        if jt == "cross":
+            merged = lwork.merge(rwork, how="cross")
+        elif jt in ("leftsemi", "leftanti"):
+            rk = rwork[rvalid][rkeys].drop_duplicates()
+            m = lwork[lvalid].merge(rk, left_on=lkeys, right_on=rkeys,
+                                    how="inner")[lwork.columns]
+            if jt == "leftsemi":
+                merged = m
+            else:
+                matched = lwork[lvalid].merge(
+                    rk, left_on=lkeys, right_on=rkeys, how="left",
+                    indicator=True)
+                keep_valid = lwork[lvalid][
+                    (matched["_merge"] == "left_only").to_numpy()]
+                merged = pd.concat([keep_valid, lwork[~lvalid]],
+                                   ignore_index=True)
+            out = merged.copy()
+            out.columns = list(ls.names)
+            return out.reset_index(drop=True)
+        else:
+            how = {"inner": "inner", "left": "left", "right": "right",
+                   "full": "outer"}[jt]
+            lm = lwork[lvalid]
+            rm = rwork[rvalid]
+            merged = lm.merge(rm, left_on=lkeys, right_on=rkeys, how=how)
+            # null-keyed rows: re-append for outer sides
+            if jt in ("left", "full") and (~lvalid).any():
+                nulls = lwork[~lvalid].copy()
+                for c in rwork.columns:
+                    nulls[c] = pd.NA
+                merged = pd.concat([merged, nulls], ignore_index=True)
+            if jt in ("right", "full") and (~rvalid).any():
+                nulls = rwork[~rvalid].copy()
+                for c in lwork.columns:
+                    nulls[c] = pd.NA
+                nulls = nulls[list(merged.columns)]
+                merged = pd.concat([merged, nulls], ignore_index=True)
+        out = merged.copy()
+        out.columns = list(ls.names) + list(rs.names)
+        return out.reset_index(drop=True)

@@ -1,0 +1,477 @@
+"""TPU columnar physical operators (the Gpu*Exec equivalents, L4).
+
+Each operator's per-batch work is a single ``jax.jit``-compiled function
+(cached per capacity bucket via pytree static aux data), so XLA fuses the
+whole expression tree — and for aggregation the whole
+hash/sort/segment-reduce pipeline — into one device executable. This is the
+TPU-first improvement over the reference's one-cuDF-kernel-per-expression
+dispatch (GpuExpressions.scala:98-149).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema, bucket_capacity
+from spark_rapids_tpu.exec.aggutil import AggPlan
+from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
+from spark_rapids_tpu.ops import aggregate as agg_ops
+from spark_rapids_tpu.ops import rowops, sortops
+from spark_rapids_tpu.ops.groupby import row_hashes
+from spark_rapids_tpu.sql.exprs.core import Expression
+from spark_rapids_tpu.sql.exprs.evalbridge import (
+    eval_projection, make_context, to_device_column,
+)
+from spark_rapids_tpu.sql.functions import SortOrder
+
+
+class TpuExec(PhysicalPlan):
+    columnar_output = True
+
+    def output_schema(self) -> Schema:
+        raise NotImplementedError
+
+
+def _concat_device(batches: List[DeviceBatch], schema: Schema,
+                   growth: float) -> DeviceBatch:
+    """Concatenate device batches (GpuCoalesceBatches / ConcatAndConsumeAll,
+    GpuCoalesceBatches.scala:38-165)."""
+    if len(batches) == 1:
+        return batches[0]
+    if not batches:
+        return DeviceBatch.empty(schema)
+    total_cap = sum(b.capacity for b in batches)
+    out_cap = bucket_capacity(total_cap, growth)
+    # string char capacity defaults to the sum of input char buffers,
+    # computed per column inside concat_batches
+    return rowops.concat_batches(batches, out_cap, 0)
+
+
+class TpuProjectExec(TpuExec):
+    """reference: GpuProjectExec (basicPhysicalOperators.scala:65)."""
+
+    def __init__(self, child: PhysicalPlan,
+                 exprs: Sequence[Tuple[str, Expression]]):
+        super().__init__([child])
+        self.exprs = list(exprs)
+        names = [n for n, _ in self.exprs]
+        bound = [e for _, e in self.exprs]
+        self._kernel = jax.jit(
+            lambda batch: eval_projection(batch, bound, names))
+
+    def output_schema(self) -> Schema:
+        cs = self.children[0].output_schema()
+        return Schema([n for n, _ in self.exprs],
+                      [e.dtype(cs) for _, e in self.exprs])
+
+    def describe(self) -> str:
+        return f"TpuProjectExec([{', '.join(n for n, _ in self.exprs)}])"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                for batch in part():
+                    yield self._kernel(batch)
+            return run
+        return [make(p) for p in child_parts]
+
+
+class TpuFilterExec(TpuExec):
+    """reference: GpuFilterExec (basicPhysicalOperators.scala:126)."""
+
+    def __init__(self, child: PhysicalPlan, condition: Expression):
+        super().__init__([child])
+        self.condition = condition
+
+        def kernel(batch: DeviceBatch) -> DeviceBatch:
+            ctx = make_context(batch)
+            pred = to_device_column(ctx, condition.eval_device(ctx))
+            keep = pred.data & pred.validity
+            return rowops.filter_batch(batch, keep)
+        self._kernel = jax.jit(kernel)
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"TpuFilterExec({self.condition!r})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                for batch in part():
+                    yield self._kernel(batch)
+            return run
+        return [make(p) for p in child_parts]
+
+
+class TpuHashAggregateExec(TpuExec):
+    """reference: GpuHashAggregateExec (aggregate.scala:227-509). Streaming
+    per-batch update, then concat + merge of the (small) partial results —
+    the reference's exact loop shape, each step one fused XLA program."""
+
+    def __init__(self, child: PhysicalPlan, plan: AggPlan, mode: str):
+        super().__init__([child])
+        self.plan = plan
+        self.mode = mode
+        p = self.plan
+        if mode == "partial":
+            key_exprs = [e for _, e in p.grouping]
+            reductions = []
+            for ops in p.update_plan:
+                for kind, input_idx, idt in ops:
+                    reductions.append((kind, input_idx, idt))
+            self._kernel = jax.jit(lambda b: agg_ops.aggregate_update(
+                b, key_exprs, p.update_inputs, reductions, p.partial_schema))
+            # merging partials within the partition uses merge kinds
+            self._merge_kernel = self._make_merge_kernel()
+        else:
+            self._merge_kernel = self._make_merge_kernel()
+            final_exprs = p.finalize_exprs()
+            names = [n for n, _ in final_exprs]
+            bound = [e for _, e in final_exprs]
+            self._final_kernel = jax.jit(
+                lambda b: eval_projection(b, bound, names))
+
+    def _make_merge_kernel(self):
+        p = self.plan
+        reductions = []
+        for merged in p.merge_plan:
+            for kind, col, idt in merged:
+                reductions.append((kind, col, idt))
+        return jax.jit(lambda b: agg_ops.aggregate_merge(
+            b, p.num_keys, reductions, p.partial_schema))
+
+    def output_schema(self) -> Schema:
+        return (self.plan.partial_schema if self.mode == "partial"
+                else self.plan.output_schema)
+
+    def describe(self) -> str:
+        keys = ", ".join(n for n, _ in self.plan.grouping)
+        return f"TpuHashAggregateExec(mode={self.mode}, keys=[{keys}])"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+        growth = ctx.conf.capacity_growth
+
+        def make(part: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                if self.mode == "partial":
+                    partials = [self._kernel(b) for b in part()]
+                    if not partials:
+                        partials = [self._kernel(
+                            DeviceBatch.empty(self.children[0].output_schema()))]
+                    if len(partials) == 1:
+                        yield partials[0]
+                        return
+                    merged = _concat_device(partials, self.plan.partial_schema,
+                                            growth)
+                    yield self._merge_kernel(merged)
+                    return
+                batches = list(part())
+                merged_in = _concat_device(batches, self.plan.partial_schema,
+                                           growth)
+                merged = self._merge_kernel(merged_in)
+                yield self._final_kernel(merged)
+            return run
+        return [make(p) for p in child_parts]
+
+
+class TpuSortExec(TpuExec):
+    """reference: GpuSortExec (GpuSortExec.scala:50-253) — RequireSingleBatch
+    global sort: concat partition batches, one fused device sort."""
+
+    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder]):
+        super().__init__([child])
+        self.orders = list(orders)
+
+        def kernel(batch: DeviceBatch) -> DeviceBatch:
+            work, key_idx = self._key_batch(batch)
+            sorted_b = sortops.sort_batch(
+                work, key_idx,
+                [o.ascending for o in self.orders],
+                [o.nulls_first for o in self.orders])
+            # drop appended key columns
+            ncols = len(batch.schema.names)
+            return DeviceBatch(batch.schema, sorted_b.columns[:ncols],
+                               sorted_b.num_rows)
+        self._kernel = jax.jit(kernel)
+
+    def _key_batch(self, batch: DeviceBatch):
+        """Append evaluated sort-key expressions as extra columns."""
+        ctx = make_context(batch)
+        cols = list(batch.columns)
+        names = list(batch.schema.names)
+        dts = list(batch.schema.dtypes)
+        key_idx = []
+        for i, o in enumerate(self.orders):
+            c = to_device_column(ctx, o.expr.eval_device(ctx))
+            cols.append(c)
+            names.append(f"_sk{i}")
+            dts.append(c.dtype)
+            key_idx.append(len(cols) - 1)
+        return DeviceBatch(Schema(names, dts), cols, batch.num_rows), key_idx
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"TpuSortExec({self.orders})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+        growth = ctx.conf.capacity_growth
+        schema = self.output_schema()
+
+        def make(part: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                batches = list(part())
+                merged = _concat_device(batches, schema, growth)
+                yield self._kernel(merged)
+            return run
+        return [make(p) for p in child_parts]
+
+
+class TpuLocalLimitExec(TpuExec):
+    """reference: GpuLocalLimitExec / GpuGlobalLimitExec (limit.scala)."""
+
+    def __init__(self, child: PhysicalPlan, limit: int):
+        super().__init__([child])
+        self.limit = limit
+        self._kernel = jax.jit(
+            lambda b, remaining: rowops.slice_batch(
+                b, jnp.asarray(0, jnp.int32), remaining))
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                remaining = self.limit
+                for batch in part():
+                    if remaining <= 0:
+                        break
+                    out = self._kernel(batch, jnp.asarray(remaining, jnp.int32))
+                    n = out.num_rows_host()
+                    remaining -= n
+                    yield out
+            return run
+        return [make(p) for p in child_parts]
+
+
+class TpuGlobalLimitExec(TpuLocalLimitExec):
+    pass
+
+
+class TpuUnionExec(TpuExec):
+    """reference: GpuUnionExec."""
+
+    def __init__(self, children: Sequence[PhysicalPlan]):
+        super().__init__(children)
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        out: List[Partition] = []
+        for c in self.children:
+            out.extend(c.partitions(ctx))
+        return out
+
+
+class TpuRangeExec(TpuExec):
+    """reference: GpuRangeExec — generates the sequence directly on device."""
+
+    def __init__(self, start: int, end: int, step: int, num_partitions: int,
+                 name: str = "id"):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+        self.col_name = name
+
+    def output_schema(self) -> Schema:
+        from spark_rapids_tpu.columnar import dtypes
+        return Schema([self.col_name], [dtypes.INT64])
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self.num_partitions) if total else 0
+        growth = ctx.conf.capacity_growth
+        schema = self.output_schema()
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def kernel(lo, n, capacity):
+            from spark_rapids_tpu.columnar.column import DeviceColumn
+            from spark_rapids_tpu.columnar import dtypes
+            idx = jnp.arange(capacity, dtype=jnp.int64)
+            data = self.start + (lo + idx) * self.step
+            validity = idx < n
+            col = DeviceColumn(dtypes.INT64, data, validity)
+            return DeviceBatch(schema, [col], n.astype(jnp.int32))
+
+        def make(i: int) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                lo = i * per
+                hi = min(total, (i + 1) * per)
+                n = max(hi - lo, 0)
+                cap = bucket_capacity(max(per, 1), growth)
+                yield kernel(jnp.asarray(lo, jnp.int64),
+                             jnp.asarray(n, jnp.int32), cap)
+            return run
+        return [make(i) for i in range(self.num_partitions)]
+
+
+class TpuScanExec(TpuExec):
+    """Columnar scan: host-side decode (pyarrow/pandas — the reference also
+    parses footers and rebuilds file buffers on the CPU,
+    GpuParquetScan.scala:316-373) + device upload per batch."""
+
+    def __init__(self, source, schema: Schema):
+        super().__init__()
+        self.source = source
+        self._schema = schema
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return f"TpuScanExec({self.source.describe()})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        cpu_parts = self.source.cpu_partitions(ctx)
+        max_rows = ctx.conf.batch_size_rows
+        schema = self._schema
+
+        def make(part: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                sem = ctx.session.semaphore if ctx.session else None
+                for df in part():
+                    if sem is not None:
+                        sem.acquire_if_necessary()
+                    for lo in range(0, max(len(df), 1), max_rows):
+                        chunk = df.iloc[lo:lo + max_rows]
+                        yield DeviceBatch.from_pandas(
+                            chunk.reset_index(drop=True), schema=schema)
+            return run
+        return [make(p) for p in cpu_parts]
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    """reference: GpuShuffleExchangeExec + GpuPartitioning
+    (GpuShuffleExchangeExec.scala:60-215, GpuPartitioning.scala:41-75).
+
+    Device-side partitioning: hash rows, sort by partition id (one fused
+    kernel — the contiguous-split analogue), then slice per output
+    partition. In-process exchange; the distributed path rides the mesh
+    transport (shuffle/)."""
+
+    def __init__(self, child: PhysicalPlan, partitioning):
+        super().__init__([child])
+        self.partitioning = partitioning
+
+        kind = partitioning[0]
+        if kind == "hash":
+            key_idx = tuple(partitioning[1])
+            n = partitioning[2]
+
+            def pkernel(batch: DeviceBatch):
+                h1, h2 = row_hashes(batch, key_idx)
+                pid = (h1 % jnp.uint64(n)).astype(jnp.int32)
+                pid = jnp.where(batch.row_mask(), pid, n)  # dead rows last
+                perm = jnp.argsort(pid, stable=True).astype(jnp.int32)
+                sorted_batch = rowops.gather_batch(batch, perm, batch.num_rows)
+                counts = jnp.zeros((n,), jnp.int32).at[
+                    jnp.clip(pid, 0, n - 1)].add(
+                        jnp.where(pid < n, 1, 0))
+                return sorted_batch, counts
+            self._pkernel = jax.jit(pkernel)
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"TpuShuffleExchangeExec({self.partitioning[0]})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+        schema = self.output_schema()
+        growth = ctx.conf.capacity_growth
+        kind = self.partitioning[0]
+
+        if kind == "single":
+            def single() -> Iterator[DeviceBatch]:
+                batches = [b for p in child_parts for b in p()]
+                if not batches:
+                    yield DeviceBatch.empty(schema)
+                    return
+                yield _concat_device(batches, schema, growth)
+            return [single]
+
+        if kind == "roundrobin":
+            n = self.partitioning[-1]
+            assigned: List[List] = [[] for _ in range(n)]
+            for i, p in enumerate(child_parts):
+                assigned[i % n].append(p)
+
+            def make(pid: int) -> Partition:
+                def run() -> Iterator[DeviceBatch]:
+                    got = False
+                    for p in assigned[pid]:
+                        for b in p():
+                            got = True
+                            yield b
+                    if not got:
+                        yield DeviceBatch.empty(schema)
+                return run
+            return [make(i) for i in range(n)]
+
+        assert kind == "hash"
+        n = self.partitioning[2]
+        slice_kernel = jax.jit(
+            lambda b, start, count: rowops.slice_batch(b, start, count))
+
+        # materialization barrier: partition every child batch once,
+        # bucket the slices
+        state = {"buckets": None}
+
+        def materialize():
+            if state["buckets"] is not None:
+                return state["buckets"]
+            buckets: List[List[DeviceBatch]] = [[] for _ in range(n)]
+            for p in child_parts:
+                for batch in p():
+                    sorted_batch, counts = self._pkernel(batch)
+                    import numpy as np
+                    host_counts = np.asarray(counts)
+                    offsets = np.concatenate([[0], np.cumsum(host_counts)])
+                    for pid in range(n):
+                        if host_counts[pid] == 0:
+                            continue
+                        piece = slice_kernel(
+                            sorted_batch,
+                            jnp.asarray(offsets[pid], jnp.int32),
+                            jnp.asarray(host_counts[pid], jnp.int32))
+                        buckets[pid].append(piece)
+            state["buckets"] = buckets
+            return buckets
+
+        def make(pid: int) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                buckets = materialize()
+                if not buckets[pid]:
+                    yield DeviceBatch.empty(schema)
+                    return
+                yield _concat_device(buckets[pid], schema, growth)
+            return run
+        return [make(i) for i in range(n)]

@@ -1,0 +1,73 @@
+"""Host<->device transition operators.
+
+The analogues of the reference's GpuRowToColumnarExec / GpuColumnarToRowExec
+/ HostColumnarToGpu / GpuBringBackToHost (GpuRowToColumnarExec.scala,
+GpuColumnarToRowExec.scala, GpuBringBackToHost.scala). The transition
+overrides pass (sql/overrides.py) inserts these at every CPU/TPU boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax.numpy as jnp
+import pandas as pd
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema, bucket_capacity
+from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
+
+
+class HostToDeviceExec(PhysicalPlan):
+    """pandas partition chunks -> DeviceBatch, chunked to the conf'd batch
+    size and padded to capacity buckets."""
+
+    columnar_output = True
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__([child])
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+        schema = self.children[0].output_schema()
+        max_rows = ctx.conf.batch_size_rows
+
+        def make(part: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                sem = ctx.session.semaphore if ctx.session else None
+                for df in part():
+                    if sem is not None:
+                        sem.acquire_if_necessary()
+                    for lo in range(0, max(len(df), 1), max_rows):
+                        chunk = df.iloc[lo:lo + max_rows]
+                        yield DeviceBatch.from_pandas(
+                            chunk.reset_index(drop=True), schema=schema)
+            return run
+        return [make(p) for p in child_parts]
+
+
+class DeviceToHostExec(PhysicalPlan):
+    columnar_output = False
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__([child])
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run() -> Iterator[pd.DataFrame]:
+                sem = ctx.session.semaphore if ctx.session else None
+                try:
+                    for batch in part():
+                        yield batch.to_pandas()
+                finally:
+                    if sem is not None:
+                        sem.release()
+            return run
+        return [make(p) for p in child_parts]

@@ -1,0 +1,55 @@
+"""Task admission semaphore (reference: GpuSemaphore.scala:101-161).
+
+Bounds how many host task threads may hold device batches concurrently
+(spark.rapids.sql.concurrentTpuTasks). Acquire-on-first-use per task,
+release on task completion, exactly the reference's protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class TpuSemaphore:
+    _instance: Optional["TpuSemaphore"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, permits: int):
+        self.permits = permits
+        self._sem = threading.Semaphore(permits)
+        self._holders: Dict[int, int] = {}  # task id -> acquire count
+        self._state_lock = threading.Lock()
+
+    @classmethod
+    def get(cls, permits: int) -> "TpuSemaphore":
+        with cls._lock:
+            if cls._instance is None or cls._instance.permits != permits:
+                cls._instance = cls(permits)
+            return cls._instance
+
+    def acquire_if_necessary(self, task_id: Optional[int] = None) -> None:
+        tid = task_id if task_id is not None else threading.get_ident()
+        with self._state_lock:
+            held = self._holders.get(tid, 0)
+            if held:
+                self._holders[tid] = held + 1
+                return
+        self._sem.acquire()
+        with self._state_lock:
+            self._holders[tid] = 1
+
+    def release(self, task_id: Optional[int] = None) -> None:
+        tid = task_id if task_id is not None else threading.get_ident()
+        with self._state_lock:
+            held = self._holders.pop(tid, 0)
+        if held:
+            self._sem.release()
+
+    def __enter__(self):
+        self.acquire_if_necessary()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False

@@ -1,0 +1,156 @@
+"""Hash-group-by kernel, sort-segment style (reference: cuDF
+groupBy().aggregate() called from aggregate.scala:728-810).
+
+TPU-first design: cuDF builds a device hash table (data-dependent memory),
+which XLA cannot express efficiently. Instead:
+
+  1. hash each key column to 64 bits (x2 independent hashes for strings and
+     for collision immunity -> 128 bits total);
+  2. one fused ``lax.sort`` of (h1, h2, row-index);
+  3. group boundaries where the hash pair changes; group ids by prefix sum;
+  4. ``jax.ops.segment_*`` reductions per aggregate.
+
+Everything is O(n log n) sort + O(n) segment ops — shapes static, output
+capacity = input capacity, real group count carried as data. This is also
+the standard recipe for groupby on SIMD/vector machines.
+
+Null keys form their own group (SQL GROUP BY semantics); float keys are
+normalized (-0.0 == 0.0, canonical NaN) before hashing to match CPU
+grouping (reference: NormalizeFloatingNumbers.scala).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.ops import hashing
+from spark_rapids_tpu.ops.rowops import gather_batch, gather_column
+
+
+def row_hashes(batch: DeviceBatch,
+               key_indices: Sequence[int]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Two independent 64-bit row hashes over the key columns."""
+    h1s, h2s = [], []
+    for ki in key_indices:
+        col = batch.columns[ki]
+        if col.dtype.is_string:
+            h1, h2 = hashing.string_poly_hashes(col.offsets, col.data,
+                                                col.validity)
+        else:
+            h = hashing.hash_fixed_width(col.data, col.validity)
+            h1 = h
+            h2 = hashing.splitmix64(h ^ jnp.uint64(hashing.SALT2))
+        h1s.append(h1)
+        h2s.append(h2)
+    return hashing.combine_hashes(h1s), hashing.combine_hashes(h2s)
+
+
+class GroupInfo:
+    """Result of the grouping phase, all device-resident."""
+
+    def __init__(self, perm, group_id_sorted, boundary, num_groups, rep_rows):
+        self.perm = perm                    # sorted row order (capacity,)
+        self.group_id_sorted = group_id_sorted  # group id per sorted slot
+        self.boundary = boundary            # bool: first row of its group
+        self.num_groups = num_groups        # int32 scalar
+        self.rep_rows = rep_rows            # original row index of each
+                                            # group's first row (capacity,)
+
+
+def group_rows(batch: DeviceBatch, key_indices: Sequence[int]) -> GroupInfo:
+    capacity = batch.capacity
+    live = batch.row_mask()
+    h1, h2 = row_hashes(batch, key_indices)
+    # dead rows sort last
+    dead = (~live).astype(jnp.uint8)
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    dead_s, h1_s, h2_s, perm = jax.lax.sort((dead, h1, h2, idx), num_keys=3,
+                                            is_stable=True)
+    live_s = dead_s == 0
+    prev_h1 = jnp.concatenate([h1_s[:1] ^ jnp.uint64(1), h1_s[:-1]])
+    prev_h2 = jnp.concatenate([h2_s[:1], h2_s[:-1]])
+    boundary = ((h1_s != prev_h1) | (h2_s != prev_h2)) & live_s
+    boundary = boundary.at[0].set(live_s[0])
+    group_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    group_id = jnp.where(live_s, group_id, capacity - 1)  # park dead rows
+    num_groups = boundary.sum().astype(jnp.int32)
+    # original row of each group's first sorted row
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    rep_rows = jax.ops.segment_sum(
+        jnp.where(boundary, perm, 0), group_id, num_segments=capacity)
+    return GroupInfo(perm, group_id, boundary, num_groups, rep_rows)
+
+
+def gather_keys(batch: DeviceBatch, key_indices: Sequence[int],
+                info: GroupInfo) -> List[DeviceColumn]:
+    """Key columns with one row per group (group's first occurrence)."""
+    live = jnp.arange(batch.capacity, dtype=jnp.int32) < info.num_groups
+    return [gather_column(batch.columns[ki], info.rep_rows, live)
+            for ki in key_indices]
+
+
+def segment_reduce(kind: str, values: jnp.ndarray, validity: jnp.ndarray,
+                   info: GroupInfo, out_dtype) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One reduction over groups. Returns (data, validity) of capacity size
+    with the first num_groups entries real.
+
+    kinds: sum, min, max, count_valid, first, last, first_valid, last_valid,
+    any.
+    """
+    capacity = values.shape[0]
+    vs = values[info.perm]
+    val_s = validity[info.perm]
+    gid = info.group_id_sorted
+    seg = lambda op, x: op(x, gid, num_segments=capacity)  # noqa: E731
+    group_has_valid = seg(jax.ops.segment_max, val_s.astype(jnp.int32)) > 0
+
+    if kind == "count_valid":
+        data = seg(jax.ops.segment_sum, val_s.astype(jnp.int64))
+        return data.astype(out_dtype), jnp.ones((capacity,), jnp.bool_)
+    if kind == "sum":
+        x = jnp.where(val_s, vs, 0).astype(out_dtype)
+        data = seg(jax.ops.segment_sum, x)
+        return data, group_has_valid
+    if kind in ("min", "max"):
+        if jnp.issubdtype(vs.dtype, jnp.floating):
+            neutral = jnp.inf if kind == "min" else -jnp.inf
+        elif vs.dtype == jnp.bool_:
+            vs = vs.astype(jnp.int32)
+            neutral = 1 if kind == "min" else 0
+        else:
+            info_ = jnp.iinfo(vs.dtype)
+            neutral = info_.max if kind == "min" else info_.min
+        x = jnp.where(val_s, vs, neutral)
+        op = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
+        data = seg(op, x)
+        if out_dtype == jnp.bool_:
+            data = data.astype(jnp.bool_)
+        return data.astype(out_dtype), group_has_valid
+    if kind in ("first", "last", "first_valid", "last_valid"):
+        pos = jnp.arange(capacity, dtype=jnp.int32)
+        if kind.endswith("_valid"):
+            eligible = val_s
+        else:
+            eligible = jnp.ones((capacity,), jnp.bool_)
+        big = capacity + 1
+        if kind.startswith("first"):
+            p = jnp.where(eligible, pos, big)
+            sel = seg(jax.ops.segment_min, p)
+        else:
+            p = jnp.where(eligible, pos, -1)
+            sel = seg(jax.ops.segment_max, p)
+        has = (sel >= 0) & (sel < capacity)
+        sel_c = jnp.clip(sel, 0, capacity - 1)
+        data = vs[sel_c].astype(out_dtype)
+        validity = jnp.where(has, val_s[sel_c], False)
+        return data, validity
+    if kind == "any":
+        data = seg(jax.ops.segment_max, (vs & val_s).astype(jnp.int32)) > 0
+        return data.astype(out_dtype), jnp.ones((capacity,), jnp.bool_)
+    raise ValueError(f"unknown reduction kind: {kind}")

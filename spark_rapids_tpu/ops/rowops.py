@@ -1,0 +1,149 @@
+"""Row-level batch kernels: gather, compaction (filter), concatenation.
+
+These replace cuDF's Table.filter / Table.concatenate / gather calls
+(reference call sites: basicPhysicalOperators.scala GpuFilterExec:126,
+GpuCoalesceBatches.scala:52). All shape-static: outputs share the input
+capacity (or a target bucket) and carry a new num_rows scalar.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+
+def gather_column(col: DeviceColumn, perm: jnp.ndarray,
+                  live: jnp.ndarray) -> DeviceColumn:
+    """Gather rows of a column by index vector ``perm`` (len = out capacity).
+    ``live`` marks which output slots are real rows; dead slots become
+    invalid/empty."""
+    out_cap = perm.shape[0]
+    if col.dtype.is_string:
+        lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+        src_start = col.offsets[:-1][perm].astype(jnp.int32)
+        new_len = jnp.where(live, lens[perm], 0)
+        new_offsets = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32), jnp.cumsum(new_len).astype(jnp.int32)])
+        nchars = col.data.shape[0]
+        total_new = new_offsets[out_cap]
+        k = jnp.arange(nchars, dtype=jnp.int32)
+        out_row = jnp.clip(
+            jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
+            0, out_cap - 1)
+        src_idx = src_start[out_row] + (k - new_offsets[out_row])
+        gathered = col.data[jnp.clip(src_idx, 0, nchars - 1)]
+        new_chars = jnp.where(k < total_new, gathered, 0).astype(jnp.uint8)
+        validity = col.validity[perm] & live
+        return DeviceColumn(col.dtype, new_chars, validity, new_offsets)
+    data = col.data[perm]
+    validity = col.validity[perm] & live
+    return DeviceColumn(col.dtype, data, validity)
+
+
+def gather_batch(batch: DeviceBatch, perm: jnp.ndarray,
+                 num_rows: jnp.ndarray) -> DeviceBatch:
+    out_cap = perm.shape[0]
+    live = jnp.arange(out_cap, dtype=jnp.int32) < num_rows
+    cols = [gather_column(c, perm, live) for c in batch.columns]
+    return DeviceBatch(batch.schema, cols, num_rows.astype(jnp.int32))
+
+
+def filter_batch(batch: DeviceBatch, keep: jnp.ndarray) -> DeviceBatch:
+    """Compact rows where ``keep`` (bool capacity-vector) is True to the
+    front. keep is pre-masked to live rows by the caller or here."""
+    capacity = batch.capacity
+    keep = keep & batch.row_mask()
+    # stable partition: indices of kept rows first, in order
+    perm = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    new_rows = keep.sum().astype(jnp.int32)
+    return gather_batch(batch, perm, new_rows)
+
+
+def concat_batches(batches: Sequence[DeviceBatch],
+                   out_capacity: int,
+                   out_char_capacity: int = 0) -> DeviceBatch:
+    """Concatenate batches into one of ``out_capacity`` (device analogue of
+    cuDF Table.concatenate under GpuCoalesceBatches)."""
+    schema = batches[0].schema
+    total = batches[0].num_rows
+    for b in batches[1:]:
+        total = total + b.num_rows
+    cols: List[DeviceColumn] = []
+    for ci, dt in enumerate(schema.dtypes):
+        parts = [b.columns[ci] for b in batches]
+        if dt.is_string:
+            cols.append(_concat_string_cols(parts, [b.num_rows for b in batches],
+                                            out_capacity, out_char_capacity))
+        else:
+            datas, vals = [], []
+            offset = jnp.asarray(0, jnp.int32)
+            out_data = jnp.zeros((out_capacity,), dtype=parts[0].data.dtype)
+            out_val = jnp.zeros((out_capacity,), dtype=jnp.bool_)
+            idx = jnp.arange(out_capacity, dtype=jnp.int32)
+            for part, b in zip(parts, batches):
+                n = b.num_rows
+                # place part rows [0, n) at [offset, offset+n)
+                src = jnp.clip(idx - offset, 0, part.data.shape[0] - 1)
+                in_range = (idx >= offset) & (idx < offset + n)
+                out_data = jnp.where(in_range, part.data[src], out_data)
+                out_val = jnp.where(in_range, part.validity[src], out_val)
+                offset = offset + n
+            cols.append(DeviceColumn(dt, out_data, out_val))
+    return DeviceBatch(schema, cols, total.astype(jnp.int32))
+
+
+def _concat_string_cols(parts: List[DeviceColumn], counts,
+                        out_capacity: int,
+                        out_char_capacity: int) -> DeviceColumn:
+    if out_char_capacity <= 0:
+        out_char_capacity = sum(int(p.data.shape[0]) for p in parts)
+    idx = jnp.arange(out_capacity, dtype=jnp.int32)
+    out_len = jnp.zeros((out_capacity,), jnp.int32)
+    out_val = jnp.zeros((out_capacity,), jnp.bool_)
+    row_offset = jnp.asarray(0, jnp.int32)
+    # first pass: lengths and validity
+    for part, n in zip(parts, counts):
+        lens = (part.offsets[1:] - part.offsets[:-1]).astype(jnp.int32)
+        src = jnp.clip(idx - row_offset, 0, part.capacity - 1)
+        in_range = (idx >= row_offset) & (idx < row_offset + n)
+        out_len = jnp.where(in_range, lens[src], out_len)
+        out_val = jnp.where(in_range, part.validity[src], out_val)
+        row_offset = row_offset + n
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), jnp.cumsum(out_len).astype(jnp.int32)])
+    # second pass: chars
+    k = jnp.arange(out_char_capacity, dtype=jnp.int32)
+    out_row = jnp.clip(
+        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
+        0, out_capacity - 1)
+    rel = k - new_offsets[out_row]
+    out_chars = jnp.zeros((out_char_capacity,), jnp.uint8)
+    row_offset = jnp.asarray(0, jnp.int32)
+    for part, n in zip(parts, counts):
+        src_row = jnp.clip(out_row - row_offset, 0, part.capacity - 1)
+        in_range = (out_row >= row_offset) & (out_row < row_offset + n)
+        src_idx = part.offsets[:-1][src_row].astype(jnp.int32) + rel
+        nc = part.data.shape[0]
+        vals = part.data[jnp.clip(src_idx, 0, nc - 1)]
+        out_chars = jnp.where(in_range, vals, out_chars)
+        row_offset = row_offset + n
+    total_chars = new_offsets[out_capacity]
+    out_chars = jnp.where(k < total_chars, out_chars, 0).astype(jnp.uint8)
+    return DeviceColumn(parts[0].dtype, out_chars, out_val, new_offsets)
+
+
+def slice_batch(batch: DeviceBatch, start: jnp.ndarray,
+                count: jnp.ndarray) -> DeviceBatch:
+    """Rows [start, start+count) compacted to the front (zero-copy-ish slice,
+    the analogue of SlicedGpuColumnVector)."""
+    capacity = batch.capacity
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    perm = jnp.clip(idx + start.astype(jnp.int32), 0, capacity - 1)
+    n = jnp.minimum(count.astype(jnp.int32),
+                    jnp.maximum(batch.num_rows - start.astype(jnp.int32), 0))
+    return gather_batch(batch, perm, n)

@@ -1,0 +1,339 @@
+"""The plan-rewrite engine: tag, convert, insert transitions.
+
+This is the heart of the framework, the re-design of the reference's
+GpuOverrides + RapidsMeta + GpuTransitionOverrides
+(GpuOverrides.scala:1704-1761, RapidsMeta.scala:64-284,
+GpuTransitionOverrides.scala:34-289):
+
+  1. every CPU physical operator is wrapped in an ``ExecMeta``;
+  2. ``tag()`` walks children-first, accumulating human-readable
+     ``will_not_work`` reasons (per-op conf keys, expression support,
+     dtype gates — the same checks RapidsMeta.tagForGpu performs);
+  3. ``convert()`` replaces cleanly-tagged nodes with Tpu*Exec equivalents,
+     leaving tagged-off subtrees on the CPU;
+  4. ``TransitionOverrides`` inserts HostToDevice / DeviceToHost at every
+     boundary;
+  5. ``explain_text()`` renders the tag tree — the reference's hallmark
+     "explain why not" feature (spark.rapids.sql.explain).
+
+Per-operator enable keys are auto-generated ``spark.rapids.sql.exec.<Name>``
+exactly like GpuOverrides.scala:122-130.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Type
+
+from spark_rapids_tpu.config.conf import TpuConf
+from spark_rapids_tpu.exec import cpu, tpu
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.exec.transitions import DeviceToHostExec, HostToDeviceExec
+from spark_rapids_tpu.sql.exprs.core import Expression, first_unsupported
+from spark_rapids_tpu.sql.sources import CsvSource, InMemorySource, ParquetSource
+
+
+class ExecRule:
+    """(CPU exec class) -> conversion recipe + doc + conf key
+    (reference: ReplacementRule/ExecRule, GpuOverrides.scala:62-266)."""
+
+    def __init__(self, cpu_class: Type[PhysicalPlan], desc: str,
+                 tag_fn: Callable[["ExecMeta"], None],
+                 convert_fn: Callable[["ExecMeta", List[PhysicalPlan]],
+                                      PhysicalPlan],
+                 incompat: Optional[str] = None,
+                 disabled_by_default: bool = False):
+        self.cpu_class = cpu_class
+        self.desc = desc
+        self.tag_fn = tag_fn
+        self.convert_fn = convert_fn
+        self.incompat = incompat
+        self.disabled_by_default = disabled_by_default
+
+    @property
+    def conf_key(self) -> str:
+        name = self.cpu_class.__name__.removeprefix("Cpu")
+        return f"spark.rapids.sql.exec.{name}"
+
+
+class ExecMeta:
+    """Wraps one CPU physical operator during tagging
+    (reference: SparkPlanMeta, RapidsMeta.scala:402-545)."""
+
+    def __init__(self, plan: PhysicalPlan, rule: Optional[ExecRule],
+                 conf: TpuConf, parent: Optional["ExecMeta"]):
+        self.plan = plan
+        self.rule = rule
+        self.conf = conf
+        self.parent = parent
+        self.children: List[ExecMeta] = []
+        self.reasons: List[str] = []
+
+    def will_not_work(self, reason: str) -> None:
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    @property
+    def can_run_on_tpu(self) -> bool:
+        return not self.reasons
+
+    def tag(self) -> None:
+        for c in self.children:
+            c.tag()
+        if self.rule is None:
+            self.will_not_work(
+                f"no TPU replacement rule for {self.plan.name}")
+            return
+        if not self.conf.is_operator_enabled(
+                self.rule.conf_key,
+                incompat=self.rule.incompat is not None,
+                disabled_by_default=self.rule.disabled_by_default):
+            extra = ""
+            if self.rule.incompat and not self.conf.incompatible_ops_enabled:
+                extra = (f" (incompatible: {self.rule.incompat}; enable with "
+                         f"{self.rule.conf_key}=true or "
+                         "spark.rapids.sql.incompatibleOps.enabled=true)")
+            self.will_not_work(f"{self.plan.name} is disabled by conf "
+                               f"{self.rule.conf_key}{extra}")
+            return
+        self.rule.tag_fn(self)
+
+    def check_exprs(self, exprs: List[Expression], what: str = "") -> None:
+        schema = (self.plan.children[0].output_schema()
+                  if self.plan.children else self.plan.output_schema())
+        for e in exprs:
+            reason = first_unsupported(e, schema)
+            if reason:
+                prefix = f"{what}: " if what else ""
+                self.will_not_work(prefix + reason)
+
+    def convert(self) -> PhysicalPlan:
+        """convertIfNeeded (RapidsMeta.scala:529-544)."""
+        new_children = [c.convert() for c in self.children]
+        if self.can_run_on_tpu and self.rule is not None:
+            return self.rule.convert_fn(self, new_children)
+        return self._keep_on_cpu(new_children)
+
+    def _keep_on_cpu(self, new_children: List[PhysicalPlan]) -> PhysicalPlan:
+        import copy
+        new = copy.copy(self.plan)
+        new.children = new_children
+        return new
+
+    def explain_lines(self, depth: int = 0) -> List[str]:
+        """RapidsMeta.explain tree printer (RapidsMeta.scala:245-283)."""
+        marker = "*" if self.can_run_on_tpu else "!"
+        line = "  " * depth + f"{marker} {self.plan.describe()}"
+        if self.reasons:
+            line += "  <-- " + "; ".join(self.reasons)
+        out = [line]
+        for c in self.children:
+            out.extend(c.explain_lines(depth + 1))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Rule table (reference: GpuOverrides.scala:1582-1699)
+# ---------------------------------------------------------------------------
+
+def _tag_project(meta: ExecMeta) -> None:
+    meta.check_exprs([e for _, e in meta.plan.exprs], "projection")
+
+
+def _convert_project(meta: ExecMeta, children) -> PhysicalPlan:
+    return tpu.TpuProjectExec(children[0], meta.plan.exprs)
+
+
+def _tag_filter(meta: ExecMeta) -> None:
+    meta.check_exprs([meta.plan.condition], "filter condition")
+
+
+def _convert_filter(meta: ExecMeta, children) -> PhysicalPlan:
+    return tpu.TpuFilterExec(children[0], meta.plan.condition)
+
+
+def _tag_agg(meta: ExecMeta) -> None:
+    plan = meta.plan.plan  # AggPlan
+    mode = meta.plan.mode
+    replace = meta.conf.hash_agg_replace_mode
+    if replace != "all" and replace != mode:
+        meta.will_not_work(
+            f"hashAgg replace mode {replace!r} excludes {mode} aggregation")
+    schema = plan.child_schema
+    for name, e in plan.grouping:
+        reason = first_unsupported(e, schema)
+        if reason:
+            meta.will_not_work(f"group key {name}: {reason}")
+    for fn in plan.agg_fns:
+        reason = fn.device_supported(schema)
+        if reason:
+            meta.will_not_work(reason)
+        for c in fn.children:
+            r = first_unsupported(c, schema)
+            if r:
+                meta.will_not_work(f"aggregate input: {r}")
+    if mode == "final":
+        for name, e in plan.finalize_exprs():
+            r = first_unsupported(e, plan.partial_schema)
+            if r:
+                meta.will_not_work(f"result {name}: {r}")
+    # string min/max not implemented on device yet
+    for fn, ops in zip(plan.agg_fns, plan.update_plan):
+        for kind, input_idx, idt in ops:
+            if idt.is_string and kind not in ("count_valid",):
+                meta.will_not_work(
+                    f"{kind} over string values is not supported on TPU")
+
+
+def _convert_agg(meta: ExecMeta, children) -> PhysicalPlan:
+    return tpu.TpuHashAggregateExec(children[0], meta.plan.plan,
+                                    meta.plan.mode)
+
+
+def _tag_sort(meta: ExecMeta) -> None:
+    schema = meta.plan.children[0].output_schema()
+    for o in meta.plan.orders:
+        reason = first_unsupported(o.expr, schema)
+        if reason:
+            meta.will_not_work(f"sort key: {reason}")
+
+
+def _convert_sort(meta: ExecMeta, children) -> PhysicalPlan:
+    return tpu.TpuSortExec(children[0], meta.plan.orders)
+
+
+def _tag_exchange(meta: ExecMeta) -> None:
+    kind = meta.plan.partitioning[0]
+    if kind not in ("hash", "single", "roundrobin"):
+        meta.will_not_work(f"partitioning {kind!r} not supported on TPU")
+
+
+def _convert_exchange(meta: ExecMeta, children) -> PhysicalPlan:
+    return tpu.TpuShuffleExchangeExec(children[0], meta.plan.partitioning)
+
+
+def _tag_scan(meta: ExecMeta) -> None:
+    src = meta.plan.source
+    c = meta.conf
+    if isinstance(src, ParquetSource):
+        if not (c.get("spark.rapids.sql.format.parquet.enabled")
+                and c.get("spark.rapids.sql.format.parquet.read.enabled")):
+            meta.will_not_work("Parquet scan disabled by conf")
+    elif isinstance(src, CsvSource):
+        if not (c.get("spark.rapids.sql.format.csv.enabled")
+                and c.get("spark.rapids.sql.format.csv.read.enabled")):
+            meta.will_not_work("CSV scan disabled by conf")
+    elif isinstance(src, InMemorySource):
+        pass
+    else:
+        meta.will_not_work(f"source {src.describe()} has no TPU scan")
+
+
+def _convert_scan(meta: ExecMeta, children) -> PhysicalPlan:
+    return tpu.TpuScanExec(meta.plan.source, meta.plan.output_schema())
+
+
+def _tag_nothing(meta: ExecMeta) -> None:
+    pass
+
+
+_RULES: Dict[Type[PhysicalPlan], ExecRule] = {}
+
+
+def _register(rule: ExecRule) -> None:
+    _RULES[rule.cpu_class] = rule
+
+
+_register(ExecRule(cpu.CpuProjectExec, "columnar projection",
+                   _tag_project, _convert_project))
+_register(ExecRule(cpu.CpuFilterExec, "columnar filter",
+                   _tag_filter, _convert_filter))
+_register(ExecRule(cpu.CpuHashAggregateExec, "hash aggregate",
+                   _tag_agg, _convert_agg))
+_register(ExecRule(cpu.CpuSortExec, "device sort",
+                   _tag_sort, _convert_sort))
+_register(ExecRule(cpu.CpuShuffleExchangeExec, "columnar shuffle exchange",
+                   _tag_exchange, _convert_exchange))
+_register(ExecRule(cpu.CpuScanExec, "columnar scan",
+                   _tag_scan, _convert_scan))
+_register(ExecRule(cpu.CpuLocalLimitExec, "local limit", _tag_nothing,
+                   lambda m, ch: tpu.TpuLocalLimitExec(ch[0], m.plan.limit)))
+_register(ExecRule(cpu.CpuGlobalLimitExec, "global limit", _tag_nothing,
+                   lambda m, ch: tpu.TpuGlobalLimitExec(ch[0], m.plan.limit)))
+_register(ExecRule(cpu.CpuUnionExec, "columnar union", _tag_nothing,
+                   lambda m, ch: tpu.TpuUnionExec(ch)))
+_register(ExecRule(cpu.CpuRangeExec, "device range source", _tag_nothing,
+                   lambda m, ch: tpu.TpuRangeExec(
+                       m.plan.start, m.plan.end, m.plan.step,
+                       m.plan.num_partitions, m.plan.col_name)))
+
+
+class TpuOverrides:
+    """The preColumnarTransitions rule (GpuOverrides.apply,
+    GpuOverrides.scala:1704-1761)."""
+
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+        self.root_meta: Optional[ExecMeta] = None
+
+    def wrap(self, plan: PhysicalPlan,
+             parent: Optional[ExecMeta] = None) -> ExecMeta:
+        rule = _RULES.get(type(plan))
+        meta = ExecMeta(plan, rule, self.conf, parent)
+        meta.children = [self.wrap(c, meta) for c in plan.children]
+        return meta
+
+    def apply(self, plan: PhysicalPlan) -> PhysicalPlan:
+        self.root_meta = self.wrap(plan)
+        self.root_meta.tag()
+        explain = self.conf.explain
+        if explain in ("ALL", "NOT_ON_TPU"):
+            print(self.explain_text(explain))
+        return self.root_meta.convert()
+
+    def explain_text(self, mode: str = "ALL") -> str:
+        assert self.root_meta is not None
+        lines = self.root_meta.explain_lines()
+        if mode == "NOT_ON_TPU":
+            lines = [ln for ln in lines if ln.lstrip().startswith("!")]
+        return "\n".join(lines)
+
+
+class TransitionOverrides:
+    """postColumnarTransitions: insert transitions at CPU/TPU boundaries
+    (GpuTransitionOverrides.scala:152-169)."""
+
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+
+    def apply(self, plan: PhysicalPlan) -> PhysicalPlan:
+        # a TPU operator consumes device batches; a CPU operator consumes
+        # host DataFrames — insert the matching transition under each child
+        wants_columnar = plan.columnar_output
+        new_children = []
+        for c in plan.children:
+            c2 = self.apply(c)
+            if wants_columnar and not c2.columnar_output:
+                c2 = HostToDeviceExec(c2)
+            elif not wants_columnar and c2.columnar_output:
+                c2 = DeviceToHostExec(c2)
+            new_children.append(c2)
+        out = plan.map_children(lambda c: c)
+        out.children = new_children
+        return out
+
+
+def assert_is_on_tpu(plan: PhysicalPlan, conf: TpuConf) -> None:
+    """Test-mode enforcement (GpuTransitionOverrides.assertIsOnTheGpu,
+    GpuTransitionOverrides.scala:225-263): fail the query if a
+    non-allow-listed operator stayed on the CPU."""
+    allowed = set(conf.test_allowed_nontpu) | {
+        "HostToDeviceExec", "DeviceToHostExec", "CpuScanExec",
+    }
+    offenders = []
+    for node in plan.walk():
+        if not node.columnar_output and node.name not in allowed:
+            offenders.append(node.name)
+    if offenders:
+        raise AssertionError(
+            f"operators did not run on the TPU: {sorted(set(offenders))} "
+            "(spark.rapids.sql.test.enabled=true)")

@@ -1,0 +1,128 @@
+"""Logical plan nodes.
+
+The reference plugs into Spark Catalyst and never owns a logical plan; this
+framework is standalone, so it carries a minimal Catalyst-equivalent. The
+interesting machinery — the tag/convert rewrite — operates on the *physical*
+plan exactly like the reference (GpuOverrides works on SparkPlan).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.sql.exprs.core import Expression
+from spark_rapids_tpu.sql.functions import SortOrder
+
+
+class LogicalPlan:
+    def __init__(self, children: Sequence["LogicalPlan"] = ()):  # noqa: D401
+        self.children: List[LogicalPlan] = list(children)
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class LogicalScan(LogicalPlan):
+    def __init__(self, source):
+        super().__init__()
+        self.source = source
+
+    def schema(self) -> Schema:
+        return self.source.schema
+
+
+class LogicalRange(LogicalPlan):
+    def __init__(self, start: int, end: int, step: int, num_partitions: int):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+
+    def schema(self) -> Schema:
+        return Schema(["id"], [dtypes.INT64])
+
+
+class LogicalProject(LogicalPlan):
+    def __init__(self, child: LogicalPlan,
+                 exprs: Sequence[Tuple[str, Expression]]):
+        super().__init__([child])
+        self.exprs = list(exprs)
+
+    def schema(self) -> Schema:
+        cs = self.children[0].schema()
+        return Schema([n for n, _ in self.exprs],
+                      [e.dtype(cs) for _, e in self.exprs])
+
+
+class LogicalFilter(LogicalPlan):
+    def __init__(self, child: LogicalPlan, condition: Expression):
+        super().__init__([child])
+        self.condition = condition
+
+    def schema(self) -> Schema:
+        return self.children[0].schema()
+
+
+class LogicalAggregate(LogicalPlan):
+    def __init__(self, child: LogicalPlan,
+                 grouping: Sequence[Tuple[str, Expression]],
+                 results: Sequence[Tuple[str, Expression]]):
+        super().__init__([child])
+        self.grouping = list(grouping)
+        self.results = list(results)
+
+    def schema(self) -> Schema:
+        cs = self.children[0].schema()
+        return Schema([n for n, _ in self.results],
+                      [e.dtype(cs) for _, e in self.results])
+
+
+class LogicalSort(LogicalPlan):
+    def __init__(self, child: LogicalPlan, orders: Sequence[SortOrder],
+                 is_global: bool = True):
+        super().__init__([child])
+        self.orders = list(orders)
+        self.is_global = is_global
+
+    def schema(self) -> Schema:
+        return self.children[0].schema()
+
+
+class LogicalLimit(LogicalPlan):
+    def __init__(self, child: LogicalPlan, limit: int):
+        super().__init__([child])
+        self.limit = limit
+
+    def schema(self) -> Schema:
+        return self.children[0].schema()
+
+
+class LogicalJoin(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, join_type: str,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression]):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+
+    def schema(self) -> Schema:
+        ls = self.children[0].schema()
+        rs = self.children[1].schema()
+        if self.join_type in ("leftsemi", "leftanti"):
+            return ls
+        return Schema(list(ls.names) + list(rs.names),
+                      list(ls.dtypes) + list(rs.dtypes))
+
+
+class LogicalUnion(LogicalPlan):
+    def __init__(self, children: Sequence[LogicalPlan]):
+        super().__init__(children)
+
+    def schema(self) -> Schema:
+        return self.children[0].schema()

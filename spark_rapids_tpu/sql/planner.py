@@ -1,0 +1,149 @@
+"""Logical -> CPU physical planning.
+
+Produces the plan shape Spark would hand the reference's ColumnarRule:
+aggregates split into partial + exchange + final, joins into
+exchange-exchange-join (shuffled hash join) with co-partitioned children,
+global sorts into single-partition exchange + sort. The TPU rewrite
+(sql/overrides.py) then tags and converts this CPU plan node by node —
+the same two-phase flow as Plugin.scala:36-54.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from spark_rapids_tpu.exec.aggutil import AggPlan
+from spark_rapids_tpu.exec import cpu
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.sql import plan as lp
+from spark_rapids_tpu.sql.exprs.core import bind_references
+
+
+class Planner:
+    def __init__(self, conf):
+        self.conf = conf
+
+    def plan(self, node: lp.LogicalPlan) -> PhysicalPlan:
+        fn = getattr(self, f"_plan_{type(node).__name__}", None)
+        if fn is None:
+            raise NotImplementedError(f"no physical plan for {node.name}")
+        return fn(node)
+
+    def _plan_LogicalScan(self, node: lp.LogicalScan) -> PhysicalPlan:
+        return cpu.CpuScanExec(node.source, node.source.schema)
+
+    def _plan_LogicalRange(self, node: lp.LogicalRange) -> PhysicalPlan:
+        return cpu.CpuRangeExec(node.start, node.end, node.step,
+                                node.num_partitions)
+
+    def _plan_LogicalProject(self, node: lp.LogicalProject) -> PhysicalPlan:
+        child = self.plan(node.children[0])
+        cs = child.output_schema()
+        exprs = [(n, bind_references(e, cs)) for n, e in node.exprs]
+        return cpu.CpuProjectExec(child, exprs)
+
+    def _plan_LogicalFilter(self, node: lp.LogicalFilter) -> PhysicalPlan:
+        child = self.plan(node.children[0])
+        cs = child.output_schema()
+        return cpu.CpuFilterExec(child, bind_references(node.condition, cs))
+
+    def _plan_LogicalAggregate(self, node: lp.LogicalAggregate) -> PhysicalPlan:
+        child = self.plan(node.children[0])
+        cs = child.output_schema()
+        grouping = [(n, bind_references(e, cs)) for n, e in node.grouping]
+        results = [(n, _bind_non_agg(e, cs)) for n, e in node.results]
+        plan = AggPlan(cs, grouping, results)
+        partial = cpu.CpuHashAggregateExec(child, plan, "partial")
+        if plan.num_keys == 0:
+            exchange = cpu.CpuShuffleExchangeExec(partial, ("single",))
+        else:
+            n = self.conf.shuffle_partitions
+            exchange = cpu.CpuShuffleExchangeExec(
+                partial, ("hash", list(range(plan.num_keys)), n))
+        return cpu.CpuHashAggregateExec(exchange, plan, "final")
+
+    def _plan_LogicalSort(self, node: lp.LogicalSort) -> PhysicalPlan:
+        child = self.plan(node.children[0])
+        cs = child.output_schema()
+        orders = [_bind_order(o, cs) for o in node.orders]
+        if node.is_global:
+            # single-partition global sort; range-partitioned parallel sort
+            # arrives with the range partitioner (reference:
+            # GpuRangePartitioner.scala)
+            child = cpu.CpuShuffleExchangeExec(child, ("single",))
+        return cpu.CpuSortExec(child, orders)
+
+    def _plan_LogicalLimit(self, node: lp.LogicalLimit) -> PhysicalPlan:
+        child = self.plan(node.children[0])
+        local = cpu.CpuLocalLimitExec(child, node.limit)
+        single = cpu.CpuShuffleExchangeExec(local, ("single",))
+        return cpu.CpuGlobalLimitExec(single, node.limit)
+
+    def _plan_LogicalJoin(self, node: lp.LogicalJoin) -> PhysicalPlan:
+        left = self.plan(node.children[0])
+        right = self.plan(node.children[1])
+        ls = left.output_schema()
+        rs = right.output_schema()
+        lkeys = [bind_references(e, ls) for e in node.left_keys]
+        rkeys = [bind_references(e, rs) for e in node.right_keys]
+        # materialize key columns as leading projections? keys must be plain
+        # column refs for the exec; project if needed
+        from spark_rapids_tpu.sql.exprs.core import BoundRef
+        lidx, left = _key_indices(left, lkeys, ls)
+        ridx, right = _key_indices(right, rkeys, rs)
+        if node.join_type != "cross":
+            n = self.conf.shuffle_partitions
+            left = cpu.CpuShuffleExchangeExec(left, ("hash", lidx, n))
+            right = cpu.CpuShuffleExchangeExec(right, ("hash", ridx, n))
+        else:
+            left = cpu.CpuShuffleExchangeExec(left, ("single",))
+            right = cpu.CpuShuffleExchangeExec(right, ("single",))
+        return cpu.CpuJoinExec(left, right, node.join_type, lidx, ridx)
+
+    def _plan_LogicalUnion(self, node: lp.LogicalUnion) -> PhysicalPlan:
+        return cpu.CpuUnionExec([self.plan(c) for c in node.children])
+
+
+def _key_indices(child: PhysicalPlan, keys, schema):
+    """Ensure join keys are plain column indices, projecting if necessary."""
+    from spark_rapids_tpu.sql.exprs.core import BoundRef
+    idx = []
+    simple = True
+    for k in keys:
+        if isinstance(k, BoundRef):
+            idx.append(k.index)
+        else:
+            simple = False
+            break
+    if simple:
+        return idx, child
+    # append computed key columns
+    exprs = [(n, BoundRef(i, dt, n)) for i, (n, dt)
+             in enumerate(zip(schema.names, schema.dtypes))]
+    key_cols = []
+    for j, k in enumerate(keys):
+        name = f"_jk{j}"
+        exprs.append((name, k))
+        key_cols.append(len(exprs) - 1)
+    return key_cols, cpu.CpuProjectExec(child, exprs)
+
+
+def _bind_non_agg(e, schema):
+    """Bind column refs inside aggregate result expressions, leaving Col
+    nodes that name grouping outputs for AggPlan.finalize_exprs to handle."""
+    from spark_rapids_tpu.sql.exprs.aggregates import AggregateFunction
+    from spark_rapids_tpu.sql.exprs.core import Col
+
+    def bind(x):
+        if isinstance(x, AggregateFunction):
+            return x.map_children(lambda c: bind_references(c, schema))
+        if isinstance(x, Col):
+            return x  # resolved against grouping names at finalize
+        return x.map_children(bind)
+    return bind(e)
+
+
+def _bind_order(o, schema):
+    from spark_rapids_tpu.sql.functions import SortOrder
+    return SortOrder(bind_references(o.expr, schema), o.ascending,
+                     o.nulls_first)

@@ -1,0 +1,154 @@
+"""Data sources: in-memory tables and files.
+
+File sources follow the reference's scan split: footer/metadata work and
+pruning on the host, columnar decode batched (GpuParquetScan.scala pattern);
+pyarrow performs the host decode, the HostToDevice transition uploads.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.exec.base import ExecContext, Partition
+
+
+class DataSource:
+    schema: Schema
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+    def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
+        raise NotImplementedError
+
+
+class InMemorySource(DataSource):
+    """createDataFrame equivalent: a pandas DataFrame split into partitions."""
+
+    def __init__(self, df: pd.DataFrame, num_partitions: int = 1):
+        self.df = df
+        self.num_partitions = max(1, num_partitions)
+        self.schema = Schema.from_pandas(df)
+
+    def describe(self) -> str:
+        return f"InMemory[{len(self.df)} rows x {len(self.df.columns)} cols]"
+
+    def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
+        n = len(self.df)
+        per = math.ceil(n / self.num_partitions) if n else 0
+
+        def make(i: int) -> Partition:
+            def run():
+                if per == 0:
+                    if i == 0:
+                        yield self.df.iloc[0:0]
+                    return
+                yield self.df.iloc[i * per:(i + 1) * per].reset_index(drop=True)
+            return run
+        return [make(i) for i in range(self.num_partitions)]
+
+
+class ParquetSource(DataSource):
+    """Parquet scan: row-group pruned, one partition per row-group chunk
+    (reference: GpuParquetScan.scala:204-373 does footer parse + row-group
+    clipping on the CPU before device decode)."""
+
+    def __init__(self, paths: List[str], columns: Optional[List[str]] = None):
+        import pyarrow.parquet as pq
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        self._pq = pq
+        pf = pq.ParquetFile(self.paths[0])
+        arrow_schema = pf.schema_arrow
+        names, dts = [], []
+        from spark_rapids_tpu.columnar import dtypes as dtmod
+        for field in arrow_schema:
+            if columns and field.name not in columns:
+                continue
+            names.append(field.name)
+            dts.append(dtmod.from_arrow(field.type))
+        self.columns = names
+        self.schema = Schema(names, dts)
+        # partition plan: (path, row_group_index)
+        self.splits = []
+        for p in self.paths:
+            f = pq.ParquetFile(p)
+            for rg in range(f.metadata.num_row_groups):
+                self.splits.append((p, rg))
+
+    def describe(self) -> str:
+        return f"Parquet[{len(self.paths)} files, {len(self.splits)} row groups]"
+
+    def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
+        pq = self._pq
+
+        def make(path: str, rg: int) -> Partition:
+            def run():
+                f = pq.ParquetFile(path)
+                table = f.read_row_group(rg, columns=self.columns)
+                yield _arrow_to_pandas(table)
+            return run
+        if not self.splits:
+            def empty():
+                yield _empty_from_schema(self.schema)
+            return [empty]
+        return [make(p, rg) for p, rg in self.splits]
+
+
+class CsvSource(DataSource):
+    """CSV scan via pyarrow.csv host parse (reference: Table.readCSV from
+    GpuBatchScanExec.scala:477, with host-side line splitting)."""
+
+    def __init__(self, paths, schema: Optional[Schema] = None,
+                 header: bool = True):
+        import pyarrow.csv as pacsv
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        self.header = header
+        self._pacsv = pacsv
+        if schema is not None:
+            self.schema = schema
+        else:
+            t = pacsv.read_csv(self.paths[0])
+            from spark_rapids_tpu.columnar import dtypes as dtmod
+            names = [f.name for f in t.schema]
+            dts = [dtmod.from_arrow(f.type) for f in t.schema]
+            self.schema = Schema(names, dts)
+
+    def describe(self) -> str:
+        return f"CSV[{len(self.paths)} files]"
+
+    def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
+        pacsv = self._pacsv
+
+        def make(path: str) -> Partition:
+            def run():
+                t = pacsv.read_csv(path)
+                df = _arrow_to_pandas(t)
+                df.columns = list(self.schema.names)
+                yield df
+            return run
+        return [make(p) for p in self.paths]
+
+
+def _arrow_to_pandas(table) -> pd.DataFrame:
+    df = table.to_pandas(types_mapper=_types_mapper)
+    return df
+
+
+def _types_mapper(pa_type):
+    import pyarrow as pa
+    # map nullable ints to pandas extension dtypes so nulls survive
+    m = {pa.int8(): pd.Int8Dtype(), pa.int16(): pd.Int16Dtype(),
+         pa.int32(): pd.Int32Dtype(), pa.int64(): pd.Int64Dtype(),
+         pa.float32(): pd.Float32Dtype(), pa.float64(): pd.Float64Dtype(),
+         pa.bool_(): pd.BooleanDtype()}
+    return m.get(pa_type)
+
+
+def _empty_from_schema(schema: Schema) -> pd.DataFrame:
+    from spark_rapids_tpu.exec.cpu import _empty_df
+    return _empty_df(schema)

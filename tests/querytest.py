@@ -1,0 +1,105 @@
+"""Query-level differential harness.
+
+The analogue of the reference's SparkQueryCompareTestSuite:66-205 —
+run the same DataFrame-building function with spark.rapids.sql.enabled on
+(TPU path, with test-mode asserts) and off (CPU path), then deep-compare
+results with NaN/-0.0/approx-float handling.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.session import DataFrame, TpuSparkSession
+
+
+def with_tpu_session(fn, conf=None, allow_non_tpu=None) -> pd.DataFrame:
+    s = TpuSparkSession.active()
+    saved = dict(s.conf._settings)
+    try:
+        s.set_conf("spark.rapids.sql.enabled", True)
+        s.set_conf("spark.rapids.sql.test.enabled", True)
+        if allow_non_tpu:
+            s.set_conf("spark.rapids.sql.test.allowedNonTpu",
+                       ",".join(allow_non_tpu))
+        for k, v in (conf or {}).items():
+            s.set_conf(k, v)
+        return fn(s).collect()
+    finally:
+        s.conf._settings = saved
+
+
+def with_cpu_session(fn, conf=None) -> pd.DataFrame:
+    s = TpuSparkSession.active()
+    saved = dict(s.conf._settings)
+    try:
+        s.set_conf("spark.rapids.sql.enabled", False)
+        for k, v in (conf or {}).items():
+            s.set_conf(k, v)
+        return fn(s).collect()
+    finally:
+        s.conf._settings = saved
+
+
+def _normalize(df: pd.DataFrame, ignore_order: bool) -> pd.DataFrame:
+    out = df.copy()
+    if ignore_order and len(out):
+        key_cols = []
+        for c in out.columns:
+            s = out[c]
+            try:
+                arr = s.astype("float64")
+                key_cols.append(np.where(s.isna(), np.inf, arr))
+            except (TypeError, ValueError):
+                key_cols.append(s.astype(str).fillna("\x00").to_numpy())
+        order = np.lexsort(list(reversed(key_cols)))
+        out = out.iloc[order].reset_index(drop=True)
+    return out
+
+
+def assert_frames_equal(tpu_df: pd.DataFrame, cpu_df: pd.DataFrame,
+                        ignore_order: bool = False, approx: bool = False):
+    assert list(tpu_df.columns) == list(cpu_df.columns), \
+        (list(tpu_df.columns), list(cpu_df.columns))
+    assert len(tpu_df) == len(cpu_df), (len(tpu_df), len(cpu_df))
+    t = _normalize(tpu_df, ignore_order)
+    c = _normalize(cpu_df, ignore_order)
+    for col in t.columns:
+        ts, cs = t[col], c[col]
+        tn = ts.isna().to_numpy()
+        cn = cs.isna().to_numpy()
+        np.testing.assert_array_equal(tn, cn,
+                                      err_msg=f"null masks differ in {col!r}")
+        tv = ts[~tn].to_numpy()
+        cv = cs[~cn].to_numpy()
+        if len(tv) == 0:
+            continue
+        if tv.dtype == object or str(ts.dtype) in ("str", "string"):
+            assert list(map(str, tv)) == list(map(str, cv)), f"column {col!r}"
+        elif np.asarray(tv).dtype.kind in "fc" or np.asarray(cv).dtype.kind in "fc":
+            rtol = 1e-6 if approx else 1e-12
+            np.testing.assert_allclose(
+                np.asarray(tv, dtype=np.float64),
+                np.asarray(cv, dtype=np.float64),
+                rtol=rtol, atol=5e-308, equal_nan=True,
+                err_msg=f"column {col!r}")
+        else:
+            np.testing.assert_array_equal(np.asarray(tv), np.asarray(cv),
+                                          err_msg=f"column {col!r}")
+
+
+def assert_tpu_and_cpu_equal(
+        fn: Callable[[TpuSparkSession], DataFrame],
+        conf: Optional[dict] = None,
+        ignore_order: bool = True,
+        approx: bool = False,
+        allow_non_tpu=None) -> pd.DataFrame:
+    """The assert_gpu_and_cpu_are_equal_collect equivalent
+    (integration_tests asserts.py:148-229)."""
+    cpu = with_cpu_session(fn, conf)
+    tpu = with_tpu_session(fn, conf, allow_non_tpu)
+    assert_frames_equal(tpu, cpu, ignore_order=ignore_order, approx=approx)
+    return tpu

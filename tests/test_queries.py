@@ -1,0 +1,196 @@
+"""End-to-end differential query tests: full plans through the rewrite
+engine, TPU vs CPU (the reference's Ring-1 suites: HashAggregatesSuite,
+SortExecSuite, basic ops)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from tests.querytest import assert_tpu_and_cpu_equal, with_tpu_session
+
+
+def _sales_df(rng, n=500):
+    return pd.DataFrame({
+        "region": pd.Series([["east", "west", "north", "south"][i % 4]
+                             for i in range(n)]),
+        "store": rng.integers(0, 20, n),
+        "qty": pd.Series(rng.integers(1, 100, n)).astype("Int64")
+                 .mask(pd.Series(rng.random(n) < 0.1)),
+        "price": rng.uniform(0.5, 500.0, n),
+        "discount": pd.Series(rng.uniform(0, 0.3, n)).astype("Float64")
+                      .mask(pd.Series(rng.random(n) < 0.2)),
+    })
+
+
+class TestProjectFilter:
+    def test_project(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3).select(
+                F.col("qty"),
+                (F.col("price") * (1 - F.coalesce(F.col("discount"), F.lit(0.0))))
+                .alias("net"),
+                (F.col("store") + 100).alias("sid")),
+            approx=True)
+
+    def test_filter(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3)
+            .filter((F.col("qty") > 50) & (F.col("price") < 250.0)))
+
+    def test_filter_string_eq(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2)
+            .filter(F.col("region") == "east").select(F.col("store"),
+                                                      F.col("qty")))
+
+    def test_chained(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 4)
+            .filter(F.col("price") > 10.0)
+            .select(F.col("region"), (F.col("price") * F.col("qty")).alias("v"))
+            .filter(F.col("v") > 500.0),
+            approx=True)
+
+
+class TestAggregate:
+    def test_global_agg(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3).agg(
+                F.sum("qty").alias("total_qty"),
+                F.count("qty").alias("n_qty"),
+                F.avg("price").alias("avg_price"),
+                F.min("store").alias("min_store"),
+                F.max("price").alias("max_price")),
+            approx=True)
+
+    def test_group_by_int(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3).group_by("store").agg(
+                F.sum("qty").alias("q"),
+                F.count("*").alias("n"),
+                F.avg("price").alias("p")),
+            approx=True)
+
+    def test_group_by_string(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3).group_by("region").agg(
+                F.sum("qty").alias("q"), F.max("price").alias("mx")),
+            approx=True)
+
+    def test_group_by_multi_key(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 4)
+            .group_by("region", "store").agg(F.count("*").alias("n"),
+                                             F.sum("qty").alias("q")))
+
+    def test_group_by_null_keys(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).group_by("qty").agg(
+                F.count("*").alias("n")))
+
+    def test_agg_expression_results(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3).group_by("region").agg(
+                (F.sum("qty") + F.count("*")).alias("combo")),
+            approx=True)
+
+    def test_empty_input_global(self, session, rng):
+        df = _sales_df(rng, n=0)
+        out = assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).agg(
+                F.sum("qty").alias("s"), F.count("*").alias("n")))
+        assert len(out) == 1
+        assert out["n"][0] == 0
+        assert pd.isna(out["s"][0])
+
+
+class TestSortLimit:
+    def test_order_by(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3)
+            .order_by(F.col("price").desc()),
+            ignore_order=False, approx=True)
+
+    def test_order_by_nulls(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3)
+            .order_by(F.col("qty").asc(), F.col("store").desc())
+            .select(F.col("qty"), F.col("store")),
+            ignore_order=False)
+
+    def test_sort_strings(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2)
+            .order_by(F.col("region").desc(), F.col("store").asc())
+            .select(F.col("region"), F.col("store")),
+            ignore_order=False)
+
+    def test_limit(self, session, rng):
+        df = _sales_df(rng)
+        out = assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3)
+            .order_by(F.col("store").asc()).limit(7)
+            .select(F.col("store")),
+            ignore_order=False)
+        assert len(out) == 7
+
+
+class TestRangeUnion:
+    def test_range(self, session):
+        assert_tpu_and_cpu_equal(
+            lambda s: s.range(0, 1000, 3, num_partitions=4)
+            .select((F.col("id") * 2).alias("x")),
+            ignore_order=True)
+
+    def test_union(self, session, rng):
+        df = _sales_df(rng, 100)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(F.col("store"))
+            .union(s.create_dataframe(df, 3).select(F.col("store"))))
+
+
+class TestFallback:
+    def test_unsupported_expr_falls_back(self, session, rng):
+        """A LIKE pattern needing general regex must fall back to CPU and
+        still produce correct results (the reference's fallback testing,
+        Plugin.scala:185-219)."""
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2)
+            .filter(F.col("region").like("e%s_")),
+            allow_non_tpu=["CpuFilterExec"])
+
+    def test_explain_reports_reason(self, session, rng):
+        df = _sales_df(rng)
+        sdf = session.create_dataframe(df, 2).filter(
+            F.col("region").like("e%s_"))
+        text = sdf.explain()
+        assert "!" in text and "LIKE" in text
+
+    def test_disable_exec_by_conf(self, session, rng):
+        df = _sales_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).filter(F.col("store") > 5),
+            conf={"spark.rapids.sql.exec.FilterExec": False},
+            allow_non_tpu=["CpuFilterExec"])
+
+    def test_test_mode_catches_fallback(self, session, rng):
+        df = _sales_df(rng)
+        with pytest.raises(AssertionError, match="did not run on the TPU"):
+            with_tpu_session(
+                lambda s: s.create_dataframe(df, 2)
+                .filter(F.col("region").like("e%s_")))
