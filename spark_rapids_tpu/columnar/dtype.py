@@ -126,6 +126,8 @@ def common_type(a: DType, b: DType) -> DType:
         other = b if a == BOOL else a
         if other in order:
             return other
+    if {a, b} == {DATE32, TIMESTAMP_US}:
+        return TIMESTAMP_US  # Spark widens date to timestamp
     raise TypeError(f"no common type for {a} and {b}")
 
 

@@ -81,6 +81,14 @@ class AggPlan:
         out_dts = [e.dtype(child_schema) for _, e in self.results]
         self.output_schema = Schema(out_names, out_dts)
 
+    @property
+    def signature(self) -> str:
+        """Deterministic structural signature for the kernel cache."""
+        from spark_rapids_tpu.utils.kernelcache import expr_signature
+        g = ";".join(f"{n}={expr_signature(e)}" for n, e in self.grouping)
+        r = ";".join(f"{n}={expr_signature(e)}" for n, e in self.results)
+        return f"agg[{self.child_schema!r}][{g}][{r}]"
+
     def finalize_exprs(self) -> List[Tuple[str, Expression]]:
         """Result expressions over the *merged partial schema*: aggregate
         nodes replaced by finalize() over intermediate BoundRefs; grouping

@@ -22,6 +22,7 @@ from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
 from spark_rapids_tpu.ops import aggregate as agg_ops
 from spark_rapids_tpu.ops import rowops, sortops
 from spark_rapids_tpu.ops.groupby import row_hashes
+from spark_rapids_tpu.utils.kernelcache import cached_jit, expr_signature
 from spark_rapids_tpu.sql.exprs.core import Expression
 from spark_rapids_tpu.sql.exprs.evalbridge import (
     eval_projection, make_context, to_device_column,
@@ -46,9 +47,11 @@ def _concat_device(batches: List[DeviceBatch], schema: Schema,
         return DeviceBatch.empty(schema)
     total_cap = sum(b.capacity for b in batches)
     out_cap = bucket_capacity(total_cap, growth)
-    # string char capacity defaults to the sum of input char buffers,
-    # computed per column inside concat_batches
-    return rowops.concat_batches(batches, out_cap, 0)
+    # one generic jitted concat kernel; jax re-specializes per pytree shape.
+    # char capacity 0 = per-column sum computed inside concat_batches
+    kernel = cached_jit("concat", lambda: jax.jit(
+        rowops.concat_batches, static_argnums=(1, 2)))
+    return kernel(batches, out_cap, 0)
 
 
 class TpuProjectExec(TpuExec):
@@ -60,8 +63,10 @@ class TpuProjectExec(TpuExec):
         self.exprs = list(exprs)
         names = [n for n, _ in self.exprs]
         bound = [e for _, e in self.exprs]
-        self._kernel = jax.jit(
-            lambda batch: eval_projection(batch, bound, names))
+        sig = "project|" + "|".join(
+            f"{n}={expr_signature(e)}" for n, e in self.exprs)
+        self._kernel = cached_jit(sig, lambda: jax.jit(
+            lambda batch: eval_projection(batch, bound, names)))
 
     def output_schema(self) -> Schema:
         cs = self.children[0].output_schema()
@@ -94,7 +99,8 @@ class TpuFilterExec(TpuExec):
             pred = to_device_column(ctx, condition.eval_device(ctx))
             keep = pred.data & pred.validity
             return rowops.filter_batch(batch, keep)
-        self._kernel = jax.jit(kernel)
+        sig = "filter|" + expr_signature(condition)
+        self._kernel = cached_jit(sig, lambda: jax.jit(kernel))
 
     def output_schema(self) -> Schema:
         return self.children[0].output_schema()
@@ -129,8 +135,11 @@ class TpuHashAggregateExec(TpuExec):
             for ops in p.update_plan:
                 for kind, input_idx, idt in ops:
                     reductions.append((kind, input_idx, idt))
-            self._kernel = jax.jit(lambda b: agg_ops.aggregate_update(
-                b, key_exprs, p.update_inputs, reductions, p.partial_schema))
+            self._kernel = cached_jit(
+                "aggupd|" + p.signature,
+                lambda: jax.jit(lambda b: agg_ops.aggregate_update(
+                    b, key_exprs, p.update_inputs, reductions,
+                    p.partial_schema)))
             # merging partials within the partition uses merge kinds
             self._merge_kernel = self._make_merge_kernel()
         else:
@@ -138,8 +147,9 @@ class TpuHashAggregateExec(TpuExec):
             final_exprs = p.finalize_exprs()
             names = [n for n, _ in final_exprs]
             bound = [e for _, e in final_exprs]
-            self._final_kernel = jax.jit(
-                lambda b: eval_projection(b, bound, names))
+            self._final_kernel = cached_jit(
+                "aggfin|" + p.signature,
+                lambda: jax.jit(lambda b: eval_projection(b, bound, names)))
 
     def _make_merge_kernel(self):
         p = self.plan
@@ -147,8 +157,10 @@ class TpuHashAggregateExec(TpuExec):
         for merged in p.merge_plan:
             for kind, col, idt in merged:
                 reductions.append((kind, col, idt))
-        return jax.jit(lambda b: agg_ops.aggregate_merge(
-            b, p.num_keys, reductions, p.partial_schema))
+        return cached_jit(
+            "aggmrg|" + p.signature,
+            lambda: jax.jit(lambda b: agg_ops.aggregate_merge(
+                b, p.num_keys, reductions, p.partial_schema)))
 
     def output_schema(self) -> Schema:
         return (self.plan.partial_schema if self.mode == "partial"
@@ -203,7 +215,10 @@ class TpuSortExec(TpuExec):
             ncols = len(batch.schema.names)
             return DeviceBatch(batch.schema, sorted_b.columns[:ncols],
                                sorted_b.num_rows)
-        self._kernel = jax.jit(kernel)
+        sig = "sort|" + "|".join(
+            f"{expr_signature(o.expr)}:{o.ascending}:{o.nulls_first}"
+            for o in self.orders)
+        self._kernel = cached_jit(sig, lambda: jax.jit(kernel))
 
     def _key_batch(self, batch: DeviceBatch):
         """Append evaluated sort-key expressions as extra columns."""
@@ -246,9 +261,9 @@ class TpuLocalLimitExec(TpuExec):
     def __init__(self, child: PhysicalPlan, limit: int):
         super().__init__([child])
         self.limit = limit
-        self._kernel = jax.jit(
+        self._kernel = cached_jit("slice0", lambda: jax.jit(
             lambda b, remaining: rowops.slice_batch(
-                b, jnp.asarray(0, jnp.int32), remaining))
+                b, jnp.asarray(0, jnp.int32), remaining)))
 
     def output_schema(self) -> Schema:
         return self.children[0].output_schema()
@@ -395,7 +410,8 @@ class TpuShuffleExchangeExec(TpuExec):
                     jnp.clip(pid, 0, n - 1)].add(
                         jnp.where(pid < n, 1, 0))
                 return sorted_batch, counts
-            self._pkernel = jax.jit(pkernel)
+            self._pkernel = cached_jit(
+                f"exchhash|{key_idx}|{n}", lambda: jax.jit(pkernel))
 
     def output_schema(self) -> Schema:
         return self.children[0].output_schema()
@@ -438,8 +454,8 @@ class TpuShuffleExchangeExec(TpuExec):
 
         assert kind == "hash"
         n = self.partitioning[2]
-        slice_kernel = jax.jit(
-            lambda b, start, count: rowops.slice_batch(b, start, count))
+        slice_kernel = cached_jit("slice", lambda: jax.jit(
+            lambda b, start, count: rowops.slice_batch(b, start, count)))
 
         # materialization barrier: partition every child batch once,
         # bucket the slices

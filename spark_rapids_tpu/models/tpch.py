@@ -1,0 +1,84 @@
+"""TPC-H-like queries over the DataFrame API.
+
+The workload family of the framework (reference:
+integration_tests/.../tpch/TpchLikeSpark.scala:290+ defines Q1Like..Q22Like
+the same way — DataFrame-API renderings of the TPC-H queries). Queries are
+added as the operator surface grows; each is a function
+(session, tables) -> DataFrame.
+
+``tables`` maps name -> DataFrame (from TpchTables.load or any source).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Callable, Dict
+
+from spark_rapids_tpu.sql import functions as F
+
+
+def q1(s, t) -> "DataFrame":
+    """Pricing summary report (TpchLikeSpark.scala Q1Like:290)."""
+    li = t["lineitem"]
+    disc_price = F.col("l_extendedprice") * (1 - F.col("l_discount"))
+    charge = (F.col("l_extendedprice") * (1 - F.col("l_discount"))
+              * (1 + F.col("l_tax")))
+    return (li.filter(F.col("l_shipdate") <= datetime.date(1998, 9, 2))
+            .group_by("l_returnflag", "l_linestatus")
+            .agg(F.sum("l_quantity").alias("sum_qty"),
+                 F.sum("l_extendedprice").alias("sum_base_price"),
+                 F.sum(disc_price).alias("sum_disc_price"),
+                 F.sum(charge).alias("sum_charge"),
+                 F.avg("l_quantity").alias("avg_qty"),
+                 F.avg("l_extendedprice").alias("avg_price"),
+                 F.avg("l_discount").alias("avg_disc"),
+                 F.count("*").alias("count_order"))
+            .order_by("l_returnflag", "l_linestatus"))
+
+
+def q6(s, t) -> "DataFrame":
+    """Forecasting revenue change (TpchLikeSpark.scala Q6Like:468)."""
+    li = t["lineitem"]
+    return (li.filter(
+        (F.col("l_shipdate") >= datetime.date(1994, 1, 1))
+        & (F.col("l_shipdate") < datetime.date(1995, 1, 1))
+        & (F.col("l_discount") >= 0.05) & (F.col("l_discount") <= 0.07)
+        & (F.col("l_quantity") < 24.0))
+        .agg(F.sum(F.col("l_extendedprice") * F.col("l_discount"))
+             .alias("revenue")))
+
+
+QUERIES: Dict[str, Callable] = {"q1": q1, "q6": q6}
+
+
+class TpchTables:
+    """Load or generate the TPC-H tables as DataFrames."""
+
+    @staticmethod
+    def generate(session, sf: float, num_partitions: int = 4):
+        from spark_rapids_tpu.models import tpch_data as gen
+        return {
+            "lineitem": session.create_dataframe(gen.gen_lineitem(sf),
+                                                 num_partitions),
+            "orders": session.create_dataframe(gen.gen_orders(sf),
+                                               num_partitions),
+            "customer": session.create_dataframe(gen.gen_customer(sf),
+                                                 num_partitions),
+            "supplier": session.create_dataframe(gen.gen_supplier(sf),
+                                                 num_partitions),
+            "part": session.create_dataframe(gen.gen_part(sf),
+                                             num_partitions),
+            "nation": session.create_dataframe(gen.gen_nation(), 1),
+            "region": session.create_dataframe(gen.gen_region(), 1),
+        }
+
+    @staticmethod
+    def from_parquet(session, path: str):
+        import os
+        out = {}
+        for name in ("lineitem", "orders", "customer", "supplier", "part",
+                     "nation", "region"):
+            f = os.path.join(path, f"{name}.parquet")
+            if os.path.exists(f):
+                out[name] = session.read.parquet(f)
+        return out

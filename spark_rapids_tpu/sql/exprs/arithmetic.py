@@ -46,8 +46,14 @@ class BinaryArithmetic(Expression):
 
     def device_supported(self, schema: Schema) -> Optional[str]:
         for c in self.children:
-            if c.dtype(schema).is_string:
+            t = c.dtype(schema)
+            if t.is_string:
                 return "string operands are not supported for arithmetic"
+            if t.is_datetime:
+                # plain +,-,*,/ on dates/timestamps would reinterpret
+                # day-counts as microseconds; use date_add & friends
+                return (f"{self.symbol} on {t} is not supported; use the "
+                        "date/time functions")
         return None
 
     # formula over the array namespace; result (data, extra_null_mask|None)

@@ -147,7 +147,7 @@ class Literal(Expression):
         super().__init__()
         if dtype_ is None:
             dtype_ = _infer_literal_dtype(value)
-        self.value = value
+        self.value = _canonicalize_literal(value, dtype_)
         self._dtype = dtype_
 
     def dtype(self, schema: Schema) -> DType:
@@ -177,7 +177,8 @@ class Literal(Expression):
         if self._dtype.is_string:
             return pd.Series([self.value] * n, dtype="str", index=df.index)
         if self._dtype == dtypes.TIMESTAMP_US:
-            return pd.Series([pd.Timestamp(self.value)] * n, index=df.index)
+            return pd.Series(np.full(n, self.value, dtype="datetime64[us]"),
+                             index=df.index)
         if self._dtype == dtypes.DATE32:
             return pd.Series(
                 np.full(n, self.value, dtype="datetime64[D]").astype(
@@ -187,6 +188,7 @@ class Literal(Expression):
 
 
 def _infer_literal_dtype(value: Any) -> DType:
+    import datetime
     if isinstance(value, bool):
         return dtypes.BOOL
     if isinstance(value, (int, np.integer)):
@@ -195,9 +197,28 @@ def _infer_literal_dtype(value: Any) -> DType:
         return dtypes.FLOAT64
     if isinstance(value, str):
         return dtypes.STRING
+    if isinstance(value, (datetime.datetime, pd.Timestamp, np.datetime64)):
+        return dtypes.TIMESTAMP_US
+    if isinstance(value, datetime.date):
+        return dtypes.DATE32
     if value is None:
         raise TypeError("null literal needs an explicit dtype")
     raise TypeError(f"cannot infer literal type for {value!r}")
+
+
+def _canonicalize_literal(value: Any, dt: DType) -> Any:
+    """Store date/timestamp literals in their physical representation
+    (days / microseconds since epoch)."""
+    import datetime
+    if value is None:
+        return None
+    if dt == dtypes.DATE32 and isinstance(value, datetime.date) \
+            and not isinstance(value, datetime.datetime):
+        return (np.datetime64(value, "D") - np.datetime64(0, "D")).astype(int)
+    if dt == dtypes.TIMESTAMP_US and isinstance(
+            value, (datetime.datetime, pd.Timestamp, np.datetime64)):
+        return int(np.datetime64(value, "us").astype(np.int64))
+    return value
 
 
 class Col(Expression):

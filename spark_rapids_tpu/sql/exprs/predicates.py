@@ -60,8 +60,8 @@ class BinaryComparison(Expression):
         if lv.dtype.is_string or rv.dtype.is_string:
             return self._eval_device_string(ctx, lv, rv)
         ct = common_type(lv.dtype, rv.dtype) if lv.dtype != rv.dtype else lv.dtype
-        a = data_of(ctx, lv).astype(ct.np_dtype)
-        b = data_of(ctx, rv).astype(ct.np_dtype)
+        a = _promote(ctx, lv, ct)
+        b = _promote(ctx, rv, ct)
         data = self.compute(jnp, a, b)
         return DevCol(dtypes.BOOL, data, valid_and(ctx, lv, rv))
 
@@ -167,6 +167,17 @@ class EqNullSafe(BinaryComparison):
         data = (amask & bmask & eq) | (~amask & ~bmask)
         return rebuild_series(data, np.ones(len(data), np.bool_), dtypes.BOOL,
                               index)
+
+
+def _promote(ctx: EvalContext, v: DevValue, ct):
+    """Raw data promoted to the common type, scaling date->timestamp
+    properly via the cast matrix."""
+    from spark_rapids_tpu.sql.exprs.cast import cast_data
+    data = data_of(ctx, v)
+    if v.dtype == ct:
+        return data
+    out, _ = cast_data(jnp, data, v.dtype, ct)
+    return out
 
 
 def _validity_vec(ctx: EvalContext, v: DevValue):

@@ -1,0 +1,63 @@
+"""Process-wide executable cache.
+
+XLA compilation is expensive (hundreds of ms per kernel); the reference
+faces the same with per-task compilation and SURVEY.md section 7 hard-part 5
+calls for a process-wide executable cache. Exec operators build their device
+kernels through ``cached_jit(signature, builder)``: identical operators
+across queries (same expression trees, same static params) share one
+``jax.jit`` wrapper, and jax's own cache then shares compiled executables
+per input shape (capacity bucket).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict
+
+_CACHE: Dict[str, Any] = {}
+_LOCK = threading.Lock()
+_STATS = {"hits": 0, "misses": 0}
+
+
+def cached_jit(signature: str, builder: Callable[[], Any]):
+    """Return the cached kernel for ``signature``, building it once."""
+    with _LOCK:
+        fn = _CACHE.get(signature)
+        if fn is not None:
+            _STATS["hits"] += 1
+            return fn
+        _STATS["misses"] += 1
+    fn = builder()
+    with _LOCK:
+        return _CACHE.setdefault(signature, fn)
+
+
+def cache_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_STATS, size=len(_CACHE))
+
+
+def clear() -> None:
+    with _LOCK:
+        _CACHE.clear()
+
+
+def expr_signature(e) -> str:
+    """Deterministic structural signature of a bound expression tree.
+
+    Walks the tree and serializes every instance attribute (patterns,
+    cast targets, literal values, ordinals...), not just repr() — many
+    nodes' repr prints only class name + children, which would collide
+    cache keys for e.g. startswith('a') vs startswith('b')."""
+    parts = [type(e).__name__]
+    for k in sorted(vars(e)):
+        if k == "children":
+            continue
+        v = vars(e)[k]
+        parts.append(f"{k}={v!r}")
+    kids = ",".join(expr_signature(c) for c in getattr(e, "children", ()))
+    return f"{'|'.join(parts)}({kids})"
+
+
+def schema_signature(schema) -> str:
+    return repr(schema)

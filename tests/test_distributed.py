@@ -1,0 +1,17 @@
+"""Distributed (mesh) path tests on the virtual 8-device CPU mesh —
+the Ring-2 pattern: no pod required (SURVEY.md section 4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.parallel.distributed import dryrun_distributed_q1
+
+
+def test_dryrun_distributed_q1_8dev():
+    assert len(jax.devices()) >= 8
+    dryrun_distributed_q1(8)
+
+
+def test_dryrun_distributed_q1_2dev():
+    dryrun_distributed_q1(2, rows_per_shard=256)

@@ -194,3 +194,28 @@ class TestFallback:
             with_tpu_session(
                 lambda s: s.create_dataframe(df, 2)
                 .filter(F.col("region").like("e%s_")))
+
+
+class TestKernelCache:
+    def test_no_signature_collision(self, session, rng):
+        """Two filters differing only in a pattern literal must not share a
+        compiled kernel (regression: repr-based cache keys collided)."""
+        df = _sales_df(rng)
+        a = assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2)
+            .filter(F.col("region").startswith("ea")))
+        b = assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2)
+            .filter(F.col("region").startswith("we")))
+        assert set(a["region"]) == {"east"}
+        assert set(b["region"]) == {"west"}
+
+    def test_cast_targets_not_collided(self, session, rng):
+        df = _sales_df(rng)
+        a = assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2)
+            .select(F.col("price").cast("int").alias("x")))
+        b = assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2)
+            .select(F.col("price").cast("long").alias("x")))
+        assert len(a) == len(b)

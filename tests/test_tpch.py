@@ -1,0 +1,67 @@
+"""TPC-H workload differential tests (BASELINE config 1: q6/q1 single
+executor) + Parquet round-trip scan test."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.models import tpch_data
+from spark_rapids_tpu.models.tpch import QUERIES, TpchTables
+from tests.querytest import assert_tpu_and_cpu_equal
+
+SF = 0.002  # ~12K lineitem rows: fast but non-trivial
+
+
+@pytest.fixture(scope="module")
+def tpch_pandas():
+    return {
+        "lineitem": tpch_data.gen_lineitem(SF),
+        "orders": tpch_data.gen_orders(SF),
+    }
+
+
+def test_q1(session, tpch_pandas):
+    out = assert_tpu_and_cpu_equal(
+        lambda s: QUERIES["q1"](s, {
+            "lineitem": s.create_dataframe(tpch_pandas["lineitem"], 4)}),
+        ignore_order=False, approx=True)
+    assert len(out) == 6  # 3 returnflags x 2 linestatus
+    assert (out["count_order"] > 0).all()
+
+
+def test_q6(session, tpch_pandas):
+    out = assert_tpu_and_cpu_equal(
+        lambda s: QUERIES["q6"](s, {
+            "lineitem": s.create_dataframe(tpch_pandas["lineitem"], 4)}),
+        ignore_order=False, approx=True)
+    assert len(out) == 1
+    assert out["revenue"][0] > 0
+
+
+def test_q1_from_parquet(session, tmp_path):
+    tpch_data.write_parquet(str(tmp_path), SF, tables=["lineitem"])
+    out = assert_tpu_and_cpu_equal(
+        lambda s: QUERIES["q1"](s, {
+            "lineitem": s.read.parquet(str(tmp_path / "lineitem.parquet"))}),
+        ignore_order=False, approx=True)
+    assert len(out) == 6
+
+
+def test_parquet_roundtrip_scan(session, tmp_path, rng):
+    df = pd.DataFrame({
+        "i": pd.array(rng.integers(0, 100, 200), dtype="Int64")
+              .to_numpy(na_value=0),
+        "f": rng.normal(0, 1, 200),
+        "s": pd.Series([f"row{i % 17}" for i in range(200)]),
+    })
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    p = tmp_path / "t.parquet"
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), str(p),
+                   row_group_size=64)
+    from spark_rapids_tpu.sql import functions as F
+    out = assert_tpu_and_cpu_equal(
+        lambda s: s.read.parquet(str(p)).filter(F.col("i") > 50)
+        .group_by("s").agg(F.count("*").alias("n"), F.sum("f").alias("sf")),
+        approx=True)
+    assert len(out) > 0
