@@ -66,9 +66,9 @@ class Schema:
     @staticmethod
     def from_pandas(df: pd.DataFrame) -> "Schema":
         names, dts = [], []
-        for name in df.columns:
+        for i, name in enumerate(df.columns):
             names.append(str(name))
-            dts.append(_pandas_col_dtype(df[name]))
+            dts.append(_pandas_col_dtype(df.iloc[:, i]))
         return Schema(names, dts)
 
 
@@ -138,22 +138,26 @@ class DeviceBatch:
         n = len(df)
         cap = capacity if capacity is not None else bucket_capacity(n)
         cols: List[DeviceColumn] = []
-        for name, dt in zip(schema.names, schema.dtypes):
-            values, validity = _pandas_to_numpy(df[name], dt)
+        # positional iteration: join outputs may carry duplicate column names
+        for i, dt in enumerate(schema.dtypes):
+            values, validity = _pandas_to_numpy(df.iloc[:, i], dt)
             cols.append(DeviceColumn.from_numpy(values, validity, dt, cap))
         return DeviceBatch(schema, cols, jnp.asarray(n, dtype=jnp.int32))
 
     def to_pandas(self) -> pd.DataFrame:
         """Device -> host transition (reference: GpuColumnarToRowExec)."""
         n = self.num_rows_host()
-        out: Dict[str, pd.Series] = {}
-        for name, dt, col in zip(self.schema.names, self.schema.dtypes,
-                                 self.columns):
+        series: List[pd.Series] = []
+        for dt, col in zip(self.schema.dtypes, self.columns):
             values, validity = col.to_numpy(n)
-            out[name] = _numpy_to_pandas(values, validity, dt)
-        df = pd.DataFrame(out, columns=list(self.schema.names))
-        if len(df) != n:  # all-column-less batch
-            df = df.reindex(range(n))
+            series.append(_numpy_to_pandas(values, validity, dt)
+                          .reset_index(drop=True))
+        if not series:
+            return pd.DataFrame(index=range(n))
+        # positional construction: join outputs may carry duplicate column
+        # names (both sides keep their key column, like Spark)
+        df = pd.concat(series, axis=1)
+        df.columns = list(self.schema.names)
         return df
 
     @staticmethod

@@ -233,6 +233,12 @@ SHUFFLE_PARTITIONS = register(
     "Default number of shuffle output partitions (spark.sql.shuffle.partitions "
     "equivalent).", validator=_positive)
 
+BROADCAST_THRESHOLD = register(
+    "spark.rapids.sql.autoBroadcastJoinThreshold", _to_bytes, 10 << 20,
+    "Maximum estimated build-side size for which a join uses a broadcast "
+    "exchange instead of hash-partitioned exchanges "
+    "(spark.sql.autoBroadcastJoinThreshold equivalent). -1 disables.")
+
 STAGE_FUSION = register(
     "spark.rapids.sql.stageFusion.enabled", _to_bool, True,
     "Trace chains of narrow operators (project/filter/partial-agg) into a "
@@ -344,6 +350,8 @@ class TpuConf:
     def num_task_threads(self) -> int: return self.get(NUM_TASK_THREADS.key)
     @property
     def shuffle_partitions(self) -> int: return self.get(SHUFFLE_PARTITIONS.key)
+    @property
+    def broadcast_threshold(self) -> int: return self.get(BROADCAST_THRESHOLD.key)
     @property
     def stage_fusion_enabled(self) -> bool: return self.get(STAGE_FUSION.key)
     @property

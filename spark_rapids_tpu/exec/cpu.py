@@ -256,6 +256,30 @@ class CpuShuffleExchangeExec(PhysicalPlan):
         raise ValueError(f"unknown partitioning {kind}")
 
 
+class CpuBroadcastExchangeExec(PhysicalPlan):
+    """Collects the child once and shares it with every consumer partition
+    (reference: GpuBroadcastExchangeExec.scala:47-178 collects child batches
+    and Spark-broadcasts them)."""
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__([child])
+        self._cache = {}
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child = self.children[0]
+
+        def run():
+            if "df" not in self._cache:
+                parts = child.partitions(ctx)
+                self._cache["df"] = _concat_parts(
+                    (df for p in parts for df in p()), child.output_schema())
+            yield self._cache["df"]
+        return [run]
+
+
 def sort_key_arrays(df: pd.DataFrame, orders: Sequence[SortOrder]):
     """Numpy lexsort keys implementing Spark ordering: per-key null
     flag + order-preserving image (floats: NaN largest, -0.0 == 0.0;
@@ -420,8 +444,16 @@ class CpuJoinExec(PhysicalPlan):
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         left_parts = self.children[0].partitions(ctx)
         right_parts = self.children[1].partitions(ctx)
-        assert len(left_parts) == len(right_parts), \
-            "join children must be co-partitioned"
+        # broadcast pairing: a single-partition broadcast side joins against
+        # every partition of the other side
+        if len(left_parts) != len(right_parts):
+            if len(right_parts) == 1:
+                right_parts = right_parts * len(left_parts)
+            elif len(left_parts) == 1:
+                left_parts = left_parts * len(right_parts)
+            else:
+                raise AssertionError("join children must be co-partitioned "
+                                     "or one side broadcast")
 
         def make(lp: Partition, rp: Partition) -> Partition:
             def run():

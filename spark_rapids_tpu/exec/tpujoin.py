@@ -1,0 +1,188 @@
+"""TPU join operators (reference: GpuShuffledHashJoinExec /
+GpuBroadcastHashJoinExec / GpuCartesianProductExec,
+shims/spark300/.../GpuHashJoin.scala:113-244).
+
+Execution shape matches the reference's hash join: the build side is
+concatenated into one device batch and held; stream batches probe it one at
+a time. Probe and expand are separately jitted (ops/joins.py) because the
+expand specializes on the bucketed output capacity — the single
+device->host sync per stream batch that dynamic join cardinality costs.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema, bucket_capacity
+from spark_rapids_tpu.columnar.column import _char_bucket
+from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
+from spark_rapids_tpu.ops import joins as join_ops
+from spark_rapids_tpu.utils.kernelcache import cached_jit
+
+SUPPORTED_JOIN_TYPES = ("inner", "left", "right", "full", "leftsemi",
+                        "leftanti", "cross")
+
+
+class TpuBroadcastExchangeExec(PhysicalPlan):
+    """Materializes the child once as a single device batch shared by every
+    consumer partition (reference: GpuBroadcastExchangeExec.scala:230-436
+    re-materializes the broadcast on device per task; here the batch is
+    already device-resident so it is simply cached)."""
+
+    columnar_output = True
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__([child])
+        self._cache = {}
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child = self.children[0]
+        growth = ctx.conf.capacity_growth
+
+        def run():
+            if "batch" not in self._cache:
+                from spark_rapids_tpu.exec.tpu import _concat_device
+                parts = child.partitions(ctx)
+                batches = [b for p in parts for b in p()]
+                self._cache["batch"] = _concat_device(
+                    batches, child.output_schema(), growth)
+            yield self._cache["batch"]
+        return [run]
+
+
+class TpuShuffledHashJoinExec(PhysicalPlan):
+    columnar_output = True
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 join_type: str, left_keys: List[int], right_keys: List[int]):
+        super().__init__([left, right])
+        assert join_type in SUPPORTED_JOIN_TYPES, join_type
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+
+        # right outer streams the right side against a left-side build so
+        # every preserved row is a stream row (the reference flips build
+        # side the same way, GpuHashJoin.scala:60-76)
+        self._stream_is_left = join_type != "right"
+        jt = join_type
+        cross = jt == "cross"
+        skey = tuple(self.left_keys if self._stream_is_left
+                     else self.right_keys)
+        bkey = tuple(self.right_keys if self._stream_is_left
+                     else self.left_keys)
+        sig = f"join|{jt}|{skey}|{bkey}"
+        self._probe = cached_jit(sig + "|probe", lambda: jax.jit(
+            lambda b, s: join_ops.join_probe(b, s, bkey, skey, cross=cross)))
+        outer = jt in ("left", "right", "full")
+        swap = not self._stream_is_left
+
+        def expand(build, stream, counts, bstart, bperm, out_cap, s_caps,
+                   b_caps):
+            adj = (join_ops.outer_adjusted_counts(stream, counts)
+                   if outer else counts)
+            return join_ops.join_expand(build, stream, counts, adj, bstart,
+                                        bperm, out_cap, swap, s_caps, b_caps)
+        self._expand = cached_jit(sig + "|expand", lambda: jax.jit(
+            expand, static_argnums=(5, 6, 7)))
+
+        def totals(build, stream, counts, bstart, bperm):
+            adj = (join_ops.outer_adjusted_counts(stream, counts)
+                   if outer else counts)
+            return join_ops.expand_totals(build, stream, counts, adj, bperm,
+                                          bstart)
+        self._totals = cached_jit(sig + "|totals", lambda: jax.jit(totals))
+        if jt == "full":
+            self._match_flags = cached_jit(sig + "|mf", lambda: jax.jit(
+                join_ops.build_match_flags))
+            self._unmatched = cached_jit(sig + "|unm", lambda: jax.jit(
+                lambda b, m, ss: join_ops.unmatched_build_batch(
+                    b, m, ss, swap_sides=False),
+                static_argnums=(2,)))
+        if jt in ("leftsemi", "leftanti"):
+            self._semi = cached_jit(sig + "|semi", lambda: jax.jit(
+                lambda s, c: join_ops.semi_anti_filter(
+                    s, c, anti=jt == "leftanti")))
+
+    def output_schema(self) -> Schema:
+        ls = self.children[0].output_schema()
+        rs = self.children[1].output_schema()
+        if self.join_type in ("leftsemi", "leftanti"):
+            return ls
+        return Schema(list(ls.names) + list(rs.names),
+                      list(ls.dtypes) + list(rs.dtypes))
+
+    def describe(self) -> str:
+        return (f"TpuShuffledHashJoinExec({self.join_type}, "
+                f"l={self.left_keys}, r={self.right_keys})")
+
+    def _sides(self):
+        """(stream_child_idx, build_child_idx)."""
+        return (0, 1) if self._stream_is_left else (1, 0)
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        si, bi = self._sides()
+        stream_parts = self.children[si].partitions(ctx)
+        build_parts = self.children[bi].partitions(ctx)
+        if len(stream_parts) != len(build_parts):
+            # broadcast build side: one build partition shared by every
+            # stream partition (full outer never broadcasts — the unmatched-
+            # build scan must see all stream rows, planner guarantees this)
+            assert len(build_parts) == 1 and self.join_type != "full", \
+                "join children must be co-partitioned or build broadcast"
+            build_parts = build_parts * len(stream_parts)
+        growth = ctx.conf.capacity_growth
+        build_schema = self.children[bi].output_schema()
+        jt = self.join_type
+
+        def make(sp: Partition, bp: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                from spark_rapids_tpu.exec.tpu import _concat_device
+                build = _concat_device(list(bp()), build_schema, growth)
+                matched_acc = None
+                emitted = False
+                for stream in sp():
+                    counts, bstart, bperm = self._probe(build, stream)
+                    if jt in ("leftsemi", "leftanti"):
+                        out = self._semi(stream, counts)
+                        emitted = True
+                        yield out
+                        continue
+                    sizes = [int(x) for x in
+                             self._totals(build, stream, counts, bstart,
+                                          bperm)]
+                    total = sizes[0]
+                    if jt == "full":
+                        flags = self._match_flags(build, counts, bstart,
+                                                  bperm)
+                        matched_acc = (flags if matched_acc is None
+                                       else matched_acc | flags)
+                    if total == 0:
+                        continue
+                    n_s = sum(1 for d in stream.schema.dtypes if d.is_string)
+                    s_caps = tuple(_char_bucket(c)
+                                   for c in sizes[1:1 + n_s])
+                    b_caps = tuple(_char_bucket(c)
+                                   for c in sizes[1 + n_s:])
+                    out_cap = bucket_capacity(total, growth)
+                    emitted = True
+                    yield self._expand(build, stream, counts, bstart, bperm,
+                                       out_cap, s_caps, b_caps)
+                if jt == "full":
+                    if matched_acc is None:
+                        matched_acc = jnp.zeros((build.capacity,), jnp.bool_)
+                    stream_schema = self.children[si].output_schema()
+                    tail = self._unmatched(build, matched_acc, stream_schema)
+                    if tail.num_rows_host() > 0 or not emitted:
+                        emitted = True
+                        yield tail
+                if not emitted:
+                    yield DeviceBatch.empty(self.output_schema())
+            return run
+        return [make(sp, bp) for sp, bp in zip(stream_parts, build_parts)]

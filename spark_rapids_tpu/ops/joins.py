@@ -1,0 +1,235 @@
+"""Device equi-join kernels (reference: cuDF inner/left/.. joins called from
+shims/spark300/.../GpuHashJoin.scala:113-244).
+
+TPU-first design: cuDF probes a device hash table (data-dependent memory,
+which XLA cannot express). Instead the join runs as sort + sorted search,
+everything shape-static:
+
+  1. hash both sides' key columns to 128 bits (same double-hash the
+     group-by uses, ops/groupby.py);
+  2. one fused ``lax.sort`` over the *union* of both sides' hash pairs
+     assigns every row a joint dense key id (int32) — exact equality on the
+     128-bit pair, no verification pass needed at these collision odds;
+  3. sort the build side by key id; probe = two ``searchsorted`` calls per
+     stream row giving the match range [bstart, bend);
+  4. count-then-expand: match counts are summed on device, one host sync
+     picks a bucketed output capacity, and a second jitted kernel
+     materializes the (stream_row, build_row) pairs by inverse-searchsorted
+     over the count prefix sum.
+
+Null keys never match (SQL semantics): rows with any invalid key column are
+parked outside the id space. Output capacity is the only data-dependent
+quantity and costs exactly one device->host sync per stream batch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.ops.groupby import row_hashes
+from spark_rapids_tpu.ops.rowops import filter_batch, gather_column
+
+
+def _key_valid(batch: DeviceBatch, key_idx: Sequence[int]) -> jnp.ndarray:
+    v = batch.row_mask()
+    for ki in key_idx:
+        v = v & batch.columns[ki].validity
+    return v
+
+
+def join_probe(build: DeviceBatch, stream: DeviceBatch,
+               build_keys: Sequence[int], stream_keys: Sequence[int],
+               cross: bool = False):
+    """Phase 1. Returns device arrays
+    (counts[ns], bstart[ns], bperm[nb], total_inner) where counts[i] is the
+    number of build matches of stream row i and bperm maps sorted build
+    slots back to build rows."""
+    nb, ns = build.capacity, stream.capacity
+    if cross:
+        n_live = build.num_rows
+        counts = jnp.where(stream.row_mask(), n_live, 0).astype(jnp.int32)
+        bstart = jnp.zeros((ns,), jnp.int32)
+        dead = (~build.row_mask()).astype(jnp.uint8)
+        _, bperm = jax.lax.sort(
+            (dead, jnp.arange(nb, dtype=jnp.int32)), num_keys=1,
+            is_stable=True)
+        return counts, bstart, bperm
+
+    bh1, bh2 = row_hashes(build, build_keys)
+    sh1, sh2 = row_hashes(stream, stream_keys)
+    bkv = _key_valid(build, build_keys)
+    skv = _key_valid(stream, stream_keys)
+
+    h1 = jnp.concatenate([bh1, sh1])
+    h2 = jnp.concatenate([bh2, sh2])
+    invalid = (~jnp.concatenate([bkv, skv])).astype(jnp.uint8)
+    pos = jnp.arange(nb + ns, dtype=jnp.int32)
+    inv_s, h1_s, h2_s, perm = jax.lax.sort((invalid, h1, h2, pos),
+                                           num_keys=3, is_stable=True)
+    valid_s = inv_s == 0
+    prev1 = jnp.concatenate([h1_s[:1] ^ jnp.uint64(1), h1_s[:-1]])
+    prev2 = jnp.concatenate([h2_s[:1], h2_s[:-1]])
+    boundary = ((h1_s != prev1) | (h2_s != prev2)) & valid_s
+    boundary = boundary.at[0].set(valid_s[0])
+    pid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    pid = jnp.where(valid_s, pid, -1)
+    ids = jnp.zeros((nb + ns,), jnp.int32).at[perm].set(pid)
+    bid = ids[:nb]
+    sid = ids[nb:]
+
+    big = jnp.asarray(nb + ns + 1, jnp.int32)
+    bid_key = jnp.where(bkv, bid, big)
+    bid_s, bperm = jax.lax.sort((bid_key, jnp.arange(nb, dtype=jnp.int32)),
+                                num_keys=1, is_stable=True)
+    sid_q = jnp.where(skv, sid, -1)
+    bstart = jnp.searchsorted(bid_s, sid_q, side="left").astype(jnp.int32)
+    bend = jnp.searchsorted(bid_s, sid_q, side="right").astype(jnp.int32)
+    counts = jnp.where(skv, bend - bstart, 0).astype(jnp.int32)
+    return counts, bstart, bperm
+
+
+def outer_adjusted_counts(stream: DeviceBatch,
+                          counts: jnp.ndarray) -> jnp.ndarray:
+    """Left-outer: every live stream row emits at least one output row."""
+    return jnp.where(stream.row_mask(), jnp.maximum(counts, 1), 0)
+
+
+def expand_totals(build: DeviceBatch, stream: DeviceBatch,
+                  counts: jnp.ndarray, counts_adj: jnp.ndarray,
+                  bperm: jnp.ndarray, bstart: jnp.ndarray) -> jnp.ndarray:
+    """All host-needed expansion sizes in ONE device array (one sync):
+    [total_rows, chars per stream string col..., chars per build string
+    col...]. String char totals are exact (each emitted pair copies the
+    source strings once); build-side totals ride a prefix sum over the
+    sorted build rows."""
+    parts = [counts_adj.sum().astype(jnp.int64)]
+    for c in stream.columns:
+        if c.dtype.is_string:
+            lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+            parts.append((counts_adj.astype(jnp.int64) * lens).sum())
+    nb = build.capacity
+    for c in build.columns:
+        if c.dtype.is_string:
+            lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+            lens_sorted = lens[bperm]
+            cl = jnp.concatenate([jnp.zeros((1,), jnp.int64),
+                                  jnp.cumsum(lens_sorted)])
+            hi = jnp.clip(bstart + counts, 0, nb)
+            lo = jnp.clip(bstart, 0, nb)
+            parts.append((cl[hi] - cl[lo]).sum())
+    return jnp.stack(parts)
+
+
+def join_expand(build: DeviceBatch, stream: DeviceBatch,
+                counts: jnp.ndarray, counts_adj: jnp.ndarray,
+                bstart: jnp.ndarray, bperm: jnp.ndarray,
+                out_capacity: int, swap_sides: bool,
+                stream_char_caps: Tuple[int, ...] = (),
+                build_char_caps: Tuple[int, ...] = ()) -> DeviceBatch:
+    """Phase 2: materialize pairs into an out_capacity batch.
+
+    counts_adj >= counts drives emission (left-outer rows with no match
+    still emit one row with a null build side). ``swap_sides`` puts the
+    build side's columns first (right outer join runs with build=left).
+    The char-cap tuples (one entry per string column of that side, from
+    expand_totals) size expanded string buffers."""
+    nb, ns = build.capacity, stream.capacity
+    total = counts_adj.sum().astype(jnp.int32)
+    incl = jnp.cumsum(counts_adj).astype(jnp.int32)
+    excl = incl - counts_adj
+    k = jnp.arange(out_capacity, dtype=jnp.int32)
+    srow = jnp.clip(jnp.searchsorted(incl, k, side="right").astype(jnp.int32),
+                    0, ns - 1)
+    j = k - excl[srow]
+    matched = counts[srow] > 0
+    slot = bstart[srow] + jnp.minimum(j, jnp.maximum(counts[srow] - 1, 0))
+    brow = bperm[jnp.clip(slot, 0, nb - 1)]
+    live = k < total
+
+    def side_cols(batch, perm, live_mask, caps):
+        cols, si = [], 0
+        for c in batch.columns:
+            if c.dtype.is_string:
+                cap = caps[si] if si < len(caps) else 0
+                si += 1
+                cols.append(gather_column(c, perm, live_mask, cap))
+            else:
+                cols.append(gather_column(c, perm, live_mask))
+        return cols
+
+    stream_cols = side_cols(stream, srow, live, stream_char_caps)
+    build_cols = side_cols(build, brow, live & matched, build_char_caps)
+    if swap_sides:
+        names = list(build.schema.names) + list(stream.schema.names)
+        dts = list(build.schema.dtypes) + list(stream.schema.dtypes)
+        cols = build_cols + stream_cols
+    else:
+        names = list(stream.schema.names) + list(build.schema.names)
+        dts = list(stream.schema.dtypes) + list(build.schema.dtypes)
+        cols = stream_cols + build_cols
+    return DeviceBatch(Schema(names, dts), cols, total)
+
+
+def build_match_flags(build: DeviceBatch, counts: jnp.ndarray,
+                      bstart: jnp.ndarray, bperm: jnp.ndarray) -> jnp.ndarray:
+    """bool[nb]: build rows matched by any stream row (for full outer).
+    Coverage of the sorted-slot ranges via +1/-1 deltas and a prefix sum."""
+    nb = build.capacity
+    has = counts > 0
+    one = jnp.where(has, 1, 0)
+    delta = jnp.zeros((nb + 1,), jnp.int32)
+    delta = delta.at[jnp.clip(bstart, 0, nb)].add(one)
+    delta = delta.at[jnp.clip(bstart + counts, 0, nb)].add(-one)
+    covered_slot = jnp.cumsum(delta)[:nb] > 0
+    return jnp.zeros((nb,), jnp.bool_).at[bperm].set(covered_slot)
+
+
+def null_columns(schema: Schema, capacity: int) -> List[DeviceColumn]:
+    """All-null columns of the given schema (the missing side of outer-join
+    rows)."""
+    cols = []
+    validity = jnp.zeros((capacity,), jnp.bool_)
+    for dt in schema.dtypes:
+        if dt.is_string:
+            cols.append(DeviceColumn(
+                dt, jnp.zeros((16,), jnp.uint8), validity,
+                jnp.zeros((capacity + 1,), jnp.int32)))
+        else:
+            cols.append(DeviceColumn(
+                dt, jnp.zeros((capacity,), dt.np_dtype), validity))
+    return cols
+
+
+def unmatched_build_batch(build: DeviceBatch, matched: jnp.ndarray,
+                          stream_schema: Schema,
+                          swap_sides: bool) -> DeviceBatch:
+    """Full-outer tail: build rows no stream row matched, with an all-null
+    stream side. Output capacity = build capacity (compacted)."""
+    keep = build.row_mask() & ~matched
+    compact = filter_batch(build, keep)
+    nulls = null_columns(stream_schema, compact.capacity)
+    if swap_sides:
+        names = list(build.schema.names) + list(stream_schema.names)
+        dts_ = list(build.schema.dtypes) + list(stream_schema.dtypes)
+        cols = list(compact.columns) + nulls
+    else:
+        names = list(stream_schema.names) + list(build.schema.names)
+        dts_ = list(stream_schema.dtypes) + list(build.schema.dtypes)
+        cols = nulls + list(compact.columns)
+    return DeviceBatch(Schema(names, dts_), cols, compact.num_rows)
+
+
+def semi_anti_filter(stream: DeviceBatch, counts: jnp.ndarray,
+                     anti: bool) -> DeviceBatch:
+    """leftsemi: stream rows with >=1 match; leftanti: live rows with none
+    (null-keyed rows count as unmatched — SQL null never equals)."""
+    if anti:
+        mask = stream.row_mask() & (counts == 0)
+    else:
+        mask = counts > 0
+    return filter_batch(stream, mask)

@@ -18,10 +18,13 @@ from spark_rapids_tpu.columnar.column import DeviceColumn
 
 
 def gather_column(col: DeviceColumn, perm: jnp.ndarray,
-                  live: jnp.ndarray) -> DeviceColumn:
+                  live: jnp.ndarray,
+                  out_char_capacity: int = 0) -> DeviceColumn:
     """Gather rows of a column by index vector ``perm`` (len = out capacity).
     ``live`` marks which output slots are real rows; dead slots become
-    invalid/empty."""
+    invalid/empty. ``out_char_capacity`` sizes the output char buffer for
+    string columns (default: same as the source — callers that *expand*
+    rows, like joins, must pass the synced total)."""
     out_cap = perm.shape[0]
     if col.dtype.is_string:
         lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
@@ -30,8 +33,9 @@ def gather_column(col: DeviceColumn, perm: jnp.ndarray,
         new_offsets = jnp.concatenate([
             jnp.zeros((1,), jnp.int32), jnp.cumsum(new_len).astype(jnp.int32)])
         nchars = col.data.shape[0]
+        out_chars_n = out_char_capacity if out_char_capacity > 0 else nchars
         total_new = new_offsets[out_cap]
-        k = jnp.arange(nchars, dtype=jnp.int32)
+        k = jnp.arange(out_chars_n, dtype=jnp.int32)
         out_row = jnp.clip(
             jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
             0, out_cap - 1)

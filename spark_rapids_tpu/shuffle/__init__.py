@@ -1,0 +1,14 @@
+"""Accelerator-resident shuffle (reference: RapidsShuffleManager +
+shuffle/ + shuffle-plugin/, SURVEY.md section 2.4).
+
+Layering mirrors the reference with the UCX endpoint mesh swapped for
+pluggable transports (in-process for tests, ICI mesh collectives for the
+distributed path — parallel/distributed.py):
+
+  wire.py       self-describing columnar wire format (JCudfSerialization)
+  transport.py  transport SPI + bounce buffers (RapidsShuffleTransport)
+  catalogs.py   shuffle/received buffer catalogs over memory/spill.py
+  server.py     metadata + buffer-send state machine (RapidsShuffleServer)
+  client.py     fetch state machine (RapidsShuffleClient)
+  manager.py    caching writer/reader glue (RapidsShuffleInternalManager)
+"""

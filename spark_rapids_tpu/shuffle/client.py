@@ -1,0 +1,108 @@
+"""Shuffle client fetch state machine (reference:
+RapidsShuffleClient.scala — doFetch:483, issueBufferReceives:584,
+BufferReceiveState:111-358).
+
+Fetch of a set of blocks from one peer:
+
+  1. METADATA request -> per-buffer (id, length, tag) triples;
+  2. for each buffer: post tagged receives for every bounce-buffer-sized
+     chunk, then issue the TRANSFER request that makes the server send;
+  3. reassemble chunks, deserialize, hand the batch to the receive
+     catalog.
+
+Errors surface as ``ShuffleFetchFailedError`` so the task layer can retry
+the stage (reference: RapidsShuffleFetchFailedException).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import List, Tuple
+
+from spark_rapids_tpu.shuffle import wire
+from spark_rapids_tpu.shuffle.catalogs import ReceivedBufferCatalog
+from spark_rapids_tpu.shuffle.server import (
+    META_REQ, META_RESP, TRANSFER_REQ,
+)
+from spark_rapids_tpu.shuffle.transport import (
+    ClientConnection, RequestType, TransactionStatus,
+)
+
+
+class ShuffleFetchFailedError(RuntimeError):
+    pass
+
+
+class ShuffleClient:
+    def __init__(self, executor_id: str, connection: ClientConnection,
+                 received: ReceivedBufferCatalog, bounce_buffer_size: int):
+        self.executor_id = executor_id
+        self.connection = connection
+        self.received = received
+        self.bounce_buffer_size = bounce_buffer_size
+
+    def fetch_blocks(self, blocks: List[Tuple[int, int, int]]) -> List[int]:
+        """Fetch all batches of the given (shuffle, map, partition) blocks
+        from the peer. Returns received-catalog buffer ids."""
+        metas = self._fetch_metadata(blocks)
+        out = []
+        for bid, length, tag in metas:
+            blob = self._receive_buffer(length, tag)
+            batch = wire.deserialize_batch(blob)
+            out.append(self.received.add_batch(batch))
+        return out
+
+    def _fetch_metadata(self, blocks) -> List[Tuple[int, int, int]]:
+        payload = b"".join(META_REQ.pack(*b) for b in blocks)
+        result = {}
+        done = threading.Event()
+
+        def cb(txn, resp: bytes):
+            result["txn"] = txn
+            result["resp"] = resp
+            done.set()
+        self.connection.request(RequestType.METADATA, payload, cb)
+        if not done.wait(30):
+            raise ShuffleFetchFailedError("metadata request timed out")
+        if result["txn"].status != TransactionStatus.SUCCESS:
+            raise ShuffleFetchFailedError(
+                f"metadata request failed: {result['txn'].error_message}")
+        resp = result["resp"]
+        n = len(resp) // META_RESP.size
+        return [META_RESP.unpack_from(resp, i * META_RESP.size)
+                for i in range(n)]
+
+    def _receive_buffer(self, length: int, tag: int) -> bytes:
+        """Post chunk receives, fire the transfer request, reassemble."""
+        size = self.bounce_buffer_size
+        nchunks = (length + size - 1) // size or 1
+        chunks: List[bytearray] = []
+        events: List[threading.Event] = []
+        for c in range(nchunks):
+            clen = min(size, length - c * size) if length else 0
+            target = bytearray(clen)
+            ev = threading.Event()
+            chunks.append(target)
+            events.append(ev)
+            self.connection.receive(tag + 1 + c, target,
+                                    lambda txn, ev=ev: ev.set())
+        peer = self.executor_id.encode("utf-8")
+        payload = (struct.pack("<H", len(peer)) + peer
+                   + TRANSFER_REQ.pack(0, tag))
+        tdone = threading.Event()
+        tres = {}
+
+        def tcb(txn, resp):
+            tres["txn"] = txn
+            tdone.set()
+        self.connection.request(RequestType.TRANSFER, payload, tcb)
+        if not tdone.wait(30):
+            raise ShuffleFetchFailedError("transfer request timed out")
+        if tres["txn"].status != TransactionStatus.SUCCESS:
+            raise ShuffleFetchFailedError(
+                f"transfer failed: {tres['txn'].error_message}")
+        for ev in events:
+            if not ev.wait(30):
+                raise ShuffleFetchFailedError("chunk receive timed out")
+        return b"".join(bytes(c) for c in chunks)

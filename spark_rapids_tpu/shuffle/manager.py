@@ -1,0 +1,126 @@
+"""Shuffle manager: caching writer/reader over the catalogs + transport
+(reference: RapidsShuffleInternalManagerBase:186-362, RapidsCachingWriter
+:74-178, RapidsCachingReader:170, GpuShuffleEnv.scala:27-136).
+
+``ShuffleEnv`` is the per-executor wiring the reference builds in
+GpuShuffleEnv.initStorage: spill-store chain, shuffle catalogs, transport,
+server. ``MapStatus`` carries the executor id where the reference smuggles
+the UCX port through the BlockManagerId topology field.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.memory.spill import BufferCatalog, SpillPriorities
+from spark_rapids_tpu.shuffle.catalogs import (
+    ReceivedBufferCatalog, ShuffleBufferCatalog,
+)
+from spark_rapids_tpu.shuffle.client import ShuffleClient
+from spark_rapids_tpu.shuffle.server import ShuffleServer
+from spark_rapids_tpu.shuffle.transport import (
+    BounceBufferManager, InProcessTransport, ShuffleTransport,
+)
+
+
+class MapStatus:
+    """Where a map task's output lives (reference: MapStatus with the
+    'rapids=<port>' topology tag, RapidsShuffleInternalManager.scala:157-172
+    — here the executor id itself is the address)."""
+
+    def __init__(self, executor_id: str, shuffle_id: int, map_id: int,
+                 partition_sizes: List[int]):
+        self.executor_id = executor_id
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.partition_sizes = partition_sizes
+
+
+class ShuffleEnv:
+    """Per-executor shuffle environment."""
+
+    def __init__(self, executor_id: str, transport: ShuffleTransport,
+                 host_limit_bytes: int = 1 << 30,
+                 bounce_buffer_size: int = 1 << 20,
+                 bounce_buffer_count: int = 4,
+                 disk_dir: Optional[str] = None, device_manager=None):
+        self.executor_id = executor_id
+        self.transport = transport
+        self.buffer_catalog = BufferCatalog(host_limit_bytes, disk_dir,
+                                            device_manager)
+        self.shuffle_catalog = ShuffleBufferCatalog(self.buffer_catalog)
+        self.received_catalog = ReceivedBufferCatalog(self.buffer_catalog)
+        self.bounce = BounceBufferManager(bounce_buffer_size,
+                                          bounce_buffer_count)
+        self.server = ShuffleServer(executor_id, transport.get_server(),
+                                    self.shuffle_catalog, self.bounce)
+        self.bounce_buffer_size = bounce_buffer_size
+        self._clients: Dict[str, ShuffleClient] = {}
+        self._lock = threading.Lock()
+
+    def client_for(self, peer_executor_id: str) -> ShuffleClient:
+        with self._lock:
+            c = self._clients.get(peer_executor_id)
+            if c is None:
+                c = ShuffleClient(self.executor_id,
+                                  self.transport.make_client(peer_executor_id),
+                                  self.received_catalog,
+                                  self.bounce_buffer_size)
+                self._clients[peer_executor_id] = c
+            return c
+
+    def close(self) -> None:
+        self.buffer_catalog.close()
+        self.transport.shutdown()
+
+
+class CachingShuffleWriter:
+    """Map side: register partitioned device batches in the catalog instead
+    of writing files (reference: RapidsCachingWriter.write:74-178)."""
+
+    def __init__(self, env: ShuffleEnv, shuffle_id: int, map_id: int):
+        self.env = env
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+
+    def write(self, partition_batches: List[List[DeviceBatch]]) -> MapStatus:
+        sizes = []
+        for pid, batches in enumerate(partition_batches):
+            total = 0
+            for b in batches:
+                self.env.shuffle_catalog.add_batch(
+                    self.shuffle_id, self.map_id, pid, b,
+                    priority=SpillPriorities.OUTPUT_FOR_WRITE)
+                total += b.device_memory_size()
+            sizes.append(total)
+        return MapStatus(self.env.executor_id, self.shuffle_id, self.map_id,
+                         sizes)
+
+
+class CachingShuffleReader:
+    """Reduce side: local blocks from the catalog, remote blocks fetched
+    over the transport (reference: RapidsCachingReader.scala:170 +
+    RapidsShuffleIterator.scala:46-341)."""
+
+    def __init__(self, env: ShuffleEnv):
+        self.env = env
+
+    def read(self, shuffle_id: int, partition_id: int,
+             map_statuses: List[MapStatus]) -> Iterator[DeviceBatch]:
+        # group remote blocks per peer (RapidsCachingReader groups per
+        # BlockManagerId the same way)
+        remote: Dict[str, List[Tuple[int, int, int]]] = {}
+        for ms in map_statuses:
+            if ms.executor_id == self.env.executor_id:
+                for batch in self.env.shuffle_catalog.acquire_batches(
+                        shuffle_id, ms.map_id, partition_id):
+                    yield batch
+            else:
+                remote.setdefault(ms.executor_id, []).append(
+                    (shuffle_id, ms.map_id, partition_id))
+        for peer, blocks in remote.items():
+            client = self.env.client_for(peer)
+            for bid in client.fetch_blocks(blocks):
+                yield self.env.received_catalog.acquire_batch(bid)

@@ -1,0 +1,142 @@
+"""Self-describing contiguous columnar wire format.
+
+The JCudfSerialization equivalent (reference call sites:
+GpuColumnarBatchSerializer.scala:84-212 writeToStream/readTableFrom): a
+header describing schema + buffer extents, followed by the raw column
+buffers back to back. Serializable from a device batch without any row
+conversion — the design goal the reference gets from cuDF's contiguous
+tables.
+
+Layout (little-endian):
+  magic   u32  0x54505543 ('TPUC')
+  version u32
+  nrows   u32
+  ncols   u32
+  per column:
+    name_len u16, name utf-8 bytes
+    dtype_len u8, dtype name bytes
+    data_len u64, validity_len u64, offsets_len u64
+  then per column: data bytes, validity bytes, offsets bytes
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtype as dtypes
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+
+MAGIC = 0x54505543
+VERSION = 1
+
+
+def serialize_host_table(schema: Schema, num_rows: int,
+                         columns: List[Tuple[np.ndarray, np.ndarray,
+                                             np.ndarray]]) -> bytes:
+    """columns: per column (data, validity, offsets-or-empty) numpy arrays
+    already trimmed to num_rows (strings: offsets has num_rows+1, data has
+    offsets[-1] chars)."""
+    head = [struct.pack("<IIII", MAGIC, VERSION, num_rows, len(schema))]
+    bufs = []
+    for (name, dt), (data, validity, offsets) in zip(
+            zip(schema.names, schema.dtypes), columns):
+        nb = name.encode("utf-8")
+        db = dt.name.encode("ascii")
+        data_b = data.tobytes()
+        val_b = np.packbits(validity.astype(np.bool_),
+                            bitorder="little").tobytes()
+        off_b = offsets.tobytes() if offsets is not None else b""
+        head.append(struct.pack("<H", len(nb)) + nb)
+        head.append(struct.pack("<B", len(db)) + db)
+        head.append(struct.pack("<QQQ", len(data_b), len(val_b), len(off_b)))
+        bufs.extend((data_b, val_b, off_b))
+    return b"".join(head + bufs)
+
+
+def serialize_batch(batch: DeviceBatch) -> bytes:
+    """Device batch -> wire bytes (one device->host copy of the live rows)."""
+    n = batch.num_rows_host()
+    cols = []
+    for col, dt in zip(batch.columns, batch.schema.dtypes):
+        if dt.is_string:
+            offsets = np.asarray(col.offsets[:n + 1], dtype=np.int32)
+            nchars = int(offsets[-1]) if n else 0
+            data = np.asarray(col.data[:nchars], dtype=np.uint8)
+        else:
+            offsets = None
+            data = np.ascontiguousarray(np.asarray(col.data[:n]))
+        validity = np.asarray(col.validity[:n])
+        cols.append((data, validity, offsets))
+    return serialize_host_table(batch.schema, n, cols)
+
+
+def deserialize_table(buf: bytes):
+    """wire bytes -> (schema, num_rows, [(data, validity, offsets)])
+    with numpy arrays viewing ``buf`` zero-copy where alignment allows."""
+    mv = memoryview(buf)
+    magic, version, nrows, ncols = struct.unpack_from("<IIII", mv, 0)
+    assert magic == MAGIC, "bad magic in shuffle payload"
+    assert version == VERSION, f"unsupported wire version {version}"
+    pos = 16
+    names, dts, extents = [], [], []
+    for _ in range(ncols):
+        (nlen,) = struct.unpack_from("<H", mv, pos); pos += 2
+        names.append(bytes(mv[pos:pos + nlen]).decode("utf-8")); pos += nlen
+        (dlen,) = struct.unpack_from("<B", mv, pos); pos += 1
+        dts.append(dtypes.by_name(bytes(mv[pos:pos + dlen]).decode("ascii")))
+        pos += dlen
+        extents.append(struct.unpack_from("<QQQ", mv, pos)); pos += 24
+    cols = []
+    for dt, (data_len, val_len, off_len) in zip(dts, extents):
+        if dt.is_string:
+            data = np.frombuffer(mv, dtype=np.uint8, count=data_len,
+                                 offset=pos)
+        else:
+            data = np.frombuffer(mv, dtype=dt.np_dtype,
+                                 count=data_len // dt.np_dtype.itemsize,
+                                 offset=pos)
+        pos += data_len
+        packed = np.frombuffer(mv, dtype=np.uint8, count=val_len, offset=pos)
+        validity = np.unpackbits(packed, bitorder="little")[:nrows] \
+            .astype(np.bool_)
+        pos += val_len
+        offsets = None
+        if off_len:
+            offsets = np.frombuffer(mv, dtype=np.int32, count=off_len // 4,
+                                    offset=pos)
+            pos += off_len
+        cols.append((data, validity, offsets))
+    return Schema(names, dts), nrows, cols
+
+
+def deserialize_batch(buf: bytes) -> DeviceBatch:
+    """wire bytes -> device batch (one host->device upload)."""
+    from spark_rapids_tpu.columnar.batch import bucket_capacity
+    from spark_rapids_tpu.columnar.column import DeviceColumn, _char_bucket
+    import jax.numpy as jnp
+
+    schema, nrows, cols = deserialize_table(buf)
+    cap = bucket_capacity(max(nrows, 1))
+    out = []
+    for dt, (data, validity, offsets) in zip(schema.dtypes, cols):
+        if dt.is_string:
+            strings_cap = _char_bucket(max(len(data), 1))
+            chars = np.zeros(strings_cap, np.uint8)
+            chars[:len(data)] = data
+            offs = np.zeros(cap + 1, np.int32)
+            offs[:nrows + 1] = offsets
+            offs[nrows + 1:] = offs[nrows]
+            vpad = np.zeros(cap, np.bool_)
+            vpad[:nrows] = validity
+            out.append(DeviceColumn(dt, jnp.asarray(chars), jnp.asarray(vpad),
+                                    jnp.asarray(offs)))
+        else:
+            dpad = np.zeros(cap, dt.np_dtype)
+            dpad[:nrows] = data
+            vpad = np.zeros(cap, np.bool_)
+            vpad[:nrows] = validity
+            out.append(DeviceColumn(dt, jnp.asarray(dpad), jnp.asarray(vpad)))
+    return DeviceBatch(schema, out, jnp.asarray(nrows, jnp.int32))

@@ -232,6 +232,20 @@ def _convert_scan(meta: ExecMeta, children) -> PhysicalPlan:
     return tpu.TpuScanExec(meta.plan.source, meta.plan.output_schema())
 
 
+def _tag_join(meta: ExecMeta) -> None:
+    from spark_rapids_tpu.exec.tpujoin import SUPPORTED_JOIN_TYPES
+    if meta.plan.join_type not in SUPPORTED_JOIN_TYPES:
+        meta.will_not_work(
+            f"join type {meta.plan.join_type!r} not supported on TPU")
+
+
+def _convert_join(meta: ExecMeta, children) -> PhysicalPlan:
+    from spark_rapids_tpu.exec.tpujoin import TpuShuffledHashJoinExec
+    return TpuShuffledHashJoinExec(children[0], children[1],
+                                   meta.plan.join_type, meta.plan.left_keys,
+                                   meta.plan.right_keys)
+
+
 def _tag_nothing(meta: ExecMeta) -> None:
     pass
 
@@ -255,6 +269,15 @@ _register(ExecRule(cpu.CpuShuffleExchangeExec, "columnar shuffle exchange",
                    _tag_exchange, _convert_exchange))
 _register(ExecRule(cpu.CpuScanExec, "columnar scan",
                    _tag_scan, _convert_scan))
+_register(ExecRule(cpu.CpuJoinExec, "shuffled hash join",
+                   _tag_join, _convert_join))
+def _convert_broadcast(meta: ExecMeta, children) -> PhysicalPlan:
+    from spark_rapids_tpu.exec.tpujoin import TpuBroadcastExchangeExec
+    return TpuBroadcastExchangeExec(children[0])
+
+
+_register(ExecRule(cpu.CpuBroadcastExchangeExec, "broadcast exchange",
+                   _tag_nothing, _convert_broadcast))
 _register(ExecRule(cpu.CpuLocalLimitExec, "local limit", _tag_nothing,
                    lambda m, ch: tpu.TpuLocalLimitExec(ch[0], m.plan.limit)))
 _register(ExecRule(cpu.CpuGlobalLimitExec, "global limit", _tag_nothing,

@@ -27,6 +27,13 @@ class LogicalPlan:
     def name(self) -> str:
         return type(self).__name__
 
+    def estimated_size_bytes(self):
+        """Broadcast-join size hint. Narrow operators pass their child's
+        estimate through; anything width-changing returns unknown."""
+        if len(self.children) == 1:
+            return self.children[0].estimated_size_bytes()
+        return None
+
 
 class LogicalScan(LogicalPlan):
     def __init__(self, source):
@@ -35,6 +42,9 @@ class LogicalScan(LogicalPlan):
 
     def schema(self) -> Schema:
         return self.source.schema
+
+    def estimated_size_bytes(self):
+        return self.source.estimated_size_bytes()
 
 
 class LogicalRange(LogicalPlan):

@@ -91,14 +91,28 @@ class Planner:
         from spark_rapids_tpu.sql.exprs.core import BoundRef
         lidx, left = _key_indices(left, lkeys, ls)
         ridx, right = _key_indices(right, rkeys, rs)
-        if node.join_type != "cross":
+        jt = node.join_type
+        # broadcast the build side when its estimate fits under the
+        # threshold (reference: GpuBroadcastHashJoinExec; build side is the
+        # non-preserved side, so full outer never broadcasts)
+        threshold = self.conf.broadcast_threshold
+        build_node = node.children[0] if jt == "right" else node.children[1]
+        est = build_node.estimated_size_bytes()
+        can_broadcast = (jt != "full" and threshold >= 0 and est is not None
+                         and est <= threshold)
+        if can_broadcast:
+            if jt == "right":
+                left = cpu.CpuBroadcastExchangeExec(left)
+            else:
+                right = cpu.CpuBroadcastExchangeExec(right)
+        elif jt != "cross":
             n = self.conf.shuffle_partitions
             left = cpu.CpuShuffleExchangeExec(left, ("hash", lidx, n))
             right = cpu.CpuShuffleExchangeExec(right, ("hash", ridx, n))
         else:
             left = cpu.CpuShuffleExchangeExec(left, ("single",))
             right = cpu.CpuShuffleExchangeExec(right, ("single",))
-        return cpu.CpuJoinExec(left, right, node.join_type, lidx, ridx)
+        return cpu.CpuJoinExec(left, right, jt, lidx, ridx)
 
     def _plan_LogicalUnion(self, node: lp.LogicalUnion) -> PhysicalPlan:
         return cpu.CpuUnionExec([self.plan(c) for c in node.children])

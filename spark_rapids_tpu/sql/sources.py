@@ -26,6 +26,10 @@ class DataSource:
     def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
         raise NotImplementedError
 
+    def estimated_size_bytes(self) -> Optional[int]:
+        """Size hint for broadcast-join planning (None = unknown)."""
+        return None
+
 
 class InMemorySource(DataSource):
     """createDataFrame equivalent: a pandas DataFrame split into partitions."""
@@ -37,6 +41,11 @@ class InMemorySource(DataSource):
 
     def describe(self) -> str:
         return f"InMemory[{len(self.df)} rows x {len(self.df.columns)} cols]"
+
+    def estimated_size_bytes(self) -> Optional[int]:
+        # deep=True so object/string columns count their payload, not just
+        # the 8-byte pointers — a shallow count broadcasts huge tables
+        return int(self.df.memory_usage(deep=True).sum())
 
     def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
         n = len(self.df)
@@ -82,6 +91,10 @@ class ParquetSource(DataSource):
 
     def describe(self) -> str:
         return f"Parquet[{len(self.paths)} files, {len(self.splits)} row groups]"
+
+    def estimated_size_bytes(self) -> Optional[int]:
+        import os
+        return sum(os.path.getsize(p) for p in self.paths)
 
     def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
         pq = self._pq

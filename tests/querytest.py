@@ -48,13 +48,14 @@ def _normalize(df: pd.DataFrame, ignore_order: bool) -> pd.DataFrame:
     out = df.copy()
     if ignore_order and len(out):
         key_cols = []
-        for c in out.columns:
-            s = out[c]
+        for i in range(out.shape[1]):
+            s = out.iloc[:, i]
             try:
-                arr = s.astype("float64")
+                arr = pd.to_numeric(s, errors="raise").astype("float64")
                 key_cols.append(np.where(s.isna(), np.inf, arr))
             except (TypeError, ValueError):
-                key_cols.append(s.astype(str).fillna("\x00").to_numpy())
+                key_cols.append(s.map(
+                    lambda x: "\x00" if pd.isna(x) else str(x)).to_numpy())
         order = np.lexsort(list(reversed(key_cols)))
         out = out.iloc[order].reset_index(drop=True)
     return out
@@ -67,8 +68,9 @@ def assert_frames_equal(tpu_df: pd.DataFrame, cpu_df: pd.DataFrame,
     assert len(tpu_df) == len(cpu_df), (len(tpu_df), len(cpu_df))
     t = _normalize(tpu_df, ignore_order)
     c = _normalize(cpu_df, ignore_order)
-    for col in t.columns:
-        ts, cs = t[col], c[col]
+    for ci in range(t.shape[1]):
+        col = t.columns[ci]
+        ts, cs = t.iloc[:, ci], c.iloc[:, ci]
         tn = ts.isna().to_numpy()
         cn = cs.isna().to_numpy()
         np.testing.assert_array_equal(tn, cn,

@@ -1,0 +1,160 @@
+"""Differential join tests, TPU vs CPU (the reference's Ring-1/Ring-3 join
+coverage: tests/.../JoinsSuite, integration_tests join_test.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from tests.querytest import assert_tpu_and_cpu_equal
+
+JOIN_TYPES = ["inner", "left", "right", "full", "leftsemi", "leftanti"]
+
+
+def _orders_df(rng, n=300):
+    return pd.DataFrame({
+        "o_id": np.arange(n, dtype=np.int64),
+        "cust": pd.Series(rng.integers(0, 40, n)).astype("Int64")
+                  .mask(pd.Series(rng.random(n) < 0.08)),
+        "amount": rng.uniform(1.0, 900.0, n),
+    })
+
+
+def _cust_df(rng, n=45):
+    return pd.DataFrame({
+        "cust": pd.Series(rng.integers(0, 50, n)).astype("Int64")
+                  .mask(pd.Series(rng.random(n) < 0.05)),
+        "name": pd.Series([f"cust_{i}" for i in range(n)]),
+        "tier": rng.integers(0, 3, n),
+    })
+
+
+NO_BROADCAST = {"spark.rapids.sql.autoBroadcastJoinThreshold": "-1"}
+
+
+@pytest.mark.parametrize("how", JOIN_TYPES)
+@pytest.mark.parametrize("conf", [None, NO_BROADCAST],
+                         ids=["broadcast", "shuffled"])
+def test_join_int_key(session, rng, how, conf):
+    odf, cdf = _orders_df(rng), _cust_df(rng)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(odf, 3).join(
+            s.create_dataframe(cdf, 2), on="cust", how=how), conf=conf)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "leftsemi", "leftanti"])
+@pytest.mark.parametrize("conf", [None, NO_BROADCAST],
+                         ids=["broadcast", "shuffled"])
+def test_join_string_key(session, rng, how, conf):
+    n = 200
+    left = pd.DataFrame({
+        "k": pd.Series([f"key_{rng.integers(0, 30)}" for _ in range(n)]),
+        "v": rng.integers(0, 1000, n),
+    })
+    right = pd.DataFrame({
+        "k": pd.Series([f"key_{i}" for i in range(40)]),
+        "w": rng.uniform(0, 1, 40),
+    })
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(left, 2).join(
+            s.create_dataframe(right, 2), on="k", how=how), conf=conf)
+
+
+def test_join_multi_key(session, rng):
+    n = 250
+    left = pd.DataFrame({
+        "a": rng.integers(0, 10, n),
+        "b": pd.Series([["x", "y", "z"][i % 3] for i in range(n)]),
+        "v": rng.uniform(0, 10, n),
+    })
+    right = pd.DataFrame({
+        "a": rng.integers(0, 12, 60),
+        "b": pd.Series([["x", "y", "w"][i % 3] for i in range(60)]),
+        "u": rng.integers(0, 5, 60),
+    })
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(left, 3).join(
+            s.create_dataframe(right, 2), on=["a", "b"], how="inner"))
+
+
+def test_cross_join(session, rng):
+    left = pd.DataFrame({"x": np.arange(17, dtype=np.int64)})
+    right = pd.DataFrame({"y": np.arange(9, dtype=np.int64),
+                          "s": [f"r{i}" for i in range(9)]})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(left, 2).join(
+            s.create_dataframe(right, 1), on=None, how="cross"))
+
+
+def test_join_empty_build_side(session, rng):
+    left = pd.DataFrame({"k": np.arange(20, dtype=np.int64),
+                         "v": rng.uniform(0, 1, 20)})
+    right = pd.DataFrame({"k": np.empty(0, dtype=np.int64),
+                          "w": np.empty(0, dtype=np.float64)})
+    for how in ("inner", "left", "leftsemi", "leftanti"):
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(left, 2).join(
+                s.create_dataframe(right, 1), on="k", how=how))
+
+
+def test_join_all_null_keys(session):
+    left = pd.DataFrame({
+        "k": pd.Series([None] * 10, dtype="Int64"),
+        "v": np.arange(10, dtype=np.int64)})
+    right = pd.DataFrame({
+        "k": pd.Series([None, 1, 2], dtype="Int64"),
+        "w": np.arange(3, dtype=np.int64)})
+    for how in ("inner", "left", "full", "leftanti"):
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(left, 1).join(
+                s.create_dataframe(right, 1), on="k", how=how))
+
+
+def test_join_duplicate_heavy(session, rng):
+    """Many-to-many expansion (skewed keys)."""
+    n = 150
+    left = pd.DataFrame({"k": rng.integers(0, 3, n), "v": np.arange(n)})
+    right = pd.DataFrame({"k": rng.integers(0, 3, 80), "w": np.arange(80)})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(left, 2).join(
+            s.create_dataframe(right, 2), on="k", how="inner"))
+
+
+def test_join_then_aggregate(session, rng):
+    """join -> groupby pipeline (the TPC-H shape)."""
+    odf, cdf = _orders_df(rng), _cust_df(rng)
+
+    def q(s):
+        o = s.create_dataframe(odf, 3)
+        c = s.create_dataframe(cdf, 2)
+        return (o.join(c, on="cust", how="inner")
+                .group_by("tier")
+                .agg(F.sum("amount").alias("total"),
+                     F.count("*").alias("cnt"))
+                .order_by("tier"))
+    assert_tpu_and_cpu_equal(q, approx=True)
+
+
+def test_join_float_key(session, rng):
+    n = 120
+    vals = rng.integers(0, 15, n).astype(np.float64)
+    left = pd.DataFrame({"k": vals, "v": np.arange(n)})
+    right = pd.DataFrame({"k": np.arange(15, dtype=np.float64),
+                          "w": rng.uniform(0, 1, 15)})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(left, 2).join(
+            s.create_dataframe(right, 1), on="k", how="inner"))
+
+
+def test_join_date_key(session, rng):
+    base = np.datetime64("2020-01-01")
+    n = 100
+    left = pd.DataFrame({
+        "d": base + rng.integers(0, 20, n).astype("timedelta64[D]"),
+        "v": np.arange(n)})
+    right = pd.DataFrame({
+        "d": base + np.arange(25).astype("timedelta64[D]"),
+        "w": np.arange(25)})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(left, 2).join(
+            s.create_dataframe(right, 1), on="d", how="left"))
