@@ -256,6 +256,35 @@ class CpuShuffleExchangeExec(PhysicalPlan):
         raise ValueError(f"unknown partitioning {kind}")
 
 
+def _assemble_join(ldf: pd.DataFrame, rdf: pd.DataFrame, ls: Schema,
+                   rs: Schema, lrow: np.ndarray,
+                   rrow: np.ndarray) -> pd.DataFrame:
+    """Build join output columns by gathering original-side values at the
+    pair indices; -1 marks a missing side (outer join null)."""
+    series = []
+    for df, schema, rows in ((ldf, ls, lrow), (rdf, rs, rrow)):
+        present = rows >= 0
+        safe = np.clip(rows, 0, max(len(df) - 1, 0))
+        for i, dt in enumerate(schema.dtypes):
+            vals, validity, _ = host_unary_values(df.iloc[:, i])
+            if len(df):
+                out_v = vals[safe]
+                out_m = validity[safe] & present
+            else:
+                out_v = np.empty(len(rows),
+                                 dtype=object if dt.is_string else dt.np_dtype)
+                out_m = np.zeros(len(rows), np.bool_)
+            if dt.is_string and (~out_m).any():
+                out_v = out_v.copy()
+                out_v[~out_m] = None
+            series.append(_numpy_to_pandas(out_v, out_m, dt)
+                          .reset_index(drop=True))
+    out = pd.concat(series, axis=1) if series else pd.DataFrame(
+        index=range(len(lrow)))
+    out.columns = list(ls.names) + list(rs.names)
+    return out
+
+
 class CpuBroadcastExchangeExec(PhysicalPlan):
     """Collects the child once and shares it with every consumer partition
     (reference: GpuBroadcastExchangeExec.scala:47-178 collects child batches
@@ -418,6 +447,41 @@ class CpuRangeExec(PhysicalPlan):
         return [make(i) for i in range(self.num_partitions)]
 
 
+class CpuExpandExec(PhysicalPlan):
+    """One output row per (input row x projection set)."""
+
+    def __init__(self, child: PhysicalPlan, projections):
+        super().__init__([child])
+        self.projections = [list(p) for p in projections]
+
+    def output_schema(self) -> Schema:
+        cs = self.children[0].output_schema()
+        first = self.projections[0]
+        return Schema([n for n, _ in first],
+                      [e.dtype(cs) for _, e in first])
+
+    def describe(self) -> str:
+        return f"CpuExpandExec({len(self.projections)} sets)"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+        names = [n for n, _ in self.projections[0]]
+
+        def make(part: Partition) -> Partition:
+            def run():
+                for df in part():
+                    for proj in self.projections:
+                        out = {}
+                        for j, (name, e) in enumerate(proj):
+                            out[j] = e.eval_host(df).reset_index(drop=True)
+                        frame = pd.concat(out.values(), axis=1) if out else \
+                            pd.DataFrame(index=range(len(df)))
+                        frame.columns = names
+                        yield frame
+            return run
+        return [make(p) for p in child_parts]
+
+
 class CpuJoinExec(PhysicalPlan):
     """Equi-join via pandas merge with SQL null-key semantics (null keys
     never match). join_type: inner, left, right, full, leftsemi, leftanti,
@@ -464,60 +528,64 @@ class CpuJoinExec(PhysicalPlan):
         return [make(lp, rp) for lp, rp in zip(left_parts, right_parts)]
 
     def _join(self, ldf: pd.DataFrame, rdf: pd.DataFrame) -> pd.DataFrame:
+        """Gather-based assembly: pandas merge only produces the
+        (left_row, right_row) pair list; output columns are rebuilt from
+        the ORIGINAL frames so missing-side values are true NULLs, never
+        the NaN a pandas-merge upcast would fabricate (NaN is a SQL value
+        in this engine's null discipline, batch.py)."""
         ls = self.children[0].output_schema()
         rs = self.children[1].output_schema()
-        # unique working column names
-        lwork = ldf.copy()
-        rwork = rdf.copy()
-        lwork.columns = [f"_l{i}" for i in range(len(ldf.columns))]
-        rwork.columns = [f"_r{i}" for i in range(len(rdf.columns))]
-        lkeys = [f"_l{i}" for i in self.left_keys]
-        rkeys = [f"_r{i}" for i in self.right_keys]
-        lvalid = np.ones(len(lwork), np.bool_)
-        for k in lkeys:
-            lvalid &= host_unary_values(lwork[k])[1]
-        rvalid = np.ones(len(rwork), np.bool_)
-        for k in rkeys:
-            rvalid &= host_unary_values(rwork[k])[1]
+        nl, nr = len(ldf), len(rdf)
+        lkey_frame = pd.DataFrame(
+            {f"k{j}": ldf.iloc[:, i].reset_index(drop=True)
+             for j, i in enumerate(self.left_keys)})
+        rkey_frame = pd.DataFrame(
+            {f"k{j}": rdf.iloc[:, i].reset_index(drop=True)
+             for j, i in enumerate(self.right_keys)})
+        lvalid = np.ones(nl, np.bool_)
+        for c in range(lkey_frame.shape[1]):
+            lvalid &= host_unary_values(lkey_frame.iloc[:, c])[1]
+        rvalid = np.ones(nr, np.bool_)
+        for c in range(rkey_frame.shape[1]):
+            rvalid &= host_unary_values(rkey_frame.iloc[:, c])[1]
+        lkey_frame["_lrow"] = np.arange(nl, dtype=np.int64)
+        rkey_frame["_rrow"] = np.arange(nr, dtype=np.int64)
+        keys = [f"k{j}" for j in range(len(self.left_keys))]
 
         jt = self.join_type
         if jt == "cross":
-            merged = lwork.merge(rwork, how="cross")
-        elif jt in ("leftsemi", "leftanti"):
-            rk = rwork[rvalid][rkeys].drop_duplicates()
-            m = lwork[lvalid].merge(rk, left_on=lkeys, right_on=rkeys,
-                                    how="inner")[lwork.columns]
+            lrow = np.repeat(np.arange(nl, dtype=np.int64), nr)
+            rrow = np.tile(np.arange(nr, dtype=np.int64), nl)
+            return _assemble_join(ldf, rdf, ls, rs, lrow, rrow)
+
+        lm = lkey_frame[lvalid]
+        rm = rkey_frame[rvalid]
+        if jt in ("leftsemi", "leftanti"):
+            rk = rm[keys].drop_duplicates()
+            hit = lm.merge(rk, on=keys, how="inner")["_lrow"].to_numpy()
             if jt == "leftsemi":
-                merged = m
+                keep = np.zeros(nl, np.bool_)
+                keep[hit] = True
             else:
-                matched = lwork[lvalid].merge(
-                    rk, left_on=lkeys, right_on=rkeys, how="left",
-                    indicator=True)
-                keep_valid = lwork[lvalid][
-                    (matched["_merge"] == "left_only").to_numpy()]
-                merged = pd.concat([keep_valid, lwork[~lvalid]],
-                                   ignore_index=True)
-            out = merged.copy()
-            out.columns = list(ls.names)
-            return out.reset_index(drop=True)
-        else:
-            how = {"inner": "inner", "left": "left", "right": "right",
-                   "full": "outer"}[jt]
-            lm = lwork[lvalid]
-            rm = rwork[rvalid]
-            merged = lm.merge(rm, left_on=lkeys, right_on=rkeys, how=how)
-            # null-keyed rows: re-append for outer sides
-            if jt in ("left", "full") and (~lvalid).any():
-                nulls = lwork[~lvalid].copy()
-                for c in rwork.columns:
-                    nulls[c] = pd.NA
-                merged = pd.concat([merged, nulls], ignore_index=True)
-            if jt in ("right", "full") and (~rvalid).any():
-                nulls = rwork[~rvalid].copy()
-                for c in lwork.columns:
-                    nulls[c] = pd.NA
-                nulls = nulls[list(merged.columns)]
-                merged = pd.concat([merged, nulls], ignore_index=True)
-        out = merged.copy()
-        out.columns = list(ls.names) + list(rs.names)
-        return out.reset_index(drop=True)
+                keep = np.ones(nl, np.bool_)
+                keep[hit] = False
+            return ldf[keep].reset_index(drop=True)
+
+        how = {"inner": "inner", "left": "left", "right": "right",
+               "full": "outer"}[jt]
+        merged = lm.merge(rm, on=keys, how=how)
+        lrow = merged["_lrow"].to_numpy(dtype=np.float64, na_value=-1) \
+            .astype(np.int64)
+        rrow = merged["_rrow"].to_numpy(dtype=np.float64, na_value=-1) \
+            .astype(np.int64)
+        # null-keyed rows re-appended for preserved sides (null never
+        # matches but outer joins keep the row)
+        if jt in ("left", "full") and (~lvalid).any():
+            extra = np.flatnonzero(~lvalid).astype(np.int64)
+            lrow = np.concatenate([lrow, extra])
+            rrow = np.concatenate([rrow, np.full(len(extra), -1, np.int64)])
+        if jt in ("right", "full") and (~rvalid).any():
+            extra = np.flatnonzero(~rvalid).astype(np.int64)
+            lrow = np.concatenate([lrow, np.full(len(extra), -1, np.int64)])
+            rrow = np.concatenate([rrow, extra])
+        return _assemble_join(ldf, rdf, ls, rs, lrow, rrow)

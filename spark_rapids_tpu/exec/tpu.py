@@ -347,6 +347,45 @@ class TpuRangeExec(TpuExec):
         return [make(i) for i in range(self.num_partitions)]
 
 
+class TpuExpandExec(TpuExec):
+    """reference: GpuExpandExec (GpuExpandExec.scala:202) — one jitted
+    projection kernel per set, each input batch replayed through all of
+    them."""
+
+    def __init__(self, child: PhysicalPlan, projections):
+        super().__init__([child])
+        self.projections = [list(p) for p in projections]
+        self._kernels = []
+        for pi, proj in enumerate(self.projections):
+            names = [n for n, _ in proj]
+            bound = [e for _, e in proj]
+            sig = f"expand{pi}|" + "|".join(
+                f"{n}={expr_signature(e)}" for n, e in proj)
+            self._kernels.append(cached_jit(sig, lambda bound=bound,
+                                            names=names: jax.jit(
+                lambda batch: eval_projection(batch, bound, names))))
+
+    def output_schema(self) -> Schema:
+        cs = self.children[0].output_schema()
+        first = self.projections[0]
+        return Schema([n for n, _ in first],
+                      [e.dtype(cs) for _, e in first])
+
+    def describe(self) -> str:
+        return f"TpuExpandExec({len(self.projections)} sets)"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                for batch in part():
+                    for kern in self._kernels:
+                        yield kern(batch)
+            return run
+        return [make(p) for p in child_parts]
+
+
 class TpuScanExec(TpuExec):
     """Columnar scan: host-side decode (pyarrow/pandas — the reference also
     parses footers and rebuilds file buffers on the CPU,

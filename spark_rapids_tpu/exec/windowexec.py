@@ -1,0 +1,346 @@
+"""Window physical operators (reference: GpuWindowExec.scala:202 and the
+window parts of Spark's WindowExec for the CPU oracle).
+
+Both sides share the descriptor resolution in ``resolve_descriptor`` so
+the differential tests compare identical frame semantics. The CPU exec
+mirrors the device kernel's sorted-domain math in numpy — positions,
+segment starts, prefix sums — rather than pandas rolling, so null
+semantics match exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema, _numpy_to_pandas
+from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
+from spark_rapids_tpu.sql.exprs.aggregates import (
+    Average, Count, Max, Min, Sum,
+)
+from spark_rapids_tpu.sql.exprs.core import Expression
+from spark_rapids_tpu.sql.exprs.hostutil import host_unary_values
+from spark_rapids_tpu.sql.functions import SortOrder
+from spark_rapids_tpu.sql.window import (
+    CURRENT_ROW, DenseRank, LeadLag, Rank, RowNumber, UNBOUNDED_FOLLOWING,
+    UNBOUNDED_PRECEDING, WindowExpression,
+)
+
+_AGG_KINDS = {Sum: "sum", Count: "count", Min: "min", Max: "max",
+              Average: "avg"}
+
+
+def resolve_descriptor(wexpr: WindowExpression, schema: Schema):
+    """-> (descriptor_without_value_index, value_expr_or_None, tpu_error).
+    ``tpu_error`` marks TPU-capability gaps only — the CPU oracle executes
+    any non-None descriptor (the fallback path must work, the reference's
+    willNotWorkOnGpu contract). A None descriptor is unsupported anywhere.
+    The value index is assigned by the exec once it lays out the work
+    batch."""
+    fn = wexpr.fn
+    if isinstance(fn, RowNumber):
+        return ("row_number",), None, None
+    if isinstance(fn, Rank):
+        return ("rank",), None, None
+    if isinstance(fn, DenseRank):
+        return ("dense_rank",), None, None
+    if isinstance(fn, LeadLag):
+        if fn.default is not None:
+            return None, None, "lead/lag with a default value is not supported"
+        off = fn.offset if fn.is_lead else -fn.offset
+        child = fn.children[0]
+        err = None
+        if child.dtype(schema).is_string:
+            err = "lead/lag over strings is not supported on TPU"
+        return ("leadlag", None, off, child.dtype(schema).name), child, err
+    kind = _AGG_KINDS.get(type(fn))
+    if kind is None:
+        return None, None, (f"window function {fn.pretty_name} "
+                            "is not supported")
+    child = fn.children[0]
+    frame_kind, lo, hi = wexpr.spec.resolved_frame(is_ranking=False)
+    bounded = lo > UNBOUNDED_PRECEDING or (CURRENT_ROW < hi <
+                                           UNBOUNDED_FOLLOWING)
+    if frame_kind == "range" and (lo > UNBOUNDED_PRECEDING
+                                  or (hi != CURRENT_ROW
+                                      and hi < UNBOUNDED_FOLLOWING)):
+        return None, None, "bounded RANGE frames are not supported"
+    err = None
+    if child.dtype(schema).is_string:
+        err = f"window {kind} over strings is not supported on TPU"
+    elif frame_kind == "rows" and bounded and kind in ("min", "max"):
+        err = ("min/max over bounded ROW frames is not supported on TPU "
+               "(no prefix-difference form)")
+    return ("agg", kind, None, frame_kind, lo, hi,
+            wexpr.dtype(schema).name), child, err
+
+
+class CpuWindowExec(PhysicalPlan):
+    """CPU oracle: numpy mirror of the device window math."""
+
+    def __init__(self, child: PhysicalPlan,
+                 window_exprs: List[Tuple[str, WindowExpression]]):
+        super().__init__([child])
+        self.window_exprs = list(window_exprs)
+
+    def output_schema(self) -> Schema:
+        cs = self.children[0].output_schema()
+        names = list(cs.names) + [n for n, _ in self.window_exprs]
+        dts = list(cs.dtypes) + [w.dtype(cs) for _, w in self.window_exprs]
+        return Schema(names, dts)
+
+    def describe(self) -> str:
+        return f"CpuWindowExec([{', '.join(n for n, _ in self.window_exprs)}])"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+        from spark_rapids_tpu.exec.cpu import _concat_parts
+
+        def make(part: Partition) -> Partition:
+            def run():
+                df = _concat_parts(part(), self.children[0].output_schema())
+                yield self._compute(df)
+            return run
+        return [make(p) for p in child_parts]
+
+    def _compute(self, df: pd.DataFrame) -> pd.DataFrame:
+        from spark_rapids_tpu.exec.cpu import host_sort_indices
+        cs = self.children[0].output_schema()
+        spec = self.window_exprs[0][1].spec
+        orders = ([SortOrder(e, True, True) for e in spec.partition_cols]
+                  + list(spec.orders))
+        idx = host_sort_indices(df, orders)
+        sdf = df.iloc[idx].reset_index(drop=True)
+        n = len(sdf)
+        pos = np.arange(n, dtype=np.int64)
+
+        def key_tuple(exprs):
+            cols = []
+            for e in exprs:
+                vals, validity, _ = host_unary_values(e.eval_host(sdf))
+                cols.append((vals, validity))
+            return cols
+
+        def boundaries(cols):
+            b = np.zeros(n, dtype=bool)
+            if n:
+                b[0] = True
+            for vals, validity in cols:
+                if n <= 1:
+                    continue
+                prev_v, cur_v = vals[:-1], vals[1:]
+                prev_m, cur_m = validity[:-1], validity[1:]
+                if vals.dtype.kind == "f":
+                    eq = (prev_v == cur_v) | (np.isnan(prev_v.astype(float))
+                                              & np.isnan(cur_v.astype(float)))
+                else:
+                    eq = prev_v == cur_v
+                same = (prev_m == cur_m) & (eq | ~prev_m)
+                b[1:] |= ~same
+            return b
+
+        if n == 0:
+            from spark_rapids_tpu.exec.cpu import _empty_df
+            return _empty_df(self.output_schema())
+
+        part_cols = key_tuple(spec.partition_cols)
+        order_cols = key_tuple([o.expr for o in spec.orders])
+        part_b = boundaries(part_cols) if spec.partition_cols else \
+            (np.arange(n) == 0)
+        peer_b = part_b | boundaries(part_cols + order_cols)
+
+        seg = np.cumsum(part_b) - 1
+        peer = np.cumsum(peer_b) - 1
+
+        def group_bound(ids, reduce_at, init):
+            acc = np.full(ids.max() + 1, init, np.int64)
+            reduce_at(acc, ids, pos)
+            return acc[ids]
+
+        seg_start = group_bound(seg, np.minimum.at, n)
+        seg_end = group_bound(seg, np.maximum.at, -1)
+        peer_end = group_bound(peer, np.maximum.at, -1)
+
+        result_series = list(sdf.iloc[:, i] for i in range(len(cs)))
+        for name, wexpr in self.window_exprs:
+            desc, value_expr, _tpu_err = resolve_descriptor(wexpr, cs)
+            if desc is None:
+                raise NotImplementedError(_tpu_err)
+            dt = wexpr.dtype(cs)
+            if value_expr is not None:
+                v, m, _ = host_unary_values(value_expr.eval_host(sdf))
+            kind = desc[0]
+            if kind == "row_number":
+                data, validity = pos - seg_start + 1, np.ones(n, bool)
+            elif kind == "rank":
+                peer_start = group_bound(peer, np.minimum.at, n)
+                data = peer_start - seg_start + 1
+                validity = np.ones(n, bool)
+            elif kind == "dense_rank":
+                pb = np.cumsum(peer_b)
+                data = pb - pb[seg_start] + 1
+                validity = np.ones(n, bool)
+            elif kind == "leadlag":
+                off = desc[2]
+                src = pos + off
+                ok = (src >= seg_start) & (src <= seg_end)
+                src_c = np.clip(src, 0, n - 1)
+                data = np.where(ok, v[src_c], np.zeros_like(v[src_c]))
+                validity = ok & m[src_c]
+            else:
+                _, agg_kind, _, frame_kind, lo, hi, _ = desc
+                mm = m.copy()
+                if frame_kind == "range":
+                    f_lo, f_hi = seg_start, (
+                        seg_end if hi >= UNBOUNDED_FOLLOWING else peer_end)
+                else:
+                    f_lo = (seg_start if lo <= UNBOUNDED_PRECEDING
+                            else np.maximum(pos + lo, seg_start))
+                    f_hi = (seg_end if hi >= UNBOUNDED_FOLLOWING
+                            else np.minimum(pos + hi, seg_end))
+                empty = f_hi < f_lo
+                f_lo_c = np.clip(f_lo, 0, max(n - 1, 0))
+                f_hi_c = np.clip(f_hi, -1, max(n - 1, 0))
+                cnt_p = np.concatenate([[0], np.cumsum(mm.astype(np.int64))])
+                fcount = np.where(empty, 0, cnt_p[f_hi_c + 1] - cnt_p[f_lo_c])
+                if agg_kind == "count":
+                    data, validity = fcount, np.ones(n, bool)
+                elif agg_kind in ("sum", "avg"):
+                    acc = np.where(mm, v, 0).astype(
+                        np.float64 if (dt.is_floating or agg_kind == "avg")
+                        else np.int64)
+                    sp = np.concatenate([[0], np.cumsum(acc)])
+                    s = np.where(empty, 0, sp[f_hi_c + 1] - sp[f_lo_c])
+                    data = (s / np.maximum(fcount, 1) if agg_kind == "avg"
+                            else s)
+                    validity = fcount > 0
+                else:  # min/max cumulative or whole partition
+                    if v.dtype.kind == "f":
+                        neutral = np.inf if agg_kind == "min" else -np.inf
+                    elif v.dtype == np.bool_:
+                        v = v.astype(np.int64)
+                        neutral = 1 if agg_kind == "min" else 0
+                    else:
+                        ii = np.iinfo(v.dtype if v.dtype.kind in "iu"
+                                      else np.int64)
+                        neutral = ii.max if agg_kind == "min" else ii.min
+                    pre = np.where(mm, v, neutral).astype(np.float64
+                                                          if v.dtype.kind == "f"
+                                                          else np.int64)
+                    fn_ = np.minimum if agg_kind == "min" else np.maximum
+                    whole = (lo <= UNBOUNDED_PRECEDING
+                             and hi >= UNBOUNDED_FOLLOWING)
+                    if whole or frame_kind == "range":
+                        scan = pre.copy()
+                        for i in range(1, n):
+                            if not part_b[i]:
+                                scan[i] = fn_(scan[i - 1], scan[i])
+                        data = (scan[seg_end] if whole
+                                else scan[np.clip(peer_end, 0, n - 1)])
+                    else:
+                        # bounded ROW frame: direct per-row reduction (CPU
+                        # oracle only; the TPU path tags this off)
+                        red = np.min if agg_kind == "min" else np.max
+                        data = np.full(n, neutral, pre.dtype)
+                        for i in range(n):
+                            if f_hi[i] >= f_lo[i]:
+                                data[i] = red(pre[f_lo_c[i]:f_hi_c[i] + 1])
+                    validity = fcount > 0
+            result_series.append(_numpy_to_pandas(
+                np.asarray(data).astype(dt.np_dtype, copy=False),
+                np.asarray(validity), dt))
+        out_schema = self.output_schema()
+        frame = pd.concat([s.reset_index(drop=True)
+                           for s in result_series], axis=1)
+        frame.columns = list(out_schema.names)
+        return frame
+
+
+class TpuWindowExec(PhysicalPlan):
+    """Device window stage: one fused kernel over a single concatenated
+    batch per partition (reference: GpuWindowExec requires the partition's
+    batches coalesced the same way)."""
+
+    columnar_output = True
+
+    def __init__(self, child: PhysicalPlan,
+                 window_exprs: List[Tuple[str, WindowExpression]]):
+        super().__init__([child])
+        self.window_exprs = list(window_exprs)
+
+    def output_schema(self) -> Schema:
+        cs = self.children[0].output_schema()
+        names = list(cs.names) + [n for n, _ in self.window_exprs]
+        dts = list(cs.dtypes) + [w.dtype(cs) for _, w in self.window_exprs]
+        return Schema(names, dts)
+
+    def describe(self) -> str:
+        return f"TpuWindowExec([{', '.join(n for n, _ in self.window_exprs)}])"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        from spark_rapids_tpu.exec.tpu import _concat_device
+        from spark_rapids_tpu.ops.windowops import window_compute
+        from spark_rapids_tpu.sql.exprs.evalbridge import (
+            make_context, to_device_column,
+        )
+        from spark_rapids_tpu.utils.kernelcache import cached_jit, expr_signature
+
+        cs = self.children[0].output_schema()
+        out_schema = self.output_schema()
+        spec = self.window_exprs[0][1].spec
+        nc = len(cs)
+
+        # resolve descriptors and collect value expressions
+        descs, value_exprs = [], []
+        for _, w in self.window_exprs:
+            desc, vexpr, err = resolve_descriptor(w, cs)
+            assert err is None, err
+            if vexpr is not None:
+                vidx = nc + len(spec.partition_cols) + len(spec.orders) \
+                    + len(value_exprs)
+                value_exprs.append(vexpr)
+                if desc[0] == "leadlag":
+                    desc = (desc[0], vidx) + desc[2:]
+                else:
+                    desc = desc[:2] + (vidx,) + desc[3:]
+            descs.append(desc)
+        descs = tuple(descs)
+        part_idx = tuple(range(nc, nc + len(spec.partition_cols)))
+        order_idx = tuple(range(nc + len(spec.partition_cols),
+                                nc + len(spec.partition_cols)
+                                + len(spec.orders)))
+        order_asc = tuple(o.ascending for o in spec.orders)
+        order_nf = tuple(o.nulls_first for o in spec.orders)
+        extra = (list(spec.partition_cols)
+                 + [o.expr for o in spec.orders] + value_exprs)
+
+        def kernel(batch: DeviceBatch) -> DeviceBatch:
+            ctx_ = make_context(batch)
+            cols = list(batch.columns)
+            names = list(batch.schema.names)
+            dts = list(batch.schema.dtypes)
+            for i, e in enumerate(extra):
+                c = to_device_column(ctx_, e.eval_device(ctx_))
+                cols.append(c)
+                names.append(f"_w{i}")
+                dts.append(c.dtype)
+            work = DeviceBatch(Schema(names, dts), cols, batch.num_rows)
+            return window_compute(work, nc, part_idx, order_idx, order_asc,
+                                  order_nf, descs, out_schema)
+        sig = ("window|" + "|".join(map(str, descs)) + "|"
+               + "|".join(expr_signature(e) for e in extra))
+        kern = cached_jit(sig, lambda: jax.jit(kernel))
+        growth = ctx.conf.capacity_growth
+        child_parts = self.children[0].partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                batches = list(part())
+                merged = _concat_device(batches, cs, growth)
+                yield kern(merged)
+            return run
+        return [make(p) for p in child_parts]

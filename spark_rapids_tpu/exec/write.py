@@ -1,0 +1,194 @@
+"""Data-writing command execs: Parquet/CSV output with a commit protocol.
+
+reference: GpuDataWritingCommandExec (94), ColumnarOutputWriter (183),
+GpuFileFormatWriter (338), GpuParquetFileFormat / GpuOrcFileFormat — the
+accelerator writes columnar batches straight to the file format and the
+commit protocol (task temp dir -> atomic rename + _SUCCESS) comes from
+Spark. Here the device batch's columns convert to one arrow table per
+batch (device->host is the only copy) and pyarrow encodes; the TPU-native
+delta vs the reference is that encode happens host-side since there is no
+device Parquet encoder for TPUs yet (SURVEY.md §7 hard part 2 — staged
+plan)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+from typing import Iterator, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
+
+
+def _arrow_table_from_batch(batch: DeviceBatch):
+    """Device batch -> pyarrow table (column buffers, no row pivot)."""
+    import pyarrow as pa
+    n = batch.num_rows_host()
+    arrays = []
+    for col, dt in zip(batch.columns, batch.schema.dtypes):
+        values, validity = col.to_numpy(n)
+        mask = ~validity if not validity.all() else None
+        arrays.append(pa.array(values, type=dt.pa_type, from_pandas=True,
+                               mask=mask))
+    return pa.Table.from_arrays(arrays, names=list(batch.schema.names))
+
+
+def _arrow_table_from_pandas(df: pd.DataFrame, schema: Schema):
+    import pyarrow as pa
+    arrays = []
+    for i, dt in enumerate(schema.dtypes):
+        s = df.iloc[:, i]
+        arrays.append(pa.Array.from_pandas(s, type=dt.pa_type))
+    return pa.Table.from_arrays(arrays, names=list(schema.names))
+
+
+class WriteCommitProtocol:
+    """Task-attempt staging + driver-side commit (reference:
+    GpuFileFormatWriter.scala:338 riding Spark's HadoopMapReduceCommitProtocol):
+    tasks write under <path>/_temporary/<job>/, commit renames into place,
+    abort removes the staging tree. Crash-safe: a reader never sees
+    partial files in the target listing."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.job_id = uuid.uuid4().hex[:12]
+        self.staging = os.path.join(path, "_temporary", self.job_id)
+
+    def setup(self, mode: str) -> None:
+        if os.path.isdir(self.path) and mode == "overwrite":
+            for entry in os.listdir(self.path):
+                full = os.path.join(self.path, entry)
+                if entry != "_temporary":
+                    (shutil.rmtree if os.path.isdir(full)
+                     else os.unlink)(full)
+        elif os.path.isdir(self.path) and mode == "error":
+            if any(e != "_temporary" for e in os.listdir(self.path)):
+                raise FileExistsError(
+                    f"path {self.path} already exists (mode=error)")
+        os.makedirs(self.staging, exist_ok=True)
+
+    def task_file(self, partition_id: int, ext: str) -> str:
+        return os.path.join(self.staging,
+                            f"part-{partition_id:05d}{ext}")
+
+    def commit(self) -> None:
+        for f in sorted(os.listdir(self.staging)):
+            os.replace(os.path.join(self.staging, f),
+                       os.path.join(self.path, f))
+        shutil.rmtree(os.path.join(self.path, "_temporary"),
+                      ignore_errors=True)
+        open(os.path.join(self.path, "_SUCCESS"), "w").close()
+
+    def abort(self) -> None:
+        shutil.rmtree(os.path.join(self.path, "_temporary"),
+                      ignore_errors=True)
+
+
+class CpuWriteExec(PhysicalPlan):
+    """Host path: pandas partition -> arrow -> file."""
+
+    def __init__(self, child: PhysicalPlan, path: str, fmt: str,
+                 mode: str = "error"):
+        super().__init__([child])
+        self.path = path
+        self.fmt = fmt
+        self.mode = mode
+
+    def output_schema(self) -> Schema:
+        return Schema([], [])
+
+    def describe(self) -> str:
+        return f"CpuWriteExec({self.fmt}, {self.path})"
+
+    def _write_table(self, table, f: str) -> None:
+        if self.fmt == "parquet":
+            import pyarrow.parquet as pq
+            pq.write_table(table, f)
+        else:
+            import pyarrow.csv as pacsv
+            pacsv.write_csv(table, f)
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+        schema = self.children[0].output_schema()
+        protocol = WriteCommitProtocol(self.path)
+        protocol.setup(self.mode)
+        ext = ".parquet" if self.fmt == "parquet" else ".csv"
+        state = {"remaining": len(child_parts), "failed": False}
+
+        def make(i: int, part: Partition) -> Partition:
+            def run() -> Iterator[pd.DataFrame]:
+                import pyarrow as pa
+                try:
+                    tables = [_arrow_table_from_pandas(df, schema)
+                              for df in part() if len(df)]
+                    if tables:
+                        self._write_table(pa.concat_tables(tables),
+                                          protocol.task_file(i, ext))
+                except Exception:
+                    state["failed"] = True
+                    protocol.abort()
+                    raise
+                state["remaining"] -= 1
+                if state["remaining"] == 0 and not state["failed"]:
+                    protocol.commit()
+                yield pd.DataFrame()
+            return run
+        return [make(i, p) for i, p in enumerate(child_parts)]
+
+
+class TpuWriteExec(PhysicalPlan):
+    """Columnar path: device batches -> arrow (one D2H copy) -> file
+    (reference: ColumnarOutputWriter + GpuParquetFileFormat)."""
+
+    columnar_output = False  # terminal command, produces no batches
+    columnar_input = True    # ...but consumes device batches
+
+    def __init__(self, child: PhysicalPlan, path: str, fmt: str,
+                 mode: str = "error"):
+        super().__init__([child])
+        self.path = path
+        self.fmt = fmt
+        self.mode = mode
+
+    def output_schema(self) -> Schema:
+        return Schema([], [])
+
+    def describe(self) -> str:
+        return f"TpuWriteExec({self.fmt}, {self.path})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].partitions(ctx)
+        protocol = WriteCommitProtocol(self.path)
+        protocol.setup(self.mode)
+        ext = ".parquet" if self.fmt == "parquet" else ".csv"
+        state = {"remaining": len(child_parts), "failed": False}
+
+        def make(i: int, part: Partition) -> Partition:
+            def run() -> Iterator[pd.DataFrame]:
+                import pyarrow as pa
+                try:
+                    tables = [_arrow_table_from_batch(b)
+                              for b in part() if b.num_rows_host()]
+                    if tables:
+                        table = pa.concat_tables(tables)
+                        if self.fmt == "parquet":
+                            import pyarrow.parquet as pq
+                            pq.write_table(table, protocol.task_file(i, ext))
+                        else:
+                            import pyarrow.csv as pacsv
+                            pacsv.write_csv(table, protocol.task_file(i, ext))
+                except Exception:
+                    state["failed"] = True
+                    protocol.abort()
+                    raise
+                state["remaining"] -= 1
+                if state["remaining"] == 0 and not state["failed"]:
+                    protocol.commit()
+                yield pd.DataFrame()
+            return run
+        return [make(i, p) for i, p in enumerate(child_parts)]

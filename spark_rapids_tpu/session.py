@@ -132,6 +132,31 @@ class TpuSparkSession:
         return plan, outs
 
 
+class DataFrameWriter:
+    """df.write.mode("overwrite").parquet(path) — the DataFrameWriter
+    surface over LogicalWrite (reference: GpuDataWritingCommandExec path)."""
+
+    def __init__(self, df: "DataFrame"):
+        self._df = df
+        self._mode = "error"
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        m = {"errorifexists": "error"}.get(m, m)
+        assert m in ("error", "overwrite"), m
+        self._mode = m
+        return self
+
+    def _run(self, path: str, fmt: str) -> None:
+        plan = lp.LogicalWrite(self._df._plan, path, fmt, self._mode)
+        self._df.session._execute(plan)
+
+    def parquet(self, path: str) -> None:
+        self._run(path, "parquet")
+
+    def csv(self, path: str) -> None:
+        self._run(path, "csv")
+
+
 class DataFrameReader:
     def __init__(self, session: TpuSparkSession):
         self.session = session
@@ -181,6 +206,58 @@ class GroupedData:
         return self.agg(F.count("*").alias("count"))
 
 
+class RollupData:
+    """rollup/cube grouping: an Expand producing one projection per
+    grouping set (null-ed out keys + a grouping id), then a regular
+    aggregate over keys+gid (Spark's Expand+Aggregate lowering)."""
+
+    def __init__(self, df: "DataFrame", grouping_cols: Sequence,
+                 kind: str):
+        self.df = df
+        self.grouping = grouping_cols
+        self.kind = kind  # 'rollup' | 'cube'
+
+    def _grouping_sets(self, nkeys: int):
+        if self.kind == "rollup":
+            return [list(range(k)) for k in range(nkeys, -1, -1)]
+        import itertools
+        sets = []
+        for r in range(nkeys, -1, -1):
+            sets.extend(list(c) for c in
+                        itertools.combinations(range(nkeys), r))
+        return sets
+
+    def agg(self, *agg_cols: Column) -> "DataFrame":
+        from spark_rapids_tpu.sql.exprs.core import Literal
+        schema = self.df._plan.schema()
+        keys = [(_c(g).sql_name(schema), _c(g)) for g in self.grouping]
+        key_dtypes = [e.dtype(schema) for _, e in keys]
+        key_names = {n for n, _ in keys}
+        # non-key child columns pass through; key columns are re-emitted
+        # per grouping set (nulled when rolled up) to avoid name collisions
+        base = [(n, col_fn(n).expr) for n in schema.names
+                if n not in key_names]
+        projections = []
+        for gid, kept in enumerate(self._grouping_sets(len(keys))):
+            proj = list(base)
+            for j, (name, e) in enumerate(keys):
+                if j in kept:
+                    proj.append((name, e))
+                else:
+                    proj.append((name, Literal(None, key_dtypes[j])))
+            proj.append(("_gid", Literal(gid)))
+            projections.append(proj)
+        expand = lp.LogicalExpand(self.df._plan, projections)
+        grouping = [(n, col_fn(n).expr) for n, _ in keys]
+        grouping.append(("_gid", col_fn("_gid").expr))
+        results = [(n, col_fn(n).expr) for n, _ in keys]
+        for c in agg_cols:
+            e = _expr(c)
+            results.append((e.sql_name(schema), e))
+        return DataFrame(self.df.session,
+                         lp.LogicalAggregate(expand, grouping, results))
+
+
 class DataFrame:
     def __init__(self, session: TpuSparkSession, plan: lp.LogicalPlan):
         self.session = session
@@ -208,9 +285,19 @@ class DataFrame:
         return DataFrame(self.session, lp.LogicalProject(self._plan, exprs))
 
     def with_column(self, name: str, c: Column) -> "DataFrame":
+        from spark_rapids_tpu.sql.window import WindowExpression
+        e = _expr(c)
+        if isinstance(e, WindowExpression):
+            # window columns append to the child (Spark's WindowExec shape)
+            out = DataFrame(self.session,
+                            lp.LogicalWindow(self._plan, [(name, e)]))
+            if name in self.schema.names:
+                raise ValueError(f"window column {name!r} would shadow an "
+                                 "existing column")
+            return out
         schema = self.schema
         exprs = [(n, col_fn(n).expr) for n in schema.names if n != name]
-        exprs.append((name, _expr(c)))
+        exprs.append((name, e))
         return DataFrame(self.session, lp.LogicalProject(self._plan, exprs))
 
     withColumn = with_column
@@ -225,6 +312,12 @@ class DataFrame:
         return GroupedData(self, cols)
 
     groupBy = group_by
+
+    def rollup(self, *cols) -> "RollupData":
+        return RollupData(self, cols, "rollup")
+
+    def cube(self, *cols) -> "RollupData":
+        return RollupData(self, cols, "cube")
 
     def agg(self, *agg_cols: Column) -> "DataFrame":
         return GroupedData(self, []).agg(*agg_cols)
@@ -250,26 +343,47 @@ class DataFrame:
         return DataFrame(self.session,
                          lp.LogicalUnion([self._plan, other._plan]))
 
-    def join(self, other: "DataFrame", on=None, how: str = "inner") -> "DataFrame":
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             left_on=None, right_on=None) -> "DataFrame":
+        """Equi-join. ``on`` names columns present on both sides;
+        ``left_on``/``right_on`` pair differently-named keys positionally
+        (the TPC-H shape: l_orderkey = o_orderkey)."""
         how = {"outer": "full", "full_outer": "full", "left_outer": "left",
                "right_outer": "right", "semi": "leftsemi",
                "anti": "leftanti"}.get(how, how)
-        if on is None:
+
+        def keyify(spec):
+            if isinstance(spec, str):
+                spec = [spec]
+            return [col_fn(c).expr if isinstance(c, str) else _expr(c)
+                    for c in spec]
+        if left_on is not None or right_on is not None:
+            assert left_on is not None and right_on is not None
+            lkeys = keyify(left_on)
+            rkeys = keyify(right_on)
+            assert len(lkeys) == len(rkeys), "left_on/right_on length mismatch"
+        elif on is None:
             lkeys, rkeys = [], []
             how = "cross"
-        elif isinstance(on, str):
-            lkeys = [col_fn(on).expr]
-            rkeys = [col_fn(on).expr]
-        elif isinstance(on, (list, tuple)):
-            lkeys = [col_fn(c).expr if isinstance(c, str) else _expr(c)
-                     for c in on]
-            rkeys = [col_fn(c).expr if isinstance(c, str) else _expr(c)
-                     for c in on]
+        elif isinstance(on, (str, list, tuple)):
+            lkeys = keyify(on)
+            rkeys = keyify(on)
         else:
             raise TypeError("join on must be a column name or list of names")
         return DataFrame(self.session,
                          lp.LogicalJoin(self._plan, other._plan, how,
                                         lkeys, rkeys))
+
+    @property
+    def write(self) -> "DataFrameWriter":
+        return DataFrameWriter(self)
+
+    def distinct(self) -> "DataFrame":
+        """Deduplicate rows (planned as a group-by over every column)."""
+        exprs = [(n, col_fn(n).expr) for n in self.schema.names]
+        return DataFrame(self.session,
+                         lp.LogicalAggregate(self._plan, exprs, [
+                             (n, col_fn(n).expr) for n in self.schema.names]))
 
     def repartition(self, n: int) -> "DataFrame":
         # exposed for parity; exchange planning handles placement

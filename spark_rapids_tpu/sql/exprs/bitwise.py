@@ -1,0 +1,117 @@
+"""Bitwise expressions (reference: sql/rapids/bitwise.scala, 145 LoC):
+and/or/xor/not and the three shifts. Integral operands only; shifts follow
+Java semantics (the shift amount is masked to the operand width, result
+keeps the left operand's type)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType
+from spark_rapids_tpu.sql.exprs.arithmetic import BinaryArithmetic
+from spark_rapids_tpu.sql.exprs.core import (
+    DevCol, DevScalar, DevValue, EvalContext, Expression,
+)
+from spark_rapids_tpu.sql.exprs.hostutil import host_unary_values, rebuild_series
+
+
+class BinaryBitwise(BinaryArithmetic):
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        for c in self.children:
+            if not c.dtype(schema).is_integral:
+                return (f"bitwise {self.symbol} requires integral operands, "
+                        f"got {c.dtype(schema)}")
+        return None
+
+
+class BitwiseAnd(BinaryBitwise):
+    symbol = "&"
+
+    def compute(self, xp, a, b, out_dt):
+        return a & b, None
+
+
+class BitwiseOr(BinaryBitwise):
+    symbol = "|"
+
+    def compute(self, xp, a, b, out_dt):
+        return a | b, None
+
+
+class BitwiseXor(BinaryBitwise):
+    symbol = "^"
+
+    def compute(self, xp, a, b, out_dt):
+        return a ^ b, None
+
+
+class _Shift(BinaryBitwise):
+    """Result type = left operand type; amount masked to the operand width
+    (Java << / >> / >>> semantics, which Spark inherits)."""
+
+    def dtype_from_children(self, lt: DType, rt: DType) -> DType:
+        return lt
+
+    def dtype(self, schema: Schema) -> DType:
+        return self.children[0].dtype(schema)
+
+    def _mask(self, out_dt: DType) -> int:
+        return 63 if out_dt == dtypes.INT64 else 31
+
+
+class ShiftLeft(_Shift):
+    symbol = "<<"
+
+    def compute(self, xp, a, b, out_dt):
+        return a << (b.astype(a.dtype) & self._mask(out_dt)), None
+
+
+class ShiftRight(_Shift):
+    symbol = ">>"
+
+    def compute(self, xp, a, b, out_dt):
+        return a >> (b.astype(a.dtype) & self._mask(out_dt)), None
+
+
+class ShiftRightUnsigned(_Shift):
+    symbol = ">>>"
+
+    def compute(self, xp, a, b, out_dt):
+        width = self._mask(out_dt) + 1
+        unsigned = a.view(getattr(xp, f"uint{width}"))
+        out = unsigned >> (b.astype(unsigned.dtype) & self._mask(out_dt))
+        return out.view(a.dtype), None
+
+
+class BitwiseNot(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return self.children[0].dtype(schema)
+
+    def sql_name(self, schema=None) -> str:
+        return f"~{self.children[0].sql_name(schema)}"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if not self.children[0].dtype(schema).is_integral:
+            return "bitwise ~ requires an integral operand"
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        if isinstance(v, DevScalar):
+            return DevScalar(v.dtype, ~v.value, v.valid)
+        return v.with_(data=~v.data)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(
+            self.children[0].eval_host(df))
+        return rebuild_series(~values, validity,
+                              dtypes.from_numpy(values.dtype), index)

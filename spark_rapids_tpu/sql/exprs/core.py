@@ -86,7 +86,18 @@ class EvalContext:
         if isinstance(v, DevCol):
             return v
         if v.dtype.is_string:
-            raise NotImplementedError("string scalar broadcast")
+            cap = self.capacity
+            if not v.valid or v.value is None:
+                return DevCol(v.dtype, jnp.zeros((16,), jnp.uint8),
+                              jnp.zeros((cap,), jnp.bool_),
+                              jnp.zeros((cap + 1,), jnp.int32))
+            raw = np.frombuffer(str(v.value).encode("utf-8"), dtype=np.uint8)
+            chars = jnp.asarray(np.tile(raw, cap)) if len(raw) else \
+                jnp.zeros((16,), jnp.uint8)
+            offsets = (jnp.arange(cap + 1, dtype=jnp.int32)
+                       * jnp.int32(len(raw)))
+            return DevCol(v.dtype, chars,
+                          jnp.ones((cap,), jnp.bool_), offsets)
         data = jnp.full((self.capacity,), v.value,
                         dtype=v.dtype.np_dtype)
         validity = jnp.full((self.capacity,), v.valid, dtype=jnp.bool_)

@@ -53,6 +53,15 @@ class Column:
     # misc
     def alias(self, name: str): return Column(Alias(self.expr, name))
     def cast(self, to): return Column(Cast(self.expr, _dtype(to)))
+    def bitwiseAND(self, other):
+        from spark_rapids_tpu.sql.exprs import bitwise as bw
+        return Column(bw.BitwiseAnd(self.expr, _expr(other)))
+    def bitwiseOR(self, other):
+        from spark_rapids_tpu.sql.exprs import bitwise as bw
+        return Column(bw.BitwiseOr(self.expr, _expr(other)))
+    def bitwiseXOR(self, other):
+        from spark_rapids_tpu.sql.exprs import bitwise as bw
+        return Column(bw.BitwiseXor(self.expr, _expr(other)))
     def isNull(self): return Column(pred.IsNull(self.expr))
     def isNotNull(self): return Column(pred.IsNotNull(self.expr))
     def isin(self, *values):
@@ -67,6 +76,12 @@ class Column:
 
     def asc(self): return SortOrder(self.expr, ascending=True)
     def desc(self): return SortOrder(self.expr, ascending=False)
+
+    def over(self, spec) -> "Column":
+        """Turn an aggregate/ranking function into a window expression
+        (reference: GpuWindowExpression)."""
+        from spark_rapids_tpu.sql.window import WindowExpression
+        return Column(WindowExpression(self.expr, spec))
 
     def __hash__(self):
         return id(self.expr)
@@ -146,6 +161,20 @@ def pow(b, e): return Column(m.Pow(_c(b), _expr(e)))  # noqa: A001
 def atan2(y, x): return Column(m.Atan2(_c(y), _expr(x)))
 def pmod(a, b): return Column(ar.Pmod(_c(a), _expr(b)))
 
+def shiftleft(c, n):
+    from spark_rapids_tpu.sql.exprs import bitwise as bw
+    return Column(bw.ShiftLeft(_c(c), _expr(n)))
+def shiftright(c, n):
+    from spark_rapids_tpu.sql.exprs import bitwise as bw
+    return Column(bw.ShiftRight(_c(c), _expr(n)))
+def shiftrightunsigned(c, n):
+    from spark_rapids_tpu.sql.exprs import bitwise as bw
+    return Column(bw.ShiftRightUnsigned(_c(c), _expr(n)))
+def bitwise_not(c):
+    from spark_rapids_tpu.sql.exprs import bitwise as bw
+    return Column(bw.BitwiseNot(_c(c)))
+bitwiseNOT = bitwise_not
+
 def isnan(c): return Column(pred.IsNan(_c(c)))
 def isnull(c): return Column(pred.IsNull(_c(c)))
 def coalesce(*cs): return Column(cond.Coalesce([_c(c) for c in cs]))
@@ -199,6 +228,31 @@ def first(c, ignorenulls: bool = False) -> Column:
     return Column(agg.First(_c(c), ignorenulls))
 def last(c, ignorenulls: bool = False) -> Column:
     return Column(agg.Last(_c(c), ignorenulls))
+
+
+def row_number() -> Column:
+    from spark_rapids_tpu.sql.window import RowNumber
+    return Column(RowNumber())
+
+
+def rank() -> Column:
+    from spark_rapids_tpu.sql.window import Rank
+    return Column(Rank())
+
+
+def dense_rank() -> Column:
+    from spark_rapids_tpu.sql.window import DenseRank
+    return Column(DenseRank())
+
+
+def lead(c, offset: int = 1, default=None) -> Column:
+    from spark_rapids_tpu.sql.window import LeadLag
+    return Column(LeadLag(_c(c), offset, default, is_lead=True))
+
+
+def lag(c, offset: int = 1, default=None) -> Column:
+    from spark_rapids_tpu.sql.window import LeadLag
+    return Column(LeadLag(_c(c), offset, default, is_lead=False))
 
 
 def _c(x: ColumnOrName) -> Expression:

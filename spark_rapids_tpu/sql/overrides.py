@@ -269,6 +269,15 @@ _register(ExecRule(cpu.CpuShuffleExchangeExec, "columnar shuffle exchange",
                    _tag_exchange, _convert_exchange))
 _register(ExecRule(cpu.CpuScanExec, "columnar scan",
                    _tag_scan, _convert_scan))
+def _tag_expand(meta: ExecMeta) -> None:
+    for proj in meta.plan.projections:
+        meta.check_exprs([e for _, e in proj], "expand projection")
+
+
+_register(ExecRule(cpu.CpuExpandExec, "expand (rollup/cube engine)",
+                   _tag_expand,
+                   lambda m, ch: tpu.TpuExpandExec(ch[0],
+                                                   m.plan.projections)))
 _register(ExecRule(cpu.CpuJoinExec, "shuffled hash join",
                    _tag_join, _convert_join))
 def _convert_broadcast(meta: ExecMeta, children) -> PhysicalPlan:
@@ -276,8 +285,69 @@ def _convert_broadcast(meta: ExecMeta, children) -> PhysicalPlan:
     return TpuBroadcastExchangeExec(children[0])
 
 
+def _tag_window(meta: ExecMeta) -> None:
+    from spark_rapids_tpu.exec.windowexec import resolve_descriptor
+    cs = meta.plan.children[0].output_schema()
+    for name, w in meta.plan.window_exprs:
+        _, vexpr, err = resolve_descriptor(w, cs)
+        if err:
+            meta.will_not_work(f"window column {name}: {err}")
+            continue
+        for e in (w.spec.partition_cols
+                  + [o.expr for o in w.spec.orders]
+                  + ([vexpr] if vexpr is not None else [])):
+            reason = first_unsupported(e, cs)
+            if reason:
+                meta.will_not_work(f"window column {name}: {reason}")
+
+
+def _convert_window(meta: ExecMeta, children) -> PhysicalPlan:
+    from spark_rapids_tpu.exec.windowexec import TpuWindowExec
+    return TpuWindowExec(children[0], meta.plan.window_exprs)
+
+
 _register(ExecRule(cpu.CpuBroadcastExchangeExec, "broadcast exchange",
                    _tag_nothing, _convert_broadcast))
+
+
+def _register_window_rule() -> None:
+    from spark_rapids_tpu.exec.windowexec import CpuWindowExec
+    _register(ExecRule(CpuWindowExec, "windowed computation",
+                       _tag_window, _convert_window))
+
+
+_register_window_rule()
+
+
+def _tag_write(meta: ExecMeta) -> None:
+    c = meta.conf
+    fmt = meta.plan.fmt
+    if fmt == "parquet":
+        if not (c.get("spark.rapids.sql.format.parquet.enabled")
+                and c.get("spark.rapids.sql.format.parquet.write.enabled")):
+            meta.will_not_work("Parquet write disabled by conf")
+    elif fmt == "csv":
+        # the reference does not accelerate CSV writes either; ours rides
+        # the same columnar D2H path so it is enabled by default
+        if not c.get("spark.rapids.sql.format.csv.enabled"):
+            meta.will_not_work("CSV write disabled by conf")
+    else:
+        meta.will_not_work(f"write format {fmt!r} has no TPU path")
+
+
+def _convert_write(meta: ExecMeta, children) -> PhysicalPlan:
+    from spark_rapids_tpu.exec.write import TpuWriteExec
+    return TpuWriteExec(children[0], meta.plan.path, meta.plan.fmt,
+                        meta.plan.mode)
+
+
+def _register_write_rule() -> None:
+    from spark_rapids_tpu.exec.write import CpuWriteExec
+    _register(ExecRule(CpuWriteExec, "data writing command",
+                       _tag_write, _convert_write))
+
+
+_register_write_rule()
 _register(ExecRule(cpu.CpuLocalLimitExec, "local limit", _tag_nothing,
                    lambda m, ch: tpu.TpuLocalLimitExec(ch[0], m.plan.limit)))
 _register(ExecRule(cpu.CpuGlobalLimitExec, "global limit", _tag_nothing,
@@ -330,8 +400,11 @@ class TransitionOverrides:
 
     def apply(self, plan: PhysicalPlan) -> PhysicalPlan:
         # a TPU operator consumes device batches; a CPU operator consumes
-        # host DataFrames — insert the matching transition under each child
-        wants_columnar = plan.columnar_output
+        # host DataFrames — insert the matching transition under each child.
+        # columnar_input (terminal commands like TpuWriteExec) overrides
+        # the output-kind default.
+        wants_columnar = getattr(plan, "columnar_input",
+                                 plan.columnar_output)
         new_children = []
         for c in plan.children:
             c2 = self.apply(c)
@@ -354,7 +427,9 @@ def assert_is_on_tpu(plan: PhysicalPlan, conf: TpuConf) -> None:
     }
     offenders = []
     for node in plan.walk():
-        if not node.columnar_output and node.name not in allowed:
+        on_tpu = (node.columnar_output
+                  or getattr(node, "columnar_input", False))
+        if not on_tpu and node.name not in allowed:
             offenders.append(node.name)
     if offenders:
         raise AssertionError(

@@ -130,6 +130,51 @@ class LogicalJoin(LogicalPlan):
                       list(ls.dtypes) + list(rs.dtypes))
 
 
+class LogicalWindow(LogicalPlan):
+    """Appends window-computed columns to the child's output (Spark's
+    WindowExec shape; reference: GpuWindowExec)."""
+
+    def __init__(self, child: LogicalPlan, window_exprs):
+        super().__init__([child])
+        self.window_exprs = list(window_exprs)  # [(name, WindowExpression)]
+
+    def schema(self) -> Schema:
+        cs = self.children[0].schema()
+        return Schema(
+            list(cs.names) + [n for n, _ in self.window_exprs],
+            list(cs.dtypes) + [w.dtype(cs) for _, w in self.window_exprs])
+
+
+class LogicalExpand(LogicalPlan):
+    """Each input row emits one output row per projection set (Spark's
+    ExpandExec, the engine under rollup/cube/grouping-sets; reference:
+    GpuExpandExec.scala:202)."""
+
+    def __init__(self, child: LogicalPlan, projections):
+        super().__init__([child])
+        self.projections = [list(p) for p in projections]
+
+    def schema(self) -> Schema:
+        cs = self.children[0].schema()
+        first = self.projections[0]
+        return Schema([n for n, _ in first],
+                      [e.dtype(cs) for _, e in first])
+
+
+class LogicalWrite(LogicalPlan):
+    """Terminal write command (reference: GpuDataWritingCommandExec wrapping
+    InsertIntoHadoopFsRelationCommand)."""
+
+    def __init__(self, child: LogicalPlan, path: str, fmt: str, mode: str):
+        super().__init__([child])
+        self.path = path
+        self.fmt = fmt
+        self.mode = mode
+
+    def schema(self) -> Schema:
+        return Schema([], [])
+
+
 class LogicalUnion(LogicalPlan):
     def __init__(self, children: Sequence[LogicalPlan]):
         super().__init__(children)

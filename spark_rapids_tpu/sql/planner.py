@@ -117,6 +117,44 @@ class Planner:
     def _plan_LogicalUnion(self, node: lp.LogicalUnion) -> PhysicalPlan:
         return cpu.CpuUnionExec([self.plan(c) for c in node.children])
 
+    def _plan_LogicalExpand(self, node: lp.LogicalExpand) -> PhysicalPlan:
+        child = self.plan(node.children[0])
+        cs = child.output_schema()
+        projections = [[(n, bind_references(e, cs)) for n, e in proj]
+                       for proj in node.projections]
+        return cpu.CpuExpandExec(child, projections)
+
+    def _plan_LogicalWrite(self, node: lp.LogicalWrite) -> PhysicalPlan:
+        from spark_rapids_tpu.exec.write import CpuWriteExec
+        child = self.plan(node.children[0])
+        return CpuWriteExec(child, node.path, node.fmt, node.mode)
+
+    def _plan_LogicalWindow(self, node: lp.LogicalWindow) -> PhysicalPlan:
+        from spark_rapids_tpu.exec.windowexec import CpuWindowExec
+        from spark_rapids_tpu.sql.exprs.core import BoundRef
+        from spark_rapids_tpu.sql.window import WindowExpression, WindowSpec
+
+        child = self.plan(node.children[0])
+        cs = child.output_schema()
+        bound = []
+        for name, w in node.window_exprs:
+            spec = WindowSpec(
+                [bind_references(e, cs) for e in w.spec.partition_cols],
+                [_bind_order(o, cs) for o in w.spec.orders], w.spec.frame)
+            fn = w.fn.map_children(lambda c: bind_references(c, cs))
+            bound.append((name, WindowExpression(fn, spec)))
+        # distribute whole partition groups to one task: hash exchange on
+        # the partition keys when they are plain columns, else single
+        spec0 = bound[0][1].spec
+        pidx = [e.index for e in spec0.partition_cols
+                if isinstance(e, BoundRef)]
+        if spec0.partition_cols and len(pidx) == len(spec0.partition_cols):
+            n = self.conf.shuffle_partitions
+            child = cpu.CpuShuffleExchangeExec(child, ("hash", pidx, n))
+        else:
+            child = cpu.CpuShuffleExchangeExec(child, ("single",))
+        return CpuWindowExec(child, bound)
+
 
 def _key_indices(child: PhysicalPlan, keys, schema):
     """Ensure join keys are plain column indices, projecting if necessary."""

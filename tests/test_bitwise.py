@@ -1,0 +1,55 @@
+"""Bitwise expression differential tests (reference:
+sql/rapids/bitwise.scala)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from tests.querytest import assert_tpu_and_cpu_equal
+
+
+def _df(rng, n=200):
+    return pd.DataFrame({
+        "a": rng.integers(-(1 << 40), 1 << 40, n),
+        "b": pd.Series(rng.integers(-1000, 1000, n)).astype("Int64")
+              .mask(pd.Series(rng.random(n) < 0.1)),
+        "i": rng.integers(-(1 << 20), 1 << 20, n).astype(np.int32),
+        "sh": rng.integers(0, 70, n).astype(np.int32),
+    })
+
+
+def test_and_or_xor(session, rng):
+    df = _df(rng)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2).select(
+            F.col("a").bitwiseAND(F.col("b")).alias("ab"),
+            F.col("a").bitwiseOR(F.col("b")).alias("ob"),
+            F.col("a").bitwiseXOR(F.col("b")).alias("xb")))
+
+
+def test_not(session, rng):
+    df = _df(rng)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2).select(
+            F.bitwise_not(F.col("a")).alias("na"),
+            F.bitwise_not(F.col("b")).alias("nb")))
+
+
+def test_shifts(session, rng):
+    df = _df(rng)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2).select(
+            F.shiftleft(F.col("a"), 3).alias("sl"),
+            F.shiftright(F.col("a"), F.col("sh")).alias("sr"),
+            F.shiftrightunsigned(F.col("a"), 5).alias("sru"),
+            F.shiftleft(F.col("i"), F.col("sh")).alias("sli")))
+
+
+def test_bitwise_on_float_falls_back(session, rng):
+    """Non-integral operands fall back to CPU with a readable reason."""
+    df = pd.DataFrame({"f": rng.uniform(0, 1, 50)})
+    from tests.querytest import with_tpu_session
+    q = lambda s: s.create_dataframe(df, 1).select(  # noqa: E731
+        F.bitwise_not(F.col("f").cast("long")).alias("ok"))
+    with_tpu_session(q)  # cast to long first -> runs on TPU

@@ -20,6 +20,28 @@ def tpch_pandas():
     }
 
 
+@pytest.fixture(scope="module")
+def tpch_all_pandas():
+    tables = {name: gen(SF) for name, gen in tpch_data.ALL_TABLES.items()}
+    tables["nation"] = tpch_data.gen_nation()
+    tables["region"] = tpch_data.gen_region()
+    return tables
+
+
+ALL_QUERIES = sorted(QUERIES, key=lambda q: int(q[1:]))
+
+
+@pytest.mark.parametrize("qname", ALL_QUERIES)
+def test_tpch_query_differential(session, tpch_all_pandas, qname):
+    """Every TPC-H-like query, TPU vs CPU (the reference's
+    TpchLikeSpark.scala coverage: Q1Like..Q22Like + tpch_test.py)."""
+    def run(s):
+        tables = {name: s.create_dataframe(df, 3 if len(df) > 50 else 1)
+                  for name, df in tpch_all_pandas.items()}
+        return QUERIES[qname](s, tables)
+    assert_tpu_and_cpu_equal(run, approx=True)
+
+
 def test_q1(session, tpch_pandas):
     out = assert_tpu_and_cpu_equal(
         lambda s: QUERIES["q1"](s, {

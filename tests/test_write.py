@@ -1,0 +1,118 @@
+"""Write-path tests (reference: GpuParquetFileFormat + write-path asserts
+in integration_tests asserts.py assert_gpu_and_cpu_writes_are_equal)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from tests.querytest import with_cpu_session, with_tpu_session
+
+
+def _df(rng, n=400):
+    return pd.DataFrame({
+        "k": rng.integers(0, 20, n),
+        "v": pd.Series(rng.uniform(-5, 5, n)).astype("Float64")
+              .mask(pd.Series(rng.random(n) < 0.1)),
+        "s": pd.Series([None if i % 9 == 0 else f"name_{i}"
+                        for i in range(n)]),
+        "d": (np.datetime64("2021-01-01") +
+              rng.integers(0, 365, n).astype("timedelta64[D]")),
+    })
+
+
+def _read_back(session, path):
+    return session.read.parquet(
+        *[os.path.join(path, f) for f in sorted(os.listdir(path))
+          if f.endswith(".parquet")]).collect()
+
+
+@pytest.mark.parametrize("enabled", [True, False], ids=["tpu", "cpu"])
+def test_parquet_write_roundtrip(session, rng, tmp_path, enabled):
+    df = _df(rng)
+    out = str(tmp_path / "out")
+    runner = with_tpu_session if enabled else with_cpu_session
+
+    class _Done:
+        def collect(self):
+            return pd.DataFrame()
+
+    def write(s):
+        s.create_dataframe(df, 3).write.mode("overwrite").parquet(out)
+        return _Done()
+    runner(write)
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    assert not os.path.exists(os.path.join(out, "_temporary"))
+    files = [f for f in os.listdir(out) if f.endswith(".parquet")]
+    assert files, "no part files written"
+    back = _read_back(session, out)
+    assert len(back) == len(df)
+    assert sorted(back.columns) == sorted(df.columns)
+    # content check (order-insensitive by key sort)
+    a = back.sort_values(["k", "s"], na_position="first").reset_index(drop=True)
+    b = df.sort_values(["k", "s"], na_position="first").reset_index(drop=True)
+    np.testing.assert_allclose(
+        a["v"].astype(float).to_numpy(), b["v"].astype(float).to_numpy(),
+        equal_nan=True)
+
+
+def test_write_tpu_and_cpu_files_equal(session, rng, tmp_path):
+    """The assert_gpu_and_cpu_writes_are_equal_collect pattern: write with
+    both paths, read both back, compare."""
+    df = _df(rng)
+    p_tpu, p_cpu = str(tmp_path / "t"), str(tmp_path / "c")
+
+    class _Done:
+        def collect(self):
+            return pd.DataFrame()
+
+    with_tpu_session(lambda s: (
+        s.create_dataframe(df, 2).write.mode("overwrite").parquet(p_tpu),
+        _Done())[1])
+    with_cpu_session(lambda s: (
+        s.create_dataframe(df, 2).write.mode("overwrite").parquet(p_cpu),
+        _Done())[1])
+    from spark_rapids_tpu.session import TpuSparkSession
+    s = TpuSparkSession.active()
+    a = _read_back(s, p_tpu).sort_values(["k", "s"], na_position="first") \
+        .reset_index(drop=True)
+    b = _read_back(s, p_cpu).sort_values(["k", "s"], na_position="first") \
+        .reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b)
+
+
+def test_write_mode_error(session, rng, tmp_path):
+    df = _df(rng, 20)
+    out = str(tmp_path / "exists")
+
+    class _Done:
+        def collect(self):
+            return pd.DataFrame()
+
+    with_cpu_session(lambda s: (
+        s.create_dataframe(df, 1).write.mode("overwrite").parquet(out),
+        _Done())[1])
+    with pytest.raises(FileExistsError):
+        with_cpu_session(lambda s: (
+            s.create_dataframe(df, 1).write.parquet(out), _Done())[1])
+
+
+def test_csv_write(session, rng, tmp_path):
+    df = pd.DataFrame({"a": rng.integers(0, 10, 50),
+                       "b": rng.uniform(0, 1, 50)})
+    out = str(tmp_path / "csvout")
+
+    class _Done:
+        def collect(self):
+            return pd.DataFrame()
+
+    with_tpu_session(lambda s: (
+        s.create_dataframe(df, 2).write.mode("overwrite").csv(out),
+        _Done())[1])
+    files = [f for f in os.listdir(out) if f.endswith(".csv")]
+    assert files
+    back = pd.concat([pd.read_csv(os.path.join(out, f)) for f in files],
+                     ignore_index=True)
+    assert len(back) == 50
