@@ -1,0 +1,334 @@
+// Native runtime for spark-rapids-tpu.
+//
+// TPU-native equivalents of the reference's external native components
+// (SURVEY.md §2.0): the reference consumes RMM (pooled allocator with
+// alloc-failure callbacks), a pinned host memory pool, an
+// AddressSpaceAllocator (best-fit sub-allocator used to carve bounce-buffer
+// pools, reference AddressSpaceAllocator.scala:22-150), a
+// HashedPriorityQueue (O(log n) priority queue with O(1) membership used
+// for spill ordering, reference HashedPriorityQueue.java:300) and
+// JCudfSerialization (native columnar wire (de)serialization, reference
+// GpuColumnarBatchSerializer.scala:84-212).  This library provides all four
+// as a C ABI consumed from Python over ctypes; a pure-Python fallback
+// exists for every entry point so the framework degrades gracefully when
+// the shared library has not been built.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <map>
+#include <unordered_map>
+#include <vector>
+
+#if defined(_WIN32)
+#define TPU_EXPORT __declspec(dllexport)
+#else
+#define TPU_EXPORT __attribute__((visibility("default")))
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Arena: aligned host memory pool with a best-fit free list (the pinned
+// host pool / RMM-pool analogue; sub-allocation logic mirrors the role of
+// AddressSpaceAllocator).  Thread-safety is the caller's job (Python holds
+// a lock), keeping the native side allocation-free on the hot path.
+// ---------------------------------------------------------------------------
+
+struct Arena {
+  uint8_t* base = nullptr;
+  uint64_t capacity = 0;
+  uint64_t alignment = 64;
+  uint64_t allocated = 0;   // bytes currently handed out
+  uint64_t peak = 0;
+  // free blocks: offset -> size (ordered for neighbour coalescing)
+  std::map<uint64_t, uint64_t> free_blocks;
+  // live allocations: offset -> size
+  std::unordered_map<uint64_t, uint64_t> live;
+};
+
+TPU_EXPORT Arena* tpu_arena_create(uint64_t capacity, uint64_t alignment) {
+  if (alignment == 0 || (alignment & (alignment - 1)) != 0) return nullptr;
+  void* mem = nullptr;
+  if (posix_memalign(&mem, alignment < sizeof(void*) ? sizeof(void*) : alignment,
+                     capacity) != 0) {
+    return nullptr;
+  }
+  Arena* a = new Arena();
+  a->base = static_cast<uint8_t*>(mem);
+  a->capacity = capacity;
+  a->alignment = alignment;
+  a->free_blocks[0] = capacity;
+  return a;
+}
+
+TPU_EXPORT void tpu_arena_destroy(Arena* a) {
+  if (!a) return;
+  free(a->base);
+  delete a;
+}
+
+TPU_EXPORT uint8_t* tpu_arena_base(Arena* a) { return a->base; }
+TPU_EXPORT uint64_t tpu_arena_capacity(Arena* a) { return a->capacity; }
+TPU_EXPORT uint64_t tpu_arena_allocated(Arena* a) { return a->allocated; }
+TPU_EXPORT uint64_t tpu_arena_peak(Arena* a) { return a->peak; }
+
+// Returns the offset of the allocation, or UINT64_MAX when no block fits.
+TPU_EXPORT uint64_t tpu_arena_alloc(Arena* a, uint64_t size) {
+  if (size == 0) size = 1;
+  // round to alignment so every block stays aligned
+  uint64_t need = (size + a->alignment - 1) & ~(a->alignment - 1);
+  // best fit: smallest free block that satisfies the request
+  auto best = a->free_blocks.end();
+  uint64_t best_size = UINT64_MAX;
+  for (auto it = a->free_blocks.begin(); it != a->free_blocks.end(); ++it) {
+    if (it->second >= need && it->second < best_size) {
+      best = it;
+      best_size = it->second;
+      if (best_size == need) break;  // exact fit
+    }
+  }
+  if (best == a->free_blocks.end()) return UINT64_MAX;
+  uint64_t off = best->first;
+  uint64_t block = best->second;
+  a->free_blocks.erase(best);
+  if (block > need) a->free_blocks[off + need] = block - need;
+  a->live[off] = need;
+  a->allocated += need;
+  if (a->allocated > a->peak) a->peak = a->allocated;
+  return off;
+}
+
+// Returns freed block size, 0 when the offset was not a live allocation.
+TPU_EXPORT uint64_t tpu_arena_free(Arena* a, uint64_t off) {
+  auto it = a->live.find(off);
+  if (it == a->live.end()) return 0;
+  uint64_t size = it->second;
+  a->live.erase(it);
+  a->allocated -= size;
+  // insert and coalesce with neighbours
+  auto ins = a->free_blocks.emplace(off, size).first;
+  if (ins != a->free_blocks.begin()) {
+    auto prev = std::prev(ins);
+    if (prev->first + prev->second == ins->first) {
+      prev->second += ins->second;
+      a->free_blocks.erase(ins);
+      ins = prev;
+    }
+  }
+  auto next = std::next(ins);
+  if (next != a->free_blocks.end() &&
+      ins->first + ins->second == next->first) {
+    ins->second += next->second;
+    a->free_blocks.erase(next);
+  }
+  return size;
+}
+
+TPU_EXPORT uint64_t tpu_arena_largest_free(Arena* a) {
+  uint64_t largest = 0;
+  for (auto& kv : a->free_blocks)
+    if (kv.second > largest) largest = kv.second;
+  return largest;
+}
+
+// ---------------------------------------------------------------------------
+// HashedPriorityQueue: binary min-heap + id -> position index, giving
+// O(log n) push/pop/update and O(1) membership (the spill-ordering
+// structure; reference HashedPriorityQueue.java).
+// ---------------------------------------------------------------------------
+
+struct HpqEntry {
+  int64_t id;
+  int64_t priority;
+};
+
+struct Hpq {
+  std::vector<HpqEntry> heap;          // 0-based binary heap
+  std::unordered_map<int64_t, size_t> pos;  // id -> heap index
+};
+
+static bool hpq_less(const HpqEntry& x, const HpqEntry& y) {
+  if (x.priority != y.priority) return x.priority < y.priority;
+  return x.id < y.id;  // deterministic tie-break
+}
+
+static void hpq_swap(Hpq* q, size_t i, size_t j) {
+  std::swap(q->heap[i], q->heap[j]);
+  q->pos[q->heap[i].id] = i;
+  q->pos[q->heap[j].id] = j;
+}
+
+static void hpq_up(Hpq* q, size_t i) {
+  while (i > 0) {
+    size_t p = (i - 1) / 2;
+    if (!hpq_less(q->heap[i], q->heap[p])) break;
+    hpq_swap(q, i, p);
+    i = p;
+  }
+}
+
+static void hpq_down(Hpq* q, size_t i) {
+  size_t n = q->heap.size();
+  for (;;) {
+    size_t l = 2 * i + 1, r = 2 * i + 2, m = i;
+    if (l < n && hpq_less(q->heap[l], q->heap[m])) m = l;
+    if (r < n && hpq_less(q->heap[r], q->heap[m])) m = r;
+    if (m == i) break;
+    hpq_swap(q, i, m);
+    i = m;
+  }
+}
+
+TPU_EXPORT Hpq* tpu_hpq_create() { return new Hpq(); }
+TPU_EXPORT void tpu_hpq_destroy(Hpq* q) { delete q; }
+TPU_EXPORT int64_t tpu_hpq_size(Hpq* q) { return (int64_t)q->heap.size(); }
+
+TPU_EXPORT int tpu_hpq_contains(Hpq* q, int64_t id) {
+  return q->pos.count(id) ? 1 : 0;
+}
+
+// push or update-in-place; returns 1 if inserted, 0 if updated
+TPU_EXPORT int tpu_hpq_push(Hpq* q, int64_t id, int64_t priority) {
+  auto it = q->pos.find(id);
+  if (it != q->pos.end()) {
+    size_t i = it->second;
+    int64_t old = q->heap[i].priority;
+    q->heap[i].priority = priority;
+    if (priority < old) hpq_up(q, i); else hpq_down(q, i);
+    return 0;
+  }
+  q->heap.push_back({id, priority});
+  q->pos[id] = q->heap.size() - 1;
+  hpq_up(q, q->heap.size() - 1);
+  return 1;
+}
+
+// pop lowest priority; returns id, or INT64_MIN when empty
+TPU_EXPORT int64_t tpu_hpq_pop_min(Hpq* q) {
+  if (q->heap.empty()) return INT64_MIN;
+  int64_t id = q->heap[0].id;
+  q->pos.erase(id);
+  if (q->heap.size() > 1) {
+    q->heap[0] = q->heap.back();
+    q->heap.pop_back();
+    q->pos[q->heap[0].id] = 0;
+    hpq_down(q, 0);
+  } else {
+    q->heap.pop_back();
+  }
+  return id;
+}
+
+TPU_EXPORT int64_t tpu_hpq_peek_min(Hpq* q) {
+  return q->heap.empty() ? INT64_MIN : q->heap[0].id;
+}
+
+TPU_EXPORT int64_t tpu_hpq_peek_min_priority(Hpq* q) {
+  return q->heap.empty() ? INT64_MIN : q->heap[0].priority;
+}
+
+// remove by id; returns 1 if removed
+TPU_EXPORT int tpu_hpq_remove(Hpq* q, int64_t id) {
+  auto it = q->pos.find(id);
+  if (it == q->pos.end()) return 0;
+  size_t i = it->second;
+  q->pos.erase(it);
+  size_t last = q->heap.size() - 1;
+  if (i != last) {
+    q->heap[i] = q->heap[last];
+    q->pos[q->heap[i].id] = i;
+    q->heap.pop_back();
+    hpq_up(q, i);
+    hpq_down(q, i);
+  } else {
+    q->heap.pop_back();
+  }
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// Wire format: single-pass columnar frame assembly (JCudfSerialization
+// analogue).  Layout must stay byte-identical with the Python fallback in
+// spark_rapids_tpu/shuffle/wire.py:
+//   magic u32 | version u32 | nrows u32 | ncols u32
+//   per column: name_len u16 + name | dtype_len u8 + dtype |
+//               data_len u64 | validity_len u64 | offsets_len u64
+//   then per column: data bytes, packed validity bits (LSB-first), offsets
+// ---------------------------------------------------------------------------
+
+static const uint32_t WIRE_MAGIC = 0x54505543u;  // 'TPUC'
+static const uint32_t WIRE_VERSION = 1u;
+
+// Packs n bool bytes into ceil(n/8) bytes, LSB-first (numpy
+// packbits(bitorder="little") semantics).
+TPU_EXPORT void tpu_pack_bits(const uint8_t* bools, int64_t n, uint8_t* out) {
+  int64_t nb = (n + 7) / 8;
+  memset(out, 0, (size_t)nb);
+  for (int64_t i = 0; i < n; ++i) {
+    if (bools[i]) out[i >> 3] |= (uint8_t)(1u << (i & 7));
+  }
+}
+
+TPU_EXPORT void tpu_unpack_bits(const uint8_t* packed, int64_t n,
+                                uint8_t* bools) {
+  for (int64_t i = 0; i < n; ++i) {
+    bools[i] = (packed[i >> 3] >> (i & 7)) & 1u;
+  }
+}
+
+// Frame size for the given column extents. names/dtypes lengths are per
+// column; data/offsets lengths are byte counts; validity is nrows bools
+// packed to ceil(nrows/8) bytes per column.
+TPU_EXPORT uint64_t tpu_wire_frame_size(uint32_t nrows, uint32_t ncols,
+                                        const uint16_t* name_lens,
+                                        const uint8_t* dtype_lens,
+                                        const uint64_t* data_lens,
+                                        const uint64_t* offsets_lens) {
+  uint64_t total = 16;  // fixed header
+  uint64_t vbytes = (nrows + 7) / 8;
+  for (uint32_t c = 0; c < ncols; ++c) {
+    total += 2 + name_lens[c] + 1 + dtype_lens[c] + 24;
+    total += data_lens[c] + vbytes + offsets_lens[c];
+  }
+  return total;
+}
+
+// Writes one complete frame into dest (caller sized it with
+// tpu_wire_frame_size).  validity[c] points at nrows bool bytes.
+// Returns bytes written.
+TPU_EXPORT uint64_t tpu_wire_write_frame(
+    uint8_t* dest, uint32_t nrows, uint32_t ncols,
+    const uint8_t* const* names, const uint16_t* name_lens,
+    const uint8_t* const* dtypes, const uint8_t* dtype_lens,
+    const uint8_t* const* data, const uint64_t* data_lens,
+    const uint8_t* const* validity,
+    const uint8_t* const* offsets, const uint64_t* offsets_lens) {
+  uint8_t* p = dest;
+  uint64_t vbytes = (nrows + 7) / 8;
+  memcpy(p, &WIRE_MAGIC, 4); p += 4;
+  memcpy(p, &WIRE_VERSION, 4); p += 4;
+  memcpy(p, &nrows, 4); p += 4;
+  memcpy(p, &ncols, 4); p += 4;
+  for (uint32_t c = 0; c < ncols; ++c) {
+    uint16_t nl = name_lens[c];
+    memcpy(p, &nl, 2); p += 2;
+    memcpy(p, names[c], nl); p += nl;
+    uint8_t dl = dtype_lens[c];
+    *p++ = dl;
+    memcpy(p, dtypes[c], dl); p += dl;
+    uint64_t ext[3] = {data_lens[c], vbytes, offsets_lens[c]};
+    memcpy(p, ext, 24); p += 24;
+  }
+  for (uint32_t c = 0; c < ncols; ++c) {
+    memcpy(p, data[c], data_lens[c]); p += data_lens[c];
+    tpu_pack_bits(validity[c], nrows, p); p += vbytes;
+    if (offsets_lens[c]) { memcpy(p, offsets[c], offsets_lens[c]); }
+    p += offsets_lens[c];
+  }
+  return (uint64_t)(p - dest);
+}
+
+}  // extern "C"

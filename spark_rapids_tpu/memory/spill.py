@@ -26,7 +26,6 @@ the framework meters its own logical budget (memory/device.py).
 
 from __future__ import annotations
 
-import heapq
 import os
 import tempfile
 import threading
@@ -74,18 +73,68 @@ class SpillableBuffer:
         self.closed = False
 
     # --- tier movement -----------------------------------------------------
-    def spill_to_host(self) -> int:
-        """DEVICE -> HOST. Returns bytes freed on device."""
+    def spill_to_host(self, arena=None) -> int:
+        """DEVICE -> HOST. Returns bytes freed on device.
+
+        When the host store's native arena (nativelib.HostArena — the
+        pinned-host-pool analogue) has room, leaf bytes land in arena
+        extents so the host tier is a real metered native pool; otherwise
+        leaves stay as plain numpy arrays (same correctness, no pool
+        accounting)."""
         with self._lock:
             if self.tier != StorageTier.DEVICE or self.closed:
                 return 0
             batch = self._device_batch
             leaves, treedef = jax.tree_util.tree_flatten(batch)
             host_leaves = jax.device_get(leaves)
-            self._host_data = {"leaves": host_leaves, "treedef": treedef}
+            entry = {"leaves": host_leaves, "treedef": treedef}
+            if arena is not None:
+                placed = self._try_arena_place(arena, host_leaves)
+                if placed is not None:
+                    entry = {"arena": arena, "extents": placed,
+                             "treedef": treedef}
+            self._host_data = entry
             self._device_batch = None
             self.tier = StorageTier.HOST
             return self.size
+
+    @staticmethod
+    def _try_arena_place(arena, host_leaves):
+        """Copy every leaf into arena extents; None if the pool is full.
+        Extents: (offset, nbytes, dtype-str, shape) per leaf."""
+        placed = []
+        for leaf in host_leaves:
+            # NB: keep np.asarray, not ascontiguousarray — the latter
+            # promotes 0-d leaves (num_rows scalars) to shape (1,)
+            a = np.asarray(leaf)
+            off = arena.alloc(max(a.nbytes, 1))
+            if off is None:
+                for o, *_ in placed:
+                    arena.free(o)
+                return None
+            arena.write(off, a.tobytes())
+            placed.append((off, a.nbytes, str(a.dtype), a.shape))
+        return placed
+
+    def _host_leaves(self):
+        """Materialize host numpy leaves from either representation."""
+        hd = self._host_data
+        if "leaves" in hd:
+            return hd["leaves"]
+        arena = hd["arena"]
+        out = []
+        for off, nbytes, dtype, shape in hd["extents"]:
+            buf = arena.read(off, nbytes)
+            out.append(np.frombuffer(buf, dtype=np.dtype(dtype))
+                       .reshape(shape))
+        return out
+
+    def _release_host(self) -> None:
+        hd = self._host_data
+        if hd and "extents" in hd:
+            for off, *_ in hd["extents"]:
+                hd["arena"].free(off)
+        self._host_data = None
 
     def spill_to_disk(self, disk_dir: str) -> int:
         """HOST -> DISK. Returns host bytes freed."""
@@ -93,13 +142,14 @@ class SpillableBuffer:
             if self.tier != StorageTier.HOST or self.closed:
                 return 0
             path = os.path.join(disk_dir, f"spill-{self.id}.npz")
+            leaves = self._host_leaves()
             arrays = {f"a{i}": np.asarray(leaf)
-                      for i, leaf in enumerate(self._host_data["leaves"])}
+                      for i, leaf in enumerate(leaves)}
             np.savez(path, **arrays)
             self._treedef = self._host_data["treedef"]
-            self._nleaves = len(self._host_data["leaves"])
+            self._nleaves = len(leaves)
             self._disk_path = path
-            self._host_data = None
+            self._release_host()
             self.tier = StorageTier.DISK
             return self.size
 
@@ -114,7 +164,7 @@ class SpillableBuffer:
             if self.tier == StorageTier.DEVICE:
                 return self._device_batch
             if self.tier == StorageTier.HOST:
-                leaves = self._host_data["leaves"]
+                leaves = self._host_leaves()
                 treedef = self._host_data["treedef"]
             else:
                 with np.load(self._disk_path) as z:
@@ -124,7 +174,7 @@ class SpillableBuffer:
             batch = jax.tree_util.tree_unflatten(treedef, dev_leaves)
             old_tier = self.tier
             self._device_batch = batch
-            self._host_data = None
+            self._release_host()
             if self._disk_path and os.path.exists(self._disk_path):
                 os.unlink(self._disk_path)
             self._disk_path = None
@@ -138,7 +188,7 @@ class SpillableBuffer:
                 return
             self.closed = True
             self._device_batch = None
-            self._host_data = None
+            self._release_host()
             if self._disk_path and os.path.exists(self._disk_path):
                 os.unlink(self._disk_path)
 
@@ -153,6 +203,11 @@ class BufferStore:
         self.spill_store = spill_store
         self._buffers: Dict[int, SpillableBuffer] = {}
         self._lock = threading.RLock()
+        # spill ordering rides the native HashedPriorityQueue (O(log n)
+        # push/pop, O(1) membership — reference HashedPriorityQueue.java);
+        # nativelib falls back to a Python dict-heap when unbuilt
+        from spark_rapids_tpu.nativelib import HashedPriorityQueue
+        self._spill_queue = HashedPriorityQueue()
 
     @property
     def total_size(self) -> int:
@@ -163,15 +218,32 @@ class BufferStore:
     def add(self, buf: SpillableBuffer) -> None:
         with self._lock:
             self._buffers[buf.id] = buf
+            self._spill_queue.push(buf.id, buf.priority)
 
     def remove(self, buffer_id: int) -> None:
         with self._lock:
             self._buffers.pop(buffer_id, None)
+            self._spill_queue.remove(buffer_id)
 
     def _spill_candidates(self) -> List[SpillableBuffer]:
+        """Priority-ordered snapshot, lowest (most spillable) first.
+        Non-destructive: every drained entry is re-queued before returning,
+        so exceptions mid-spill or concurrent spill passes never lose
+        queue membership; actually-spilled buffers leave via remove()."""
+        out: List[SpillableBuffer] = []
         with self._lock:
-            bufs = [b for b in self._buffers.values() if not b.closed]
-        return sorted(bufs, key=lambda b: b.priority)
+            drained = []
+            while True:
+                bid = self._spill_queue.pop_min()
+                if bid is None:
+                    break
+                buf = self._buffers.get(bid)
+                if buf is not None and not buf.closed:
+                    drained.append((bid, buf.priority))
+                    out.append(buf)
+            for bid, prio in drained:
+                self._spill_queue.push(bid, prio)
+        return out
 
     def spill_one(self, buf: SpillableBuffer) -> int:
         raise NotImplementedError
@@ -205,12 +277,13 @@ class DeviceStore(BufferStore):
 
     def remove(self, buffer_id: int) -> None:
         with self._lock:
-            buf = self._buffers.pop(buffer_id, None)
+            buf = self._buffers.get(buffer_id)
+            super().remove(buffer_id)
         if buf is not None and self.device_manager is not None:
             self.device_manager.track_free(buf.size)
 
     def spill_one(self, buf: SpillableBuffer) -> int:
-        freed = buf.spill_to_host()
+        freed = buf.spill_to_host(arena=self.spill_store.arena)
         if freed:
             self.spill_store.add(buf)
             # keep the host tier within its bound
@@ -225,6 +298,10 @@ class HostStore(BufferStore):
     def __init__(self, limit_bytes: int, spill_store: "DiskStore"):
         super().__init__(StorageTier.HOST, spill_store)
         self.limit_bytes = limit_bytes
+        # native aligned host pool for spilled leaf bytes (pinned-pool
+        # analogue); plain numpy fallback engages per-buffer when full
+        from spark_rapids_tpu.nativelib import HostArena
+        self.arena = HostArena(max(limit_bytes, 1 << 20))
 
     def spill_one(self, buf: SpillableBuffer) -> int:
         freed = buf.spill_to_disk(self.spill_store.disk_dir)
@@ -321,6 +398,7 @@ class BufferCatalog:
         for bid in ids:
             self.remove(bid)
         self.disk_store.cleanup()
+        self.host_store.arena.close()
 
 
 class MemoryEventHandler:

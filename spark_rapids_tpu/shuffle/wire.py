@@ -38,7 +38,14 @@ def serialize_host_table(schema: Schema, num_rows: int,
                                              np.ndarray]]) -> bytes:
     """columns: per column (data, validity, offsets-or-empty) numpy arrays
     already trimmed to num_rows (strings: offsets has num_rows+1, data has
-    offsets[-1] chars)."""
+    offsets[-1] chars).
+
+    Uses the native single-pass frame writer (native/src/tpu_native.cpp,
+    the JCudfSerialization-analogue) when built; the Python path below
+    produces byte-identical frames."""
+    from spark_rapids_tpu.nativelib import get_lib
+    if get_lib() is not None:
+        return _serialize_native(schema, num_rows, columns)
     head = [struct.pack("<IIII", MAGIC, VERSION, num_rows, len(schema))]
     bufs = []
     for (name, dt), (data, validity, offsets) in zip(
@@ -54,6 +61,58 @@ def serialize_host_table(schema: Schema, num_rows: int,
         head.append(struct.pack("<QQQ", len(data_b), len(val_b), len(off_b)))
         bufs.extend((data_b, val_b, off_b))
     return b"".join(head + bufs)
+
+
+def _serialize_native(schema: Schema, num_rows: int, columns) -> bytes:
+    """One-pass native frame assembly over ctypes pointer arrays."""
+    import ctypes as C
+    from spark_rapids_tpu.nativelib import get_lib
+    lib = get_lib()
+    ncols = len(schema)
+    u8p = C.POINTER(C.c_uint8)
+
+    # keep every array referenced until the native call returns
+    keep = []
+    name_bufs, dtype_bufs = [], []
+    data_arrs, val_arrs, off_arrs = [], [], []
+    for (name, dt), (data, validity, offsets) in zip(
+            zip(schema.names, schema.dtypes), columns):
+        name_bufs.append(name.encode("utf-8"))
+        dtype_bufs.append(dt.name.encode("ascii"))
+        d = np.ascontiguousarray(data)
+        v = np.ascontiguousarray(validity.astype(np.uint8))
+        o = (np.ascontiguousarray(offsets) if offsets is not None
+             else np.empty(0, np.int32))
+        keep.extend((d, v, o))
+        data_arrs.append(d)
+        val_arrs.append(v)
+        off_arrs.append(o)
+
+    def ptrs(arrs):
+        out = (u8p * ncols)()
+        for i, a in enumerate(arrs):
+            if isinstance(a, bytes):
+                buf = C.create_string_buffer(a, len(a) or 1)
+                keep.append(buf)
+                out[i] = C.cast(buf, u8p)
+            else:
+                out[i] = C.cast(a.ctypes.data, u8p)
+        return out
+
+    name_lens = (C.c_uint16 * ncols)(*[len(b) for b in name_bufs])
+    dtype_lens = (C.c_uint8 * ncols)(*[len(b) for b in dtype_bufs])
+    data_lens = (C.c_uint64 * ncols)(*[a.nbytes for a in data_arrs])
+    off_lens = (C.c_uint64 * ncols)(*[a.nbytes for a in off_arrs])
+    size = lib.tpu_wire_frame_size(num_rows, ncols, name_lens, dtype_lens,
+                                   data_lens, off_lens)
+    dest = C.create_string_buffer(size)
+    written = lib.tpu_wire_write_frame(
+        C.cast(dest, u8p), num_rows, ncols,
+        ptrs(name_bufs), name_lens, ptrs(dtype_bufs), dtype_lens,
+        ptrs(data_arrs), data_lens, ptrs(val_arrs),
+        ptrs(off_arrs), off_lens)
+    assert written == size, (written, size)
+    return dest.raw[:size]
 
 
 def serialize_batch(batch: DeviceBatch) -> bytes:
