@@ -197,6 +197,12 @@ CSV_READ_ENABLED = register(
 ORC_ENABLED = register(
     "spark.rapids.sql.format.orc.enabled", _to_bool, True,
     "Enable ORC input/output acceleration.")
+ORC_READ_ENABLED = register(
+    "spark.rapids.sql.format.orc.read.enabled", _to_bool, True,
+    "Enable accelerated ORC scans.")
+ORC_WRITE_ENABLED = register(
+    "spark.rapids.sql.format.orc.write.enabled", _to_bool, True,
+    "Enable accelerated ORC writes.")
 
 # --- test hooks (ref RapidsConf.scala:476-501) -----------------------------
 TEST_ENABLED = register(

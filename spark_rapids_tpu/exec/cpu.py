@@ -78,17 +78,25 @@ class CpuProjectExec(PhysicalPlan):
         return f"CpuProjectExec([{', '.join(n for n, _ in self.exprs)}])"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
+        from spark_rapids_tpu.exec import taskctx
+        from spark_rapids_tpu.sql.exprs.nondet import has_nondeterministic
         child_parts = self.children[0].partitions(ctx)
+        impure = any(has_nondeterministic(e) for _, e in self.exprs)
 
-        def make(part: Partition) -> Partition:
+        def make(index: int, part: Partition) -> Partition:
             def run():
+                seen = 0
                 for df in part():
+                    if impure:
+                        taskctx.set_partition(index)
+                        taskctx.set_row_base(seen)
+                        seen += len(df)
                     out = {}
                     for name, e in self.exprs:
                         out[name] = e.eval_host(df).reset_index(drop=True)
                     yield pd.DataFrame(out, columns=[n for n, _ in self.exprs])
             return run
-        return [make(p) for p in child_parts]
+        return [make(i, p) for i, p in enumerate(child_parts)]
 
 
 class CpuFilterExec(PhysicalPlan):
@@ -103,17 +111,25 @@ class CpuFilterExec(PhysicalPlan):
         return f"CpuFilterExec({self.condition!r})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
+        from spark_rapids_tpu.exec import taskctx
+        from spark_rapids_tpu.sql.exprs.nondet import has_nondeterministic
         child_parts = self.children[0].partitions(ctx)
+        impure = has_nondeterministic(self.condition)
 
-        def make(part: Partition) -> Partition:
+        def make(index: int, part: Partition) -> Partition:
             def run():
+                seen = 0
                 for df in part():
+                    if impure:
+                        taskctx.set_partition(index)
+                        taskctx.set_row_base(seen)
+                        seen += len(df)
                     pred = self.condition.eval_host(df)
                     vals, validity, _ = host_unary_values(pred)
                     keep = vals.astype(np.bool_) & validity
                     yield df[keep].reset_index(drop=True)
             return run
-        return [make(p) for p in child_parts]
+        return [make(i, p) for i, p in enumerate(child_parts)]
 
 
 class CpuHashAggregateExec(PhysicalPlan):

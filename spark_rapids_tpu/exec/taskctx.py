@@ -1,0 +1,51 @@
+"""Per-task execution context (thread-local).
+
+The analogue of Spark's ``TaskContext`` + ``InputFileBlockHolder``: the
+reference's nondeterministic expressions (GpuSparkPartitionID.scala:58,
+GpuMonotonicallyIncreasingID.scala:75, GpuInputFileBlock.scala:114) read the
+partition index and the current input file from task-scoped state that the
+scan/exec machinery maintains. Here every operator's partition runner sets
+the partition index before iterating, and file sources publish the file they
+are currently decoding.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_state = threading.local()
+
+
+def set_partition(index: int) -> None:
+    _state.part_id = index
+    _state.row_base = 0
+
+
+def partition_id() -> int:
+    return getattr(_state, "part_id", 0)
+
+
+def row_base() -> int:
+    """Rows already emitted by earlier batches of this partition — the
+    monotonically_increasing_id intra-partition offset. Each operator that
+    evaluates nondeterministic expressions tracks its own count locally and
+    publishes it with ``set_row_base`` right before evaluating, so stacked
+    operators in one generator pipeline cannot corrupt each other."""
+    return getattr(_state, "row_base", 0)
+
+
+def set_row_base(n: int) -> None:
+    _state.row_base = n
+
+
+def set_input_file(path: str) -> None:
+    _state.input_file = path
+
+
+def input_file() -> str:
+    """Empty string outside a file scan, like Spark's input_file_name()."""
+    return getattr(_state, "input_file", "")
+
+
+def clear_input_file() -> None:
+    _state.input_file = ""

@@ -63,10 +63,20 @@ class TpuProjectExec(TpuExec):
         self.exprs = list(exprs)
         names = [n for n, _ in self.exprs]
         bound = [e for _, e in self.exprs]
-        sig = "project|" + "|".join(
-            f"{n}={expr_signature(e)}" for n, e in self.exprs)
-        self._kernel = cached_jit(sig, lambda: jax.jit(
-            lambda batch: eval_projection(batch, bound, names)))
+        from spark_rapids_tpu.sql.exprs.nondet import has_nondeterministic
+        self._impure = any(has_nondeterministic(e) for e in bound)
+        if self._impure:
+            # nondeterministic exprs read task-local state (partition id,
+            # row offset, input file) that must be current at call time, so
+            # the projection is traced eagerly per batch instead of through
+            # the process-wide kernel cache (the reference similarly special
+            # cases these, GpuTransitionOverrides.scala:110-123).
+            self._kernel = lambda batch: eval_projection(batch, bound, names)
+        else:
+            sig = "project|" + "|".join(
+                f"{n}={expr_signature(e)}" for n, e in self.exprs)
+            self._kernel = cached_jit(sig, lambda: jax.jit(
+                lambda batch: eval_projection(batch, bound, names)))
 
     def output_schema(self) -> Schema:
         cs = self.children[0].output_schema()
@@ -77,14 +87,20 @@ class TpuProjectExec(TpuExec):
         return f"TpuProjectExec([{', '.join(n for n, _ in self.exprs)}])"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
+        from spark_rapids_tpu.exec import taskctx
         child_parts = self.children[0].partitions(ctx)
 
-        def make(part: Partition) -> Partition:
+        def make(index: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
+                seen = 0
                 for batch in part():
+                    if self._impure:
+                        taskctx.set_partition(index)
+                        taskctx.set_row_base(seen)
+                        seen += batch.num_rows_host()
                     yield self._kernel(batch)
             return run
-        return [make(p) for p in child_parts]
+        return [make(i, p) for i, p in enumerate(child_parts)]
 
 
 class TpuFilterExec(TpuExec):
@@ -99,8 +115,14 @@ class TpuFilterExec(TpuExec):
             pred = to_device_column(ctx, condition.eval_device(ctx))
             keep = pred.data & pred.validity
             return rowops.filter_batch(batch, keep)
-        sig = "filter|" + expr_signature(condition)
-        self._kernel = cached_jit(sig, lambda: jax.jit(kernel))
+        from spark_rapids_tpu.sql.exprs.nondet import has_nondeterministic
+        self._impure = has_nondeterministic(condition)
+        if self._impure:
+            # see TpuProjectExec: task-local state must be read at call time
+            self._kernel = kernel
+        else:
+            sig = "filter|" + expr_signature(condition)
+            self._kernel = cached_jit(sig, lambda: jax.jit(kernel))
 
     def output_schema(self) -> Schema:
         return self.children[0].output_schema()
@@ -109,14 +131,20 @@ class TpuFilterExec(TpuExec):
         return f"TpuFilterExec({self.condition!r})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
+        from spark_rapids_tpu.exec import taskctx
         child_parts = self.children[0].partitions(ctx)
 
-        def make(part: Partition) -> Partition:
+        def make(index: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
+                seen = 0
                 for batch in part():
+                    if self._impure:
+                        taskctx.set_partition(index)
+                        taskctx.set_row_base(seen)
+                        seen += batch.num_rows_host()
                     yield self._kernel(batch)
             return run
-        return [make(p) for p in child_parts]
+        return [make(i, p) for i, p in enumerate(child_parts)]
 
 
 class TpuHashAggregateExec(TpuExec):

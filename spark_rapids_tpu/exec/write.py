@@ -105,19 +105,14 @@ class CpuWriteExec(PhysicalPlan):
         return f"CpuWriteExec({self.fmt}, {self.path})"
 
     def _write_table(self, table, f: str) -> None:
-        if self.fmt == "parquet":
-            import pyarrow.parquet as pq
-            pq.write_table(table, f)
-        else:
-            import pyarrow.csv as pacsv
-            pacsv.write_csv(table, f)
+        _encode_table(table, f, self.fmt)
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child_parts = self.children[0].partitions(ctx)
         schema = self.children[0].output_schema()
         protocol = WriteCommitProtocol(self.path)
         protocol.setup(self.mode)
-        ext = ".parquet" if self.fmt == "parquet" else ".csv"
+        ext = _EXTENSIONS[self.fmt]
         state = {"remaining": len(child_parts), "failed": False}
 
         def make(i: int, part: Partition) -> Partition:
@@ -165,7 +160,7 @@ class TpuWriteExec(PhysicalPlan):
         child_parts = self.children[0].partitions(ctx)
         protocol = WriteCommitProtocol(self.path)
         protocol.setup(self.mode)
-        ext = ".parquet" if self.fmt == "parquet" else ".csv"
+        ext = _EXTENSIONS[self.fmt]
         state = {"remaining": len(child_parts), "failed": False}
 
         def make(i: int, part: Partition) -> Partition:
@@ -175,13 +170,8 @@ class TpuWriteExec(PhysicalPlan):
                     tables = [_arrow_table_from_batch(b)
                               for b in part() if b.num_rows_host()]
                     if tables:
-                        table = pa.concat_tables(tables)
-                        if self.fmt == "parquet":
-                            import pyarrow.parquet as pq
-                            pq.write_table(table, protocol.task_file(i, ext))
-                        else:
-                            import pyarrow.csv as pacsv
-                            pacsv.write_csv(table, protocol.task_file(i, ext))
+                        _encode_table(pa.concat_tables(tables),
+                                      protocol.task_file(i, ext), self.fmt)
                 except Exception:
                     state["failed"] = True
                     protocol.abort()
@@ -192,3 +182,18 @@ class TpuWriteExec(PhysicalPlan):
                 yield pd.DataFrame()
             return run
         return [make(i, p) for i, p in enumerate(child_parts)]
+
+
+_EXTENSIONS = {"parquet": ".parquet", "csv": ".csv", "orc": ".orc"}
+
+
+def _encode_table(table, f: str, fmt: str) -> None:
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        pq.write_table(table, f)
+    elif fmt == "orc":
+        import pyarrow.orc as paorc
+        paorc.write_table(table, f)
+    else:
+        import pyarrow.csv as pacsv
+        pacsv.write_csv(table, f)

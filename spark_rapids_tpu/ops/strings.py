@@ -213,3 +213,206 @@ def concat_columns(ctx: EvalContext, cols) -> DevCol:
     total_new = new_offsets[capacity]
     out = jnp.where(k < total_new, out, 0).astype(jnp.uint8)
     return DevCol(dtypes.STRING, out, validity, new_offsets)
+
+
+def _char_row_ids(col: DevCol, capacity: int) -> jnp.ndarray:
+    """Row id owning each char slot (clipped into [0, capacity-1])."""
+    nchars = col.data.shape[0]
+    i = jnp.arange(nchars, dtype=jnp.int32)
+    return jnp.clip(
+        jnp.searchsorted(col.offsets, i, side="right").astype(jnp.int32) - 1,
+        0, capacity - 1)
+
+
+def trim(ctx: EvalContext, col: DevCol, chars: str = " \t\r\n",
+         left: bool = True, right: bool = True) -> DevCol:
+    """trim/ltrim/rtrim of a literal char set (Spark default: spaces; the
+    wider default whitespace set matches java.lang.String.trim)."""
+    capacity = ctx.capacity
+    nchars = col.data.shape[0]
+    i = jnp.arange(nchars, dtype=jnp.int32)
+    row_ids = _char_row_ids(col, capacity)
+    is_trim = jnp.zeros((nchars,), jnp.bool_)
+    for ch in chars.encode("utf-8"):
+        is_trim = is_trim | (col.data == ch)
+    total = col.offsets[capacity]
+    live = i < total
+    # first / last non-trim char position per row (defaults: empty row)
+    non_trim = (~is_trim) & live
+    big = jnp.int32(2**30)
+    # clamp the segment identities (int32 min/max for empty segments) so
+    # the arithmetic below cannot wrap around
+    first_keep = jnp.minimum(jax.ops.segment_min(
+        jnp.where(non_trim, i, big), row_ids, num_segments=capacity), big)
+    last_keep = jnp.maximum(jax.ops.segment_max(
+        jnp.where(non_trim, i, -1), row_ids, num_segments=capacity), -1)
+    starts = col.offsets[:-1].astype(jnp.int32)
+    ends = col.offsets[1:].astype(jnp.int32)
+    new_start = jnp.where(left, jnp.minimum(first_keep, ends), starts)
+    new_end = jnp.where(right, last_keep + 1, ends)
+    # all-trim rows: first_keep=big, last_keep=-1 -> empty
+    new_len = jnp.maximum(
+        jnp.minimum(new_end, ends) - jnp.maximum(new_start, starts), 0)
+    src_start = jnp.maximum(new_start, starts)
+    return _gather_substrings(ctx, col, src_start, new_len)
+
+
+def pad(ctx: EvalContext, col: DevCol, n: int, pad_char: str,
+        left: bool) -> DevCol:
+    """lpad/rpad to exactly ``n`` bytes (Spark truncates longer strings)."""
+    capacity = ctx.capacity
+    lens = lengths_of(col)
+    out_len = jnp.full((capacity,), n, dtype=jnp.int32)
+    new_offsets = jnp.arange(capacity + 1, dtype=jnp.int32) * jnp.int32(n)
+    out_cap = max(capacity * n, 1)
+    k = jnp.arange(out_cap, dtype=jnp.int32)
+    out_row = k // jnp.maximum(n, 1)
+    out_row = jnp.clip(out_row, 0, capacity - 1)
+    p = k - out_row * n                      # position within the row
+    padlen = jnp.maximum(n - lens, 0)
+    if left:
+        from_src = p >= padlen[out_row]
+        src_rel = p - padlen[out_row]
+    else:
+        from_src = p < lens[out_row]
+        src_rel = p
+    nchars = col.data.shape[0]
+    src_idx = col.offsets[:-1][out_row].astype(jnp.int32) + src_rel
+    vals = col.data[jnp.clip(src_idx, 0, max(nchars - 1, 0))]
+    pad_byte = pad_char.encode("utf-8")[0] if pad_char else ord(" ")
+    out = jnp.where(from_src, vals, jnp.uint8(pad_byte))
+    total_new = new_offsets[capacity]
+    out = jnp.where(k < total_new, out, 0).astype(jnp.uint8)
+    return DevCol(dtypes.STRING, out, col.validity, new_offsets)
+
+
+def locate(ctx: EvalContext, col: DevCol, lit: str,
+           start_pos: int = 1) -> jnp.ndarray:
+    """1-based byte position of the first occurrence of ``lit`` at or after
+    ``start_pos``; 0 when absent (Spark locate/instr semantics)."""
+    pat = lit.encode("utf-8")
+    m = len(pat)
+    capacity = ctx.capacity
+    lens = lengths_of(col)
+    if m == 0:
+        return jnp.where(lens >= 0, jnp.int32(max(start_pos, 1)), 0)
+    chars = col.data
+    nchars = chars.shape[0]
+    pos_match = jnp.ones((nchars,), dtype=jnp.bool_)
+    for j, c in enumerate(pat):
+        shifted = jnp.roll(chars, -j) if j else chars
+        ok = (jnp.arange(nchars) + j) < nchars
+        pos_match = pos_match & (shifted == c) & ok
+    i = jnp.arange(nchars, dtype=jnp.int32)
+    row_ids = _char_row_ids(col, capacity)
+    fits = (i + m) <= col.offsets[row_ids + 1]
+    rel = i - col.offsets[:-1][row_ids]
+    after = rel >= (start_pos - 1)
+    total = col.offsets[capacity]
+    big = jnp.int32(2**30)
+    cand = jnp.where(pos_match & fits & after & (i < total), rel, big)
+    first = jax.ops.segment_min(cand, row_ids, num_segments=capacity)
+    return jnp.where(first < big, first + 1, 0).astype(jnp.int32)
+
+
+def replace_literal(ctx: EvalContext, col: DevCol, search: str,
+                    replacement: str) -> DevCol:
+    """str_replace with literal search/replacement. Non-overlapping
+    leftmost-first matches selected with a short lax.scan over char
+    positions, then the output is built with an expansion gather."""
+    pat = search.encode("utf-8")
+    rep = replacement.encode("utf-8")
+    m = len(pat)
+    capacity = ctx.capacity
+    if m == 0:
+        return col
+    chars = col.data
+    nchars = chars.shape[0]
+    pos_match = jnp.ones((nchars,), dtype=jnp.bool_)
+    for j, c in enumerate(pat):
+        shifted = jnp.roll(chars, -j) if j else chars
+        ok = (jnp.arange(nchars) + j) < nchars
+        pos_match = pos_match & (shifted == c) & ok
+    i = jnp.arange(nchars, dtype=jnp.int32)
+    row_ids = _char_row_ids(col, capacity)
+    fits = (i + m) <= col.offsets[row_ids + 1]
+    total = col.offsets[capacity]
+    candidate = pos_match & fits & (i < total)
+
+    # greedy leftmost non-overlapping selection: scan position by position,
+    # carrying (blocked_until, current_row)
+    def step(carry, x):
+        blocked_until, = carry
+        pos, cand, row_start = x
+        fresh = pos >= jnp.maximum(blocked_until, row_start)
+        take = cand & fresh
+        new_blocked = jnp.where(take, pos + m, blocked_until)
+        return (new_blocked,), take
+    row_start = col.offsets[:-1][row_ids].astype(jnp.int32)
+    (_,), selected = jax.lax.scan(
+        step, (jnp.int32(-1),), (i, candidate, row_start))
+
+    delta = len(rep) - m
+    sel_i = selected.astype(jnp.int32)
+    matches_per_row = jax.ops.segment_sum(sel_i, row_ids,
+                                          num_segments=capacity)
+    lens = lengths_of(col)
+    new_len = lens + matches_per_row * delta
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(new_len).astype(jnp.int32)])
+
+    # source-position -> output-position mapping: each selected match makes
+    # the following chars shift by delta and its own m chars map into rep
+    shift_after = jnp.cumsum(sel_i) * delta        # includes own match
+    # a char at position p is inside a match iff a selected start s has
+    # s <= p < s+m
+    start_marks = jnp.zeros((nchars + 1,), jnp.int32)
+    start_marks = start_marks.at[jnp.clip(i, 0, nchars)].add(sel_i)
+    end_marks = jnp.zeros((nchars + 1,), jnp.int32)
+    end_marks = end_marks.at[jnp.clip(i + m, 0, nchars)].add(sel_i)
+    inside = jnp.cumsum(start_marks - end_marks)[:nchars] > 0
+
+    # output chars built by scatter: passthrough chars go to
+    # i + shift_before(i) where shift_before counts earlier matches' delta
+    shift_before = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        (jnp.cumsum(sel_i) * delta)[:-1].astype(jnp.int32)])
+    out_cap = max(int(nchars + (nchars // max(m, 1) + 1) * max(delta, 0)), 1)
+    out = jnp.zeros((out_cap,), jnp.uint8)
+    pass_dst = i + shift_before
+    keep = (~inside) & (i < total)
+    out = out.at[jnp.where(keep, jnp.clip(pass_dst, 0, out_cap - 1),
+                           out_cap - 1)].max(
+        jnp.where(keep, chars, 0).astype(jnp.uint8), mode="drop")
+    # replacement bytes for each selected match
+    if len(rep):
+        repv = jnp.asarray(bytearray(rep), dtype=jnp.uint8)
+        match_dst = i + shift_before   # match start maps to same shifted pos
+        for j in range(len(rep)):
+            dst = jnp.clip(match_dst + j, 0, out_cap - 1)
+            out = out.at[jnp.where(selected, dst, out_cap - 1)].max(
+                jnp.where(selected, repv[j], 0).astype(jnp.uint8),
+                mode="drop")
+    total_new = new_offsets[capacity]
+    k = jnp.arange(out_cap, dtype=jnp.int32)
+    out = jnp.where(k < total_new, out, 0).astype(jnp.uint8)
+    return DevCol(dtypes.STRING, out, col.validity, new_offsets)
+
+
+def initcap_ascii(col: DevCol) -> DevCol:
+    """Uppercase the first letter of each word, lowercase the rest."""
+    c = col.data
+    nchars = c.shape[0]
+    prev = jnp.roll(c, 1).at[0].set(ord(" "))
+    # chars at row starts also begin words
+    starts_mask = jnp.zeros((nchars,), jnp.bool_)
+    nrows = col.offsets.shape[0] - 1
+    starts_mask = starts_mask.at[
+        jnp.clip(col.offsets[:-1], 0, max(nchars - 1, 0))].set(True)
+    word_start = starts_mask | (prev == ord(" "))
+    lowered = jnp.where((c >= 65) & (c <= 90), c + 32, c)
+    uppered = jnp.where((c >= 97) & (c <= 122), c - 32, c)
+    return DevCol(dtypes.STRING,
+                  jnp.where(word_start, uppered, lowered).astype(jnp.uint8),
+                  col.validity, col.offsets)

@@ -156,6 +156,9 @@ class DataFrameWriter:
     def csv(self, path: str) -> None:
         self._run(path, "csv")
 
+    def orc(self, path: str) -> None:
+        self._run(path, "orc")
+
 
 class DataFrameReader:
     def __init__(self, session: TpuSparkSession):
@@ -181,6 +184,11 @@ class DataFrameReader:
                          lp.LogicalScan(CsvSource(list(paths),
                                                   schema=self._schema,
                                                   header=header)))
+
+    def orc(self, *paths: str) -> "DataFrame":
+        from spark_rapids_tpu.sql.sources import OrcSource
+        return DataFrame(self.session,
+                         lp.LogicalScan(OrcSource(list(paths))))
 
 
 class GroupedData:

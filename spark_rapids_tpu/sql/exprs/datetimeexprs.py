@@ -194,6 +194,201 @@ class DateAdd(Expression):
     def eval_host(self, df: pd.DataFrame) -> pd.Series:
         a, av, index = host_unary_values(self.children[0].eval_host(df))
         b, bv, _ = host_unary_values(self.children[1].eval_host(df))
-        # host dates ride as datetime64->micros; add days in micro space
-        data = a.astype(np.int64) + b.astype(np.int64) * MICROS_PER_DAY
-        return rebuild_series(data, av & bv, dtypes.TIMESTAMP_US, index)
+        # host dates ride as datetime64->micros; truncate to the day first
+        # (Spark casts timestamp inputs to date) then add days in micro space
+        days = days_from_micros(np, a) + b.astype(np.int64)
+        return rebuild_series(days * MICROS_PER_DAY, av & bv,
+                              dtypes.TIMESTAMP_US, index)
+
+
+class Quarter(ExtractDatePart):
+    fname = "quarter"
+    def compute_from_parts(self, xp, days, tod):
+        y, m, d = civil_from_days(xp, days)
+        return (m - 1) // 3 + 1
+
+
+class DayOfYear(ExtractDatePart):
+    fname = "dayofyear"
+    def compute_from_parts(self, xp, days, tod):
+        y, m, d = civil_from_days(xp, days)
+        # days since Jan 1 of the same year
+        jan1 = days_from_civil(xp, y, xp.ones_like(m), xp.ones_like(d))
+        return (days - jan1 + 1)
+
+
+class WeekOfYear(ExtractDatePart):
+    """ISO-8601 week number (Spark's weekofyear)."""
+    fname = "weekofyear"
+    def compute_from_parts(self, xp, days, tod):
+        # ISO week: Thursday of the current week determines the year;
+        # week number = (doy_of_thursday - 1) // 7 + 1
+        dow = (days + 3) % 7            # 0 = Monday ... 6 = Sunday
+        thursday = days + (3 - dow)
+        y, m, d = civil_from_days(xp, thursday)
+        jan1 = days_from_civil(xp, y, xp.ones_like(m), xp.ones_like(d))
+        return (thursday - jan1) // 7 + 1
+
+
+def days_from_civil(xp, y, m, d):
+    """Inverse of civil_from_days (Hinnant's days_from_civil)."""
+    y = y.astype(np.int64) - (m <= 2)
+    era = xp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = xp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+class LastDay(Expression):
+    """last_day(date): last day of the month."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.DATE32
+
+    def sql_name(self, schema=None) -> str:
+        return f"last_day({self.children[0].sql_name(schema)})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if not self.children[0].dtype(schema).is_datetime:
+            return "last_day requires a date input"
+        return None
+
+    def _compute(self, xp, days):
+        y, m, d = civil_from_days(xp, days)
+        ny = xp.where(m == 12, y + 1, y)
+        nm = xp.where(m == 12, xp.ones_like(m), m + 1)
+        first_next = days_from_civil(xp, ny, nm, xp.ones_like(d))
+        return (first_next - 1).astype(np.int32)
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        days = (v.data.astype(jnp.int64) if v.dtype == dtypes.DATE32
+                else days_from_micros(jnp, v.data))
+        return DevCol(dtypes.DATE32, self._compute(jnp, days), v.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        days = days_from_micros(np, values)   # host datetimes ride as micros
+        out_days = self._compute(np, days).astype(np.int64)
+        return rebuild_series(out_days * MICROS_PER_DAY, validity,
+                              dtypes.TIMESTAMP_US, index)
+
+
+class DateSub(DateAdd):
+    """date_sub(date, n days)."""
+
+    def sql_name(self, schema=None) -> str:
+        return (f"date_sub({self.children[0].sql_name(schema)}, "
+                f"{self.children[1].sql_name(schema)})")
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = ctx.broadcast(self.children[0].eval_device(ctx))
+        rv = ctx.broadcast(self.children[1].eval_device(ctx))
+        data = (lv.data.astype(jnp.int32) - rv.data.astype(jnp.int32))
+        return DevCol(dtypes.DATE32, data, lv.validity & rv.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        b, bv, _ = host_unary_values(self.children[1].eval_host(df))
+        days = days_from_micros(np, a) - b.astype(np.int64)
+        return rebuild_series(days * MICROS_PER_DAY, av & bv,
+                              dtypes.TIMESTAMP_US, index)
+
+
+class DateDiff(Expression):
+    """datediff(end, start) in whole days."""
+
+    def __init__(self, end: Expression, start: Expression):
+        super().__init__([end, start])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT32
+
+    def sql_name(self, schema=None) -> str:
+        return (f"datediff({self.children[0].sql_name(schema)}, "
+                f"{self.children[1].sql_name(schema)})")
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        for c in self.children:
+            if not c.dtype(schema).is_datetime:
+                return "datediff requires date/timestamp inputs"
+        return None
+
+    def _days(self, xp, data, dt: DType):
+        if dt == dtypes.DATE32:
+            return data.astype(np.int64)
+        return days_from_micros(xp, data)
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = ctx.broadcast(self.children[0].eval_device(ctx))
+        rv = ctx.broadcast(self.children[1].eval_device(ctx))
+        out = (self._days(jnp, lv.data, lv.dtype)
+               - self._days(jnp, rv.data, rv.dtype)).astype(jnp.int32)
+        return DevCol(dtypes.INT32, out, lv.validity & rv.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        b, bv, _ = host_unary_values(self.children[1].eval_host(df))
+        out = (days_from_micros(np, a) - days_from_micros(np, b)).astype(np.int32)
+        return rebuild_series(out, av & bv, dtypes.INT32, index)
+
+
+class ToDate(Expression):
+    """to_date(timestamp) — truncate to the day."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.DATE32
+
+    def sql_name(self, schema=None) -> str:
+        return f"to_date({self.children[0].sql_name(schema)})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if not self.children[0].dtype(schema).is_datetime:
+            return "to_date requires a date/timestamp input (string parsing "\
+                   "is not supported on TPU)"
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        if v.dtype == dtypes.DATE32:
+            return v
+        days = days_from_micros(jnp, v.data).astype(jnp.int32)
+        return DevCol(dtypes.DATE32, days, v.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        days = days_from_micros(np, values)
+        return rebuild_series(days * MICROS_PER_DAY, validity,
+                              dtypes.TIMESTAMP_US, index)
+
+
+class FromUnixTime(Expression):
+    """from_unixtime(seconds) -> timestamp (no format string: the reference
+    also restricts strftime conversions, UnixTimeExprMeta)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.TIMESTAMP_US
+
+    def sql_name(self, schema=None) -> str:
+        return f"from_unixtime({self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        data = v.data.astype(jnp.int64) * MICROS_PER_SEC
+        return DevCol(dtypes.TIMESTAMP_US, data, v.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        data = values.astype(np.int64) * MICROS_PER_SEC
+        return rebuild_series(data, validity, dtypes.TIMESTAMP_US, index)

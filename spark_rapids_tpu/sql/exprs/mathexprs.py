@@ -171,3 +171,79 @@ class Atan2(Expression):
         with np.errstate(all="ignore"):
             data = np.arctan2(a.astype(np.float64), b.astype(np.float64))
         return rebuild_series(data, av & bv, dtypes.FLOAT64, index)
+
+
+class Hypot(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.FLOAT64
+
+    def sql_name(self, schema=None) -> str:
+        return (f"hypot({self.children[0].sql_name(schema)}, "
+                f"{self.children[1].sql_name(schema)})")
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = ctx.broadcast(self.children[0].eval_device(ctx))
+        rv = ctx.broadcast(self.children[1].eval_device(ctx))
+        data = jnp.hypot(lv.data.astype(jnp.float64),
+                         rv.data.astype(jnp.float64))
+        return DevCol(dtypes.FLOAT64, data, lv.validity & rv.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        b, bv, _ = host_unary_values(self.children[1].eval_host(df))
+        with np.errstate(all="ignore"):
+            data = np.hypot(a.astype(np.float64), b.astype(np.float64))
+        return rebuild_series(data, av & bv, dtypes.FLOAT64, index)
+
+
+class Round(Expression):
+    """round(x, scale) with Spark/Java HALF_UP semantics (numpy/XLA rint is
+    HALF_EVEN, so the kernel is sign(x) * floor(|x| * 10^s + 0.5) / 10^s)."""
+
+    def __init__(self, child: Expression, scale: int = 0):
+        super().__init__([child])
+        self.scale = int(scale)
+
+    def dtype(self, schema: Schema) -> DType:
+        t = self.children[0].dtype(schema)
+        if t.is_integral and self.scale >= 0:
+            return t
+        return dtypes.FLOAT64
+
+    def sql_name(self, schema=None) -> str:
+        return f"round({self.children[0].sql_name(schema)}, {self.scale})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if self.children[0].dtype(schema).is_string:
+            return "string input"
+        return None
+
+    def _compute(self, xp, x, integral: bool):
+        if integral and self.scale >= 0:
+            return x
+        p = float(10.0 ** self.scale)
+        y = xp.floor(xp.abs(x.astype(np.float64)) * p + 0.5) / p
+        out = xp.where(x < 0, -y, y)
+        return out
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        integral = v.dtype.is_integral
+        out = self._compute(jnp, v.data, integral)
+        dt = v.dtype if (integral and self.scale >= 0) else dtypes.FLOAT64
+        return DevCol(dt, out.astype(dt.np_dtype), v.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        s = self.children[0].eval_host(df)
+        values, validity, index = host_unary_values(s)
+        from spark_rapids_tpu.sql.exprs.hostutil import series_dtype
+        integral = series_dtype(s).is_integral
+        with np.errstate(all="ignore"):
+            out = self._compute(np, values, integral)
+        dt = series_dtype(s) if (integral and self.scale >= 0) \
+            else dtypes.FLOAT64
+        return rebuild_series(np.asarray(out).astype(dt.np_dtype), validity,
+                              dt, index)

@@ -1,0 +1,153 @@
+"""Nondeterministic expressions (reference:
+catalyst/expressions/GpuRandomExpressions.scala:75,
+GpuMonotonicallyIncreasingID.scala:75, GpuSparkPartitionID.scala:58,
+GpuInputFileBlock.scala:114).
+
+These read task-scoped state (partition index, rows emitted so far, current
+input file) from ``exec.taskctx``, so projections containing them are
+evaluated *eagerly* per batch rather than through the cached-jit path — the
+operator checks ``is_nondeterministic`` and opts out of kernel caching (the
+reference similarly forces coalesce-disable around input-file expressions,
+GpuTransitionOverrides.scala:110-123).
+
+``Rand`` uses a stateless splitmix64-style counter hash of
+(seed, partition, row index) — the identical integer formula on host (numpy)
+and device (jax.numpy), so CPU and TPU paths produce bit-equal streams
+(unlike the reference, whose GPU rand is documented incompatible with
+Spark's XORShift sequence).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType
+from spark_rapids_tpu.exec import taskctx
+from spark_rapids_tpu.sql.exprs.core import (
+    DevCol, DevValue, EvalContext, Expression,
+)
+
+
+def _splitmix64(xp, x):
+    """Finalizer of the splitmix64 generator; uint64 in, uint64 out."""
+    x = (x + xp.uint64(0x9E3779B97F4A7C15)) & xp.uint64(0xFFFFFFFFFFFFFFFF)
+    x = ((x ^ (x >> xp.uint64(30))) * xp.uint64(0xBF58476D1CE4E5B9)) \
+        & xp.uint64(0xFFFFFFFFFFFFFFFF)
+    x = ((x ^ (x >> xp.uint64(27))) * xp.uint64(0x94D049BB133111EB)) \
+        & xp.uint64(0xFFFFFFFFFFFFFFFF)
+    return x ^ (x >> xp.uint64(31))
+
+
+class Rand(Expression):
+    """rand(seed): uniform [0, 1) double; stream determined by
+    (seed, partition index, row position)."""
+
+    is_nondeterministic = True
+
+    def __init__(self, seed: int = 0):
+        super().__init__()
+        self.seed = int(seed)
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.FLOAT64
+
+    def sql_name(self, schema=None) -> str:
+        return f"rand({self.seed})"
+
+    def _uniform(self, xp, idx):
+        mixed = _splitmix64(
+            xp, idx.astype(xp.uint64)
+            ^ (xp.uint64(self.seed & 0xFFFFFFFFFFFFFFFF))
+            ^ (xp.uint64(taskctx.partition_id()) << xp.uint64(32)))
+        # take the top 53 bits for a double in [0, 1)
+        return (mixed >> xp.uint64(11)).astype(xp.float64) / float(1 << 53)
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        idx = jnp.arange(ctx.capacity, dtype=jnp.uint64) \
+            + jnp.uint64(taskctx.row_base())
+        return DevCol(dtypes.FLOAT64, self._uniform(jnp, idx),
+                      jnp.ones((ctx.capacity,), jnp.bool_))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        idx = np.arange(len(df), dtype=np.uint64) \
+            + np.uint64(taskctx.row_base())
+        return pd.Series(self._uniform(np, idx), index=df.index)
+
+
+class SparkPartitionID(Expression):
+    """spark_partition_id() (reference: GpuSparkPartitionID.scala:58)."""
+
+    is_nondeterministic = True
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT32
+
+    def sql_name(self, schema=None) -> str:
+        return "SPARK_PARTITION_ID()"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        pid = jnp.full((ctx.capacity,), taskctx.partition_id(), jnp.int32)
+        return DevCol(dtypes.INT32, pid, jnp.ones((ctx.capacity,), jnp.bool_))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        return pd.Series(np.full(len(df), taskctx.partition_id(),
+                                 dtype=np.int32), index=df.index)
+
+
+class MonotonicallyIncreasingID(Expression):
+    """(partition id << 33) + row position within the partition — Spark's
+    exact layout (reference: GpuMonotonicallyIncreasingID.scala:75)."""
+
+    is_nondeterministic = True
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT64
+
+    def sql_name(self, schema=None) -> str:
+        return "monotonically_increasing_id()"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        base = (np.int64(taskctx.partition_id()) << np.int64(33)) \
+            + np.int64(taskctx.row_base())
+        data = jnp.arange(ctx.capacity, dtype=jnp.int64) + jnp.int64(base)
+        return DevCol(dtypes.INT64, data,
+                      jnp.ones((ctx.capacity,), jnp.bool_))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        base = (np.int64(taskctx.partition_id()) << np.int64(33)) \
+            + np.int64(taskctx.row_base())
+        return pd.Series(np.arange(len(df), dtype=np.int64) + base,
+                         index=df.index)
+
+
+class InputFileName(Expression):
+    """input_file_name(): path of the file being scanned, '' otherwise
+    (reference: GpuInputFileBlock.scala:114)."""
+
+    is_nondeterministic = True
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return "input_file_name()"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        from spark_rapids_tpu.sql.exprs.core import DevScalar
+        return ctx.broadcast(
+            DevScalar(dtypes.STRING, taskctx.input_file()))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        return pd.Series([taskctx.input_file()] * len(df), dtype="str",
+                         index=df.index)
+
+
+def has_nondeterministic(expr: Expression) -> bool:
+    from spark_rapids_tpu.sql.exprs.core import walk
+    return any(getattr(n, "is_nondeterministic", False) for n in walk(expr))

@@ -1,0 +1,200 @@
+"""Null-handling expressions (reference: sql/rapids/nullExpressions.scala,
+297 LoC — Coalesce/IsNull live in conditional.py/predicates.py) and float
+normalization (reference: NormalizeFloatingNumbers.scala:38,
+FloatUtils.scala:84): Greatest/Least, AtLeastNNonNulls, and
+NormalizeNaNAndZero (canonical NaN, -0.0 -> +0.0) used before grouping and
+joining on float keys.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType
+from spark_rapids_tpu.sql.exprs.core import (
+    DevCol, DevValue, EvalContext, Expression,
+)
+from spark_rapids_tpu.sql.exprs.hostutil import host_unary_values, rebuild_series
+
+
+class _GreatestLeast(Expression):
+    """greatest()/least(): null-skipping n-ary extremum (null only when all
+    operands are null). NaN is greater than any other double, like Spark."""
+
+    is_greatest = True
+
+    def __init__(self, children: List[Expression]):
+        assert len(children) >= 2, "greatest/least need at least 2 args"
+        super().__init__(children)
+
+    def dtype(self, schema: Schema) -> DType:
+        import functools
+        from spark_rapids_tpu.columnar.dtype import common_type
+        dts = [c.dtype(schema) for c in self.children]
+        return functools.reduce(common_type, dts)
+
+    def sql_name(self, schema=None) -> str:
+        fn = "greatest" if self.is_greatest else "least"
+        args = ", ".join(c.sql_name(schema) for c in self.children)
+        return f"{fn}({args})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        for c in self.children:
+            if c.dtype(schema).is_string:
+                return "string operands are not supported on TPU"
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        dt = self.dtype(_schema_of(ctx))
+        out_data = None
+        out_valid = None
+        for c in self.children:
+            v = ctx.broadcast(c.eval_device(ctx))
+            data = v.data.astype(dt.np_dtype)
+            valid = v.validity
+            if out_data is None:
+                out_data, out_valid = data, valid
+                continue
+            if self.is_greatest:
+                better = jnp.where(
+                    out_valid & valid,
+                    _nan_aware_gt(jnp, data, out_data), valid & ~out_valid)
+            else:
+                better = jnp.where(
+                    out_valid & valid,
+                    _nan_aware_lt(jnp, data, out_data), valid & ~out_valid)
+            out_data = jnp.where(better, data, out_data)
+            out_valid = out_valid | valid
+        return DevCol(dt, out_data, out_valid)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        import functools
+        from spark_rapids_tpu.columnar.dtype import common_type
+        from spark_rapids_tpu.sql.exprs.hostutil import series_dtype
+        parts = []
+        dts = []
+        for c in self.children:
+            series = c.eval_host(df)
+            dts.append(series_dtype(series))
+            parts.append(host_unary_values(series))
+        dt = functools.reduce(common_type, dts)
+        out_data = None
+        out_valid = None
+        for vals, valid, index in parts:
+            data = vals.astype(dt.np_dtype)
+            if out_data is None:
+                out_data, out_valid = data.copy(), valid.copy()
+                continue
+            both = out_valid & valid
+            if self.is_greatest:
+                better = np.where(both, _nan_aware_gt(np, data, out_data),
+                                  valid & ~out_valid)
+            else:
+                better = np.where(both, _nan_aware_lt(np, data, out_data),
+                                  valid & ~out_valid)
+            out_data = np.where(better, data, out_data)
+            out_valid = out_valid | valid
+        return rebuild_series(out_data.astype(dt.np_dtype), out_valid, dt,
+                              parts[0][2])
+
+
+def _nan_aware_gt(xp, a, b):
+    if a.dtype.kind == "f":
+        return (a > b) | (xp.isnan(a) & ~xp.isnan(b))
+    return a > b
+
+
+def _nan_aware_lt(xp, a, b):
+    if a.dtype.kind == "f":
+        return (a < b) | (xp.isnan(b) & ~xp.isnan(a))
+    return a < b
+
+
+class Greatest(_GreatestLeast):
+    is_greatest = True
+
+
+class Least(_GreatestLeast):
+    is_greatest = False
+
+
+class AtLeastNNonNulls(Expression):
+    """Spark's internal predicate behind df.na.drop(thresh=n)."""
+
+    def __init__(self, n: int, children: List[Expression]):
+        super().__init__(children)
+        self.n = int(n)
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.BOOL
+
+    def sql_name(self, schema=None) -> str:
+        return f"atleastnnonnulls({self.n})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        count = jnp.zeros((ctx.capacity,), jnp.int32)
+        for c in self.children:
+            v = ctx.broadcast(c.eval_device(ctx))
+            ok = v.validity
+            if v.dtype.np_dtype.kind == "f":
+                ok = ok & ~jnp.isnan(v.data)
+            count = count + ok.astype(jnp.int32)
+        return DevCol(dtypes.BOOL, count >= self.n,
+                      jnp.ones((ctx.capacity,), jnp.bool_))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        count = np.zeros(len(df), dtype=np.int32)
+        index = df.index
+        for c in self.children:
+            vals, valid, index = host_unary_values(c.eval_host(df))
+            ok = valid.copy()
+            if vals.dtype.kind == "f":
+                ok &= ~np.isnan(vals)
+            count += ok.astype(np.int32)
+        return pd.Series(count >= self.n, index=index)
+
+
+class NormalizeNaNAndZero(Expression):
+    """Canonicalize floats before hashing/grouping: every NaN to the same
+    bit pattern, -0.0 to +0.0 (reference: NormalizeFloatingNumbers.scala:38).
+    Identity for non-float inputs."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return self.children[0].dtype(schema)
+
+    def sql_name(self, schema=None) -> str:
+        return self.children[0].sql_name(schema)
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        if v.dtype.np_dtype.kind != "f":
+            return v
+        data = v.data + jnp.zeros_like(v.data)   # -0.0 + 0.0 == +0.0
+        data = jnp.where(jnp.isnan(data), jnp.asarray(
+            jnp.nan, dtype=data.dtype), data)
+        return v.with_(data=data)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        s = self.children[0].eval_host(df)
+        vals, valid, index = host_unary_values(s)
+        if vals.dtype.kind != "f":
+            return s
+        data = vals + np.zeros_like(vals)
+        data = np.where(np.isnan(data), np.nan, data)
+        from spark_rapids_tpu.sql.exprs.hostutil import series_dtype
+        return rebuild_series(data, valid, series_dtype(s), index)
+
+
+def _schema_of(ctx: EvalContext) -> Schema:
+    """Pseudo-schema from context columns (dtype resolution inside eval)."""
+    return Schema([f"c{i}" for i in range(len(ctx.cols))],
+                  [c.dtype for c in ctx.cols])

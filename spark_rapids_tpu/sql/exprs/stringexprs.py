@@ -301,3 +301,245 @@ class ConcatStrings(Expression):
             else:
                 out[i] = None
         return rebuild_series(out, validity, dtypes.STRING, parts[0][2])
+
+
+class _TrimBase(Expression):
+    """trim/ltrim/rtrim with an optional literal trim-char set."""
+    fn_name = "trim"
+    left = True
+    right = True
+
+    def __init__(self, child: Expression, chars: Optional[str] = None):
+        super().__init__([child])
+        # Spark's trim/ltrim/rtrim strip only the space character
+        self.chars = chars if chars is not None else " "
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return f"{self.fn_name}({self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        assert isinstance(v, DevCol)
+        return string_ops.trim(ctx, v, self.chars, self.left, self.right)
+
+    def _host_one(self, s: str) -> str:
+        if self.left and self.right:
+            return s.strip(self.chars)
+        if self.left:
+            return s.lstrip(self.chars)
+        return s.rstrip(self.chars)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        out = np.array([self._host_one(x) if x is not None else None
+                        for x in values], dtype=object)
+        return rebuild_series(out, validity, dtypes.STRING, index)
+
+
+class Trim(_TrimBase):
+    fn_name, left, right = "trim", True, True
+
+
+class LTrim(_TrimBase):
+    fn_name, left, right = "ltrim", True, False
+
+
+class RTrim(_TrimBase):
+    fn_name, left, right = "rtrim", False, True
+
+
+class _PadBase(Expression):
+    fn_name = "lpad"
+    left = True
+
+    def __init__(self, child: Expression, n: int, pad: str = " "):
+        super().__init__([child])
+        self.n = int(n)
+        self.pad = pad or " "
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return f"{self.fn_name}({self.children[0].sql_name(schema)}, {self.n})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if len(self.pad.encode("utf-8")) != 1:
+            return "only single-byte pad characters run on TPU"
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        assert isinstance(v, DevCol)
+        return string_ops.pad(ctx, v, self.n, self.pad, self.left)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        out = np.empty(len(values), dtype=object)
+        for i, x in enumerate(values):
+            if x is None:
+                out[i] = None
+            elif len(x) >= self.n:
+                out[i] = x[:self.n]
+            elif self.left:
+                out[i] = self.pad * (self.n - len(x)) + x
+            else:
+                out[i] = x + self.pad * (self.n - len(x))
+        return rebuild_series(out, validity, dtypes.STRING, index)
+
+
+class LPad(_PadBase):
+    fn_name, left = "lpad", True
+
+
+class RPad(_PadBase):
+    fn_name, left = "rpad", False
+
+
+class StringLocate(Expression):
+    """locate(substr, str, pos) / instr(str, substr): 1-based, 0 = absent."""
+
+    def __init__(self, child: Expression, substr: str, start_pos: int = 1):
+        super().__init__([child])
+        self.substr = substr
+        self.start_pos = int(start_pos)
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT32
+
+    def sql_name(self, schema=None) -> str:
+        return (f"locate({self.substr!r}, "
+                f"{self.children[0].sql_name(schema)}, {self.start_pos})")
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        assert isinstance(v, DevCol)
+        return DevCol(dtypes.INT32,
+                      string_ops.locate(ctx, v, self.substr, self.start_pos),
+                      v.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        out = np.zeros(len(values), dtype=np.int32)
+        for i, x in enumerate(values):
+            if x is None:
+                continue
+            out[i] = x.find(self.substr, self.start_pos - 1) + 1
+        return rebuild_series(out, validity, dtypes.INT32, index)
+
+
+class StringReplace(Expression):
+    """replace(str, search, replacement) with literal arguments."""
+
+    def __init__(self, child: Expression, search: str, replacement: str):
+        super().__init__([child])
+        self.search = search
+        self.replacement = replacement
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return (f"replace({self.children[0].sql_name(schema)}, "
+                f"{self.search!r}, {self.replacement!r})")
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        assert isinstance(v, DevCol)
+        return string_ops.replace_literal(ctx, v, self.search,
+                                          self.replacement)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        out = np.array([x.replace(self.search, self.replacement)
+                        if x is not None else None
+                        for x in values], dtype=object)
+        return rebuild_series(out, validity, dtypes.STRING, index)
+
+
+class InitCap(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return f"initcap({self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        assert isinstance(v, DevCol)
+        return string_ops.initcap_ascii(v)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+
+        def one(s):
+            out = []
+            prev_space = True
+            for ch in s:
+                o = ord(ch)
+                if prev_space and 97 <= o <= 122:
+                    out.append(chr(o - 32))
+                elif not prev_space and 65 <= o <= 90:
+                    out.append(chr(o + 32))
+                else:
+                    out.append(ch)
+                prev_space = ch == " "
+            return "".join(out)
+        out = np.array([one(x) if x is not None else None for x in values],
+                       dtype=object)
+        return rebuild_series(out, validity, dtypes.STRING, index)
+
+
+class RegexpReplace(Expression):
+    """regexp_replace: general regex stays on the CPU (the reference also
+    restricts the regex dialect, GpuOverrides.scala:334-379); literal
+    patterns collapse to StringReplace during planning via
+    maybe_literal_regex()."""
+
+    def __init__(self, child: Expression, pattern: str, replacement: str):
+        super().__init__([child])
+        self.pattern = pattern
+        self.replacement = replacement
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return (f"regexp_replace({self.children[0].sql_name(schema)}, "
+                f"{self.pattern!r})")
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        return (f"regular expression {self.pattern!r} is not supported on "
+                "TPU (only literal patterns run on device)")
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        import re
+        rx = re.compile(self.pattern)
+        values, validity, index = host_unary_values(self.children[0].eval_host(df))
+        out = np.array([rx.sub(self.replacement, x) if x is not None else None
+                        for x in values], dtype=object)
+        return rebuild_series(out, validity, dtypes.STRING, index)
+
+
+_REGEX_META = set("\\^$.|?*+()[]{}")
+
+
+def maybe_literal_regex(pattern: str) -> Optional[str]:
+    """If a regex pattern contains no metacharacters it is a plain literal."""
+    if any(ch in _REGEX_META for ch in pattern):
+        return None
+    return pattern
+
+
+def make_regexp_replace(child: Expression, pattern: str,
+                        replacement: str) -> Expression:
+    lit = maybe_literal_regex(pattern)
+    if lit is not None and "$" not in replacement:
+        return StringReplace(child, lit, replacement)
+    return RegexpReplace(child, pattern, replacement)

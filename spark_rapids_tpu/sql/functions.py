@@ -259,3 +259,82 @@ def _c(x: ColumnOrName) -> Expression:
     if isinstance(x, str):
         return Col(x)
     return _expr(x)
+
+
+# --- null handling / extremum ----------------------------------------------
+
+def greatest(*cs) -> Column:
+    from spark_rapids_tpu.sql.exprs import nullexprs as ne
+    return Column(ne.Greatest([_c(c) for c in cs]))
+def least(*cs) -> Column:
+    from spark_rapids_tpu.sql.exprs import nullexprs as ne
+    return Column(ne.Least([_c(c) for c in cs]))
+def nvl(a, b) -> Column: return coalesce(a, b)
+ifnull = nvl
+def nvl2(a, b, c) -> Column:
+    return when(Column(_c(a)).isNotNull(), Column(_c(b))) \
+        .otherwise(Column(_c(c)))
+
+
+# --- math tail --------------------------------------------------------------
+
+def round(c, scale: int = 0) -> Column:  # noqa: A001
+    return Column(m.Round(_c(c), scale))
+def hypot(a, b) -> Column: return Column(m.Hypot(_c(a), _c(b)))
+def cbrt(c) -> Column: return Column(m.Cbrt(_c(c)))
+def expm1(c) -> Column: return Column(m.Expm1(_c(c)))
+def log1p(c) -> Column: return Column(m.Log1p(_c(c)))
+def rint(c) -> Column: return Column(m.Rint(_c(c)))
+def sinh(c) -> Column: return Column(m.Sinh(_c(c)))
+def cosh(c) -> Column: return Column(m.Cosh(_c(c)))
+def degrees(c) -> Column: return Column(m.ToDegrees(_c(c)))
+def radians(c) -> Column: return Column(m.ToRadians(_c(c)))
+
+
+# --- string tail ------------------------------------------------------------
+
+def trim(c) -> Column: return Column(st.Trim(_c(c)))
+def ltrim(c) -> Column: return Column(st.LTrim(_c(c)))
+def rtrim(c) -> Column: return Column(st.RTrim(_c(c)))
+def lpad(c, n: int, pad: str = " ") -> Column:
+    return Column(st.LPad(_c(c), n, pad))
+def rpad(c, n: int, pad: str = " ") -> Column:
+    return Column(st.RPad(_c(c), n, pad))
+def locate(substr: str, c, pos: int = 1) -> Column:
+    return Column(st.StringLocate(_c(c), substr, pos))
+def instr(c, substr: str) -> Column:
+    return Column(st.StringLocate(_c(c), substr, 1))
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    return Column(st.make_regexp_replace(_c(c), pattern, replacement))
+def replace(c, search: str, replacement: str) -> Column:
+    return Column(st.StringReplace(_c(c), search, replacement))
+def initcap(c) -> Column: return Column(st.InitCap(_c(c)))
+
+
+# --- datetime tail ----------------------------------------------------------
+
+def quarter(c) -> Column: return Column(dt.Quarter(_c(c)))
+def dayofyear(c) -> Column: return Column(dt.DayOfYear(_c(c)))
+def weekofyear(c) -> Column: return Column(dt.WeekOfYear(_c(c)))
+def last_day(c) -> Column: return Column(dt.LastDay(_c(c)))
+def date_sub(c, days) -> Column: return Column(dt.DateSub(_c(c), _expr(days)))
+def datediff(end, start) -> Column:
+    return Column(dt.DateDiff(_c(end), _c(start)))
+def to_date(c) -> Column: return Column(dt.ToDate(_c(c)))
+def from_unixtime(c) -> Column: return Column(dt.FromUnixTime(_c(c)))
+
+
+# --- nondeterministic --------------------------------------------------------
+
+def rand(seed: int = 0) -> Column:
+    from spark_rapids_tpu.sql.exprs import nondet
+    return Column(nondet.Rand(seed))
+def spark_partition_id() -> Column:
+    from spark_rapids_tpu.sql.exprs import nondet
+    return Column(nondet.SparkPartitionID())
+def monotonically_increasing_id() -> Column:
+    from spark_rapids_tpu.sql.exprs import nondet
+    return Column(nondet.MonotonicallyIncreasingID())
+def input_file_name() -> Column:
+    from spark_rapids_tpu.sql.exprs import nondet
+    return Column(nondet.InputFileName())

@@ -29,7 +29,9 @@ from spark_rapids_tpu.exec import cpu, tpu
 from spark_rapids_tpu.exec.base import PhysicalPlan
 from spark_rapids_tpu.exec.transitions import DeviceToHostExec, HostToDeviceExec
 from spark_rapids_tpu.sql.exprs.core import Expression, first_unsupported
-from spark_rapids_tpu.sql.sources import CsvSource, InMemorySource, ParquetSource
+from spark_rapids_tpu.sql.sources import (
+    CsvSource, InMemorySource, OrcSource, ParquetSource,
+)
 
 
 class ExecRule:
@@ -222,6 +224,10 @@ def _tag_scan(meta: ExecMeta) -> None:
         if not (c.get("spark.rapids.sql.format.csv.enabled")
                 and c.get("spark.rapids.sql.format.csv.read.enabled")):
             meta.will_not_work("CSV scan disabled by conf")
+    elif isinstance(src, OrcSource):
+        if not (c.get("spark.rapids.sql.format.orc.enabled")
+                and c.get("spark.rapids.sql.format.orc.read.enabled")):
+            meta.will_not_work("ORC scan disabled by conf")
     elif isinstance(src, InMemorySource):
         pass
     else:
@@ -326,6 +332,10 @@ def _tag_write(meta: ExecMeta) -> None:
         if not (c.get("spark.rapids.sql.format.parquet.enabled")
                 and c.get("spark.rapids.sql.format.parquet.write.enabled")):
             meta.will_not_work("Parquet write disabled by conf")
+    elif fmt == "orc":
+        if not (c.get("spark.rapids.sql.format.orc.enabled")
+                and c.get("spark.rapids.sql.format.orc.write.enabled")):
+            meta.will_not_work("ORC write disabled by conf")
     elif fmt == "csv":
         # the reference does not accelerate CSV writes either; ours rides
         # the same columnar D2H path so it is enabled by default

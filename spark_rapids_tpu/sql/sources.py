@@ -101,9 +101,12 @@ class ParquetSource(DataSource):
 
         def make(path: str, rg: int) -> Partition:
             def run():
+                from spark_rapids_tpu.exec import taskctx
+                taskctx.set_input_file(path)
                 f = pq.ParquetFile(path)
                 table = f.read_row_group(rg, columns=self.columns)
                 yield _arrow_to_pandas(table)
+                taskctx.clear_input_file()
             return run
         if not self.splits:
             def empty():
@@ -139,12 +142,70 @@ class CsvSource(DataSource):
 
         def make(path: str) -> Partition:
             def run():
+                from spark_rapids_tpu.exec import taskctx
+                taskctx.set_input_file(path)
                 t = pacsv.read_csv(path)
                 df = _arrow_to_pandas(t)
                 df.columns = list(self.schema.names)
                 yield df
+                taskctx.clear_input_file()
             return run
         return [make(p) for p in self.paths]
+
+
+class OrcSource(DataSource):
+    """ORC scan: stripe-partitioned host decode via pyarrow.orc (reference:
+    GpuOrcScan.scala:711 decodes via Table.readORC after host-side stripe
+    clipping; OrcFilters SARG pushdown is host-side there too)."""
+
+    def __init__(self, paths, columns: Optional[List[str]] = None):
+        import pyarrow.orc as paorc
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        self._paorc = paorc
+        f = paorc.ORCFile(self.paths[0])
+        from spark_rapids_tpu.columnar import dtypes as dtmod
+        names, dts = [], []
+        for field in f.schema:
+            if columns and field.name not in columns:
+                continue
+            names.append(field.name)
+            dts.append(dtmod.from_arrow(field.type))
+        self.columns = names
+        self.schema = Schema(names, dts)
+        # partition plan: (path, stripe index)
+        self.splits = []
+        for p in self.paths:
+            fh = paorc.ORCFile(p)
+            for s in range(fh.nstripes):
+                self.splits.append((p, s))
+
+    def describe(self) -> str:
+        return f"ORC[{len(self.paths)} files, {len(self.splits)} stripes]"
+
+    def estimated_size_bytes(self) -> Optional[int]:
+        import os
+        return sum(os.path.getsize(p) for p in self.paths)
+
+    def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
+        paorc = self._paorc
+
+        def make(path: str, stripe: int) -> Partition:
+            def run():
+                from spark_rapids_tpu.exec import taskctx
+                taskctx.set_input_file(path)
+                f = paorc.ORCFile(path)
+                table = f.read_stripe(stripe, columns=self.columns)
+                import pyarrow as pa
+                if isinstance(table, pa.RecordBatch):
+                    table = pa.Table.from_batches([table])
+                yield _arrow_to_pandas(table)
+                taskctx.clear_input_file()
+            return run
+        if not self.splits:
+            def empty():
+                yield _empty_from_schema(self.schema)
+            return [empty]
+        return [make(p, s) for p, s in self.splits]
 
 
 def _arrow_to_pandas(table) -> pd.DataFrame:
