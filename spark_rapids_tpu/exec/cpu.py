@@ -219,7 +219,8 @@ class CpuShuffleExchangeExec(PhysicalPlan):
     """Materialization barrier repartitioning child output.
 
     partitioning: ('hash', [col indices], n) | ('single',) |
-    ('roundrobin', n) | ('range', [SortOrder], n)."""
+    ('roundrobin', n) |
+    ('range', [key indices], [ascending], [nulls_first], n)."""
 
     def __init__(self, child: PhysicalPlan, partitioning):
         super().__init__([child])
@@ -267,6 +268,39 @@ class CpuShuffleExchangeExec(PhysicalPlan):
                         yield pd.concat(buckets[pid], ignore_index=True)
                     else:
                         yield _empty_df(schema)
+                return run
+            return [make(i) for i in range(n)]
+        if kind == "range":
+            # ('range', [key indices], [ascending], [nulls_first], n):
+            # the host oracle sorts everything once with the same comparator
+            # CpuSortExec uses and hands out contiguous chunks — a valid
+            # range partitioning by construction (the device path samples
+            # bounds instead, GpuRangePartitioner.scala:42-120)
+            from spark_rapids_tpu.sql.exprs.core import BoundRef
+            key_idx, asc, nf, n = self.partitioning[1:]
+            orders = [SortOrder(BoundRef(i, schema.dtypes[i],
+                                         schema.names[i]), a, f)
+                      for i, a, f in zip(key_idx, asc, nf)]
+
+            state: dict = {}
+
+            def chunks():
+                if "parts" in state:
+                    return state["parts"]
+                dfs = [df for p in child_parts for df in p()]
+                df = (pd.concat(dfs, ignore_index=True) if dfs
+                      else _empty_df(schema))
+                idx = host_sort_indices(df, orders)
+                df = df.iloc[idx].reset_index(drop=True)
+                per = -(-len(df) // n) if len(df) else 0
+                state["parts"] = [
+                    df.iloc[i * per:(i + 1) * per].reset_index(drop=True)
+                    if per else _empty_df(schema) for i in range(n)]
+                return state["parts"]
+
+            def make(pid: int) -> Partition:
+                def run():
+                    yield chunks()[pid]
                 return run
             return [make(i) for i in range(n)]
         raise ValueError(f"unknown partitioning {kind}")
@@ -605,3 +639,71 @@ class CpuJoinExec(PhysicalPlan):
             lrow = np.concatenate([lrow, np.full(len(extra), -1, np.int64)])
             rrow = np.concatenate([rrow, extra])
         return _assemble_join(ldf, rdf, ls, rs, lrow, rrow)
+
+
+class CpuBroadcastHashJoinExec(CpuJoinExec):
+    """Equi-join whose build side is a broadcast exchange (reference:
+    GpuBroadcastHashJoinExec, shims/spark300). Execution is identical to
+    CpuJoinExec — the distinct class lets the rewrite engine carry a
+    distinct rule/conf key, like the reference's separate exec."""
+
+
+class CpuCartesianProductExec(CpuJoinExec):
+    """Unconditioned cross product (reference: GpuCartesianProductExec,
+    disabled by default there too)."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan):
+        super().__init__(left, right, "cross", [], [])
+
+    def describe(self) -> str:
+        return "CpuCartesianProductExec"
+
+
+class CpuBroadcastNestedLoopJoinExec(PhysicalPlan):
+    """Join on an arbitrary boolean condition: every stream row pairs with
+    every broadcast-side row, then the condition filters (reference:
+    GpuBroadcastNestedLoopJoinExec.scala:258, inner/cross only, disabled by
+    default). ``condition`` is bound against the combined left+right
+    schema."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 join_type: str, condition: Optional[Expression]):
+        super().__init__([left, right])
+        assert join_type in ("inner", "cross"), join_type
+        self.join_type = join_type
+        self.condition = condition
+
+    def output_schema(self) -> Schema:
+        ls = self.children[0].output_schema()
+        rs = self.children[1].output_schema()
+        return Schema(list(ls.names) + list(rs.names),
+                      list(ls.dtypes) + list(rs.dtypes))
+
+    def describe(self) -> str:
+        return f"CpuBroadcastNestedLoopJoinExec({self.join_type})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        left_parts = self.children[0].partitions(ctx)
+        right_parts = self.children[1].partitions(ctx)
+        assert len(right_parts) == 1, \
+            "nested-loop build side must be a broadcast (single partition)"
+        right_parts = right_parts * len(left_parts)
+        ls = self.children[0].output_schema()
+        rs = self.children[1].output_schema()
+
+        def make(lp: Partition, rp: Partition) -> Partition:
+            def run():
+                ldf = _concat_parts(lp(), ls)
+                rdf = _concat_parts(rp(), rs)
+                nl, nr = len(ldf), len(rdf)
+                lrow = np.repeat(np.arange(nl, dtype=np.int64), nr)
+                rrow = np.tile(np.arange(nr, dtype=np.int64), nl)
+                out = _assemble_join(ldf, rdf, ls, rs, lrow, rrow)
+                if self.condition is not None and len(out):
+                    pred = self.condition.eval_host(out)
+                    vals, validity, _ = host_unary_values(pred)
+                    out = out[vals.astype(np.bool_)
+                              & validity].reset_index(drop=True)
+                yield out
+            return run
+        return [make(lp, rp) for lp, rp in zip(left_parts, right_parts)]

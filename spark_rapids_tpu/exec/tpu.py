@@ -54,6 +54,17 @@ def _concat_device(batches: List[DeviceBatch], schema: Schema,
     return kernel(batches, out_cap, 0)
 
 
+def _split_by_pid(batch: DeviceBatch, pid: jnp.ndarray, n: int):
+    """Sort rows by partition id (dead rows to the back) and count per-pid
+    rows — the contiguous-split analogue (GpuPartitioning.scala:41-75)."""
+    pid = jnp.where(batch.row_mask(), pid, n)
+    perm = jnp.argsort(pid, stable=True).astype(jnp.int32)
+    sorted_batch = rowops.gather_batch(batch, perm, batch.num_rows)
+    counts = jnp.zeros((n,), jnp.int32).at[
+        jnp.clip(pid, 0, n - 1)].add(jnp.where(pid < n, 1, 0))
+    return sorted_batch, counts
+
+
 class TpuProjectExec(TpuExec):
     """reference: GpuProjectExec (basicPhysicalOperators.scala:65)."""
 
@@ -470,15 +481,28 @@ class TpuShuffleExchangeExec(TpuExec):
             def pkernel(batch: DeviceBatch):
                 h1, h2 = row_hashes(batch, key_idx)
                 pid = (h1 % jnp.uint64(n)).astype(jnp.int32)
-                pid = jnp.where(batch.row_mask(), pid, n)  # dead rows last
-                perm = jnp.argsort(pid, stable=True).astype(jnp.int32)
-                sorted_batch = rowops.gather_batch(batch, perm, batch.num_rows)
-                counts = jnp.zeros((n,), jnp.int32).at[
-                    jnp.clip(pid, 0, n - 1)].add(
-                        jnp.where(pid < n, 1, 0))
-                return sorted_batch, counts
+                return _split_by_pid(batch, pid, n)
             self._pkernel = cached_jit(
                 f"exchhash|{key_idx}|{n}", lambda: jax.jit(pkernel))
+        elif kind == "range":
+            key_idx = tuple(partitioning[1])
+            asc = tuple(partitioning[2])
+            nf = tuple(partitioning[3])
+            n = partitioning[4]
+            sig = f"exchrange|{key_idx}|{asc}|{nf}|{n}"
+
+            def sample_kernel(batch: DeviceBatch):
+                ops = sortops.sort_key_operands(batch, key_idx, asc, nf)
+                return jnp.stack([o.astype(jnp.uint64) for o in ops])
+            self._sample_kernel = cached_jit(
+                sig + "|sample", lambda: jax.jit(sample_kernel))
+
+            def range_pkernel(batch: DeviceBatch, bounds):
+                pid = sortops.range_partition_ids(batch, key_idx, asc, nf,
+                                                  list(bounds))
+                return _split_by_pid(batch, pid, n)
+            self._pkernel = cached_jit(
+                sig + "|part", lambda: jax.jit(range_pkernel))
 
     def output_schema(self) -> Schema:
         return self.children[0].output_schema()
@@ -519,8 +543,8 @@ class TpuShuffleExchangeExec(TpuExec):
                 return run
             return [make(i) for i in range(n)]
 
-        assert kind == "hash"
-        n = self.partitioning[2]
+        assert kind in ("hash", "range")
+        n = self.partitioning[-1]
         slice_kernel = cached_jit("slice", lambda: jax.jit(
             lambda b, start, count: rowops.slice_batch(b, start, count)))
 
@@ -528,24 +552,60 @@ class TpuShuffleExchangeExec(TpuExec):
         # bucket the slices
         state = {"buckets": None}
 
+        def compute_range_bounds(batches: List[DeviceBatch]):
+            """Reservoir-style sample of sort-key operand vectors -> n-1
+            lexicographic upper bounds (GpuRangePartitioner.scala:42-120)."""
+            import numpy as np
+            samples = []
+            for batch in batches:
+                rows = batch.num_rows_host()
+                if rows == 0:
+                    continue
+                ops = np.asarray(self._sample_kernel(batch))  # (k, capacity)
+                take = min(rows, 128)
+                sel = np.linspace(0, rows - 1, take).astype(np.int64)
+                samples.append(ops[:, sel])
+            k = None
+            if samples:
+                all_s = np.concatenate(samples, axis=1)  # (k, total)
+                k = all_s.shape[0]
+                order = np.lexsort(all_s[::-1])
+                all_s = all_s[:, order]
+                total = all_s.shape[1]
+                picks = [int((i + 1) * total / n) - 1 for i in range(n - 1)]
+                bounds = [all_s[j, picks].astype(np.uint64)
+                          for j in range(k)]
+            else:
+                # no rows anywhere: operand count from an empty batch
+                probe = np.asarray(self._sample_kernel(
+                    DeviceBatch.empty(schema)))
+                k = probe.shape[0]
+                bounds = [np.zeros((n - 1,), np.uint64) for _ in range(k)]
+            return tuple(jnp.asarray(b) for b in bounds)
+
         def materialize():
             if state["buckets"] is not None:
                 return state["buckets"]
             buckets: List[List[DeviceBatch]] = [[] for _ in range(n)]
-            for p in child_parts:
-                for batch in p():
-                    sorted_batch, counts = self._pkernel(batch)
-                    import numpy as np
-                    host_counts = np.asarray(counts)
-                    offsets = np.concatenate([[0], np.cumsum(host_counts)])
-                    for pid in range(n):
-                        if host_counts[pid] == 0:
-                            continue
-                        piece = slice_kernel(
-                            sorted_batch,
-                            jnp.asarray(offsets[pid], jnp.int32),
-                            jnp.asarray(host_counts[pid], jnp.int32))
-                        buckets[pid].append(piece)
+            if kind == "range":
+                all_batches = [b for p in child_parts for b in p()]
+                bounds = compute_range_bounds(all_batches)
+                splits = (self._pkernel(b, bounds) for b in all_batches)
+            else:
+                splits = (self._pkernel(b) for p in child_parts
+                          for b in p())
+            for sorted_batch, counts in splits:
+                import numpy as np
+                host_counts = np.asarray(counts)
+                offsets = np.concatenate([[0], np.cumsum(host_counts)])
+                for pid in range(n):
+                    if host_counts[pid] == 0:
+                        continue
+                    piece = slice_kernel(
+                        sorted_batch,
+                        jnp.asarray(offsets[pid], jnp.int32),
+                        jnp.asarray(host_counts[pid], jnp.int32))
+                    buckets[pid].append(piece)
             state["buckets"] = buckets
             return buckets
 

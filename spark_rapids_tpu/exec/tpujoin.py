@@ -186,3 +186,78 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                     yield DeviceBatch.empty(self.output_schema())
             return run
         return [make(sp, bp) for sp, bp in zip(stream_parts, build_parts)]
+
+
+class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
+    """Equi-join streaming against a broadcast build batch (reference:
+    GpuBroadcastHashJoinExec, shims/spark300). The probe/expand machinery is
+    TpuShuffledHashJoinExec's; the distinct class carries its own rule/conf
+    key, like the reference's separate exec."""
+
+
+class TpuCartesianProductExec(TpuShuffledHashJoinExec):
+    """Unconditioned cross product (reference: GpuCartesianProductExec.scala,
+    disabled by default there as well)."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan):
+        super().__init__(left, right, "cross", [], [])
+
+    def describe(self) -> str:
+        return "TpuCartesianProductExec"
+
+
+class TpuBroadcastNestedLoopJoinExec(PhysicalPlan):
+    """Condition (non-equi) join: device cross product of each stream batch
+    with the broadcast build batch, then one fused condition-filter kernel
+    over the combined row (reference:
+    execution/GpuBroadcastNestedLoopJoinExec.scala:258, inner/cross,
+    disabled by default)."""
+
+    columnar_output = True
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 join_type: str, condition):
+        super().__init__([left, right])
+        assert join_type in ("inner", "cross"), join_type
+        self.join_type = join_type
+        self.condition = condition
+        self._cross = TpuShuffledHashJoinExec(left, right, "cross", [], [])
+        if condition is not None:
+            from spark_rapids_tpu.ops import rowops
+            from spark_rapids_tpu.sql.exprs.evalbridge import (
+                make_context, to_device_column,
+            )
+
+            def fkernel(batch):
+                ctx = make_context(batch)
+                pred = to_device_column(ctx, condition.eval_device(ctx))
+                keep = pred.data & pred.validity
+                return rowops.filter_batch(batch, keep)
+            from spark_rapids_tpu.utils.kernelcache import (
+                cached_jit, expr_signature,
+            )
+            self._filter = cached_jit(
+                "bnlj|" + expr_signature(condition),
+                lambda: jax.jit(fkernel))
+        else:
+            self._filter = None
+
+    def output_schema(self) -> Schema:
+        return self._cross.output_schema()
+
+    def describe(self) -> str:
+        return f"TpuBroadcastNestedLoopJoinExec({self.join_type})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        # keep the cross exec's children in sync with post-transition
+        # children (TransitionOverrides rewrites self.children)
+        self._cross.children = list(self.children)
+        cross_parts = self._cross.partitions(ctx)
+
+        def make(part: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                for batch in part():
+                    yield (self._filter(batch) if self._filter is not None
+                           else batch)
+            return run
+        return [make(p) for p in cross_parts]

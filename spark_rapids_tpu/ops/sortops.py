@@ -81,18 +81,11 @@ def sort_permutation(batch: DeviceBatch,
     """Row permutation sorting live rows; padding rows sort to the end."""
     capacity = batch.capacity
     live = batch.row_mask()
-    operands: List[jnp.ndarray] = []
-    # dead rows last, always
-    operands.append((~live).astype(jnp.uint8))
-    for ki, asc, nf in zip(key_indices, ascending, nulls_first):
-        col = batch.columns[ki]
-        null_flag = (~col.validity).astype(jnp.uint8)
-        # nulls first => null_flag must sort before: invert when nulls first
-        flag = null_flag if not nf else (1 - null_flag)
-        # flag sorts ascending (0 before 1) regardless of key direction
-        operands.append(flag)
-        for img in u64_key_image(col):
-            operands.append(img if asc else ~img)
+    # dead rows last, always; then the shared key operands (also used for
+    # range partitioning so bounds compare exactly like this sort)
+    operands: List[jnp.ndarray] = [(~live).astype(jnp.uint8)]
+    operands.extend(sort_key_operands(batch, key_indices, ascending,
+                                      nulls_first))
     idx = jnp.arange(capacity, dtype=jnp.int32)
     results = jax.lax.sort(tuple(operands) + (idx,),
                            num_keys=len(operands), is_stable=True)
@@ -104,3 +97,46 @@ def sort_batch(batch: DeviceBatch, key_indices: Sequence[int],
                nulls_first: Sequence[bool]) -> DeviceBatch:
     perm = sort_permutation(batch, key_indices, ascending, nulls_first)
     return gather_batch(batch, perm, batch.num_rows)
+
+
+def sort_key_operands(batch: DeviceBatch, key_indices: Sequence[int],
+                      ascending: Sequence[bool],
+                      nulls_first: Sequence[bool]) -> List[jnp.ndarray]:
+    """The per-row comparison operand vectors (null flags + order-preserving
+    key images, direction applied) that sort_permutation sorts by — reused
+    for range partitioning so partition bounds compare exactly like the
+    downstream sort."""
+    operands: List[jnp.ndarray] = []
+    for ki, asc, nf in zip(key_indices, ascending, nulls_first):
+        col = batch.columns[ki]
+        null_flag = (~col.validity).astype(jnp.uint8)
+        flag = null_flag if not nf else (1 - null_flag)
+        operands.append(flag.astype(jnp.uint64))
+        for img in u64_key_image(col):
+            operands.append(img if asc else ~img)
+    return operands
+
+
+def range_partition_ids(batch: DeviceBatch, key_indices: Sequence[int],
+                        ascending: Sequence[bool],
+                        nulls_first: Sequence[bool],
+                        bounds: List[jnp.ndarray]) -> jnp.ndarray:
+    """Partition id per row for range partitioning (reference:
+    GpuRangePartitioner.scala:42-120): pid = number of upper bounds the row
+    is strictly greater than, compared lexicographically over the sort-key
+    operand vectors. ``bounds`` holds one (n-1,) vector per operand."""
+    operands = sort_key_operands(batch, key_indices, ascending, nulls_first)
+    capacity = batch.capacity
+    nb = bounds[0].shape[0] if bounds else 0
+    pid = jnp.zeros((capacity,), jnp.int32)
+    if nb == 0:
+        return pid
+    # lexicographic row > bound, vectorized over (capacity, n-1)
+    gt = jnp.zeros((capacity, nb), jnp.bool_)
+    eq = jnp.ones((capacity, nb), jnp.bool_)
+    for o, b in zip(operands, bounds):
+        ov = o[:, None]
+        bv = b[None, :]
+        gt = gt | (eq & (ov > bv))
+        eq = eq & (ov == bv)
+    return gt.sum(axis=1).astype(jnp.int32)

@@ -373,11 +373,18 @@ class DataFrame:
         elif on is None:
             lkeys, rkeys = [], []
             how = "cross"
+        elif isinstance(on, Column):
+            # arbitrary boolean condition -> nested-loop join (reference:
+            # GpuBroadcastNestedLoopJoinExec, disabled on device by default)
+            return DataFrame(self.session,
+                             lp.LogicalJoin(self._plan, other._plan, how,
+                                            [], [], condition=_expr(on)))
         elif isinstance(on, (str, list, tuple)):
             lkeys = keyify(on)
             rkeys = keyify(on)
         else:
-            raise TypeError("join on must be a column name or list of names")
+            raise TypeError("join on must be a column name, list of names, "
+                            "or a boolean Column condition")
         return DataFrame(self.session,
                          lp.LogicalJoin(self._plan, other._plan, how,
                                         lkeys, rkeys))

@@ -205,7 +205,7 @@ def _convert_sort(meta: ExecMeta, children) -> PhysicalPlan:
 
 def _tag_exchange(meta: ExecMeta) -> None:
     kind = meta.plan.partitioning[0]
-    if kind not in ("hash", "single", "roundrobin"):
+    if kind not in ("hash", "single", "roundrobin", "range"):
         meta.will_not_work(f"partitioning {kind!r} not supported on TPU")
 
 
@@ -286,6 +286,45 @@ _register(ExecRule(cpu.CpuExpandExec, "expand (rollup/cube engine)",
                                                    m.plan.projections)))
 _register(ExecRule(cpu.CpuJoinExec, "shuffled hash join",
                    _tag_join, _convert_join))
+
+
+def _convert_broadcast_join(meta: ExecMeta, children) -> PhysicalPlan:
+    from spark_rapids_tpu.exec.tpujoin import TpuBroadcastHashJoinExec
+    return TpuBroadcastHashJoinExec(children[0], children[1],
+                                    meta.plan.join_type, meta.plan.left_keys,
+                                    meta.plan.right_keys)
+
+
+_register(ExecRule(cpu.CpuBroadcastHashJoinExec, "broadcast hash join",
+                   _tag_join, _convert_broadcast_join))
+def _convert_cartesian(meta: ExecMeta, children) -> PhysicalPlan:
+    from spark_rapids_tpu.exec.tpujoin import TpuCartesianProductExec
+    return TpuCartesianProductExec(children[0], children[1])
+
+
+_register(ExecRule(cpu.CpuCartesianProductExec, "cartesian product",
+                   _tag_nothing, _convert_cartesian,
+                   disabled_by_default=True))
+
+
+def _tag_bnlj(meta: ExecMeta) -> None:
+    cond = meta.plan.condition
+    if cond is not None:
+        reason = first_unsupported(cond, meta.plan.output_schema())
+        if reason:
+            meta.will_not_work(f"join condition: {reason}")
+
+
+def _convert_bnlj(meta: ExecMeta, children) -> PhysicalPlan:
+    from spark_rapids_tpu.exec.tpujoin import TpuBroadcastNestedLoopJoinExec
+    return TpuBroadcastNestedLoopJoinExec(children[0], children[1],
+                                          meta.plan.join_type,
+                                          meta.plan.condition)
+
+
+_register(ExecRule(cpu.CpuBroadcastNestedLoopJoinExec,
+                   "broadcast nested loop join",
+                   _tag_bnlj, _convert_bnlj, disabled_by_default=True))
 def _convert_broadcast(meta: ExecMeta, children) -> PhysicalPlan:
     from spark_rapids_tpu.exec.tpujoin import TpuBroadcastExchangeExec
     return TpuBroadcastExchangeExec(children[0])

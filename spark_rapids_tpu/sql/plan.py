@@ -115,11 +115,15 @@ class LogicalLimit(LogicalPlan):
 class LogicalJoin(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan, join_type: str,
                  left_keys: Sequence[Expression],
-                 right_keys: Sequence[Expression]):
+                 right_keys: Sequence[Expression],
+                 condition: Optional[Expression] = None):
         super().__init__([left, right])
         self.join_type = join_type
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
+        # non-equi condition, bound against the combined left+right schema
+        # (reference: GpuBroadcastNestedLoopJoinExec)
+        self.condition = condition
 
     def schema(self) -> Schema:
         ls = self.children[0].schema()

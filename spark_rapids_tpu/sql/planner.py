@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import List
 
+from spark_rapids_tpu.columnar.batch import Schema
 from spark_rapids_tpu.exec.aggutil import AggPlan
 from spark_rapids_tpu.exec import cpu
 from spark_rapids_tpu.exec.base import PhysicalPlan
@@ -67,10 +68,19 @@ class Planner:
         cs = child.output_schema()
         orders = [_bind_order(o, cs) for o in node.orders]
         if node.is_global:
-            # single-partition global sort; range-partitioned parallel sort
-            # arrives with the range partitioner (reference:
-            # GpuRangePartitioner.scala)
-            child = cpu.CpuShuffleExchangeExec(child, ("single",))
+            # range-partitioned parallel global sort when the keys are plain
+            # columns (reference: GpuRangePartitioner.scala + Spark's
+            # rangepartitioning requirement); single-partition otherwise
+            from spark_rapids_tpu.sql.exprs.core import BoundRef
+            n = self.conf.shuffle_partitions
+            simple = all(isinstance(o.expr, BoundRef) for o in orders)
+            if simple and n > 1:
+                child = cpu.CpuShuffleExchangeExec(
+                    child, ("range", [o.expr.index for o in orders],
+                            [o.ascending for o in orders],
+                            [o.nulls_first for o in orders], n))
+            else:
+                child = cpu.CpuShuffleExchangeExec(child, ("single",))
         return cpu.CpuSortExec(child, orders)
 
     def _plan_LogicalLimit(self, node: lp.LogicalLimit) -> PhysicalPlan:
@@ -84,14 +94,31 @@ class Planner:
         right = self.plan(node.children[1])
         ls = left.output_schema()
         rs = right.output_schema()
+        jt = node.join_type
+
+        if node.condition is not None:
+            # non-equi condition -> broadcast nested loop (reference:
+            # GpuBroadcastNestedLoopJoinExec; inner/cross only)
+            if jt not in ("inner", "cross"):
+                raise NotImplementedError(
+                    f"condition joins support inner/cross, not {jt!r}")
+            combined = Schema(list(ls.names) + list(rs.names),
+                              list(ls.dtypes) + list(rs.dtypes))
+            cond = bind_references(node.condition, combined)
+            right = cpu.CpuBroadcastExchangeExec(right)
+            return cpu.CpuBroadcastNestedLoopJoinExec(left, right,
+                                                      "inner", cond)
+
+        if jt == "cross":
+            left = cpu.CpuShuffleExchangeExec(left, ("single",))
+            right = cpu.CpuShuffleExchangeExec(right, ("single",))
+            return cpu.CpuCartesianProductExec(left, right)
+
         lkeys = [bind_references(e, ls) for e in node.left_keys]
         rkeys = [bind_references(e, rs) for e in node.right_keys]
-        # materialize key columns as leading projections? keys must be plain
-        # column refs for the exec; project if needed
-        from spark_rapids_tpu.sql.exprs.core import BoundRef
+        # keys must be plain column refs for the exec; project if needed
         lidx, left = _key_indices(left, lkeys, ls)
         ridx, right = _key_indices(right, rkeys, rs)
-        jt = node.join_type
         # broadcast the build side when its estimate fits under the
         # threshold (reference: GpuBroadcastHashJoinExec; build side is the
         # non-preserved side, so full outer never broadcasts)
@@ -105,13 +132,10 @@ class Planner:
                 left = cpu.CpuBroadcastExchangeExec(left)
             else:
                 right = cpu.CpuBroadcastExchangeExec(right)
-        elif jt != "cross":
-            n = self.conf.shuffle_partitions
-            left = cpu.CpuShuffleExchangeExec(left, ("hash", lidx, n))
-            right = cpu.CpuShuffleExchangeExec(right, ("hash", ridx, n))
-        else:
-            left = cpu.CpuShuffleExchangeExec(left, ("single",))
-            right = cpu.CpuShuffleExchangeExec(right, ("single",))
+            return cpu.CpuBroadcastHashJoinExec(left, right, jt, lidx, ridx)
+        n = self.conf.shuffle_partitions
+        left = cpu.CpuShuffleExchangeExec(left, ("hash", lidx, n))
+        right = cpu.CpuShuffleExchangeExec(right, ("hash", ridx, n))
         return cpu.CpuJoinExec(left, right, jt, lidx, ridx)
 
     def _plan_LogicalUnion(self, node: lp.LogicalUnion) -> PhysicalPlan:

@@ -78,12 +78,39 @@ def test_join_multi_key(session, rng):
 
 
 def test_cross_join(session, rng):
+    # cartesian product is disabled by default like the reference
+    # (GpuOverrides.scala:1662-1681) and needs its conf key
     left = pd.DataFrame({"x": np.arange(17, dtype=np.int64)})
     right = pd.DataFrame({"y": np.arange(9, dtype=np.int64),
                           "s": [f"r{i}" for i in range(9)]})
     assert_tpu_and_cpu_equal(
         lambda s: s.create_dataframe(left, 2).join(
-            s.create_dataframe(right, 1), on=None, how="cross"))
+            s.create_dataframe(right, 1), on=None, how="cross"),
+        conf={"spark.rapids.sql.exec.CartesianProductExec": True})
+
+
+def test_cross_join_disabled_falls_back(session, rng):
+    left = pd.DataFrame({"x": np.arange(5, dtype=np.int64)})
+    right = pd.DataFrame({"y": np.arange(3, dtype=np.int64)})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(left, 1).join(
+            s.create_dataframe(right, 1), on=None, how="cross"),
+        allow_non_tpu=["CpuCartesianProductExec", "CpuShuffleExchangeExec",
+                       "CpuScanExec"])
+
+
+def test_broadcast_nested_loop_join_condition(session, rng):
+    from spark_rapids_tpu.sql import functions as F
+    left = pd.DataFrame({"x": np.arange(25, dtype=np.int64),
+                         "lv": rng.uniform(0, 1, 25)})
+    right = pd.DataFrame({"y": np.arange(12, dtype=np.int64),
+                          "rv": rng.uniform(0, 1, 12)})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(left, 2).join(
+            s.create_dataframe(right, 1),
+            on=(F.col("x") > F.col("y") * 2) & (F.col("y") < 10),
+            how="inner"),
+        conf={"spark.rapids.sql.exec.BroadcastNestedLoopJoinExec": True})
 
 
 def test_join_empty_build_side(session, rng):

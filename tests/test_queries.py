@@ -219,3 +219,31 @@ class TestKernelCache:
             lambda s: s.create_dataframe(df, 2)
             .select(F.col("price").cast("long").alias("x")))
         assert len(a) == len(b)
+
+
+def test_parallel_range_partitioned_sort(session, rng):
+    # global sort rides a range exchange when there are multiple shuffle
+    # partitions (GpuRangePartitioner.scala analogue); output must be
+    # globally ordered across partition boundaries, including desc keys,
+    # nulls, strings, and NaN placement
+    import numpy as np
+    import pandas as pd
+    from spark_rapids_tpu.sql import functions as F
+    n = 500
+    pdf = pd.DataFrame({
+        "k": pd.array([None if i % 47 == 0 else int(rng.integers(0, 50))
+                       for i in range(n)], dtype="Int64"),
+        "f": [np.nan if i % 31 == 0 else float(rng.uniform(-5, 5))
+              for i in range(n)],
+        "s": [f"s{int(rng.integers(0, 100)):03d}" for i in range(n)],
+    })
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(pdf, 4).order_by("k", "f"),
+        ignore_order=False)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(pdf, 4).order_by(
+            F.col("f").desc(), F.col("s").asc()),
+        ignore_order=False)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(pdf, 4).order_by("s", "k"),
+        ignore_order=False)
