@@ -194,6 +194,12 @@ CSV_ENABLED = register(
 CSV_READ_ENABLED = register(
     "spark.rapids.sql.format.csv.read.enabled", _to_bool, True,
     "Enable accelerated CSV scans.")
+METRICS_ENABLED = register(
+    "spark.rapids.sql.metrics.enabled", _to_bool, True,
+    "Collect per-operator SQL metrics (rows/batches/time) and emit "
+    "profiler trace ranges per operator (the reference's GpuMetricNames "
+    "and NVTX ranges, GpuExec.scala:24-41, NvtxWithMetrics.scala:17-44).")
+
 ORC_ENABLED = register(
     "spark.rapids.sql.format.orc.enabled", _to_bool, True,
     "Enable ORC input/output acceleration.")

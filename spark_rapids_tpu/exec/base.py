@@ -41,6 +41,43 @@ class PhysicalPlan:
     def partitions(self, ctx: "ExecContext") -> List[Partition]:
         raise NotImplementedError
 
+    def executed_partitions(self, ctx: "ExecContext") -> List[Partition]:
+        """``partitions`` wrapped with per-operator SQL metrics and profiler
+        ranges (reference: GpuMetricNames per-exec Spark metrics,
+        GpuExec.scala:24-41, + NvtxWithMetrics.scala:17-44). Consumers call
+        this; operators implement ``partitions``."""
+        parts = self.partitions(ctx)
+        if not ctx.metrics_enabled:
+            return parts
+        import time
+        op = self.describe()
+        try:
+            from jax.profiler import TraceAnnotation
+        except ImportError:  # pragma: no cover
+            import contextlib
+            TraceAnnotation = lambda _name: contextlib.nullcontext()  # noqa: E731
+
+        def wrap(part: Partition) -> Partition:
+            def run():
+                it = part()
+                while True:
+                    t0 = time.perf_counter()
+                    with TraceAnnotation(self.name):
+                        try:
+                            batch = next(it)
+                        except StopIteration:
+                            return
+                    ctx.metric_add(op, "totalTime",
+                                   time.perf_counter() - t0)
+                    ctx.metric_add(op, "numOutputBatches", 1)
+                    rows = (batch._host_rows
+                            if hasattr(batch, "_host_rows") else len(batch))
+                    if rows is not None:
+                        ctx.metric_add(op, "numOutputRows", rows)
+                    yield batch
+            return run
+        return [wrap(p) for p in parts]
+
     def map_children(self, fn) -> "PhysicalPlan":
         import copy
         new = copy.copy(self)
@@ -69,6 +106,8 @@ class ExecContext:
         self.conf = conf
         self.session = session
         self.metrics: dict = {}
+        self.metrics_enabled = conf.get_bool(
+            "spark.rapids.sql.metrics.enabled", True)
 
     def metric_add(self, op: str, name: str, value):
         self.metrics.setdefault(op, {}).setdefault(name, 0)

@@ -80,7 +80,7 @@ class CpuProjectExec(PhysicalPlan):
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         from spark_rapids_tpu.exec import taskctx
         from spark_rapids_tpu.sql.exprs.nondet import has_nondeterministic
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
         impure = any(has_nondeterministic(e) for _, e in self.exprs)
 
         def make(index: int, part: Partition) -> Partition:
@@ -113,7 +113,7 @@ class CpuFilterExec(PhysicalPlan):
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         from spark_rapids_tpu.exec import taskctx
         from spark_rapids_tpu.sql.exprs.nondet import has_nondeterministic
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
         impure = has_nondeterministic(self.condition)
 
         def make(index: int, part: Partition) -> Partition:
@@ -151,7 +151,7 @@ class CpuHashAggregateExec(PhysicalPlan):
         return f"CpuHashAggregateExec(mode={self.mode}, keys=[{keys}])"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
 
         def make(part: Partition) -> Partition:
             def run():
@@ -233,7 +233,7 @@ class CpuShuffleExchangeExec(PhysicalPlan):
         return f"CpuShuffleExchangeExec({self.partitioning[0]})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
         schema = self.children[0].output_schema()
         kind = self.partitioning[0]
         if kind == "single":
@@ -352,7 +352,7 @@ class CpuBroadcastExchangeExec(PhysicalPlan):
 
         def run():
             if "df" not in self._cache:
-                parts = child.partitions(ctx)
+                parts = child.executed_partitions(ctx)
                 self._cache["df"] = _concat_parts(
                     (df for p in parts for df in p()), child.output_schema())
             yield self._cache["df"]
@@ -416,7 +416,7 @@ class CpuSortExec(PhysicalPlan):
         return f"CpuSortExec({self.orders})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
 
         def make(part: Partition) -> Partition:
             def run():
@@ -436,7 +436,7 @@ class CpuLocalLimitExec(PhysicalPlan):
         return self.children[0].output_schema()
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
 
         def make(part: Partition) -> Partition:
             def run():
@@ -465,7 +465,7 @@ class CpuUnionExec(PhysicalPlan):
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         out: List[Partition] = []
         for c in self.children:
-            out.extend(c.partitions(ctx))
+            out.extend(c.executed_partitions(ctx))
         return out
 
 
@@ -514,7 +514,7 @@ class CpuExpandExec(PhysicalPlan):
         return f"CpuExpandExec({len(self.projections)} sets)"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
         names = [n for n, _ in self.projections[0]]
 
         def make(part: Partition) -> Partition:
@@ -556,8 +556,8 @@ class CpuJoinExec(PhysicalPlan):
         return f"CpuJoinExec({self.join_type})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        left_parts = self.children[0].partitions(ctx)
-        right_parts = self.children[1].partitions(ctx)
+        left_parts = self.children[0].executed_partitions(ctx)
+        right_parts = self.children[1].executed_partitions(ctx)
         # broadcast pairing: a single-partition broadcast side joins against
         # every partition of the other side
         if len(left_parts) != len(right_parts):
@@ -683,8 +683,8 @@ class CpuBroadcastNestedLoopJoinExec(PhysicalPlan):
         return f"CpuBroadcastNestedLoopJoinExec({self.join_type})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        left_parts = self.children[0].partitions(ctx)
-        right_parts = self.children[1].partitions(ctx)
+        left_parts = self.children[0].executed_partitions(ctx)
+        right_parts = self.children[1].executed_partitions(ctx)
         assert len(right_parts) == 1, \
             "nested-loop build side must be a broadcast (single partition)"
         right_parts = right_parts * len(left_parts)

@@ -99,7 +99,7 @@ class TpuProjectExec(TpuExec):
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         from spark_rapids_tpu.exec import taskctx
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
 
         def make(index: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
@@ -143,7 +143,7 @@ class TpuFilterExec(TpuExec):
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         from spark_rapids_tpu.exec import taskctx
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
 
         def make(index: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
@@ -210,7 +210,7 @@ class TpuHashAggregateExec(TpuExec):
         return f"TpuHashAggregateExec(mode={self.mode}, keys=[{keys}])"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
         growth = ctx.conf.capacity_growth
 
         def make(part: Partition) -> Partition:
@@ -281,7 +281,7 @@ class TpuSortExec(TpuExec):
         return f"TpuSortExec({self.orders})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
         growth = ctx.conf.capacity_growth
         schema = self.output_schema()
 
@@ -308,7 +308,7 @@ class TpuLocalLimitExec(TpuExec):
         return self.children[0].output_schema()
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
 
         def make(part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
@@ -340,7 +340,7 @@ class TpuUnionExec(TpuExec):
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         out: List[Partition] = []
         for c in self.children:
-            out.extend(c.partitions(ctx))
+            out.extend(c.executed_partitions(ctx))
         return out
 
 
@@ -414,7 +414,7 @@ class TpuExpandExec(TpuExec):
         return f"TpuExpandExec({len(self.projections)} sets)"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
 
         def make(part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
@@ -511,7 +511,7 @@ class TpuShuffleExchangeExec(TpuExec):
         return f"TpuShuffleExchangeExec({self.partitioning[0]})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
         schema = self.output_schema()
         growth = ctx.conf.capacity_growth
         kind = self.partitioning[0]

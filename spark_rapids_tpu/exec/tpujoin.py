@@ -48,7 +48,7 @@ class TpuBroadcastExchangeExec(PhysicalPlan):
         def run():
             if "batch" not in self._cache:
                 from spark_rapids_tpu.exec.tpu import _concat_device
-                parts = child.partitions(ctx)
+                parts = child.executed_partitions(ctx)
                 batches = [b for p in parts for b in p()]
                 self._cache["batch"] = _concat_device(
                     batches, child.output_schema(), growth)
@@ -128,8 +128,8 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         si, bi = self._sides()
-        stream_parts = self.children[si].partitions(ctx)
-        build_parts = self.children[bi].partitions(ctx)
+        stream_parts = self.children[si].executed_partitions(ctx)
+        build_parts = self.children[bi].executed_partitions(ctx)
         if len(stream_parts) != len(build_parts):
             # broadcast build side: one build partition shared by every
             # stream partition (full outer never broadcasts — the unmatched-

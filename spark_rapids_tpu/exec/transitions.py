@@ -30,7 +30,7 @@ class HostToDeviceExec(PhysicalPlan):
         return self.children[0].output_schema()
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
         schema = self.children[0].output_schema()
         max_rows = ctx.conf.batch_size_rows
 
@@ -58,7 +58,7 @@ class DeviceToHostExec(PhysicalPlan):
         return self.children[0].output_schema()
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
 
         def make(part: Partition) -> Partition:
             def run() -> Iterator[pd.DataFrame]:

@@ -97,7 +97,7 @@ class CpuWindowExec(PhysicalPlan):
         return f"CpuWindowExec([{', '.join(n for n, _ in self.window_exprs)}])"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
         from spark_rapids_tpu.exec.cpu import _concat_parts
 
         def make(part: Partition) -> Partition:
@@ -335,7 +335,7 @@ class TpuWindowExec(PhysicalPlan):
                + "|".join(expr_signature(e) for e in extra))
         kern = cached_jit(sig, lambda: jax.jit(kernel))
         growth = ctx.conf.capacity_growth
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
 
         def make(part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:

@@ -108,7 +108,7 @@ class CpuWriteExec(PhysicalPlan):
         _encode_table(table, f, self.fmt)
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
         schema = self.children[0].output_schema()
         protocol = WriteCommitProtocol(self.path)
         protocol.setup(self.mode)
@@ -157,7 +157,7 @@ class TpuWriteExec(PhysicalPlan):
         return f"TpuWriteExec({self.fmt}, {self.path})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].partitions(ctx)
+        child_parts = self.children[0].executed_partitions(ctx)
         protocol = WriteCommitProtocol(self.path)
         protocol.setup(self.mode)
         ext = _EXTENSIONS[self.fmt]

@@ -1,0 +1,128 @@
+"""Device generator kernels: explode(split(str, delim)) (reference:
+GpuGenerateExec.scala:194 runs explode-style generators through cuDF; here
+the fused split+explode is one segmentation kernel over the char buffer).
+
+Two-phase like joins: a totals kernel syncs the output row count and char
+totals to the host (the one device->host sync dynamic cardinality costs),
+then the expand kernel builds the output batch at a bucketed capacity.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.ops.rowops import gather_column
+
+
+def _token_layout(batch: DeviceBatch, col_idx: int, delim: int):
+    """Per-row token counts and the global ascending delimiter-position
+    list (segmented by row via a cumulative offset table)."""
+    col = batch.columns[col_idx]
+    capacity = batch.capacity
+    nchars = col.data.shape[0]
+    i = jnp.arange(nchars, dtype=jnp.int32)
+    row_ids = jnp.clip(
+        jnp.searchsorted(col.offsets, i, side="right").astype(jnp.int32) - 1,
+        0, capacity - 1)
+    live_char = i < col.offsets[capacity]
+    is_delim = (col.data == jnp.uint8(delim)) & live_char
+    delims_per_row = jax.ops.segment_sum(
+        is_delim.astype(jnp.int32), row_ids, num_segments=capacity)
+    valid = col.validity & batch.row_mask()
+    tokens = jnp.where(valid, delims_per_row + 1, 0)
+    # compact delimiter positions (ascending) to the front
+    perm_d = jnp.argsort(~is_delim, stable=True).astype(jnp.int32)
+    delim_pos = i[perm_d]
+    delim_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(delims_per_row).astype(jnp.int32)])
+    return col, tokens, delim_pos, delim_offsets
+
+
+def explode_totals(batch: DeviceBatch, col_idx: int, delim: int):
+    """(total output rows, replicated char total per string column, token
+    char total) — the host sync before expansion."""
+    col, tokens, _, _ = _token_layout(batch, col_idx, delim)
+    totals = [tokens.sum()]
+    for ci, dt in enumerate(batch.schema.dtypes):
+        if not dt.is_string:
+            continue
+        c = batch.columns[ci]
+        lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int32)
+        totals.append((lens * tokens).sum())
+    # token column chars never exceed the source column's chars
+    totals.append(col.offsets[batch.capacity])
+    return jnp.stack([t.astype(jnp.int32) for t in totals])
+
+
+def explode_split(batch: DeviceBatch, col_idx: int, delim: int,
+                  out_name: str, out_cap: int, char_caps: Tuple[int, ...],
+                  tok_char_cap: int, with_pos: bool,
+                  pos_name: str = "pos") -> DeviceBatch:
+    """Output: child columns (replicated per token) + [pos] + token column.
+    Null input strings produce no rows (Spark explode drops nulls)."""
+    col, tokens, delim_pos, delim_offsets = _token_layout(batch, col_idx,
+                                                          delim)
+    capacity = batch.capacity
+    nchars = max(col.data.shape[0], 1)
+    tok_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), jnp.cumsum(tokens).astype(jnp.int32)])
+    total = tok_offsets[capacity]
+    t = jnp.arange(out_cap, dtype=jnp.int32)
+    out_live = t < total
+    out_row = jnp.clip(
+        jnp.searchsorted(tok_offsets, t, side="right").astype(jnp.int32) - 1,
+        0, capacity - 1)
+    k = t - tok_offsets[out_row]                       # token ordinal in row
+    d_base = delim_offsets[out_row]
+    starts = jnp.where(
+        k == 0, col.offsets[:-1][out_row].astype(jnp.int32),
+        delim_pos[jnp.clip(d_base + k - 1, 0, delim_pos.shape[0] - 1)] + 1)
+    ends = jnp.where(
+        k == tokens[out_row] - 1, col.offsets[1:][out_row].astype(jnp.int32),
+        delim_pos[jnp.clip(d_base + k, 0, delim_pos.shape[0] - 1)])
+    tok_len = jnp.where(out_live, jnp.maximum(ends - starts, 0), 0)
+
+    # replicated child columns (the source column stays, like Spark's
+    # requiredChildOutput keeps it)
+    out_cols = []
+    names = []
+    dts = []
+    si = 0
+    for ci, (name, dt) in enumerate(zip(batch.schema.names,
+                                        batch.schema.dtypes)):
+        ccap = 0
+        if dt.is_string:
+            ccap = char_caps[si]
+            si += 1
+        out_cols.append(gather_column(batch.columns[ci], out_row, out_live,
+                                      out_char_capacity=ccap))
+        names.append(name)
+        dts.append(dt)
+    if with_pos:
+        out_cols.append(DeviceColumn(dtypes.INT32, k.astype(jnp.int32),
+                                     out_live))
+        names.append(pos_name)
+        dts.append(dtypes.INT32)
+    # token string column
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), jnp.cumsum(tok_len).astype(jnp.int32)])
+    cchars = jnp.arange(tok_char_cap, dtype=jnp.int32)
+    c_row = jnp.clip(
+        jnp.searchsorted(new_offsets, cchars,
+                         side="right").astype(jnp.int32) - 1, 0, out_cap - 1)
+    src_idx = starts[c_row] + (cchars - new_offsets[c_row])
+    gathered = col.data[jnp.clip(src_idx, 0, nchars - 1)]
+    total_chars = new_offsets[out_cap]
+    tok_chars = jnp.where(cchars < total_chars, gathered, 0).astype(jnp.uint8)
+    out_cols.append(DeviceColumn(dtypes.STRING, tok_chars, out_live,
+                                 new_offsets))
+    names.append(out_name)
+    dts.append(dtypes.STRING)
+    return DeviceBatch(Schema(names, dts), out_cols, total)

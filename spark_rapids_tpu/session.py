@@ -126,9 +126,12 @@ class TpuSparkSession:
         if plan.columnar_output:
             plan = DeviceToHostExec(plan)
         outs: List[pd.DataFrame] = []
-        for part in plan.partitions(ctx):
+        for part in plan.executed_partitions(ctx):
             for df in part():
                 outs.append(df)
+        # per-operator SQL metrics of the last executed query (the
+        # reference surfaces these in the Spark UI, GpuExec.scala:61-67)
+        self.last_query_metrics = ctx.metrics
         return plan, outs
 
 
@@ -197,17 +200,28 @@ class GroupedData:
         self.grouping = grouping_cols
 
     def agg(self, *agg_cols: Column) -> "DataFrame":
+        from spark_rapids_tpu.sql.exprs.core import Alias, Col
         schema = self.df._plan.schema()
+        child = self.df._plan
         grouping = []
+        computed = []   # non-column keys get pre-projected (Spark's shape)
         for g in self.grouping:
             e = _c(g)
-            grouping.append((e.sql_name(schema), e))
+            name = e.sql_name(schema)
+            base = e.children[0] if isinstance(e, Alias) else e
+            if not isinstance(base, Col):
+                computed.append((name, e))
+                e = Col(name)
+            grouping.append((name, e))
+        if computed:
+            passthrough = [(n, col_fn(n).expr) for n in schema.names]
+            child = lp.LogicalProject(child, passthrough + computed)
         results = list(grouping)
         for c in agg_cols:
             e = _expr(c)
             results.append((e.sql_name(schema), e))
         return DataFrame(self.df.session,
-                         lp.LogicalAggregate(self.df._plan, grouping, results))
+                         lp.LogicalAggregate(child, grouping, results))
 
     def count(self) -> "DataFrame":
         from spark_rapids_tpu.sql import functions as F
@@ -294,7 +308,18 @@ class DataFrame:
 
     def with_column(self, name: str, c: Column) -> "DataFrame":
         from spark_rapids_tpu.sql.window import WindowExpression
+        from spark_rapids_tpu.sql.exprs.generators import ExplodeSplit
         e = _expr(c)
+        if isinstance(e, ExplodeSplit):
+            if name in self.schema.names:
+                raise ValueError(f"generated column {name!r} would shadow "
+                                 "an existing column")
+            if e.with_pos and "pos" in self.schema.names:
+                raise ValueError("posexplode's 'pos' column would shadow an "
+                                 "existing column; rename it first")
+            return DataFrame(self.session, lp.LogicalGenerate(
+                self._plan, e.split.children[0], e.split.delim, name,
+                e.with_pos))
         if isinstance(e, WindowExpression):
             # window columns append to the child (Spark's WindowExec shape)
             out = DataFrame(self.session,

@@ -1,0 +1,53 @@
+"""Generator expressions: split + explode/posexplode markers (reference:
+GpuGenerateExec.scala; Spark's Generate node). ``SplitStr`` produces an
+array value that only ``ExplodeSplit`` can consume — the framework has no
+first-class array columns (the reference's type gate also excludes arrays,
+GpuOverrides.scala:383-395), so the planner fuses split+explode into one
+Generate operator.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType
+from spark_rapids_tpu.sql.exprs.core import Expression
+
+
+class SplitStr(Expression):
+    """split(str, delim) with a literal delimiter."""
+
+    def __init__(self, child: Expression, delim: str):
+        super().__init__([child])
+        self.delim = delim
+
+    def dtype(self, schema: Schema) -> DType:
+        raise TypeError("split() produces an array; it can only be consumed "
+                        "by explode()/posexplode()")
+
+    def sql_name(self, schema=None) -> str:
+        return f"split({self.children[0].sql_name(schema)}, {self.delim!r})"
+
+
+class ExplodeSplit(Expression):
+    """explode(split(...)) / posexplode(split(...)) marker, lowered to a
+    Generate plan node by DataFrame.with_column."""
+
+    def __init__(self, split: SplitStr, with_pos: bool):
+        assert isinstance(split, SplitStr), \
+            "explode() supports split(column, delimiter) input"
+        super().__init__([split])
+        self.with_pos = with_pos
+
+    @property
+    def split(self) -> SplitStr:
+        return self.children[0]
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        fn = "posexplode" if self.with_pos else "explode"
+        return f"{fn}({self.children[0].sql_name(schema)})"

@@ -338,3 +338,25 @@ def monotonically_increasing_id() -> Column:
 def input_file_name() -> Column:
     from spark_rapids_tpu.sql.exprs import nondet
     return Column(nondet.InputFileName())
+
+
+# --- generators --------------------------------------------------------------
+
+def split(c, delim: str) -> Column:
+    """split(str, pattern): like Spark, metacharacter patterns are regexes
+    (host-evaluated; tagged off the device); plain literals split fused on
+    device via explode()."""
+    from spark_rapids_tpu.sql.exprs.generators import SplitStr
+    if not delim:
+        raise ValueError("split() requires a non-empty delimiter")
+    return Column(SplitStr(_c(c), delim))
+
+
+def explode(c: Column) -> Column:
+    from spark_rapids_tpu.sql.exprs.generators import ExplodeSplit
+    return Column(ExplodeSplit(_expr(c), with_pos=False))
+
+
+def posexplode(c: Column) -> Column:
+    from spark_rapids_tpu.sql.exprs.generators import ExplodeSplit
+    return Column(ExplodeSplit(_expr(c), with_pos=True))

@@ -397,6 +397,35 @@ def _register_write_rule() -> None:
 
 
 _register_write_rule()
+def _tag_generate(meta: ExecMeta) -> None:
+    plan = meta.plan
+    cs = plan.children[0].output_schema()
+    if not cs.dtypes[plan.col_idx].is_string:
+        meta.will_not_work("explode source must be a string column")
+    if len(plan.delim.encode("utf-8")) != 1:
+        meta.will_not_work(
+            f"delimiter {plan.delim!r}: only single-byte delimiters run on "
+            "TPU (multi-byte/regex split stays on CPU)")
+    elif plan.delim in "\\^$.|?*+()[]{}":
+        meta.will_not_work(
+            f"delimiter {plan.delim!r} is a regex metacharacter (Spark "
+            "split() patterns are regexes); runs on CPU")
+
+
+def _convert_generate(meta: ExecMeta, children) -> PhysicalPlan:
+    from spark_rapids_tpu.exec.generate import TpuGenerateExec
+    p = meta.plan
+    return TpuGenerateExec(children[0], p.col_idx, p.delim, p.out_name,
+                           p.with_pos, p.pos_name)
+
+
+def _register_generate_rule() -> None:
+    from spark_rapids_tpu.exec.generate import CpuGenerateExec
+    _register(ExecRule(CpuGenerateExec, "explode-style generator",
+                       _tag_generate, _convert_generate))
+
+
+_register_generate_rule()
 _register(ExecRule(cpu.CpuLocalLimitExec, "local limit", _tag_nothing,
                    lambda m, ch: tpu.TpuLocalLimitExec(ch[0], m.plan.limit)))
 _register(ExecRule(cpu.CpuGlobalLimitExec, "global limit", _tag_nothing,
@@ -442,12 +471,17 @@ class TpuOverrides:
 
 class TransitionOverrides:
     """postColumnarTransitions: insert transitions at CPU/TPU boundaries
-    (GpuTransitionOverrides.scala:152-169)."""
+    (GpuTransitionOverrides.scala:152-169) and coalesce batches above
+    fragmenting producers (insertCoalesce, :64-147)."""
 
     def __init__(self, conf: TpuConf):
         self.conf = conf
 
     def apply(self, plan: PhysicalPlan) -> PhysicalPlan:
+        from spark_rapids_tpu.exec.coalesce import insert_coalesce
+        return insert_coalesce(self._apply(plan), self.conf)
+
+    def _apply(self, plan: PhysicalPlan) -> PhysicalPlan:
         # a TPU operator consumes device batches; a CPU operator consumes
         # host DataFrames — insert the matching transition under each child.
         # columnar_input (terminal commands like TpuWriteExec) overrides
@@ -456,7 +490,7 @@ class TransitionOverrides:
                                  plan.columnar_output)
         new_children = []
         for c in plan.children:
-            c2 = self.apply(c)
+            c2 = self._apply(c)
             if wants_columnar and not c2.columnar_output:
                 c2 = HostToDeviceExec(c2)
             elif not wants_columnar and c2.columnar_output:

@@ -185,3 +185,24 @@ class LogicalUnion(LogicalPlan):
 
     def schema(self) -> Schema:
         return self.children[0].schema()
+
+
+class LogicalGenerate(LogicalPlan):
+    """Explode-style generator appended to the child's output (Spark's
+    Generate; reference: GpuGenerateExec.scala). Carries the fused
+    split+explode: source string column expr, literal delimiter."""
+
+    def __init__(self, child: LogicalPlan, source, delim: str,
+                 out_name: str, with_pos: bool, pos_name: str = "pos"):
+        super().__init__([child])
+        self.source = source
+        self.delim = delim
+        self.out_name = out_name
+        self.with_pos = with_pos
+        self.pos_name = pos_name
+
+    def schema(self) -> Schema:
+        from spark_rapids_tpu.exec.generate import generate_output_schema
+        return generate_output_schema(self.children[0].schema(),
+                                      self.with_pos, self.pos_name,
+                                      self.out_name)

@@ -148,6 +148,29 @@ class Planner:
                        for proj in node.projections]
         return cpu.CpuExpandExec(child, projections)
 
+    def _plan_LogicalGenerate(self, node: lp.LogicalGenerate) -> PhysicalPlan:
+        from spark_rapids_tpu.exec.generate import CpuGenerateExec
+        from spark_rapids_tpu.sql.exprs.core import BoundRef
+        child = self.plan(node.children[0])
+        cs = child.output_schema()
+        src = bind_references(node.source, cs)
+        if not isinstance(src, BoundRef):
+            # computed source: pre-project it, generate, then drop the
+            # helper column to keep the logical schema
+            exprs = [(n, BoundRef(i, dt, n)) for i, (n, dt)
+                     in enumerate(zip(cs.names, cs.dtypes))]
+            exprs.append(("_gen_src", src))
+            child = cpu.CpuProjectExec(child, exprs)
+            gen = CpuGenerateExec(child, len(exprs) - 1, node.delim,
+                                  node.out_name, node.with_pos,
+                                  node.pos_name)
+            gs = gen.output_schema()
+            keep = [(n, BoundRef(gs.index_of(n), gs.dtype_of(n), n))
+                    for n in node.schema().names]
+            return cpu.CpuProjectExec(gen, keep)
+        return CpuGenerateExec(child, src.index, node.delim, node.out_name,
+                               node.with_pos, node.pos_name)
+
     def _plan_LogicalWrite(self, node: lp.LogicalWrite) -> PhysicalPlan:
         from spark_rapids_tpu.exec.write import CpuWriteExec
         child = self.plan(node.children[0])

@@ -1,0 +1,34 @@
+"""Per-operator SQL metrics (reference: GpuMetricNames, GpuExec.scala:24-41)."""
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.sql import functions as F
+
+
+def test_metrics_collected(session):
+    pdf = pd.DataFrame({"k": np.arange(100, dtype=np.int64) % 5,
+                        "v": np.linspace(0, 1, 100)})
+    df = session.create_dataframe(pdf, 2).filter(F.col("v") > 0.2) \
+        .group_by("k").agg(F.sum("v").alias("sv"))
+    session.set_conf("spark.rapids.sql.enabled", True)
+    out = df.collect()
+    assert len(out) == 5
+    m = session.last_query_metrics
+    ops = list(m)
+    assert any("TpuFilterExec" in op for op in ops), ops
+    assert any("TpuHashAggregateExec" in op for op in ops), ops
+    filt = next(v for k, v in m.items() if "TpuFilterExec" in k)
+    assert filt["numOutputBatches"] >= 1
+    assert filt["totalTime"] > 0
+
+
+def test_metrics_disabled(session):
+    pdf = pd.DataFrame({"x": np.arange(10, dtype=np.int64)})
+    session.set_conf("spark.rapids.sql.metrics.enabled", False)
+    try:
+        df = session.create_dataframe(pdf, 1).filter(F.col("x") > 3)
+        df.collect()
+        assert session.last_query_metrics == {}
+    finally:
+        session.set_conf("spark.rapids.sql.metrics.enabled", True)

@@ -34,12 +34,20 @@ ALL_QUERIES = sorted(QUERIES, key=lambda q: int(q[1:]))
 @pytest.mark.parametrize("qname", ALL_QUERIES)
 def test_tpch_query_differential(session, tpch_all_pandas, qname):
     """Every TPC-H-like query, TPU vs CPU (the reference's
-    TpchLikeSpark.scala coverage: Q1Like..Q22Like + tpch_test.py)."""
+    TpchLikeSpark.scala coverage: Q1Like..Q22Like + tpch_test.py).
+
+    Cartesian product is enabled explicitly: q11/q15/q22 use scalar-subquery
+    cross joins, and the exec is disabled by default like the reference
+    (GpuOverrides.scala:1662-1681). Two shuffle partitions keep the set of
+    compiled kernel shapes small."""
     def run(s):
         tables = {name: s.create_dataframe(df, 3 if len(df) > 50 else 1)
                   for name, df in tpch_all_pandas.items()}
         return QUERIES[qname](s, tables)
-    assert_tpu_and_cpu_equal(run, approx=True)
+    assert_tpu_and_cpu_equal(run, approx=True, conf={
+        "spark.rapids.sql.exec.CartesianProductExec": True,
+        "spark.rapids.sql.shuffle.partitions": 2,
+    })
 
 
 def test_q1(session, tpch_pandas):
