@@ -1,17 +1,18 @@
-"""Benchmark: TPC-H-like query sweep, framework TPU path vs CPU path.
+"""Benchmark: query-sweep wall clock, framework TPU path vs CPU path.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 The measured quantity is the geomean wall-clock speedup of the TPU
-(accelerated) path over the framework's CPU path across a set of TPC-H
-queries — the same shape as the reference's headline claim ("3x-7x, 4x
-typical" end-to-end GPU vs CPU Spark, docs/FAQ.md:62-66 -> BASELINE.md).
-vs_baseline normalizes the geomean against that 4x typical.
+(accelerated) path over the framework's CPU path across a set of
+workload queries — the same shape as the reference's headline claim
+("3x-7x, 4x typical" end-to-end GPU vs CPU Spark, docs/FAQ.md:62-66 ->
+BASELINE.md). vs_baseline normalizes the geomean against that 4x typical.
 
 Env knobs:
-  BENCH_SF      scale factor          (default 0.05, ~300K lineitem rows)
+  BENCH_SUITE   tpch | tpcxbb | mortgage | all   (default tpch)
+  BENCH_SF      scale factor          (default 0.05)
   BENCH_ITERS   timed iterations      (default 3)
-  BENCH_QUERIES comma list            (default q1,q3,q5,q6,q9,q18)
+  BENCH_QUERIES comma list overriding the suite default (tpch/tpcxbb only)
 """
 
 import json
@@ -20,36 +21,73 @@ import os
 import time
 
 
+def _suite_tpch(session, sf, qnames):
+    from spark_rapids_tpu.models.tpch import QUERIES, TpchTables
+    tables = TpchTables.generate(session, sf, num_partitions=4)
+    names = qnames or ["q1", "q3", "q5", "q6", "q9", "q18"]
+    return {q: (lambda s, q=q: QUERIES[q](s, tables)) for q in names}
+
+
+def _suite_tpcxbb(session, sf, qnames):
+    from spark_rapids_tpu.models.tpcxbb import QUERIES, TpcxbbTables
+    tables = TpcxbbTables.generate(session, sf * 20, num_partitions=4)
+    names = qnames or ["q5", "q9", "q12", "q16", "q20", "q25", "q26"]
+    return {q: (lambda s, q=q: QUERIES[q](s, tables)) for q in names}
+
+
+def _suite_mortgage(session, sf, qnames):
+    from spark_rapids_tpu.models import mortgage, mortgage_data
+    perf = session.create_dataframe(mortgage_data.gen_performance(sf * 20), 4)
+    acq = session.create_dataframe(mortgage_data.gen_acquisition(sf * 20), 4)
+    session.set_conf("spark.rapids.sql.exec.CartesianProductExec", True)
+    return {
+        "etl": lambda s: mortgage.run_etl(s, perf, acq),
+        "agg_join": lambda s: mortgage.aggregates_with_join(s, perf, acq),
+        "percentiles": lambda s: mortgage.aggregates_with_percentiles(s, perf),
+    }
+
+
+SUITES = {"tpch": _suite_tpch, "tpcxbb": _suite_tpcxbb,
+          "mortgage": _suite_mortgage}
+
+
 def main():
+    suite_names = os.environ.get("BENCH_SUITE", "tpch")
     sf = float(os.environ.get("BENCH_SF", "0.05"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
-    qnames = os.environ.get("BENCH_QUERIES", "q1,q3,q5,q6,q9,q18").split(",")
+    qenv = os.environ.get("BENCH_QUERIES")
+    qnames = [q.strip() for q in qenv.split(",")] if qenv else None
 
-    from spark_rapids_tpu.models.tpch import QUERIES, TpchTables
     from spark_rapids_tpu.session import TpuSparkSession
 
     session = TpuSparkSession.builder().config(
         "spark.rapids.sql.enabled", True).get_or_create()
-    tables = TpchTables.generate(session, sf, num_partitions=4)
 
-    def run_query(q, enabled: bool):
+    names = (list(SUITES) if suite_names == "all"
+             else [s.strip() for s in suite_names.split(",")])
+    queries = {}
+    for sn in names:
+        built = SUITES[sn](session, sf, qnames)
+        for q, fn in built.items():
+            queries[f"{sn}.{q}" if len(names) > 1 else q] = fn
+
+    def run_query(fn, enabled: bool):
         session.set_conf("spark.rapids.sql.enabled", enabled)
-        return QUERIES[q](session, tables).collect()
+        return fn(session).collect()
 
     detail = {}
     speedups = []
-    for q in qnames:
-        q = q.strip()
-        run_query(q, True)   # warm: compile + cache kernels
+    for q, fn in queries.items():
+        run_query(fn, True)   # warm: compile + cache kernels
         t0 = time.perf_counter()
         for _ in range(iters):
-            tpu_out = run_query(q, True)
+            tpu_out = run_query(fn, True)
         tpu_s = (time.perf_counter() - t0) / iters
 
-        run_query(q, False)  # warm CPU caches too
+        run_query(fn, False)  # warm CPU caches too
         t0 = time.perf_counter()
         for _ in range(iters):
-            cpu_out = run_query(q, False)
+            cpu_out = run_query(fn, False)
         cpu_s = (time.perf_counter() - t0) / iters
 
         assert len(tpu_out) == len(cpu_out), \
@@ -61,7 +99,7 @@ def main():
 
     geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
     print(json.dumps({
-        "metric": "tpch_geomean_speedup_tpu_vs_cpu_path",
+        "metric": f"{suite_names}_geomean_speedup_tpu_vs_cpu_path",
         "value": round(geomean, 4),
         "unit": "x",
         "vs_baseline": round(geomean / 4.0, 4),

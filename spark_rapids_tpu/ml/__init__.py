@@ -1,0 +1,1 @@
+from spark_rapids_tpu.ml.columnar_rdd import ColumnarRdd  # noqa: F401

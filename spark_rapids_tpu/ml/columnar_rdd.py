@@ -1,0 +1,71 @@
+"""Zero-copy DataFrame -> device-columnar export for ML training
+(reference: ColumnarRdd.scala:41-50 + InternalColumnarRddConverter.scala:
+470-579 re-extract the device-resident RDD[Table] under the final
+GpuColumnarToRowExec so XGBoost trains without a host round trip).
+
+Here the export executes the TPU physical plan and stops *before* the
+DeviceToHost transition: the partitions yield device-resident
+``DeviceBatch``es whose columns are jax arrays already on the accelerator —
+a trainer consumes them directly (e.g. stack into feature matrices with
+``to_feature_matrix``). Gated by ``spark.rapids.sql.exportColumnarRdd``
+(RapidsConf.scala:332-337).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Tuple
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+
+
+class ColumnarRdd:
+    @staticmethod
+    def convert(df) -> List[Callable[[], Iterator[DeviceBatch]]]:
+        """DataFrame -> device-batch partitions (no device->host copy).
+
+        Raises unless ``spark.rapids.sql.exportColumnarRdd`` is true and
+        the final plan is fully columnar (any CPU fallback would force a
+        host round trip, defeating the zero-copy contract)."""
+        session = df.session
+        conf = session.conf
+        if not conf.get_bool("spark.rapids.sql.exportColumnarRdd", False):
+            raise RuntimeError(
+                "ColumnarRdd export requires "
+                "spark.rapids.sql.exportColumnarRdd=true")
+        from spark_rapids_tpu.exec.base import ExecContext
+        from spark_rapids_tpu.sql.overrides import (
+            TpuOverrides, TransitionOverrides,
+        )
+        from spark_rapids_tpu.sql.planner import Planner
+        if not conf.sql_enabled:
+            raise RuntimeError("ColumnarRdd export requires "
+                               "spark.rapids.sql.enabled=true")
+        cpu_plan = Planner(conf).plan(df._plan)
+        plan = TpuOverrides(conf).apply(cpu_plan)
+        plan = TransitionOverrides(conf).apply(plan)
+        if not plan.columnar_output:
+            raise RuntimeError(
+                "query does not end on the TPU; the export would require a "
+                "device->host round trip (plan root: "
+                f"{plan.describe()})")
+        ctx = ExecContext(conf, session)
+        return plan.executed_partitions(ctx)
+
+
+def to_feature_matrix(batch: DeviceBatch,
+                      feature_cols: List[str],
+                      label_col: str) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                               jnp.ndarray]:
+    """Stack feature columns of a device batch into a dense (rows, k)
+    float32 matrix + label vector + live-row mask — the hand-off shape a
+    jax trainer wants (the XGBoost4J-Spark zero-copy pattern,
+    BASELINE config 5)."""
+    cols = []
+    for name in feature_cols:
+        c = batch.column(name)
+        cols.append(c.data.astype(jnp.float32))
+    x = jnp.stack(cols, axis=1)
+    y = batch.column(label_col).data.astype(jnp.float32)
+    return x, y, batch.row_mask()

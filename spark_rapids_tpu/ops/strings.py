@@ -215,6 +215,33 @@ def concat_columns(ctx: EvalContext, cols) -> DevCol:
     return DevCol(dtypes.STRING, out, validity, new_offsets)
 
 
+def select_strings(ctx: EvalContext, cond: jnp.ndarray, a: DevCol,
+                   b: DevCol, validity: jnp.ndarray) -> DevCol:
+    """Row-wise choice between two string columns (the string kernel behind
+    if()/coalesce()): rows where ``cond`` take their bytes from ``a``,
+    others from ``b``. Same segment-gather shape as concat_columns."""
+    capacity = ctx.capacity
+    la, lb = lengths_of(a), lengths_of(b)
+    lens = jnp.where(cond, la, lb)
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(lens).astype(jnp.int32)])
+    total_new = new_offsets[capacity]
+    out_cap = int(a.data.shape[0]) + int(b.data.shape[0])
+    k = jnp.arange(out_cap, dtype=jnp.int32)
+    out_row = jnp.clip(
+        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
+        0, capacity - 1)
+    rel = k - new_offsets[out_row]
+    src_a = a.offsets[:-1][out_row].astype(jnp.int32) + rel
+    src_b = b.offsets[:-1][out_row].astype(jnp.int32) + rel
+    va = a.data[jnp.clip(src_a, 0, a.data.shape[0] - 1)]
+    vb = b.data[jnp.clip(src_b, 0, b.data.shape[0] - 1)]
+    out = jnp.where(cond[out_row], va, vb)
+    out = jnp.where(k < total_new, out, 0).astype(jnp.uint8)
+    return DevCol(dtypes.STRING, out, validity, new_offsets)
+
+
 def _char_row_ids(col: DevCol, capacity: int) -> jnp.ndarray:
     """Row id owning each char slot (clipped into [0, capacity-1])."""
     nchars = col.data.shape[0]

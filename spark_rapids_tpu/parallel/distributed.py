@@ -32,8 +32,10 @@ from spark_rapids_tpu.ops.groupby import row_hashes
 
 
 def data_parallel_mesh(n_devices: int) -> Mesh:
-    devices = np.array(jax.devices()[:n_devices])
-    return Mesh(devices, ("dp",))
+    # mesh construction goes through the version shim layer (the jax
+    # sharding API moves between release trains; shims/loader.py)
+    from spark_rapids_tpu.shims import ShimLoader
+    return ShimLoader.get_shims().make_mesh([n_devices], ("dp",))
 
 
 def _send_buffers(batch: DeviceBatch, key_idx: Sequence[int], n: int):

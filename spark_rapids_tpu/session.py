@@ -216,12 +216,97 @@ class GroupedData:
         if computed:
             passthrough = [(n, col_fn(n).expr) for n in schema.names]
             child = lp.LogicalProject(child, passthrough + computed)
-        results = list(grouping)
+        result_exprs = []
         for c in agg_cols:
             e = _expr(c)
-            results.append((e.sql_name(schema), e))
+            result_exprs.append((e.sql_name(schema), e))
+        from spark_rapids_tpu.sql.exprs.aggregates import find_aggregates
+        if any(getattr(fn, "is_distinct", False)
+               for _, e in result_exprs for fn in find_aggregates(e)):
+            return self._agg_with_distinct(child, grouping, schema,
+                                           result_exprs)
+        results = list(grouping) + result_exprs
         return DataFrame(self.df.session,
                          lp.LogicalAggregate(child, grouping, results))
+
+    def _agg_with_distinct(self, child, grouping, schema, result_exprs):
+        """count(DISTINCT d) rewrite: aggregate twice.
+
+        Level 1 groups by keys+d, reducing every non-distinct aggregate to
+        its update intermediates; level 2 groups by the keys, merging the
+        intermediates and counting the now-unique d values. Same plan shape
+        Spark produces for a single distinct column set (the reference
+        falls back to CPU for the multi-distinct cases it can't split this
+        way, aggregate.scala:40-225)."""
+        from spark_rapids_tpu.sql.exprs import aggregates as am
+        from spark_rapids_tpu.sql.exprs.core import Col
+        fns, seen = [], set()
+        for _, e in result_exprs:
+            for fn in am.find_aggregates(e):
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    fns.append(fn)
+        dist = [fn for fn in fns if getattr(fn, "is_distinct", False)]
+        dist_names = {fn.children[0].sql_name(schema) for fn in dist}
+        if len(dist_names) > 1:
+            raise NotImplementedError(
+                "multiple DISTINCT aggregate column sets in one aggregation "
+                f"are not supported: {sorted(dist_names)}")
+        # the grouping machinery keys columns by name: materialize d as
+        # __dist so both aggregation levels can refer to it uniformly
+        names = child.schema().names
+        child = lp.LogicalProject(
+            child, [(n, col_fn(n).expr) for n in names]
+            + [("__dist", dist[0].children[0])])
+        l1_grouping = list(grouping) + [("__dist", Col("__dist"))]
+
+        # reduction kind -> aggregate constructor, shared by the level-1
+        # (update) and level-2 (merge) tables; count_valid only appears on
+        # the update side (its merge kind is 'sum')
+        kind_ctor = {
+            "sum": am.Sum, "min": am.Min, "max": am.Max, "any": am.Max,
+            "first": lambda e: am.First(e, False),
+            "first_valid": lambda e: am.First(e, True),
+            "last": lambda e: am.Last(e, False),
+            "last_valid": lambda e: am.Last(e, True),
+        }
+
+        def level1_fn(kind, child_expr):
+            if kind == "count_valid":
+                return am.Count(child_expr)
+            return kind_ctor[kind](child_expr)
+
+        def merge_fn(kind, ref):
+            return kind_ctor[kind](ref)
+
+        l1_results = list(l1_grouping)
+        fn_level2 = {}
+        pi = 0
+        for fn in fns:
+            if getattr(fn, "is_distinct", False):
+                # d is unique per level-2 group now; counting its non-NULL
+                # occurrences is exactly count(DISTINCT d)
+                fn_level2[id(fn)] = am.Count(Col("__dist"))
+                continue
+            refs = []
+            for (ukind, cidx), mkind in zip(fn.update_ops(), fn.merge_ops()):
+                pname = f"__p{pi}"
+                pi += 1
+                l1_results.append((pname, level1_fn(ukind, fn.children[cidx])))
+                refs.append(merge_fn(mkind, Col(pname)))
+            fn_level2[id(fn)] = fn.finalize(refs, schema)
+        level1 = lp.LogicalAggregate(child, l1_grouping, l1_results)
+
+        def rewrite(e):
+            if isinstance(e, am.AggregateFunction):
+                return fn_level2[id(e)]
+            return e.map_children(rewrite)
+
+        l2_grouping = [(n, col_fn(n).expr) for n, _ in grouping]
+        l2_results = list(l2_grouping) + [(n, rewrite(e))
+                                          for n, e in result_exprs]
+        return DataFrame(self.df.session,
+                         lp.LogicalAggregate(level1, l2_grouping, l2_results))
 
     def count(self) -> "DataFrame":
         from spark_rapids_tpu.sql import functions as F
@@ -299,12 +384,29 @@ class DataFrame:
 
     # --- transformations ---------------------------------------------------
     def select(self, *cols) -> "DataFrame":
+        from spark_rapids_tpu.sql.exprs.core import Col
+        from spark_rapids_tpu.sql.window import WindowExpression
         schema = self.schema
         exprs = []
         for c in cols:
             e = _c(c)
             exprs.append((e.sql_name(schema), e))
-        return DataFrame(self.session, lp.LogicalProject(self._plan, exprs))
+        # window expressions in a projection: append the windowed columns
+        # first (Spark's WindowExec shape), then project over them
+        win_items = []
+
+        def extract(e):
+            if isinstance(e, WindowExpression):
+                name = f"__w{len(win_items)}"
+                win_items.append((name, e))
+                return Col(name)
+            return e.map_children(extract)
+
+        exprs = [(n, extract(e)) for n, e in exprs]
+        child = self._plan
+        if win_items:
+            child = lp.LogicalWindow(child, win_items)
+        return DataFrame(self.session, lp.LogicalProject(child, exprs))
 
     def with_column(self, name: str, c: Column) -> "DataFrame":
         from spark_rapids_tpu.sql.window import WindowExpression
@@ -405,14 +507,63 @@ class DataFrame:
                              lp.LogicalJoin(self._plan, other._plan, how,
                                             [], [], condition=_expr(on)))
         elif isinstance(on, (str, list, tuple)):
-            lkeys = keyify(on)
-            rkeys = keyify(on)
+            # Spark USING-column semantics: one output column per key name
+            names = [on] if isinstance(on, str) else list(on)
+            if how in ("leftsemi", "leftanti"):
+                lkeys, rkeys = keyify(names), keyify(names)
+            else:
+                return self._join_using(other, names, how)
         else:
             raise TypeError("join on must be a column name, list of names, "
                             "or a boolean Column condition")
         return DataFrame(self.session,
                          lp.LogicalJoin(self._plan, other._plan, how,
                                         lkeys, rkeys))
+
+    def _join_using(self, other: "DataFrame", names, how: str) -> "DataFrame":
+        """join(on=[k]) merges each key into ONE output column: rename the
+        right side's keys, join positionally, then re-emit a single key
+        column (the left value, the right for right joins, coalesce for
+        full — matching Spark's USING resolution)."""
+        from spark_rapids_tpu.sql.exprs.conditional import Coalesce
+        shared = (set(self.schema.names) & set(other.schema.names)) \
+            - set(names)
+        if shared:
+            raise ValueError(
+                "join(on=...) with non-key columns present on both sides is "
+                f"ambiguous: {sorted(shared)}; alias or drop them first")
+        rmap = {n: f"__rk_{n}" for n in names}
+        right = other.select(*[
+            col_fn(n).alias(rmap[n]) if n in rmap else col_fn(n)
+            for n in other.schema.names])
+        joined = DataFrame(self.session, lp.LogicalJoin(
+            self._plan, right._plan, how,
+            [col_fn(n).expr for n in names],
+            [col_fn(rmap[n]).expr for n in names]))
+        out = []
+        for n in names:
+            if how == "right":
+                out.append(col_fn(rmap[n]).alias(n))
+            elif how == "full":
+                out.append(Column(Coalesce([col_fn(n).expr,
+                                            col_fn(rmap[n]).expr])).alias(n))
+            else:
+                out.append(col_fn(n))
+        out += [col_fn(n) for n in self.schema.names if n not in names]
+        out += [col_fn(n) for n in other.schema.names if n not in names]
+        return joined.select(*out)
+
+    def drop(self, *names: str) -> "DataFrame":
+        dropped = set(names)
+        return self.select(*[n for n in self.schema.names
+                             if n not in dropped])
+
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        return self.select(*[
+            col_fn(n).alias(new) if n == old else col_fn(n)
+            for n in self.schema.names])
+
+    withColumnRenamed = with_column_renamed
 
     @property
     def write(self) -> "DataFrameWriter":

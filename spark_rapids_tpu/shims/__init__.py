@@ -1,0 +1,1 @@
+from spark_rapids_tpu.shims.loader import ShimLoader, TpuShims  # noqa: F401

@@ -1,0 +1,206 @@
+"""Version shim layer.
+
+The reference supports several Spark releases from ONE plugin jar by
+ServiceLoader-discovering a version-matched provider of the
+version-sensitive APIs (sql-plugin/.../SparkShims.scala:38-71 trait;
+ShimLoader.scala:26-60 provider matching; shims/spark300, spark301,
+spark310 modules). The version axis for a TPU framework is the JAX /
+jaxlib / libtpu release train: sharding constructors, tree utilities and
+donation/compilation options move between releases. Same design:
+
+- ``TpuShims``: the trait — every version-sensitive operation the rest of
+  the framework is allowed to touch goes through here.
+- ``ShimServiceProvider`` subclasses: one per supported release range,
+  each declaring ``matches(version)`` (SparkShimServiceProvider's
+  VERSIONNAMES match) and building its shims.
+- ``ShimLoader.get_shims()``: picks the first provider matching the
+  running jax version, caches it; ``SPARK_RAPIDS_TPU_SHIM`` forces one by
+  name (the reference's version-override test hook,
+  RapidsConf SHIMS_PROVIDER_OVERRIDE analogue).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+
+class TpuShims:
+    """Version-sensitive API surface (the SparkShims trait analogue)."""
+
+    version_name: str = "base"
+
+    # --- tree utilities ----------------------------------------------------
+    def tree_map(self, fn, *trees):
+        raise NotImplementedError
+
+    def tree_leaves(self, tree):
+        raise NotImplementedError
+
+    # --- meshes & shardings ------------------------------------------------
+    def make_mesh(self, axis_shapes: Sequence[int],
+                  axis_names: Sequence[str], devices=None):
+        """Build a Mesh over the given (possibly virtual) device grid."""
+        raise NotImplementedError
+
+    def named_sharding(self, mesh, *spec):
+        raise NotImplementedError
+
+    def replicated_sharding(self, mesh):
+        raise NotImplementedError
+
+    # --- compilation -------------------------------------------------------
+    def jit(self, fn, *, static_argnums=(), donate_argnums=(),
+            out_shardings=None):
+        raise NotImplementedError
+
+    def device_put(self, value, sharding=None):
+        raise NotImplementedError
+
+    # --- introspection -----------------------------------------------------
+    def devices(self) -> List:
+        raise NotImplementedError
+
+    def default_backend(self) -> str:
+        raise NotImplementedError
+
+
+class _ModernJaxShims(TpuShims):
+    """jax >= 0.4.26: jax.tree.*, jax.sharding.*, jax.make_mesh available."""
+
+    version_name = "jax-modern"
+
+    def __init__(self):
+        import jax
+        self._jax = jax
+
+    def tree_map(self, fn, *trees):
+        return self._jax.tree.map(fn, *trees)
+
+    def tree_leaves(self, tree):
+        return self._jax.tree.leaves(tree)
+
+    def make_mesh(self, axis_shapes, axis_names, devices=None):
+        import numpy as np
+        from jax.sharding import Mesh
+        devs = list(devices if devices is not None else self._jax.devices())
+        n = 1
+        for a in axis_shapes:
+            n *= a
+        grid = np.asarray(devs[:n]).reshape(tuple(axis_shapes))
+        return Mesh(grid, tuple(axis_names))
+
+    def named_sharding(self, mesh, *spec):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    def replicated_sharding(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(mesh, PartitionSpec())
+
+    def jit(self, fn, *, static_argnums=(), donate_argnums=(),
+            out_shardings=None):
+        kw = {}
+        if out_shardings is not None:
+            kw["out_shardings"] = out_shardings
+        return self._jax.jit(fn, static_argnums=static_argnums,
+                             donate_argnums=donate_argnums, **kw)
+
+    def device_put(self, value, sharding=None):
+        return (self._jax.device_put(value, sharding)
+                if sharding is not None else self._jax.device_put(value))
+
+    def devices(self):
+        return list(self._jax.devices())
+
+    def default_backend(self) -> str:
+        return self._jax.default_backend()
+
+
+class _LegacyJaxShims(_ModernJaxShims):
+    """jax < 0.4.26: no jax.tree namespace — tree_util spellings."""
+
+    version_name = "jax-legacy"
+
+    def tree_map(self, fn, *trees):
+        return self._jax.tree_util.tree_map(fn, *trees)
+
+    def tree_leaves(self, tree):
+        return self._jax.tree_util.tree_leaves(tree)
+
+
+class ShimServiceProvider:
+    """One per supported release range (SparkShimServiceProvider)."""
+
+    name: str = "?"
+
+    def matches(self, version: Tuple[int, ...]) -> bool:
+        raise NotImplementedError
+
+    def build(self) -> TpuShims:
+        raise NotImplementedError
+
+
+class ModernJaxProvider(ShimServiceProvider):
+    name = "jax-modern"
+
+    def matches(self, version):
+        return version >= (0, 4, 26)
+
+    def build(self):
+        return _ModernJaxShims()
+
+
+class LegacyJaxProvider(ShimServiceProvider):
+    name = "jax-legacy"
+
+    def matches(self, version):
+        return (0, 4, 0) <= version < (0, 4, 26)
+
+    def build(self):
+        return _LegacyJaxShims()
+
+
+class ShimLoader:
+    """Pick the provider matching the running jax (ShimLoader.scala:26-60:
+    iterate registered providers, first VERSIONNAMES match wins)."""
+
+    _PROVIDERS: List[ShimServiceProvider] = [
+        ModernJaxProvider(), LegacyJaxProvider(),
+    ]
+    _cached: Optional[TpuShims] = None
+
+    @staticmethod
+    def parse_version(text: str) -> Tuple[int, ...]:
+        parts = []
+        for p in text.split(".")[:3]:
+            digits = "".join(ch for ch in p if ch.isdigit())
+            parts.append(int(digits) if digits else 0)
+        return tuple(parts)
+
+    @classmethod
+    def register(cls, provider: ShimServiceProvider) -> None:
+        cls._PROVIDERS.insert(0, provider)
+        cls._cached = None
+
+    @classmethod
+    def get_shims(cls) -> TpuShims:
+        if cls._cached is not None:
+            return cls._cached
+        override = os.environ.get("SPARK_RAPIDS_TPU_SHIM")
+        if override:
+            for p in cls._PROVIDERS:
+                if p.name == override:
+                    cls._cached = p.build()
+                    return cls._cached
+            raise RuntimeError(f"no shim provider named {override!r} "
+                               f"(have {[p.name for p in cls._PROVIDERS]})")
+        import jax
+        version = cls.parse_version(jax.__version__)
+        for p in cls._PROVIDERS:
+            if p.matches(version):
+                cls._cached = p.build()
+                return cls._cached
+        raise RuntimeError(
+            f"no shim provider matches jax {jax.__version__}; supported: "
+            f"{[p.name for p in cls._PROVIDERS]}")

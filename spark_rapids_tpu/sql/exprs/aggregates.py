@@ -216,6 +216,181 @@ class Last(AggregateFunction):
         return refs[0]
 
 
+def _float(e: Expression) -> Expression:
+    from spark_rapids_tpu.sql.exprs.cast import Cast
+    return Cast(e, dtypes.FLOAT64)
+
+
+def _null_if_other_null(value: Expression, other: Expression) -> Expression:
+    """``value`` where ``other`` is non-NULL, else NULL — pairwise-deletion
+    masking for the bivariate moments (SQL corr skips a row if either
+    input is NULL)."""
+    from spark_rapids_tpu.sql.exprs.conditional import If
+    from spark_rapids_tpu.sql.exprs.core import Literal
+    from spark_rapids_tpu.sql.exprs.predicates import IsNotNull
+    return If(IsNotNull(other), value, Literal(None, dtypes.FLOAT64))
+
+
+class _CentralMoment(AggregateFunction):
+    """var/stddev via the (n, Σx, Σx²) sufficient statistics — three plain
+    sums that re-aggregate across batches and shuffle partitions, the shape
+    the two-phase update/merge pipeline wants (no Welford state needed: the
+    merge operator is just +)."""
+
+    sample = True  # n-1 denominator
+
+    def __init__(self, child: Expression):
+        x = _float(child)
+        from spark_rapids_tpu.sql.exprs.arithmetic import Multiply
+        super().__init__([x, Multiply(x, x)])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.FLOAT64
+
+    def update_ops(self):
+        return [("count_valid", 0), ("sum", 0), ("sum", 1)]
+
+    def merge_ops(self): return ["sum", "sum", "sum"]
+
+    def intermediate_dtypes(self, schema):
+        return [dtypes.INT64, dtypes.FLOAT64, dtypes.FLOAT64]
+
+    def _variance(self, refs, schema):
+        from spark_rapids_tpu.sql.exprs.arithmetic import (
+            Divide, Multiply, Subtract,
+        )
+        from spark_rapids_tpu.sql.exprs.cast import Cast
+        from spark_rapids_tpu.sql.exprs.core import Literal
+        from spark_rapids_tpu.sql.exprs.nullexprs import Greatest
+        n, sx, sxx = refs
+        nf = Cast(n, dtypes.FLOAT64)
+        # Σ(x-μ)² = Σx² - (Σx)²/n; clamp the tiny negative residue floating
+        # point can leave so sqrt never sees it
+        ss = Greatest([Subtract(sxx, Divide(Multiply(sx, sx), nf)),
+                       Literal(0.0)])
+        denom = (Subtract(nf, Literal(1.0)) if self.sample else nf)
+        # Divide-by-zero yields NULL: var_samp of 1 row / var_pop of 0 rows
+        return Divide(ss, denom)
+
+    def finalize(self, refs, schema):
+        return self._variance(refs, schema)
+
+
+class VarSamp(_CentralMoment):
+    sample = True
+
+    def sql_name(self, schema=None) -> str:
+        return f"var_samp({self.children[0].sql_name(schema)})"
+
+
+class VarPop(_CentralMoment):
+    sample = False
+
+    def sql_name(self, schema=None) -> str:
+        return f"var_pop({self.children[0].sql_name(schema)})"
+
+
+class StddevSamp(_CentralMoment):
+    sample = True
+
+    def sql_name(self, schema=None) -> str:
+        return f"stddev_samp({self.children[0].sql_name(schema)})"
+
+    def finalize(self, refs, schema):
+        from spark_rapids_tpu.sql.exprs.mathexprs import Sqrt
+        return Sqrt(self._variance(refs, schema))
+
+
+class StddevPop(_CentralMoment):
+    sample = False
+
+    def sql_name(self, schema=None) -> str:
+        return f"stddev_pop({self.children[0].sql_name(schema)})"
+
+    def finalize(self, refs, schema):
+        from spark_rapids_tpu.sql.exprs.mathexprs import Sqrt
+        return Sqrt(self._variance(refs, schema))
+
+
+class Corr(AggregateFunction):
+    """Pearson correlation from the five pairwise-masked sums + the pair
+    count — again all-+ merges, so partial/final and the mesh shuffle
+    need nothing new."""
+
+    def __init__(self, left: Expression, right: Expression):
+        from spark_rapids_tpu.sql.exprs.arithmetic import Multiply
+        x, y = _float(left), _float(right)
+        xm = _null_if_other_null(x, y)
+        ym = _null_if_other_null(y, x)
+        super().__init__([xm, ym, Multiply(x, y),
+                          Multiply(xm, xm), Multiply(ym, ym)])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.FLOAT64
+
+    def sql_name(self, schema=None) -> str:
+        return "corr(...)"
+
+    def update_ops(self):
+        return [("count_valid", 2), ("sum", 0), ("sum", 1),
+                ("sum", 2), ("sum", 3), ("sum", 4)]
+
+    def merge_ops(self): return ["sum"] * 6
+
+    def intermediate_dtypes(self, schema):
+        return [dtypes.INT64] + [dtypes.FLOAT64] * 5
+
+    def finalize(self, refs, schema):
+        from spark_rapids_tpu.sql.exprs.arithmetic import (
+            Divide, Multiply, Subtract,
+        )
+        from spark_rapids_tpu.sql.exprs.cast import Cast
+        from spark_rapids_tpu.sql.exprs.core import Literal
+        from spark_rapids_tpu.sql.exprs.mathexprs import Sqrt
+        from spark_rapids_tpu.sql.exprs.nullexprs import Greatest
+        n, sx, sy, sxy, sxx, syy = refs
+        nf = Cast(n, dtypes.FLOAT64)
+        cov = Subtract(sxy, Divide(Multiply(sx, sy), nf))
+        vx = Greatest([Subtract(sxx, Divide(Multiply(sx, sx), nf)),
+                       Literal(0.0)])
+        vy = Greatest([Subtract(syy, Divide(Multiply(sy, sy), nf)),
+                       Literal(0.0)])
+        # zero variance -> sqrt gives 0 -> Divide yields NULL
+        return Divide(cov, Sqrt(Multiply(vx, vy)))
+
+
+class CountDistinct(AggregateFunction):
+    """count(DISTINCT expr). Never executed directly: the DataFrame layer
+    rewrites an aggregation containing it into a two-level aggregation
+    (group by keys+expr with partial non-distinct aggs, then group by keys
+    re-aggregating + counting the now-unique expr values) — the same
+    distinct-expansion Spark plans and the reference falls back on when it
+    can't (aggregate.scala:40-225 tags distinct+multiple-agg cases)."""
+
+    is_distinct = True
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT64
+
+    def sql_name(self, schema=None) -> str:
+        return f"count(DISTINCT {self.children[0].sql_name(schema)})"
+
+    def _not_executable(self):
+        raise RuntimeError(
+            "CountDistinct must be rewritten by the grouped-aggregation "
+            "planner before execution")
+
+    def update_ops(self): self._not_executable()
+    def merge_ops(self): self._not_executable()
+    def intermediate_dtypes(self, schema): self._not_executable()
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        return None
+
+
 def find_aggregates(expr: Expression) -> List[AggregateFunction]:
     out = []
     if isinstance(expr, AggregateFunction):

@@ -120,8 +120,10 @@ class Cast(Expression):
     def eval_host(self, df: pd.DataFrame) -> pd.Series:
         s = self.children[0].eval_host(df)
         values, validity, index = host_unary_values(s)
-        src = (dtypes.from_numpy(values.dtype) if values.dtype != object
-               else dtypes.STRING)
+        from spark_rapids_tpu.sql.exprs.hostutil import series_dtype
+        # the logical dtype, not the unpacked numpy dtype: timestamps/dates
+        # unpack to int64 micros / int32 days and would mis-dispatch
+        src = series_dtype(s)
         # the host twin stores timestamps as datetime64 -> int64 micros already
         with np.errstate(all="ignore"):
             data, extra = cast_data(np, values, src, self.to)

@@ -47,8 +47,6 @@ class If(Expression):
         return f"if({p}, {t}, {f})"
 
     def device_supported(self, schema: Schema) -> Optional[str]:
-        if any(c.dtype(schema).is_string for c in self.children[1:]):
-            return "string-typed branches are not supported on TPU yet"
         return None
 
     def eval_device(self, ctx: EvalContext) -> DevValue:
@@ -56,6 +54,12 @@ class If(Expression):
         pv = ctx.broadcast(self.children[0].eval_device(ctx))
         tv = self.children[1].eval_device(ctx)
         fv = self.children[2].eval_device(ctx)
+        if tv.dtype.is_string or fv.dtype.is_string:
+            from spark_rapids_tpu.ops import strings as string_ops
+            tc, fc = ctx.broadcast(tv), ctx.broadcast(fv)
+            cond = pv.data & pv.validity  # NULL predicate -> else branch
+            validity = jnp.where(cond, tc.validity, fc.validity)
+            return string_ops.select_strings(ctx, cond, tc, fc, validity)
         dt = tv.dtype if tv.dtype == fv.dtype else common_type(tv.dtype, fv.dtype)
         tdata, tval, _ = _as_pair(ctx, tv, dt)
         fdata, fval, _ = _as_pair(ctx, fv, dt)
@@ -117,17 +121,27 @@ class CaseWhen(Expression):
         return " ".join(parts)
 
     def device_supported(self, schema: Schema) -> Optional[str]:
-        if self.dtype(schema).is_string:
-            return "string-typed CASE WHEN is not supported on TPU yet"
         return None
 
     def eval_device(self, ctx: EvalContext) -> DevValue:
-        # fold from the last branch backwards
-        vals = [v for _, v in self._branches()]
-        dt = vals[0].dtype(None) if False else None
-        # compute common type from actual evaluated dtypes
         evaluated = [(ctx.broadcast(p.eval_device(ctx)), v.eval_device(ctx))
                      for p, v in self._branches()]
+        if any(v.dtype.is_string for _, v in evaluated):
+            # fold branches back-to-front through the string row-select
+            # kernel: else-value (or all-null) is the running accumulator
+            from spark_rapids_tpu.ops import strings as string_ops
+            from spark_rapids_tpu.sql.exprs.core import DevScalar
+            if self.has_else:
+                acc = ctx.broadcast(self._else().eval_device(ctx))
+            else:
+                acc = ctx.broadcast(DevScalar(dtypes.STRING, None,
+                                              valid=False))
+            for p, v in reversed(evaluated):
+                cond = p.data & p.validity
+                vc = ctx.broadcast(v)
+                validity = jnp.where(cond, vc.validity, acc.validity)
+                acc = string_ops.select_strings(ctx, cond, vc, acc, validity)
+            return acc
         dts = [v.dtype for _, v in evaluated]
         ev = self._else().eval_device(ctx) if self.has_else else None
         if ev is not None:
@@ -197,12 +211,19 @@ class Coalesce(Expression):
         return f"coalesce({', '.join(c.sql_name(schema) for c in self.children)})"
 
     def device_supported(self, schema: Schema) -> Optional[str]:
-        if self.dtype(schema).is_string:
-            return "string-typed coalesce is not supported on TPU yet"
         return None
 
     def eval_device(self, ctx: EvalContext) -> DevValue:
         evaluated = [c.eval_device(ctx) for c in self.children]
+        if any(v.dtype.is_string for v in evaluated):
+            from spark_rapids_tpu.ops import strings as string_ops
+            cols = [ctx.broadcast(v) for v in evaluated]
+            out = cols[0]
+            for nxt in cols[1:]:
+                # rows already valid keep their bytes; others take nxt's
+                out = string_ops.select_strings(
+                    ctx, out.validity, out, nxt, out.validity | nxt.validity)
+            return out
         dt = evaluated[0].dtype
         for v in evaluated[1:]:
             if v.dtype != dt:

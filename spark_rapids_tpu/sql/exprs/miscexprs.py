@@ -1,0 +1,102 @@
+"""Misc expressions: hash() and hex() (reference: Spark's Murmur3Hash /
+Hex used by the Mortgage workload's loan anonymization,
+integration_tests/.../mortgage/MortgageSpark.scala:370,394).
+
+hash() here is the framework's own 64->32-bit mixer (splitmix64 over
+fixed-width bits, dual polynomial hashes for strings — ops/hashing.py),
+NOT Spark's murmur3_32: the contract the workloads need is "deterministic,
+well-mixed, identical on the CPU and TPU paths", which the shared-constant
+numpy/jax twin kernels guarantee."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.columnar.dtype import DType
+from spark_rapids_tpu.ops import hashing
+from spark_rapids_tpu.sql.exprs.core import (
+    DevCol, DevValue, EvalContext, Expression,
+)
+from spark_rapids_tpu.sql.exprs.hostutil import host_unary_values, rebuild_series
+
+
+class Hash(Expression):
+    """hash(c1, c2, ...) -> int32; never NULL (NULL inputs feed a fixed
+    null sentinel into the mix, like Spark's seed-based null handling)."""
+
+    def __init__(self, children):
+        super().__init__(list(children))
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT32
+
+    def sql_name(self, schema=None) -> str:
+        args = ", ".join(c.sql_name(schema) for c in self.children)
+        return f"hash({args})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        hs = []
+        for c in self.children:
+            v = ctx.broadcast(c.eval_device(ctx))
+            if v.dtype.is_string:
+                hs.append(hashing.hash_string_col(v.offsets, v.data,
+                                                  v.validity))
+            else:
+                hs.append(hashing.hash_fixed_width(v.data, v.validity))
+        combined = hashing.combine_hashes(hs)
+        data = combined.astype(jnp.uint32).view(jnp.int32).astype(jnp.int32)
+        return DevCol(dtypes.INT32, data,
+                      jnp.ones(data.shape, jnp.bool_))
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        hs = []
+        index = df.index
+        for c in self.children:
+            values, validity, index = host_unary_values(c.eval_host(df))
+            if values.dtype == object or str(values.dtype) in ("str",
+                                                               "string"):
+                hs.append(hashing.np_string_hashes(list(values), validity))
+            else:
+                hs.append(hashing.np_hash_fixed_width(values, validity))
+        combined = hashing.np_combine_hashes(hs)
+        data = combined.astype(np.uint32).view(np.int32)
+        return rebuild_series(data, np.ones(len(data), np.bool_),
+                              dtypes.INT32, index)
+
+
+class Hex(Expression):
+    """hex(n) -> uppercase hex string (negatives as 16-digit two's
+    complement, Spark semantics). String-producing, so it runs on the CPU
+    path and the plan rewriter tags the reason."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return f"hex({self.children[0].sql_name(schema)})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        return "hex produces variable-length strings; runs on CPU"
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(
+            self.children[0].eval_host(df))
+        out = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            if not validity[i]:
+                out[i] = None
+            else:
+                out[i] = format(int(v) & 0xFFFFFFFFFFFFFFFF, "X")
+        return rebuild_series(out, validity, dtypes.STRING, index)

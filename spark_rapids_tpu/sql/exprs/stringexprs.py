@@ -280,12 +280,7 @@ class ConcatStrings(Expression):
         return f"concat({', '.join(c.sql_name(schema) for c in self.children)})"
 
     def eval_device(self, ctx: EvalContext) -> DevValue:
-        cols = []
-        for c in self.children:
-            v = c.eval_device(ctx)
-            if isinstance(v, DevScalar):
-                raise NotImplementedError("concat with scalar operand")
-            cols.append(v)
+        cols = [ctx.broadcast(c.eval_device(ctx)) for c in self.children]
         return string_ops.concat_columns(ctx, cols)
 
     def eval_host(self, df: pd.DataFrame) -> pd.Series:

@@ -228,6 +228,15 @@ def first(c, ignorenulls: bool = False) -> Column:
     return Column(agg.First(_c(c), ignorenulls))
 def last(c, ignorenulls: bool = False) -> Column:
     return Column(agg.Last(_c(c), ignorenulls))
+def count_distinct(c) -> Column: return Column(agg.CountDistinct(_c(c)))
+countDistinct = count_distinct
+def var_samp(c) -> Column: return Column(agg.VarSamp(_c(c)))
+def var_pop(c) -> Column: return Column(agg.VarPop(_c(c)))
+variance = var_samp
+def stddev_samp(c) -> Column: return Column(agg.StddevSamp(_c(c)))
+def stddev_pop(c) -> Column: return Column(agg.StddevPop(_c(c)))
+stddev = stddev_samp
+def corr(a, b) -> Column: return Column(agg.Corr(_c(a), _c(b)))
 
 
 def row_number() -> Column:
@@ -325,6 +334,16 @@ def from_unixtime(c) -> Column: return Column(dt.FromUnixTime(_c(c)))
 
 
 # --- nondeterministic --------------------------------------------------------
+
+def hash(*cs) -> Column:  # noqa: A001
+    from spark_rapids_tpu.sql.exprs.miscexprs import Hash
+    return Column(Hash([_c(c) for c in cs]))
+
+
+def hex(c) -> Column:  # noqa: A001
+    from spark_rapids_tpu.sql.exprs.miscexprs import Hex
+    return Column(Hex(_c(c)))
+
 
 def rand(seed: int = 0) -> Column:
     from spark_rapids_tpu.sql.exprs import nondet

@@ -1,0 +1,4 @@
+from spark_rapids_tpu.testing.datagen import (  # noqa: F401
+    BooleanGen, ByteGen, DateGen, DoubleGen, FloatGen, IntegerGen, LongGen,
+    RepeatSeqGen, ShortGen, StringGen, StructGen, TimestampGen, gen_df,
+)

@@ -62,7 +62,8 @@ def _normalize(df: pd.DataFrame, ignore_order: bool) -> pd.DataFrame:
 
 
 def assert_frames_equal(tpu_df: pd.DataFrame, cpu_df: pd.DataFrame,
-                        ignore_order: bool = False, approx: bool = False):
+                        ignore_order: bool = False, approx: bool = False,
+                        atol: float = 0.0):
     assert list(tpu_df.columns) == list(cpu_df.columns), \
         (list(tpu_df.columns), list(cpu_df.columns))
     assert len(tpu_df) == len(cpu_df), (len(tpu_df), len(cpu_df))
@@ -86,7 +87,7 @@ def assert_frames_equal(tpu_df: pd.DataFrame, cpu_df: pd.DataFrame,
             np.testing.assert_allclose(
                 np.asarray(tv, dtype=np.float64),
                 np.asarray(cv, dtype=np.float64),
-                rtol=rtol, atol=5e-308, equal_nan=True,
+                rtol=rtol, atol=max(atol, 5e-308), equal_nan=True,
                 err_msg=f"column {col!r}")
         else:
             np.testing.assert_array_equal(np.asarray(tv), np.asarray(cv),
@@ -98,10 +99,12 @@ def assert_tpu_and_cpu_equal(
         conf: Optional[dict] = None,
         ignore_order: bool = True,
         approx: bool = False,
+        atol: float = 0.0,
         allow_non_tpu=None) -> pd.DataFrame:
     """The assert_gpu_and_cpu_are_equal_collect equivalent
     (integration_tests asserts.py:148-229)."""
     cpu = with_cpu_session(fn, conf)
     tpu = with_tpu_session(fn, conf, allow_non_tpu)
-    assert_frames_equal(tpu, cpu, ignore_order=ignore_order, approx=approx)
+    assert_frames_equal(tpu, cpu, ignore_order=ignore_order, approx=approx,
+                        atol=atol)
     return tpu

@@ -1,0 +1,56 @@
+"""ColumnarRdd zero-copy export (reference: ColumnarRdd.scala,
+InternalColumnarRddConverter.scala; BASELINE config 5 XGBoost pattern)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.ml import ColumnarRdd
+from spark_rapids_tpu.ml.columnar_rdd import to_feature_matrix
+from spark_rapids_tpu.sql import functions as F
+
+
+def _df(session):
+    pdf = pd.DataFrame({
+        "f1": np.linspace(0, 1, 64),
+        "f2": np.linspace(2, 3, 64),
+        "label": (np.arange(64) % 2).astype(np.float64),
+    })
+    return session.create_dataframe(pdf, 2)
+
+
+def test_export_requires_conf(session):
+    session.set_conf("spark.rapids.sql.enabled", True)
+    session.set_conf("spark.rapids.sql.exportColumnarRdd", False)
+    with pytest.raises(RuntimeError, match="exportColumnarRdd"):
+        ColumnarRdd.convert(_df(session))
+
+
+def test_export_yields_device_batches(session):
+    session.set_conf("spark.rapids.sql.enabled", True)
+    session.set_conf("spark.rapids.sql.exportColumnarRdd", True)
+    df = _df(session).filter(F.col("f1") > 0.25)
+    parts = ColumnarRdd.convert(df)
+    assert len(parts) == 2
+    import jax
+    total = 0
+    for p in parts:
+        for batch in p():
+            # device-resident jax arrays, no pandas anywhere
+            assert isinstance(batch.columns[0].data, jax.Array)
+            x, y, mask = to_feature_matrix(batch, ["f1", "f2"], "label")
+            assert x.shape[1] == 2
+            total += int(mask.sum())
+    expected = int((np.linspace(0, 1, 64) > 0.25).sum())
+    assert total == expected
+
+
+def test_export_rejects_cpu_tail(session):
+    session.set_conf("spark.rapids.sql.enabled", True)
+    session.set_conf("spark.rapids.sql.exportColumnarRdd", True)
+    # general regex forces the projection onto the CPU -> export must refuse
+    pdf = pd.DataFrame({"s": ["ab", "cd"], "v": [1.0, 2.0]})
+    df = session.create_dataframe(pdf, 1).select(
+        F.regexp_replace("s", "[ab]+", "_").alias("r"))
+    with pytest.raises(RuntimeError, match="device->host"):
+        ColumnarRdd.convert(df)

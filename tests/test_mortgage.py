@@ -1,0 +1,85 @@
+"""Mortgage ETL workload differential tests (reference:
+integration_tests/.../mortgage/MortgageSpark.scala + MortgageSparkSuite)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.models import mortgage, mortgage_data
+from tests.querytest import assert_tpu_and_cpu_equal
+
+SF = 0.03
+
+
+@pytest.fixture(scope="module")
+def mortgage_pandas():
+    return (mortgage_data.gen_performance(SF),
+            mortgage_data.gen_acquisition(SF))
+
+
+def _tables(s, mortgage_pandas):
+    perf_pd, acq_pd = mortgage_pandas
+    return (s.create_dataframe(perf_pd, 3), s.create_dataframe(acq_pd, 2))
+
+
+def test_full_etl(session, mortgage_pandas):
+    """Run.parquet equivalent: prepare -> delinquency windows -> name
+    normalization -> final join."""
+    def run(s):
+        perf, acq = _tables(s, mortgage_pandas)
+        return mortgage.run_etl(s, perf, acq)
+    # the month-expansion cross join needs the nested-loop exec, which is
+    # disabled by default like the reference (GpuOverrides.scala:1662-1681)
+    out = assert_tpu_and_cpu_equal(run, approx=True, conf={
+        "spark.rapids.sql.exec.CartesianProductExec": True,
+    })
+    assert len(out) == len(mortgage_pandas[0])  # left joins preserve perf
+    assert "seller_name" in out.columns and "ever_90" in out.columns
+    # name normalization happened: no messy raw spellings survive except
+    # deliberately unmapped ones
+    assert "WELLS FARGO BANK, N.A." not in set(out["seller_name"])
+    assert "Wells Fargo" in set(out["seller_name"])
+
+
+def test_simple_aggregates(session, mortgage_pandas):
+    def run(s):
+        perf, acq = _tables(s, mortgage_pandas)
+        return mortgage.simple_aggregates(s, perf, acq)
+    out = assert_tpu_and_cpu_equal(run, approx=True)
+    assert (out["min_max_monthly_rate"] > 0).all()
+
+
+def test_aggregates_with_join(session, mortgage_pandas):
+    def run(s):
+        perf, acq = _tables(s, mortgage_pandas)
+        return mortgage.aggregates_with_join(s, perf, acq)
+    out = assert_tpu_and_cpu_equal(run, approx=True)
+    assert len(out) == out["loan_id_hash"].nunique()
+
+
+def test_aggregates_with_percentiles(session, mortgage_pandas):
+    """Window-based exact percentiles vs the pandas quantile oracle."""
+    perf_pd, _ = mortgage_pandas
+
+    def run(s):
+        perf, _ = _tables(s, mortgage_pandas)
+        return mortgage.aggregates_with_percentiles(s, perf)
+    # round(x, 4) sits on rounding boundaries when the two paths' sums
+    # differ in the last ulp -> tolerate one rounding quantum
+    out = assert_tpu_and_cpu_equal(run, approx=True, atol=1.1e-4)
+
+    from spark_rapids_tpu.ops import hashing
+    h = hashing.np_combine_hashes([
+        hashing.np_hash_fixed_width(perf_pd["loan_id"].to_numpy(),
+                                    np.ones(len(perf_pd), bool)),
+    ]).astype(np.uint32).view(np.int32)
+    grouped = perf_pd.assign(h=h).groupby("h")["interest_rate"]
+    got = out.set_index("loan_id_hash").sort_index()
+    for col, q in [("interest_rate_50p", 0.5), ("interest_rate_75p", 0.75),
+                   ("interest_rate_90p", 0.9), ("interest_rate_99p", 0.99)]:
+        np.testing.assert_allclose(
+            got[col].to_numpy(dtype=float),
+            grouped.quantile(q).round(4).sort_index().to_numpy(),
+            atol=1e-4, err_msg=col)
+    np.testing.assert_allclose(
+        got["interest_rate_avg"].to_numpy(dtype=float),
+        grouped.mean().round(4).sort_index().to_numpy(), atol=1e-4)

@@ -1,0 +1,177 @@
+"""QA sweep: many small SELECT forms, CPU vs TPU differential
+(reference: integration_tests qa_nightly_sql.py enumerates hundreds of
+SELECT forms over one wide table; same idea over the datagen harness)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.testing import (
+    BooleanGen, DateGen, DoubleGen, FloatGen, IntegerGen, LongGen,
+    RepeatSeqGen, ShortGen, StringGen, gen_df,
+)
+from tests.querytest import assert_tpu_and_cpu_equal
+
+N = 160
+
+
+@pytest.fixture(scope="module")
+def qa_pandas():
+    rng = np.random.default_rng(20260730)
+    return gen_df(rng, [
+        ("i", IntegerGen()),
+        ("j", IntegerGen(special_cases=[0, 1, -1, 100])),
+        ("l", LongGen(special_cases=[0, 1, -1])),
+        ("sh", ShortGen()),
+        ("f", FloatGen(no_nans=True, special_cases=[0.0, -0.0, 1.5])),
+        ("d", DoubleGen(no_nans=True, special_cases=[0.0, -0.0, 2.5])),
+        ("dn", DoubleGen()),          # with NaN/inf specials
+        ("b", BooleanGen()),
+        ("s", StringGen()),
+        ("k", RepeatSeqGen(["a", "b", "c", None, "dd"])),
+        ("g", RepeatSeqGen([1, 2, 3, 4], pandas_dtype="Int32")),
+        ("dt", DateGen()),
+    ], N)
+
+
+def _run(qa_pandas, build, **kw):
+    def fn(s):
+        df = s.create_dataframe(qa_pandas, 3)
+        return build(df)
+    return assert_tpu_and_cpu_equal(fn, approx=True, **kw)
+
+
+# --- projection forms -------------------------------------------------------
+
+PROJECTIONS = {
+    "add": lambda c: c("i") + c("j"),
+    "sub_mul": lambda c: (c("l") - c("i")) * 2,
+    "div": lambda c: c("d") / c("f"),
+    "int_div_null_on_zero": lambda c: c("i") / c("j"),
+    "mod": lambda c: c("i") % c("j"),
+    "pmod": lambda c: F.pmod(c("i"), c("j")),
+    "neg_abs": lambda c: -F.abs(c("i")),
+    "cmp_lt": lambda c: c("i") < c("l"),
+    "cmp_eq": lambda c: c("f") == c("d"),
+    "eq_null_safe": lambda c: c("k").eqNullSafe("a"),
+    "and_or": lambda c: (c("b") & (c("i") > 0)) | (c("j") < 0),
+    "not": lambda c: ~c("b"),
+    "in_set": lambda c: c("g").isin(1, 3),
+    "is_null": lambda c: c("k").isNull(),
+    "is_nan": lambda c: F.isnan(c("dn")),
+    "coalesce": lambda c: F.coalesce(c("k"), c("s")),
+    "coalesce_num": lambda c: F.coalesce(c("i"), c("j"), F.lit(0)),
+    "nanvl": lambda c: F.nanvl(c("dn"), c("d")),
+    "if_else": lambda c: F.when(c("i") > 0, c("d")).otherwise(-c("d")),
+    "case_when_str": lambda c: F.when(c("g") == 1, c("k"))
+        .when(c("g") == 2, F.lit("two")).otherwise(c("s")),
+    "cast_int_double": lambda c: c("i").cast("double"),
+    "cast_double_int": lambda c: c("f").cast("int"),
+    "cast_bool_int": lambda c: c("b").cast("int"),
+    "sqrt_abs": lambda c: F.sqrt(F.abs(c("d"))),
+    "log_exp": lambda c: F.log(F.abs(c("d")) + 1.0),
+    "pow": lambda c: F.pow(F.abs(c("f")) + 1.0, 2.0),
+    "floor_ceil": lambda c: F.floor(c("d") / 1e6) + F.ceil(c("f")),
+    "round": lambda c: F.round(c("d") / 1e9, 2),
+    "greatest": lambda c: F.greatest(c("i"), c("j"), F.lit(5)),
+    "least": lambda c: F.least(c("i"), c("j")),
+    "bitwise": lambda c: c("i").bitwiseAND(c("j")).bitwiseOR(255),
+    "shift": lambda c: F.shiftleft(c("g").cast("int"), 2),
+    "str_len": lambda c: F.length(c("s")),
+    "str_upper_lower": lambda c: F.concat(F.upper(c("s")), F.lower(c("k"))),
+    "str_substr": lambda c: F.substring(c("s"), 2, 3),
+    "str_concat": lambda c: F.concat(c("s"), F.lit("-"), c("k")),
+    "str_trim": lambda c: F.trim(c("s")),
+    "str_contains": lambda c: c("s").contains("a"),
+    "str_starts": lambda c: c("s").startswith("A"),
+    "str_like": lambda c: c("k").like("%d"),
+    "str_replace": lambda c: F.replace(c("s"), "a", "_"),
+    "date_year_month": lambda c: F.year(c("dt")) * 100 + F.month(c("dt")),
+    "date_dom_dow": lambda c: F.dayofmonth(c("dt")) + F.dayofweek(c("dt")),
+    "date_add": lambda c: F.date_add(c("dt").cast("date"), 30),
+    "date_quarter": lambda c: F.quarter(c("dt")),
+    "hash_multi": lambda c: F.hash(c("i"), c("s"), c("d")),
+}
+
+
+@pytest.mark.parametrize("name", sorted(PROJECTIONS))
+def test_select_form(qa_pandas, session, name):
+    build = PROJECTIONS[name]
+    out = _run(qa_pandas,
+               lambda df: df.select(build(df.__getitem__).alias("r"),
+                                    F.col("i")))
+    assert len(out) == N
+
+
+# --- filter + aggregate + sort forms ----------------------------------------
+
+def test_filter_project(qa_pandas, session):
+    _run(qa_pandas, lambda df: df.filter(
+        (F.col("i") > 0) & F.col("k").isNotNull())
+        .select("i", "k", (F.col("d") * 2).alias("dd")))
+
+
+def test_group_agg_basic(qa_pandas, session):
+    _run(qa_pandas, lambda df: df.group_by("g").agg(
+        F.count("*").alias("n"), F.sum("i").alias("si"),
+        F.avg("d").alias("ad"), F.min("f").alias("mf"),
+        F.max("l").alias("ml")))
+
+
+def test_group_agg_string_key(qa_pandas, session):
+    _run(qa_pandas, lambda df: df.group_by("k").agg(
+        F.count("s").alias("n"), F.sum("j").alias("sj")))
+
+
+def test_group_agg_stats(qa_pandas, session):
+    _run(qa_pandas, lambda df: df.group_by("g").agg(
+        F.stddev_samp("d").alias("sd"), F.var_pop("f").alias("vp"),
+        F.corr("i", "d").alias("cc")))
+
+
+def test_group_count_distinct(qa_pandas, session):
+    _run(qa_pandas, lambda df: df.group_by("g").agg(
+        F.count_distinct("k").alias("cd"), F.count("k").alias("c")))
+
+
+def test_global_agg(qa_pandas, session):
+    _run(qa_pandas, lambda df: df.agg(
+        F.sum("i").alias("si"), F.count("*").alias("n"),
+        F.avg("f").alias("af")))
+
+
+def test_sort_limit(qa_pandas, session):
+    _run(qa_pandas,
+         lambda df: df.order_by(F.col("i").desc(), "l").limit(17),
+         ignore_order=False)
+
+
+def test_distinct(qa_pandas, session):
+    _run(qa_pandas, lambda df: df.select("g", "k").distinct())
+
+
+def test_union_filter(qa_pandas, session):
+    def build(df):
+        a = df.filter(F.col("i") > 0).select("i", "g")
+        b = df.filter(F.col("i") <= 0).select("i", "g")
+        return a.union(b)
+    _run(qa_pandas, build)
+
+
+def test_join_self(qa_pandas, session):
+    def build(df):
+        left = df.select("g", "i").group_by("g").agg(F.sum("i").alias("si"))
+        right = df.select(F.col("g").alias("g2"), "l") \
+            .group_by("g2").agg(F.count("*").alias("n"))
+        return left.join(right, left_on=["g"], right_on=["g2"])
+    _run(qa_pandas, build)
+
+
+def test_window_rank_sum(qa_pandas, session):
+    from spark_rapids_tpu.sql.window import Window
+    def build(df):
+        w = Window.partition_by("g").order_by("i", "l")
+        return df.select("g", "i",
+                         F.row_number().over(w).alias("rn"),
+                         F.sum("i").over(w).alias("run"))
+    _run(qa_pandas, build)

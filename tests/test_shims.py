@@ -1,0 +1,91 @@
+"""Version shim layer tests (reference: ShimLoader.scala:26-60 provider
+matching + shims/spark300..310 providers; tpu analogue keyed on the jax
+release train)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.shims.loader import (
+    LegacyJaxProvider, ModernJaxProvider, ShimLoader, ShimServiceProvider,
+    TpuShims,
+)
+
+
+def test_parse_version():
+    assert ShimLoader.parse_version("0.4.26") == (0, 4, 26)
+    assert ShimLoader.parse_version("0.9.0") == (0, 9, 0)
+    assert ShimLoader.parse_version("0.4.26.dev1") == (0, 4, 26)
+    assert ShimLoader.parse_version("1.0") == (1, 0)
+
+
+def test_provider_matching_ranges():
+    modern, legacy = ModernJaxProvider(), LegacyJaxProvider()
+    assert modern.matches((0, 9, 0)) and modern.matches((0, 4, 26))
+    assert not modern.matches((0, 4, 25))
+    assert legacy.matches((0, 4, 25)) and not legacy.matches((0, 4, 26))
+
+
+def test_loader_picks_running_version():
+    import jax
+    shims = ShimLoader.get_shims()
+    v = ShimLoader.parse_version(jax.__version__)
+    expect = "jax-modern" if v >= (0, 4, 26) else "jax-legacy"
+    assert shims.version_name == expect
+    # cached: same instance on second call
+    assert ShimLoader.get_shims() is shims
+
+
+def test_shims_tree_and_mesh():
+    shims = ShimLoader.get_shims()
+    doubled = shims.tree_map(lambda x: x * 2, {"a": 1, "b": (2, 3)})
+    assert doubled == {"a": 2, "b": (4, 6)}
+    assert sorted(shims.tree_leaves(doubled)) == [2, 4, 6]
+
+    mesh = shims.make_mesh([4, 2], ("dp", "tp"))
+    assert mesh.devices.shape == (4, 2)
+    assert mesh.axis_names == ("dp", "tp")
+
+    sh = shims.named_sharding(mesh, "dp", None)
+    import jax.numpy as jnp
+    x = shims.device_put(np.ones((8, 4), np.float32), sh)
+    assert x.sharding.is_equivalent_to(sh, 2)
+    rep = shims.replicated_sharding(mesh)
+    y = shims.device_put(np.ones((3,), np.float32), rep)
+    assert jnp.allclose(y, 1.0)
+
+
+def test_shims_jit_donation():
+    shims = ShimLoader.get_shims()
+    f = shims.jit(lambda a, b: a + b, donate_argnums=(0,))
+    out = f(np.ones((4,), np.float32), np.ones((4,), np.float32))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_custom_provider_registration_and_override(monkeypatch):
+    class FakeShims(TpuShims):
+        version_name = "fake"
+
+    class FakeProvider(ShimServiceProvider):
+        name = "fake"
+
+        def matches(self, version):
+            return False  # never auto-selected
+
+        def build(self):
+            return FakeShims()
+
+    saved = list(ShimLoader._PROVIDERS)
+    try:
+        ShimLoader.register(FakeProvider())
+        monkeypatch.setenv("SPARK_RAPIDS_TPU_SHIM", "fake")
+        ShimLoader._cached = None
+        assert ShimLoader.get_shims().version_name == "fake"
+        monkeypatch.setenv("SPARK_RAPIDS_TPU_SHIM", "nope")
+        ShimLoader._cached = None
+        with pytest.raises(RuntimeError, match="no shim provider named"):
+            ShimLoader.get_shims()
+    finally:
+        ShimLoader._PROVIDERS[:] = saved
+        ShimLoader._cached = None
+        monkeypatch.delenv("SPARK_RAPIDS_TPU_SHIM", raising=False)
+        ShimLoader.get_shims()  # restore the real selection
