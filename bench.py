@@ -61,7 +61,10 @@ def main():
     from spark_rapids_tpu.session import TpuSparkSession
 
     session = TpuSparkSession.builder().config(
-        "spark.rapids.sql.enabled", True).get_or_create()
+        "spark.rapids.sql.enabled", True).config(
+        # symmetric residency: the CPU path holds its pandas tables in
+        # RAM, the TPU path holds uploaded scan batches in HBM
+        "spark.rapids.sql.cacheDeviceScans", True).get_or_create()
 
     names = (list(SUITES) if suite_names == "all"
              else [s.strip() for s in suite_names.split(",")])

@@ -112,6 +112,14 @@ class DeviceBatch:
             self._host_rows = int(self.num_rows)
         return self._host_rows
 
+    def num_rows_hint(self) -> int:
+        """Row-count upper bound WITHOUT a device sync: the exact count if
+        already fetched, else the capacity. Scalar device->host fetches
+        cost a full round trip (~hundreds of ms on tunneled attachments),
+        so control-flow that only needs an estimate must use this."""
+        return self._host_rows if self._host_rows is not None \
+            else self.capacity
+
     def row_mask(self) -> jnp.ndarray:
         """bool (capacity,): True for live rows (the leading num_rows)."""
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
@@ -137,19 +145,37 @@ class DeviceBatch:
             schema = Schema.from_pandas(df)
         n = len(df)
         cap = capacity if capacity is not None else bucket_capacity(n)
-        cols: List[DeviceColumn] = []
+        # build every column's device-layout buffers host-side, then ship
+        # the whole batch in ONE device_put (per-buffer uploads each pay a
+        # round trip on remote attachments)
+        host_bufs = []
         # positional iteration: join outputs may carry duplicate column names
         for i, dt in enumerate(schema.dtypes):
             values, validity = _pandas_to_numpy(df.iloc[:, i], dt)
-            cols.append(DeviceColumn.from_numpy(values, validity, dt, cap))
-        return DeviceBatch(schema, cols, jnp.asarray(n, dtype=jnp.int32))
+            host_bufs.append(DeviceColumn.build_host_buffers(
+                values, validity, dt, cap))
+        dev = jax.device_put((host_bufs, np.asarray(n, np.int32)))
+        dev_bufs, num_rows = dev
+        cols = [DeviceColumn(dt, *bufs)
+                for dt, bufs in zip(schema.dtypes, dev_bufs)]
+        batch = DeviceBatch(schema, cols, num_rows)
+        batch._host_rows = n
+        return batch
 
     def to_pandas(self) -> pd.DataFrame:
-        """Device -> host transition (reference: GpuColumnarToRowExec)."""
-        n = self.num_rows_host()
+        """Device -> host transition (reference: GpuColumnarToRowExec).
+        All column buffers (and the row count) ride one batched
+        ``jax.device_get`` — per-buffer fetches pay a full round trip each
+        on remote attachments (~hundreds of ms)."""
+        import jax
+        if self._host_rows is None:
+            self._host_rows = int(jax.device_get(self.num_rows))
+        n = self._host_rows
+        views = [col.device_views(n) for col in self.columns]
+        host = jax.device_get(views)
         series: List[pd.Series] = []
-        for dt, col in zip(self.schema.dtypes, self.columns):
-            values, validity = col.to_numpy(n)
+        for dt, col, parts in zip(self.schema.dtypes, self.columns, host):
+            values, validity = col.numpy_from_host(parts, n)
             series.append(_numpy_to_pandas(values, validity, dt)
                           .reset_index(drop=True))
         if not series:

@@ -83,6 +83,19 @@ class DeviceColumn:
         The host-side build-then-upload mirrors the reference's
         ``GpuColumnarBatchBuilder`` (GpuColumnVector.java:43-132).
         """
+        bufs = DeviceColumn.build_host_buffers(values, validity, dtype,
+                                               capacity, char_capacity)
+        return DeviceColumn(dtype, *[jnp.asarray(b) for b in bufs])
+
+    @staticmethod
+    def build_host_buffers(values: np.ndarray,
+                           validity: Optional[np.ndarray],
+                           dtype: DType, capacity: int,
+                           char_capacity: Optional[int] = None):
+        """Device-layout numpy buffers (constructor order), upload-ready —
+        kept separate from the upload so a whole batch's buffers can ride
+        ONE jax.device_put (per-buffer uploads each pay a round trip on
+        remote attachments)."""
         n = len(values)
         assert n <= capacity, (n, capacity)
         if validity is None:
@@ -112,8 +125,7 @@ class DeviceColumn:
                 chars[:total] = np.frombuffer(
                     data_buf, dtype=np.uint8,
                     count=total, offset=src_off[0])
-            return DeviceColumn(dtype, jnp.asarray(chars), jnp.asarray(vpad),
-                                jnp.asarray(offsets))
+            return (chars, vpad, offsets)
 
         fill = dtypes.null_fill_value(dtype)
         dpad = np.full(capacity, fill, dtype=dtype.np_dtype)
@@ -121,18 +133,34 @@ class DeviceColumn:
         # canonicalize nulls to the fill value so device math is deterministic
         vals = np.where(validity[:n], vals, np.asarray(fill, dtype=dtype.np_dtype))
         dpad[:n] = vals
-        return DeviceColumn(dtype, jnp.asarray(dpad), jnp.asarray(vpad))
+        return (dpad, vpad)
 
     # --- host access -------------------------------------------------------
+    def device_views(self, num_rows: int):
+        """The device arrays a host copy needs (leading-rows slices).
+        Kept lazy so a whole batch's views can ride ONE jax.device_get —
+        per-buffer fetches each pay a full round trip on remote
+        attachments."""
+        if self.dtype.is_string:
+            return (self.validity[:num_rows], self.offsets[:num_rows + 1],
+                    self.data)
+        return (self.data[:num_rows], self.validity[:num_rows])
+
     def to_numpy(self, num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
         """Copy the leading ``num_rows`` to host. Returns (values, validity).
         String columns return an object array of python str (None if null)."""
-        validity = np.asarray(self.validity[:num_rows])
+        import jax
+        return self.numpy_from_host(
+            jax.device_get(self.device_views(num_rows)), num_rows)
+
+    def numpy_from_host(self, host_parts,
+                        num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Finish a host copy from already-fetched device_views buffers."""
         if self.dtype.is_string:
             import pyarrow as pa
-            offsets = np.ascontiguousarray(
-                np.asarray(self.offsets[:num_rows + 1]))
-            chars = np.ascontiguousarray(np.asarray(self.data))
+            validity, offsets, chars = (np.asarray(p) for p in host_parts)
+            offsets = np.ascontiguousarray(offsets)
+            chars = np.ascontiguousarray(chars)
             null_count = int(num_rows - validity.sum())
             vbuf = (pa.py_buffer(np.packbits(validity, bitorder="little"))
                     if null_count else None)
@@ -153,7 +181,8 @@ class DeviceColumn:
                     else:
                         out[i] = None
             return out, validity
-        return np.asarray(self.data[:num_rows]), validity
+        data, validity = (np.asarray(p) for p in host_parts)
+        return data, validity
 
 
 def _char_bucket(n: int, minimum: int = 16) -> int:

@@ -98,6 +98,13 @@ SQL_ENABLED = register(
     "Enable (true) or disable (false) TPU acceleration of SQL plans. When "
     "disabled every operator executes on the CPU path.")
 
+CACHE_DEVICE_SCANS = register(
+    "spark.rapids.sql.cacheDeviceScans", _to_bool, False,
+    "Keep uploaded scan batches resident in device memory across query "
+    "executions of the same source (the device-side analogue of a cached "
+    "DataFrame). Trades HBM for re-upload cost; essential when the "
+    "host-device link is high-latency.")
+
 EXPLAIN = register(
     "spark.rapids.sql.explain", str, "NONE",
     "Explain why some parts of a query were or were not placed on the TPU. "

@@ -62,9 +62,14 @@ class TpuCoalesceBatchesExec(PhysicalPlan):
                 pending: List[DeviceBatch] = []
                 pending_rows = 0
                 for batch in part():
-                    rows = batch.num_rows_host()
+                    # capacity-based accounting: an exact count would cost
+                    # a device->host scalar sync per batch (~hundreds of ms
+                    # through remote attachments); the bucketed capacity
+                    # over-estimates by at most 2x, which only makes
+                    # coalesced outputs slightly smaller than the goal
+                    rows = batch.num_rows_hint()
                     if rows == 0 and pending:
-                        continue  # drop empty fragments
+                        continue  # drop known-empty fragments
                     pending.append(batch)
                     pending_rows += rows
                     if not single and pending_rows >= target:

@@ -446,18 +446,39 @@ class TpuScanExec(TpuExec):
         max_rows = ctx.conf.batch_size_rows
         schema = self._schema
 
-        def make(part: Partition) -> Partition:
+        # device-resident scan cache (spark.rapids.sql.cacheDeviceScans):
+        # skip the re-upload when the same source is scanned again — the
+        # HBM analogue of a cached DataFrame
+        from spark_rapids_tpu.exec.transitions import scan_cache_for
+        cache = scan_cache_for(ctx, self.source, schema, max_rows)
+
+        def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
+                from spark_rapids_tpu.exec import taskctx
                 sem = ctx.session.semaphore if ctx.session else None
+                if sem is not None:
+                    sem.acquire_if_necessary()
+                if cache is not None and i in cache:
+                    # replay with each batch's origin file restored so
+                    # input_file_name() stays correct on cache hits
+                    for fname, batch in cache[i]:
+                        taskctx.set_input_file(fname)
+                        yield batch
+                    taskctx.clear_input_file()
+                    return
+                out = [] if cache is not None else None
                 for df in part():
-                    if sem is not None:
-                        sem.acquire_if_necessary()
                     for lo in range(0, max(len(df), 1), max_rows):
                         chunk = df.iloc[lo:lo + max_rows]
-                        yield DeviceBatch.from_pandas(
+                        batch = DeviceBatch.from_pandas(
                             chunk.reset_index(drop=True), schema=schema)
+                        if out is not None:
+                            out.append((taskctx.input_file(), batch))
+                        yield batch
+                if out is not None:
+                    cache[i] = out
             return run
-        return [make(p) for p in cpu_parts]
+        return [make(i, p) for i, p in enumerate(cpu_parts)]
 
 
 class TpuShuffleExchangeExec(TpuExec):
@@ -590,22 +611,34 @@ class TpuShuffleExchangeExec(TpuExec):
             if kind == "range":
                 all_batches = [b for p in child_parts for b in p()]
                 bounds = compute_range_bounds(all_batches)
-                splits = (self._pkernel(b, bounds) for b in all_batches)
+                split_iter = (self._pkernel(b, bounds) for b in all_batches)
             else:
-                splits = (self._pkernel(b) for p in child_parts
-                          for b in p())
-            for sorted_batch, counts in splits:
-                import numpy as np
-                host_counts = np.asarray(counts)
-                offsets = np.concatenate([[0], np.cumsum(host_counts)])
-                for pid in range(n):
-                    if host_counts[pid] == 0:
-                        continue
-                    piece = slice_kernel(
-                        sorted_batch,
-                        jnp.asarray(offsets[pid], jnp.int32),
-                        jnp.asarray(host_counts[pid], jnp.int32))
-                    buckets[pid].append(piece)
+                split_iter = (self._pkernel(b) for p in child_parts
+                              for b in p())
+            # fetch bucket counts in windows: one device->host round trip
+            # per WINDOW batches (per-batch scalar syncs each pay a full
+            # round trip; one giant window would pin every split output in
+            # device memory at once)
+            import itertools
+            import jax
+            import numpy as np
+            WINDOW = 16
+            windowed = iter(lambda: list(itertools.islice(split_iter,
+                                                          WINDOW)), [])
+            for window in windowed:
+                window_counts = jax.device_get([c for _, c in window])
+                for (sorted_batch, counts), host_counts in zip(
+                        window, window_counts):
+                    host_counts = np.asarray(host_counts)
+                    offsets = np.concatenate([[0], np.cumsum(host_counts)])
+                    for pid in range(n):
+                        if host_counts[pid] == 0:
+                            continue
+                        piece = slice_kernel(
+                            sorted_batch,
+                            jnp.asarray(offsets[pid], jnp.int32),
+                            jnp.asarray(host_counts[pid], jnp.int32))
+                        buckets[pid].append(piece)
             state["buckets"] = buckets
             return buckets
 

@@ -17,6 +17,23 @@ from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema, bucket_capacity
 from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
 
 
+def scan_cache_for(ctx: ExecContext, source, schema: Schema,
+                   max_rows: int):
+    """Per-source device-batch cache (spark.rapids.sql.cacheDeviceScans),
+    or None when disabled. The entry holds a strong reference to the
+    source object: keys include id(source), and without the reference a
+    GC'd source's id could be reused by a different dataset and serve its
+    cached batches. Entries live until session.clear_device_cache()."""
+    if ctx.session is None or not ctx.conf.get_bool(
+            "spark.rapids.sql.cacheDeviceScans", False):
+        return None
+    store = ctx.session.device_scan_cache
+    key = (id(source), tuple(schema.names), max_rows)
+    if key not in store:
+        store[key] = (source, {})
+    return store[key][1]
+
+
 class HostToDeviceExec(PhysicalPlan):
     """pandas partition chunks -> DeviceBatch, chunked to the conf'd batch
     size and padded to capacity buckets."""
@@ -30,22 +47,44 @@ class HostToDeviceExec(PhysicalPlan):
         return self.children[0].output_schema()
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        child_parts = self.children[0].executed_partitions(ctx)
-        schema = self.children[0].output_schema()
+        child = self.children[0]
+        child_parts = child.executed_partitions(ctx)
+        schema = child.output_schema()
         max_rows = ctx.conf.batch_size_rows
 
-        def make(part: Partition) -> Partition:
+        # device-resident scan cache: re-executing a query over the same
+        # source skips the re-upload — the HBM analogue of a cached
+        # DataFrame, symmetric with the CPU path holding pandas in RAM
+        cache = None
+        from spark_rapids_tpu.exec.cpu import CpuScanExec
+        if isinstance(child, CpuScanExec):
+            cache = scan_cache_for(ctx, child.source, schema, max_rows)
+
+        def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
+                from spark_rapids_tpu.exec import taskctx
                 sem = ctx.session.semaphore if ctx.session else None
+                if sem is not None:
+                    sem.acquire_if_necessary()
+                if cache is not None and i in cache:
+                    for fname, batch in cache[i]:
+                        taskctx.set_input_file(fname)
+                        yield batch
+                    taskctx.clear_input_file()
+                    return
+                out = [] if cache is not None else None
                 for df in part():
-                    if sem is not None:
-                        sem.acquire_if_necessary()
                     for lo in range(0, max(len(df), 1), max_rows):
                         chunk = df.iloc[lo:lo + max_rows]
-                        yield DeviceBatch.from_pandas(
+                        batch = DeviceBatch.from_pandas(
                             chunk.reset_index(drop=True), schema=schema)
+                        if out is not None:
+                            out.append((taskctx.input_file(), batch))
+                        yield batch
+                if out is not None:
+                    cache[i] = out
             return run
-        return [make(p) for p in child_parts]
+        return [make(i, p) for i, p in enumerate(child_parts)]
 
 
 class DeviceToHostExec(PhysicalPlan):

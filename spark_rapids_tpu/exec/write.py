@@ -71,14 +71,21 @@ class WriteCommitProtocol:
                     f"path {self.path} already exists (mode=error)")
         os.makedirs(self.staging, exist_ok=True)
 
-    def task_file(self, partition_id: int, ext: str) -> str:
-        return os.path.join(self.staging,
-                            f"part-{partition_id:05d}{ext}")
+    def task_file(self, partition_id: int, ext: str,
+                  subdir: str = "") -> str:
+        d = os.path.join(self.staging, subdir) if subdir else self.staging
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"part-{partition_id:05d}{ext}")
 
     def commit(self) -> None:
-        for f in sorted(os.listdir(self.staging)):
-            os.replace(os.path.join(self.staging, f),
-                       os.path.join(self.path, f))
+        # move staged files preserving key=value subdirectories
+        for root, _dirs, files in os.walk(self.staging):
+            rel = os.path.relpath(root, self.staging)
+            target = (self.path if rel == "."
+                      else os.path.join(self.path, rel))
+            os.makedirs(target, exist_ok=True)
+            for f in sorted(files):
+                os.replace(os.path.join(root, f), os.path.join(target, f))
         shutil.rmtree(os.path.join(self.path, "_temporary"),
                       ignore_errors=True)
         open(os.path.join(self.path, "_SUCCESS"), "w").close()
@@ -88,24 +95,54 @@ class WriteCommitProtocol:
                       ignore_errors=True)
 
 
+def _partition_subdirs(df: pd.DataFrame, pcols: List[str]):
+    """Split a frame by its partition-column tuples into
+    (key=value/... subdir, frame-without-partition-cols) pairs (Spark's
+    dynamic-partition layout; NULL renders as __HIVE_DEFAULT_PARTITION__)."""
+    if not pcols:
+        yield "", df
+        return
+    def render(v):
+        return "__HIVE_DEFAULT_PARTITION__" if pd.isna(v) else str(v)
+    for key, group in df.groupby(pcols, dropna=False, sort=True):
+        key = key if isinstance(key, tuple) else (key,)
+        subdir = os.path.join(*[f"{c}={render(v)}"
+                                for c, v in zip(pcols, key)])
+        yield subdir, group.drop(columns=pcols)
+
+
+def _write_partitioned(tables, schema: Schema, protocol: WriteCommitProtocol,
+                       task_id: int, ext: str, fmt: str,
+                       pcols: List[str]) -> None:
+    import pyarrow as pa
+    table = pa.concat_tables(tables)
+    if not pcols:
+        _encode_table(table, protocol.task_file(task_id, ext), fmt)
+        return
+    keep = Schema([n for n in schema.names if n not in pcols],
+                  [d for n, d in zip(schema.names, schema.dtypes)
+                   if n not in pcols])
+    for subdir, group in _partition_subdirs(table.to_pandas(), pcols):
+        _encode_table(_arrow_table_from_pandas(group, keep),
+                      protocol.task_file(task_id, ext, subdir), fmt)
+
+
 class CpuWriteExec(PhysicalPlan):
     """Host path: pandas partition -> arrow -> file."""
 
     def __init__(self, child: PhysicalPlan, path: str, fmt: str,
-                 mode: str = "error"):
+                 mode: str = "error", partition_cols: List[str] = ()):
         super().__init__([child])
         self.path = path
         self.fmt = fmt
         self.mode = mode
+        self.partition_cols = list(partition_cols)
 
     def output_schema(self) -> Schema:
         return Schema([], [])
 
     def describe(self) -> str:
         return f"CpuWriteExec({self.fmt}, {self.path})"
-
-    def _write_table(self, table, f: str) -> None:
-        _encode_table(table, f, self.fmt)
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child_parts = self.children[0].executed_partitions(ctx)
@@ -117,13 +154,12 @@ class CpuWriteExec(PhysicalPlan):
 
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[pd.DataFrame]:
-                import pyarrow as pa
                 try:
                     tables = [_arrow_table_from_pandas(df, schema)
                               for df in part() if len(df)]
                     if tables:
-                        self._write_table(pa.concat_tables(tables),
-                                          protocol.task_file(i, ext))
+                        _write_partitioned(tables, schema, protocol, i, ext,
+                                           self.fmt, self.partition_cols)
                 except Exception:
                     state["failed"] = True
                     protocol.abort()
@@ -144,11 +180,12 @@ class TpuWriteExec(PhysicalPlan):
     columnar_input = True    # ...but consumes device batches
 
     def __init__(self, child: PhysicalPlan, path: str, fmt: str,
-                 mode: str = "error"):
+                 mode: str = "error", partition_cols: List[str] = ()):
         super().__init__([child])
         self.path = path
         self.fmt = fmt
         self.mode = mode
+        self.partition_cols = list(partition_cols)
 
     def output_schema(self) -> Schema:
         return Schema([], [])
@@ -158,6 +195,7 @@ class TpuWriteExec(PhysicalPlan):
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child_parts = self.children[0].executed_partitions(ctx)
+        schema = self.children[0].output_schema()
         protocol = WriteCommitProtocol(self.path)
         protocol.setup(self.mode)
         ext = _EXTENSIONS[self.fmt]
@@ -165,13 +203,12 @@ class TpuWriteExec(PhysicalPlan):
 
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[pd.DataFrame]:
-                import pyarrow as pa
                 try:
                     tables = [_arrow_table_from_batch(b)
                               for b in part() if b.num_rows_host()]
                     if tables:
-                        _encode_table(pa.concat_tables(tables),
-                                      protocol.task_file(i, ext), self.fmt)
+                        _write_partitioned(tables, schema, protocol, i, ext,
+                                           self.fmt, self.partition_cols)
                 except Exception:
                     state["failed"] = True
                     protocol.abort()

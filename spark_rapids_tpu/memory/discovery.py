@@ -1,0 +1,83 @@
+"""Exclusive-mode device discovery.
+
+The reference ships a Spark ``ResourceDiscoveryPlugin`` that probes GPUs
+and claims one per executor in PROCESS_EXCLUSIVE mode so co-located
+executors never share a device
+(sql-plugin/.../ExclusiveModeGpuDiscoveryPlugin.scala:42+ probing via
+setGpuDeviceAndAcquire, GpuDeviceManager.scala:72-96). The TPU analogue:
+enumerate the PJRT devices of this host and claim one with an exclusive
+OS file lock — two executor processes racing for the same chip resolve
+through ``flock``, exactly the role CUDA's exclusive-process compute mode
+plays in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List, Optional
+
+
+class DeviceClaim:
+    """A held exclusive claim on one local device ordinal."""
+
+    def __init__(self, ordinal: int, lock_path: str, lock_fd: int):
+        self.ordinal = ordinal
+        self._lock_path = lock_path
+        self._lock_fd = lock_fd
+
+    def release(self) -> None:
+        if self._lock_fd is not None:
+            try:
+                os.close(self._lock_fd)
+            except OSError:
+                pass
+            self._lock_fd = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def _lock_dir() -> str:
+    d = os.environ.get("SPARK_RAPIDS_TPU_LOCK_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "spark-rapids-tpu-locks"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _try_claim(ordinal: int) -> Optional[DeviceClaim]:
+    import fcntl
+    path = os.path.join(_lock_dir(), f"device-{ordinal}.lock")
+    fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        os.close(fd)
+        return None
+    os.ftruncate(fd, 0)
+    os.write(fd, str(os.getpid()).encode())
+    return DeviceClaim(ordinal, path, fd)
+
+
+def visible_device_ordinals() -> List[int]:
+    import jax
+    return [d.id for d in jax.local_devices()]
+
+
+def discover_and_claim(ordinals: Optional[List[int]] = None) -> DeviceClaim:
+    """Claim the first unclaimed local device; raises if every device is
+    held by another process (the reference's executor init likewise fails
+    fast rather than oversubscribing, Plugin.scala:129-136)."""
+    if ordinals is None:
+        ordinals = visible_device_ordinals()
+    for o in ordinals:
+        claim = _try_claim(o)
+        if claim is not None:
+            return claim
+    raise RuntimeError(
+        f"no unclaimed TPU device among ordinals {ordinals}; every device "
+        "is exclusively held by another executor process")

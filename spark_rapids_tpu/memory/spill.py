@@ -71,6 +71,8 @@ class SpillableBuffer:
         self._schema: Schema = batch.schema
         self._lock = threading.RLock()
         self.closed = False
+        from spark_rapids_tpu.memory.leak import TRACKER
+        self._leak_token = TRACKER.register(self.size, "SpillableBuffer")
 
     # --- tier movement -----------------------------------------------------
     def spill_to_host(self, arena=None) -> int:
@@ -191,6 +193,8 @@ class SpillableBuffer:
             self._release_host()
             if self._disk_path and os.path.exists(self._disk_path):
                 os.unlink(self._disk_path)
+            from spark_rapids_tpu.memory.leak import TRACKER
+            TRACKER.unregister(self._leak_token)
 
 
 class BufferStore:

@@ -16,6 +16,7 @@ spans 2000-01-01..2004-12-31, covering every date literal in the suite.
 from __future__ import annotations
 
 import datetime
+import functools
 
 import numpy as np
 import pandas as pd
@@ -254,6 +255,7 @@ def gen_promotion(seed: int = 67) -> pd.DataFrame:
     })
 
 
+@functools.lru_cache(maxsize=4)
 def gen_store_sales(sf: float, seed: int = 71) -> pd.DataFrame:
     n = max(200, int(STORE_SALES_PER_SF * sf))
     rng = np.random.default_rng(seed)
@@ -292,21 +294,29 @@ def gen_store_sales(sf: float, seed: int = 71) -> pd.DataFrame:
 
 
 def gen_store_returns(sf: float, seed: int = 73) -> pd.DataFrame:
+    """Returns reference actual store_sales rows (ticket/item/customer
+    triples), as in the real dataset — Q21's sale->return->web-repurchase
+    chain depends on it."""
     n = max(50, int(STORE_RETURNS_PER_SF * sf))
     rng = np.random.default_rng(seed)
-    n_cust = max(50, int(CUSTOMERS_PER_SF * sf))
-    n_item = max(20, int(ITEMS_PER_SF * sf))
+    sales = gen_store_sales(sf)
+    pick = rng.integers(0, len(sales), n)
+    cust = sales["ss_customer_sk"].to_numpy()[pick]
+    cust = pd.array(cust).astype("Int64")
+    cust = np.where(pd.isna(cust), 1, cust).astype(np.int64)
     return pd.DataFrame({
-        "sr_returned_date_sk": _days(rng, n),
-        "sr_item_sk": rng.integers(1, n_item + 1, n).astype(np.int64),
-        "sr_customer_sk": rng.integers(1, n_cust + 1, n).astype(np.int64),
-        "sr_ticket_number": rng.integers(
-            1, max(2, int(STORE_SALES_PER_SF * sf) // 3), n).astype(np.int64),
+        "sr_returned_date_sk": np.minimum(
+            sales["ss_sold_date_sk"].to_numpy()[pick]
+            + rng.integers(1, 180, n), _SK_HI).astype(np.int64),
+        "sr_item_sk": sales["ss_item_sk"].to_numpy()[pick],
+        "sr_customer_sk": cust,
+        "sr_ticket_number": sales["ss_ticket_number"].to_numpy()[pick],
         "sr_return_quantity": rng.integers(1, 40, n).astype(np.int32),
         "sr_return_amt": np.round(rng.uniform(1.0, 4000.0, n), 2),
     })
 
 
+@functools.lru_cache(maxsize=4)
 def gen_web_sales(sf: float, seed: int = 79) -> pd.DataFrame:
     n = max(100, int(WEB_SALES_PER_SF * sf))
     rng = np.random.default_rng(seed)
@@ -315,12 +325,28 @@ def gen_web_sales(sf: float, seed: int = 79) -> pd.DataFrame:
     qty = rng.integers(1, 100, n).astype(np.int32)
     wholesale = np.round(rng.uniform(1.0, 100.0, n), 2)
     sales_price = np.round(rng.uniform(0.0, 300.0, n), 2)
+    # a third of web orders are repurchases by store customers of the
+    # same item, later in time — the behaviour Q21's store-sale ->
+    # return -> web-repurchase chain measures
+    ss = gen_store_sales(sf)
+    pick = rng.integers(0, len(ss), n)
+    rep = rng.random(n) < 0.33
+    ss_cust = pd.array(ss["ss_customer_sk"].to_numpy()[pick]).astype("Int64")
+    ss_cust = np.where(pd.isna(ss_cust), 1, ss_cust).astype(np.int64)
+    item = np.where(rep, ss["ss_item_sk"].to_numpy()[pick],
+                    rng.integers(1, n_item + 1, n)).astype(np.int64)
+    cust = np.where(rep, ss_cust,
+                    rng.integers(1, n_cust + 1, n)).astype(np.int64)
+    sold = np.where(
+        rep,
+        np.minimum(ss["ss_sold_date_sk"].to_numpy()[pick]
+                   + rng.integers(30, 700, n), _SK_HI),
+        _days(rng, n)).astype(np.int64)
     return pd.DataFrame({
-        "ws_sold_date_sk": _days(rng, n),
+        "ws_sold_date_sk": sold,
         "ws_sold_time_sk": (rng.integers(0, 1440, n) * 60).astype(np.int64),
-        "ws_item_sk": rng.integers(1, n_item + 1, n).astype(np.int64),
-        "ws_bill_customer_sk": rng.integers(1, n_cust + 1,
-                                            n).astype(np.int64),
+        "ws_item_sk": item,
+        "ws_bill_customer_sk": cust,
         "ws_ship_hdemo_sk": rng.integers(1, 101, n).astype(np.int64),
         "ws_web_page_sk": rng.integers(1, 61, n).astype(np.int64),
         "ws_warehouse_sk": rng.integers(1, 7, n).astype(np.int64),
@@ -340,14 +366,18 @@ def gen_web_sales(sf: float, seed: int = 79) -> pd.DataFrame:
 
 
 def gen_web_returns(sf: float, seed: int = 83) -> pd.DataFrame:
+    """Returns reference actual web_sales (order, item) pairs so Q16's
+    left join finds refunds."""
     n = max(30, int(WEB_RETURNS_PER_SF * sf))
     rng = np.random.default_rng(seed)
-    n_item = max(20, int(ITEMS_PER_SF * sf))
+    sales = gen_web_sales(sf)
+    pick = rng.integers(0, len(sales), n)
     return pd.DataFrame({
-        "wr_returned_date_sk": _days(rng, n),
-        "wr_item_sk": rng.integers(1, n_item + 1, n).astype(np.int64),
-        "wr_order_number": rng.integers(
-            1, max(2, int(WEB_SALES_PER_SF * sf) // 2), n).astype(np.int64),
+        "wr_returned_date_sk": np.minimum(
+            sales["ws_sold_date_sk"].to_numpy()[pick]
+            + rng.integers(1, 90, n), _SK_HI).astype(np.int64),
+        "wr_item_sk": sales["ws_item_sk"].to_numpy()[pick],
+        "wr_order_number": sales["ws_order_number"].to_numpy()[pick],
         "wr_return_quantity": rng.integers(1, 40, n).astype(np.int32),
         "wr_refunded_cash": np.round(rng.uniform(0.0, 2000.0, n), 2),
     })
@@ -373,14 +403,28 @@ def gen_web_clickstreams(sf: float, seed: int = 89) -> pd.DataFrame:
 
 
 def gen_inventory(sf: float, seed: int = 97) -> pd.DataFrame:
-    n = max(200, int(INVENTORY_PER_SF * sf))
+    """Weekly snapshots per (warehouse, item) across 2001 — the TPC shape:
+    Q22's +-30-day window around 2001-05-08 and Q23's per-month
+    coefficient of variation both need several observations per group."""
     rng = np.random.default_rng(seed)
     n_item = max(20, int(ITEMS_PER_SF * sf))
+    weeks = np.arange(date_sk(datetime.date(2001, 1, 1)),
+                      date_sk(datetime.date(2001, 12, 31)), 7,
+                      dtype=np.int64)
+    wh = np.arange(1, 7, dtype=np.int64)
+    items = np.arange(1, n_item + 1, dtype=np.int64)
+    grid = np.array(np.meshgrid(weeks, wh, items,
+                                indexing="ij")).reshape(3, -1)
+    n = grid.shape[1]
+    # zero-inflated quantities: stock-outs push the coefficient of
+    # variation past Q23's >= 1.3 threshold for a realistic slice of items
+    qty = rng.integers(0, 1000, n).astype(np.int32)
+    qty[rng.random(n) < 0.55] = 0
     return pd.DataFrame({
-        "inv_date_sk": _days(rng, n),
-        "inv_item_sk": rng.integers(1, n_item + 1, n).astype(np.int64),
-        "inv_warehouse_sk": rng.integers(1, 7, n).astype(np.int64),
-        "inv_quantity_on_hand": rng.integers(0, 1000, n).astype(np.int32),
+        "inv_date_sk": grid[0],
+        "inv_item_sk": grid[2],
+        "inv_warehouse_sk": grid[1],
+        "inv_quantity_on_hand": qty,
     })
 
 

@@ -1,0 +1,183 @@
+"""Pallas TPU kernels for the engine's hot data-movement ops.
+
+First kernel: dual exclusive prefix-count for stream compaction. Every
+filter/join output pays a stable partition ("kept rows first, in order" —
+the cuDF filter/apply_boolean_mask equivalent the reference leans on,
+GpuFilterExec in basicPhysicalOperators.scala). The XLA spelling used to
+be a full O(n log n) argsort; the compaction permutation only actually
+needs the two exclusive running counts
+
+    kept_ex[i] = #kept in rows [0, i)      dead_ex[i] = #dead in rows [0, i)
+
+and those are one sequential O(n) sweep. The Pallas kernel runs the sweep
+block-by-block over the TPU's sequential grid with the carry pair living
+in SMEM — one HBM read producing both prefix streams in a single pass.
+Mosaic has no cumsum primitive, so the in-block scan is the classic
+scan-by-matmul: a (16,128) tile times a 128x128 upper-triangular ones
+matrix gives per-row inclusive prefixes on the MXU, and a 16x16 strict
+lower-triangular matmul accumulates across rows. Counts <= 2048 are exact
+in float32. Off-TPU the jnp twin (two fused cumsums) provides identical
+results.
+
+Toggle: SPARK_RAPIDS_TPU_PALLAS=0 forces the jnp path; =interpret runs
+the kernel in interpreter mode (CPU CI of the kernel itself).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_ROWS = 16
+_LANES = 128
+_BLK = _ROWS * _LANES  # 2048 elements per grid step
+
+
+def _mode() -> str:
+    """auto = the XLA cumsum path: on tunneled/remote-compile TPU
+    attachments Mosaic compiles are unreliable (a standalone probe can
+    pass while the same kernel embedded in a larger program fails to
+    legalize), so the pallas path is explicit opt-in for directly
+    attached chips."""
+    env = os.environ.get("SPARK_RAPIDS_TPU_PALLAS", "auto")
+    if env in ("0", "off", "jnp", "auto"):
+        return "jnp"
+    if env == "interpret":
+        return "interpret"
+    if env in ("1", "on"):
+        return "pallas" if jax.default_backend() == "tpu" else "jnp"
+    return "jnp"
+
+
+def _dual_prefix_jnp(keep_i32: jnp.ndarray):
+    incl = jnp.cumsum(keep_i32)
+    kept_ex = incl - keep_i32
+    dead = 1 - keep_i32
+    dead_ex = jnp.cumsum(dead) - dead
+    return kept_ex, dead_ex, incl[-1]
+
+
+def _dual_prefix_kernel(keep_ref, kex_ref, dex_ref, tot_ref, carry):
+    import jax.experimental.pallas as pl
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        carry[0] = 0
+        carry[1] = 0
+
+    k = keep_ref[:].astype(jnp.float32)           # (16, 128) of 0/1
+    d = 1.0 - k
+    # inclusive prefix along lanes: x @ upper-triangular ones (MXU)
+    r = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 1)
+    tri_incl = (r <= c).astype(jnp.float32)       # (128, 128)
+    # strict prefix across sublane rows: lower-triangular row-sum matmul
+    r2 = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, _ROWS), 0)
+    c2 = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, _ROWS), 1)
+    tri_rows = (r2 > c2).astype(jnp.float32)      # (16, 16)
+
+    def dual_scan(x):
+        within = jnp.dot(x, tri_incl, preferred_element_type=jnp.float32)
+        rowsum = within[:, _LANES - 1:_LANES]     # (16, 1) per-row totals
+        off = jnp.dot(tri_rows, rowsum,
+                      preferred_element_type=jnp.float32)  # rows before
+        incl = within + off
+        ex = (incl - x).astype(jnp.int32)
+        total = incl[_ROWS - 1, _LANES - 1].astype(jnp.int32)
+        return ex, total
+
+    kex, ktot = dual_scan(k)
+    dex, dtot = dual_scan(d)
+    kex_ref[:] = kex + carry[0]
+    dex_ref[:] = dex + carry[1]
+    carry[0] = carry[0] + ktot
+    carry[1] = carry[1] + dtot
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        tot_ref[0, 0] = carry[0]
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _dual_prefix_pallas(keep_i32: jnp.ndarray, interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    n = keep_i32.shape[0]
+    padded = ((n + _BLK - 1) // _BLK) * _BLK
+    buf = jnp.zeros((padded,), jnp.int32).at[:n].set(keep_i32)
+    buf = buf.reshape(padded // _LANES, _LANES)
+    grid = padded // _BLK
+    kex, dex, tot = pl.pallas_call(
+        _dual_prefix_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded // _LANES, _LANES), jnp.int32),
+            jax.ShapeDtypeStruct((padded // _LANES, _LANES), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.SMEM((2,), jnp.int32)],
+        interpret=interpret,
+    )(buf)
+    return kex.reshape(-1)[:n], dex.reshape(-1)[:n], tot[0, 0]
+
+
+_pallas_ok: bool = None  # resolved by the first eager probe
+
+
+def _pallas_available() -> bool:
+    """Eager one-shot compile probe. The caller is usually *inside* a
+    traced per-batch kernel, where a pallas_call just traces in and its
+    compile failure would surface later, at the outer program's compile —
+    so availability must be decided here with a small concrete run (some
+    TPU attachment modes, e.g. remote-compile tunnels, cannot compile
+    Mosaic kernels at all)."""
+    global _pallas_ok
+    if _pallas_ok is None:
+        try:
+            probe = jnp.asarray(np.arange(_BLK) % 3 == 0, jnp.int32)
+            kex, _, tot = _dual_prefix_pallas(probe, False)
+            jax.block_until_ready(kex)
+            _pallas_ok = True
+        except Exception:  # noqa: BLE001 — any compile/runtime failure
+            _pallas_ok = False
+            import logging
+            logging.getLogger(__name__).warning(
+                "pallas compaction kernel unavailable on this backend; "
+                "using the XLA cumsum path")
+    return _pallas_ok
+
+
+def dual_prefix_counts(keep: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                                   jnp.ndarray]:
+    """(kept_ex, dead_ex, kept_total) for a bool vector."""
+    keep_i32 = keep.astype(jnp.int32)
+    mode = _mode()
+    if mode == "pallas" and _pallas_available():
+        return _dual_prefix_pallas(keep_i32, False)
+    if mode == "interpret":
+        return _dual_prefix_pallas(keep_i32, True)
+    return _dual_prefix_jnp(keep_i32)
+
+
+def compact_permutation(keep: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stable-partition permutation: kept row indices first (in order),
+    then the rest. Returns (perm int32[n], kept_total). O(n), replacing
+    the argsort spelling."""
+    n = keep.shape[0]
+    kept_ex, dead_ex, kept_total = dual_prefix_counts(keep)
+    dest = jnp.where(keep, kept_ex, kept_total + dead_ex).astype(jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    perm = jnp.zeros((n,), jnp.int32).at[dest].set(idx)
+    return perm, kept_total.astype(jnp.int32)

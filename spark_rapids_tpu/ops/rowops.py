@@ -60,11 +60,10 @@ def gather_batch(batch: DeviceBatch, perm: jnp.ndarray,
 def filter_batch(batch: DeviceBatch, keep: jnp.ndarray) -> DeviceBatch:
     """Compact rows where ``keep`` (bool capacity-vector) is True to the
     front. keep is pre-masked to live rows by the caller or here."""
-    capacity = batch.capacity
     keep = keep & batch.row_mask()
-    # stable partition: indices of kept rows first, in order
-    perm = jnp.argsort(~keep, stable=True).astype(jnp.int32)
-    new_rows = keep.sum().astype(jnp.int32)
+    # stable partition via the O(n) prefix-count kernel (pallas on TPU)
+    from spark_rapids_tpu.ops.pallas_kernels import compact_permutation
+    perm, new_rows = compact_permutation(keep)
     return gather_batch(batch, perm, new_rows)
 
 

@@ -38,6 +38,11 @@ class TpuSparkSession:
         # (reference: ExecutionPlanCaptureCallback, Plugin.scala:144-233)
         self.captured_plans: List = []
         self.capture_plans = False
+        # device-resident scan batches (spark.rapids.sql.cacheDeviceScans)
+        self.device_scan_cache: dict = {}
+
+    def clear_device_cache(self) -> None:
+        self.device_scan_cache.clear()
 
     # --- builder -----------------------------------------------------------
     class Builder:
@@ -142,6 +147,7 @@ class DataFrameWriter:
     def __init__(self, df: "DataFrame"):
         self._df = df
         self._mode = "error"
+        self._partition_cols: List[str] = []
 
     def mode(self, m: str) -> "DataFrameWriter":
         m = {"errorifexists": "error"}.get(m, m)
@@ -149,8 +155,21 @@ class DataFrameWriter:
         self._mode = m
         return self
 
+    def partition_by(self, *cols: str) -> "DataFrameWriter":
+        """Hive-style dynamic partitioning: one key=value directory level
+        per column (reference: GpuInsertIntoHadoopFsRelationCommand's
+        dynamic-partition write path)."""
+        missing = [c for c in cols if c not in self._df.schema.names]
+        if missing:
+            raise ValueError(f"partition_by columns not in schema: {missing}")
+        self._partition_cols = list(cols)
+        return self
+
+    partitionBy = partition_by
+
     def _run(self, path: str, fmt: str) -> None:
-        plan = lp.LogicalWrite(self._df._plan, path, fmt, self._mode)
+        plan = lp.LogicalWrite(self._df._plan, path, fmt, self._mode,
+                               self._partition_cols)
         self._df.session._execute(plan)
 
     def parquet(self, path: str) -> None:

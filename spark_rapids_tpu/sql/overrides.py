@@ -387,7 +387,7 @@ def _tag_write(meta: ExecMeta) -> None:
 def _convert_write(meta: ExecMeta, children) -> PhysicalPlan:
     from spark_rapids_tpu.exec.write import TpuWriteExec
     return TpuWriteExec(children[0], meta.plan.path, meta.plan.fmt,
-                        meta.plan.mode)
+                        meta.plan.mode, meta.plan.partition_cols)
 
 
 def _register_write_rule() -> None:

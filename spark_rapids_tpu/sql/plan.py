@@ -169,11 +169,13 @@ class LogicalWrite(LogicalPlan):
     """Terminal write command (reference: GpuDataWritingCommandExec wrapping
     InsertIntoHadoopFsRelationCommand)."""
 
-    def __init__(self, child: LogicalPlan, path: str, fmt: str, mode: str):
+    def __init__(self, child: LogicalPlan, path: str, fmt: str, mode: str,
+                 partition_cols: Sequence[str] = ()):
         super().__init__([child])
         self.path = path
         self.fmt = fmt
         self.mode = mode
+        self.partition_cols = list(partition_cols)
 
     def schema(self) -> Schema:
         return Schema([], [])

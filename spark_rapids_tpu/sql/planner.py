@@ -174,7 +174,8 @@ class Planner:
     def _plan_LogicalWrite(self, node: lp.LogicalWrite) -> PhysicalPlan:
         from spark_rapids_tpu.exec.write import CpuWriteExec
         child = self.plan(node.children[0])
-        return CpuWriteExec(child, node.path, node.fmt, node.mode)
+        return CpuWriteExec(child, node.path, node.fmt, node.mode,
+                            node.partition_cols)
 
     def _plan_LogicalWindow(self, node: lp.LogicalWindow) -> PhysicalPlan:
         from spark_rapids_tpu.exec.windowexec import CpuWindowExec

@@ -62,14 +62,72 @@ class InMemorySource(DataSource):
         return [make(i) for i in range(self.num_partitions)]
 
 
+def _expand_paths(paths: List[str], suffix: str):
+    """Resolve directories to their data files, hive-style: a directory
+    scan recurses and ``key=value`` path segments under the root become
+    per-file partition values (the reference appends them as scalar
+    columns per partition, ColumnarPartitionReaderWithPartitionValues)."""
+    import os
+    out = []  # (file_path, {partition_key: value})
+    for p in paths:
+        if not os.path.isdir(p):
+            out.append((p, {}))
+            continue
+        for root, _dirs, files in sorted(os.walk(p)):
+            rel = os.path.relpath(root, p)
+            pvals = {}
+            if rel != ".":
+                for seg in rel.split(os.sep):
+                    if "=" in seg:
+                        k, v = seg.split("=", 1)
+                        pvals[k] = v
+            for f in sorted(files):
+                if f.endswith(suffix) and not f.startswith(("_", ".")):
+                    out.append((os.path.join(root, f), dict(pvals)))
+    return out
+
+
+_HIVE_NULL = "__HIVE_DEFAULT_PARTITION__"
+
+
+def _infer_partition_value(text: str):
+    if text == _HIVE_NULL:  # the writer's NULL sentinel round-trips to NULL
+        return None
+    try:
+        return int(text)
+    except ValueError:
+        try:
+            return float(text)
+        except ValueError:
+            return text
+
+
+def _partition_key_dtype(values):
+    """Common dtype over every directory value of one key (dtype module
+    constant). Mixed or unparseable -> STRING."""
+    from spark_rapids_tpu.columnar import dtype as dtmod
+    kinds = {type(_infer_partition_value(v)) for v in values
+             if _infer_partition_value(v) is not None}
+    if kinds == {int}:
+        return dtmod.INT64
+    if kinds <= {int, float} and kinds:
+        return dtmod.FLOAT64
+    return dtmod.STRING
+
+
 class ParquetSource(DataSource):
     """Parquet scan: row-group pruned, one partition per row-group chunk
     (reference: GpuParquetScan.scala:204-373 does footer parse + row-group
-    clipping on the CPU before device decode)."""
+    clipping on the CPU before device decode). Directory inputs resolve
+    hive-partitioned layouts (``key=value`` dirs)."""
 
     def __init__(self, paths: List[str], columns: Optional[List[str]] = None):
         import pyarrow.parquet as pq
-        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        paths = [paths] if isinstance(paths, str) else list(paths)
+        self._files = _expand_paths(paths, ".parquet")
+        if not self._files:
+            raise FileNotFoundError(f"no parquet files under {paths}")
+        self.paths = [f for f, _ in self._files]
         self._pq = pq
         pf = pq.ParquetFile(self.paths[0])
         arrow_schema = pf.schema_arrow
@@ -81,13 +139,23 @@ class ParquetSource(DataSource):
             names.append(field.name)
             dts.append(dtmod.from_arrow(field.type))
         self.columns = names
+        # partition-value columns appended after data columns, typed by
+        # inference over EVERY directory value (mixed kinds -> string)
+        self._pkeys = sorted({k for _, pv in self._files for k in pv})
+        self._pkey_dtypes = {}
+        for k in self._pkeys:
+            dt = _partition_key_dtype([pv[k] for _, pv in self._files
+                                       if k in pv])
+            self._pkey_dtypes[k] = dt
+            names.append(k)
+            dts.append(dt)
         self.schema = Schema(names, dts)
-        # partition plan: (path, row_group_index)
+        # partition plan: (path, row_group_index, partition_values)
         self.splits = []
-        for p in self.paths:
+        for p, pvals in self._files:
             f = pq.ParquetFile(p)
             for rg in range(f.metadata.num_row_groups):
-                self.splits.append((p, rg))
+                self.splits.append((p, rg, pvals))
 
     def describe(self) -> str:
         return f"Parquet[{len(self.paths)} files, {len(self.splits)} row groups]"
@@ -99,20 +167,32 @@ class ParquetSource(DataSource):
     def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
         pq = self._pq
 
-        def make(path: str, rg: int) -> Partition:
+        def make(path: str, rg: int, pvals) -> Partition:
             def run():
                 from spark_rapids_tpu.exec import taskctx
                 taskctx.set_input_file(path)
                 f = pq.ParquetFile(path)
                 table = f.read_row_group(rg, columns=self.columns)
-                yield _arrow_to_pandas(table)
+                df = _arrow_to_pandas(table)
+                for k in self._pkeys:
+                    v = (_infer_partition_value(pvals[k])
+                         if k in pvals else None)
+                    dt = self._pkey_dtypes[k]
+                    if v is not None and not dt.is_string:
+                        v = dt.np_dtype.type(v)
+                    elif v is not None:
+                        v = str(v)
+                    df[k] = pd.Series([v] * len(df),
+                                      dtype=dt.pandas_nullable
+                                      if not dt.is_string else object)
+                yield df
                 taskctx.clear_input_file()
             return run
         if not self.splits:
             def empty():
                 yield _empty_from_schema(self.schema)
             return [empty]
-        return [make(p, rg) for p, rg in self.splits]
+        return [make(p, rg, pv) for p, rg, pv in self.splits]
 
 
 class CsvSource(DataSource):
@@ -122,7 +202,8 @@ class CsvSource(DataSource):
     def __init__(self, paths, schema: Optional[Schema] = None,
                  header: bool = True):
         import pyarrow.csv as pacsv
-        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        paths = [paths] if isinstance(paths, str) else list(paths)
+        self.paths = [f for f, _ in _expand_paths(paths, ".csv")] or paths
         self.header = header
         self._pacsv = pacsv
         if schema is not None:
@@ -160,7 +241,8 @@ class OrcSource(DataSource):
 
     def __init__(self, paths, columns: Optional[List[str]] = None):
         import pyarrow.orc as paorc
-        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        paths = [paths] if isinstance(paths, str) else list(paths)
+        self.paths = [f for f, _ in _expand_paths(paths, ".orc")] or paths
         self._paorc = paorc
         f = paorc.ORCFile(self.paths[0])
         from spark_rapids_tpu.columnar import dtypes as dtmod

@@ -1,0 +1,21 @@
+"""exec API-parity tool tests (reference: api_validation/.../
+ApiValidation.scala:27-60 signature diffing)."""
+
+from spark_rapids_tpu.tools.api_validation import validate
+
+
+def test_exec_api_parity_clean():
+    errors, lines = validate()
+    assert errors == [], errors
+    assert any("HashAggregateExec" in l for l in lines)
+
+
+def test_every_known_exec_covered():
+    # the report must mention the headline operators so a future rename
+    # can't silently drop them from validation
+    _, lines = validate()
+    text = "\n".join(lines)
+    for op in ("FilterExec", "ProjectExec", "SortExec", "WindowExec",
+               "ShuffleExchangeExec", "ExpandExec", "GenerateExec",
+               "WriteExec"):
+        assert op in text, op

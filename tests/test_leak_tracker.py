@@ -1,0 +1,60 @@
+"""Buffer-leak tracker tests (SURVEY.md section 5: the build supplies its
+own leak detection since cudf's Java MemoryCleaner is not inherited)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.memory.leak import TRACKER, LeakTracker, assert_no_leaks
+
+
+def test_register_unregister_and_report():
+    t = LeakTracker()
+    a = t.register(1024, "bufA")
+    b = t.register(2048, "bufB")
+    assert t.live_count == 2 and t.live_bytes == 3072
+    lines = t.report()
+    assert len(lines) == 2 and "bufA" in lines[0] and "size=1024B" in lines[0]
+    t.unregister(a)
+    assert t.live_count == 1
+    t.unregister(b)
+    assert t.live_count == 0 and t.report() == []
+
+
+def test_stack_capture(monkeypatch):
+    t = LeakTracker()
+    t.capture_stacks = True
+    tok = t.register(64, "withstack")
+    line = t.report()[0]
+    assert "test_leak_tracker" in line  # creation site visible
+    t.unregister(tok)
+
+
+def test_assert_no_leaks_context():
+    with assert_no_leaks():
+        tok = TRACKER.register(10, "temp")
+        TRACKER.unregister(tok)
+    with pytest.raises(AssertionError, match="buffer leak"):
+        with assert_no_leaks():
+            leaked = TRACKER.register(10, "oops")
+    TRACKER.unregister(leaked)
+
+
+def test_spillable_buffers_tracked(session):
+    """Catalog-managed buffers register and deregister through their
+    lifecycle, including after spilling."""
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.memory.spill import BufferCatalog
+
+    catalog = BufferCatalog(host_limit_bytes=1 << 20)
+    before = TRACKER.live_count
+    pdf = pd.DataFrame({"x": np.arange(100, dtype=np.float64)})
+    batch = DeviceBatch.from_pandas(pdf)
+    bid = catalog.add_batch(batch)
+    assert TRACKER.live_count == before + 1
+    catalog.device_store.synchronous_spill(0)  # push to host tier
+    assert TRACKER.live_count == before + 1    # spilled, not leaked/closed
+    got = catalog.acquire_batch(bid)
+    assert got.num_rows_host() == 100
+    catalog.close()
+    assert TRACKER.live_count == before

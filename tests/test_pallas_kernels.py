@@ -1,0 +1,55 @@
+"""Pallas kernel tests: the compaction prefix-count kernel in interpreter
+mode against the jnp twin and a numpy oracle (the kernel itself runs
+un-interpreted only on real TPUs)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.ops import pallas_kernels as pk
+
+
+@pytest.mark.parametrize("n", [1, 7, 128, 2048, 2049, 5000])
+def test_dual_prefix_jnp_matches_numpy(n, rng):
+    keep = rng.random(n) < 0.4
+    import jax.numpy as jnp
+    kex, dex, tot = pk._dual_prefix_jnp(jnp.asarray(keep, jnp.int32))
+    k = keep.astype(np.int64)
+    np.testing.assert_array_equal(np.asarray(kex), np.cumsum(k) - k)
+    np.testing.assert_array_equal(np.asarray(dex),
+                                  np.cumsum(1 - k) - (1 - k))
+    assert int(tot) == int(k.sum())
+
+
+@pytest.mark.parametrize("n", [64, 2048, 2050, 4096])
+def test_pallas_kernel_interpret_matches_jnp(n, rng):
+    import jax.numpy as jnp
+    keep = jnp.asarray(rng.random(n) < 0.55, jnp.int32)
+    kex_p, dex_p, tot_p = pk._dual_prefix_pallas(keep, True)
+    kex_j, dex_j, tot_j = pk._dual_prefix_jnp(keep)
+    np.testing.assert_array_equal(np.asarray(kex_p), np.asarray(kex_j))
+    np.testing.assert_array_equal(np.asarray(dex_p), np.asarray(dex_j))
+    assert int(tot_p) == int(tot_j)
+
+
+def test_compact_permutation_stable(rng):
+    import jax.numpy as jnp
+    keep = jnp.asarray(rng.random(300) < 0.3)
+    perm, total = pk.compact_permutation(keep)
+    k = np.asarray(keep)
+    expect = np.concatenate([np.nonzero(k)[0], np.nonzero(~k)[0]])
+    np.testing.assert_array_equal(np.asarray(perm), expect)
+    assert int(total) == int(k.sum())
+
+
+def test_mode_env_toggle(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_PALLAS", "0")
+    assert pk._mode() == "jnp"
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_PALLAS", "interpret")
+    assert pk._mode() == "interpret"
+    # auto stays on the XLA path (Mosaic is opt-in for attached chips)
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_PALLAS", "auto")
+    assert pk._mode() == "jnp"
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_PALLAS", "1")
+    import jax
+    expect = "pallas" if jax.default_backend() == "tpu" else "jnp"
+    assert pk._mode() == expect

@@ -116,3 +116,63 @@ def test_csv_write(session, rng, tmp_path):
     back = pd.concat([pd.read_csv(os.path.join(out, f)) for f in files],
                      ignore_index=True)
     assert len(back) == 50
+
+
+def test_partitioned_write_read_roundtrip(session, tmp_path, rng):
+    """writer.partition_by -> key=value layout -> directory scan appends
+    partition columns back (reference: dynamic-partition write via
+    GpuInsertIntoHadoopFsRelationCommand + partition-value reader)."""
+    import numpy as np
+    import pandas as pd
+    from spark_rapids_tpu.sql import functions as F
+    pdf = pd.DataFrame({
+        "k": np.asarray(["a", "b"], dtype=object)[
+            rng.integers(0, 2, 60)],
+        "year": rng.integers(2020, 2023, 60),
+        "v": rng.normal(size=60),
+    })
+    out = str(tmp_path / "part_out")
+    session.set_conf("spark.rapids.sql.enabled", True)
+    df = session.create_dataframe(pdf, 3)
+    df.write.mode("overwrite").partition_by("k", "year").parquet(out)
+
+    import os
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    subdirs = {os.path.relpath(r, out) for r, d, files in os.walk(out)
+               if any(f.endswith(".parquet") for f in files)}
+    assert any(s.startswith("k=a") and "year=" in s for s in subdirs), subdirs
+
+    back = (session.read.parquet(out)
+            .group_by("k", "year").agg(F.sum("v").alias("sv"),
+                                       F.count("*").alias("n"))
+            .collect())
+    exp = (pdf.groupby(["k", "year"])
+           .agg(sv=("v", "sum"), n=("v", "size")).reset_index())
+    back = back.sort_values(["k", "year"]).reset_index(drop=True)
+    exp = exp.sort_values(["k", "year"]).reset_index(drop=True)
+    assert (back["n"].to_numpy() == exp["n"].to_numpy()).all()
+    np.testing.assert_allclose(back["sv"].to_numpy(dtype=float),
+                               exp["sv"].to_numpy(), rtol=1e-9)
+
+
+def test_partitioned_write_null_partition_value(session, tmp_path):
+    """NULL partition values round-trip: written as
+    __HIVE_DEFAULT_PARTITION__, read back as NULL (Spark semantics)."""
+    import numpy as np
+    import pandas as pd
+    from spark_rapids_tpu.sql import functions as F
+    pdf = pd.DataFrame({
+        "k": pd.array([1, 1, None, 2], dtype="Int64"),
+        "v": [1.0, 2.0, 3.0, 4.0],
+    })
+    out = str(tmp_path / "null_part")
+    session.set_conf("spark.rapids.sql.enabled", True)
+    session.create_dataframe(pdf, 1).write.mode("overwrite") \
+        .partition_by("k").parquet(out)
+    import os
+    dirs = set(os.listdir(out))
+    assert "k=__HIVE_DEFAULT_PARTITION__" in dirs, dirs
+    back = session.read.parquet(out).collect()
+    assert back["k"].isna().sum() == 1
+    got = back[back["k"].isna()]["v"].iloc[0]
+    assert float(got) == 3.0
