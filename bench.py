@@ -24,7 +24,10 @@ import time
 def _suite_tpch(session, sf, qnames):
     from spark_rapids_tpu.models.tpch import QUERIES, TpchTables
     tables = TpchTables.generate(session, sf, num_partitions=4)
-    names = qnames or ["q1", "q3", "q5", "q6", "q9", "q18"]
+    # default sweep: scan-agg (q1), join+agg (q3), scan-filter-agg (q6) —
+    # representative operator mix that completes in bounded time even on
+    # high-latency remote attachments; widen via BENCH_QUERIES
+    names = qnames or ["q1", "q3", "q6"]
     return {q: (lambda s, q=q: QUERIES[q](s, tables)) for q in names}
 
 

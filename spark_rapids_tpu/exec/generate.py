@@ -135,7 +135,9 @@ class TpuGenerateExec(PhysicalPlan):
             def run() -> Iterator[DeviceBatch]:
                 emitted = False
                 for batch in part():
-                    sizes = [int(x) for x in self._totals(batch)]
+                    import jax
+                    sizes = [int(x) for x in
+                             jax.device_get(self._totals(batch))]
                     total = sizes[0]
                     if total == 0:
                         continue

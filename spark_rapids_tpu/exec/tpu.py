@@ -576,13 +576,19 @@ class TpuShuffleExchangeExec(TpuExec):
         def compute_range_bounds(batches: List[DeviceBatch]):
             """Reservoir-style sample of sort-key operand vectors -> n-1
             lexicographic upper bounds (GpuRangePartitioner.scala:42-120)."""
+            import jax
             import numpy as np
+            # one batched fetch of every batch's (row count, key operands)
+            fetched = jax.device_get([(b.num_rows,
+                                       self._sample_kernel(b))
+                                      for b in batches])
             samples = []
-            for batch in batches:
-                rows = batch.num_rows_host()
+            for batch, (rows, ops) in zip(batches, fetched):
+                rows = int(rows)
+                batch._host_rows = rows
                 if rows == 0:
                     continue
-                ops = np.asarray(self._sample_kernel(batch))  # (k, capacity)
+                ops = np.asarray(ops)  # (k, capacity)
                 take = min(rows, 128)
                 sel = np.linspace(0, rows - 1, take).astype(np.int64)
                 samples.append(ops[:, sel])

@@ -154,9 +154,11 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                         emitted = True
                         yield out
                         continue
-                    sizes = [int(x) for x in
-                             self._totals(build, stream, counts, bstart,
-                                          bperm)]
+                    # one batched fetch: per-element int() syncs each
+                    # pay a full device->host round trip
+                    sizes = [int(x) for x in jax.device_get(
+                        self._totals(build, stream, counts, bstart,
+                                     bperm))]
                     total = sizes[0]
                     if jt == "full":
                         flags = self._match_flags(build, counts, bstart,
