@@ -18,7 +18,34 @@ Env knobs:
 import json
 import math
 import os
+import signal
 import time
+
+
+class _QueryTimeout(Exception):
+    pass
+
+
+class _deadline:
+    """SIGALRM watchdog: remote attachments can wedge a single compile
+    indefinitely; one stuck query must not zero out the whole benchmark."""
+
+    def __init__(self, seconds: int):
+        self.seconds = seconds
+
+    def __enter__(self):
+        if self.seconds > 0:
+            def handler(signum, frame):
+                raise _QueryTimeout()
+            self._old = signal.signal(signal.SIGALRM, handler)
+            signal.alarm(self.seconds)
+        return self
+
+    def __exit__(self, *exc):
+        if self.seconds > 0:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, self._old)
+        return False
 
 
 def _suite_tpch(session, sf, qnames):
@@ -81,20 +108,29 @@ def main():
         session.set_conf("spark.rapids.sql.enabled", enabled)
         return fn(session).collect()
 
+    per_query_timeout = int(os.environ.get("BENCH_QUERY_TIMEOUT_S", "900"))
     detail = {}
     speedups = []
     for q, fn in queries.items():
-        run_query(fn, True)   # warm: compile + cache kernels
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            tpu_out = run_query(fn, True)
-        tpu_s = (time.perf_counter() - t0) / iters
+        try:
+            with _deadline(per_query_timeout):
+                run_query(fn, True)   # warm: compile + cache kernels
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    tpu_out = run_query(fn, True)
+                tpu_s = (time.perf_counter() - t0) / iters
 
-        run_query(fn, False)  # warm CPU caches too
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            cpu_out = run_query(fn, False)
-        cpu_s = (time.perf_counter() - t0) / iters
+                run_query(fn, False)  # warm CPU caches too
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    cpu_out = run_query(fn, False)
+                cpu_s = (time.perf_counter() - t0) / iters
+        except _QueryTimeout:
+            detail[q] = {"skipped": f"timed out after {per_query_timeout}s"}
+            continue
+        except Exception as e:  # noqa: BLE001 — keep benchmarking
+            detail[q] = {"skipped": f"{type(e).__name__}: {e}"[:200]}
+            continue
 
         assert len(tpu_out) == len(cpu_out), \
             (q, len(tpu_out), len(cpu_out))
@@ -103,6 +139,14 @@ def main():
         detail[q] = {"cpu_s": round(cpu_s, 4), "tpu_s": round(tpu_s, 4),
                      "speedup": round(sp, 3)}
 
+    if not speedups:
+        print(json.dumps({
+            "metric": f"{suite_names}_geomean_speedup_tpu_vs_cpu_path",
+            "value": 0.0, "unit": "x", "vs_baseline": 0.0,
+            "detail": {"sf": sf, "iters": iters, "queries": detail,
+                       "error": "every query timed out or failed"},
+        }))
+        return
     geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
     print(json.dumps({
         "metric": f"{suite_names}_geomean_speedup_tpu_vs_cpu_path",

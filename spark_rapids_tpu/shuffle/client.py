@@ -36,11 +36,33 @@ class ShuffleFetchFailedError(RuntimeError):
 
 class ShuffleClient:
     def __init__(self, executor_id: str, connection: ClientConnection,
-                 received: ReceivedBufferCatalog, bounce_buffer_size: int):
+                 received: ReceivedBufferCatalog, bounce_buffer_size: int,
+                 max_bytes_in_flight: int = 128 << 20):
         self.executor_id = executor_id
         self.connection = connection
         self.received = received
         self.bounce_buffer_size = bounce_buffer_size
+        # inflight-bytes throttle (reference:
+        # spark.rapids.shuffle.ucx.maximumBytesInFlight,
+        # RapidsConf.scala:532-537 + UCXShuffleTransport's throttle):
+        # bounds receive-side staging memory when fetching from many peers
+        self.max_bytes_in_flight = max(1, max_bytes_in_flight)
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+
+    def _acquire_inflight(self, nbytes: int) -> None:
+        with self._inflight_cv:
+            while (self._inflight > 0
+                   and self._inflight + nbytes > self.max_bytes_in_flight):
+                if not self._inflight_cv.wait(timeout=30):
+                    raise ShuffleFetchFailedError(
+                        "timed out waiting for inflight-bytes window")
+            self._inflight += nbytes
+
+    def _release_inflight(self, nbytes: int) -> None:
+        with self._inflight_cv:
+            self._inflight -= nbytes
+            self._inflight_cv.notify_all()
 
     def fetch_blocks(self, blocks: List[Tuple[int, int, int]]) -> List[int]:
         """Fetch all batches of the given (shuffle, map, partition) blocks
@@ -48,7 +70,11 @@ class ShuffleClient:
         metas = self._fetch_metadata(blocks)
         out = []
         for bid, length, tag in metas:
-            blob = self._receive_buffer(length, tag)
+            self._acquire_inflight(length)
+            try:
+                blob = self._receive_buffer(length, tag)
+            finally:
+                self._release_inflight(length)
             batch = wire.deserialize_batch(blob)
             out.append(self.received.add_batch(batch))
         return out

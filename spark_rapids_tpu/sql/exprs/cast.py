@@ -75,6 +75,87 @@ def cast_data(xp, data, src: DType, dst: DType):
     raise NotImplementedError(f"cast {src} -> {dst}")
 
 
+def _cast_strings_host(values, validity, src: DType, dst: DType):
+    """String-involved casts on the host path (non-ANSI Spark semantics:
+    unparseable strings become NULL; reference GpuCast.scala:240-877
+    string<->numeric/timestamp arms, gated off-device by the same confs).
+    """
+    n = len(values)
+    if dst.is_string:
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if not validity[i]:
+                out[i] = None
+                continue
+            v = values[i]
+            if src == dtypes.BOOL:
+                out[i] = "true" if v else "false"
+            elif src == dtypes.DATE32:
+                out[i] = str(np.datetime64(int(v), "D"))
+            elif src == dtypes.TIMESTAMP_US:
+                out[i] = str(np.datetime64(int(v), "us")).replace("T", " ")
+            elif src.is_floating:
+                fv = float(v)
+                if np.isnan(fv):
+                    out[i] = "NaN"
+                elif np.isinf(fv):
+                    out[i] = "Infinity" if fv > 0 else "-Infinity"
+                else:
+                    out[i] = repr(fv)
+            elif src.is_string:
+                out[i] = v
+            else:
+                out[i] = str(int(v))
+        return out, validity.copy()
+
+    # string -> typed
+    out_validity = validity.copy()
+    if dst.is_string:
+        raise AssertionError  # handled above
+    fill = dtypes.null_fill_value(dst)
+    out = np.full(n, fill, dtype=dst.np_dtype)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        text = str(values[i]).strip()
+        try:
+            if dst == dtypes.BOOL:
+                low = text.lower()
+                if low in ("true", "t", "yes", "y", "1"):
+                    out[i] = True
+                elif low in ("false", "f", "no", "n", "0"):
+                    out[i] = False
+                else:
+                    raise ValueError(text)
+            elif dst.is_integral:
+                # Spark accepts trailing .xxx by truncating via double
+                v = int(float(text)) if "." in text or "e" in text.lower() \
+                    else int(text)
+                lo, hi = _INT_RANGE[dst.name]
+                if not (lo <= v <= hi):
+                    raise ValueError(text)
+                out[i] = v
+            elif dst.is_floating:
+                out[i] = float(text)
+            elif dst == dtypes.DATE32:
+                import re
+                if not re.match(r"^\d{4}-\d{2}-\d{2}", text):
+                    raise ValueError(text)  # Spark needs yyyy-MM-dd...
+                out[i] = (np.datetime64(text[:10], "D")
+                          - np.datetime64(0, "D")).astype(np.int32)
+            elif dst == dtypes.TIMESTAMP_US:
+                import re
+                if not re.match(r"^\d{4}-\d{2}-\d{2}", text):
+                    raise ValueError(text)
+                out[i] = np.datetime64(
+                    text.replace(" ", "T"), "us").astype(np.int64)
+            else:
+                raise ValueError(f"cast string -> {dst}")
+        except (ValueError, OverflowError):
+            out_validity[i] = False  # unparseable -> NULL (non-ANSI)
+    return out, out_validity
+
+
 def _castable(src: DType, dst: DType) -> bool:
     try:
         probe = np.zeros(1, dtype=src.np_dtype) if not src.is_string else None
@@ -124,6 +205,10 @@ class Cast(Expression):
         # the logical dtype, not the unpacked numpy dtype: timestamps/dates
         # unpack to int64 micros / int32 days and would mis-dispatch
         src = series_dtype(s)
+        if src.is_string or self.to.is_string:
+            data, validity = _cast_strings_host(values, validity, src,
+                                                self.to)
+            return rebuild_series(data, validity, self.to, index)
         # the host twin stores timestamps as datetime64 -> int64 micros already
         with np.errstate(all="ignore"):
             data, extra = cast_data(np, values, src, self.to)

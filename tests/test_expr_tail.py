@@ -248,3 +248,34 @@ class TestOrc:
         df = session.read.orc(*files)
         out = df.collect()
         assert sorted(out["x"]) == [1, 2, 3]
+
+
+def test_string_casts_host_path(session):
+    """String<->typed casts on the CPU path with non-ANSI semantics:
+    unparseable -> NULL (reference: GpuCast.scala string arms behind
+    spark.rapids.sql.castStringTo* confs)."""
+    import numpy as np
+    import pandas as pd
+    from spark_rapids_tpu.sql import functions as F
+    session.set_conf("spark.rapids.sql.enabled", False)
+    pdf = pd.DataFrame({"s": ["123", "4.5", "oops", None, " 42 ",
+                              "2003-01-02", "true", "123"]})
+    df = session.create_dataframe(pdf, 1)
+    out = df.select(
+        F.col("s").cast("int").alias("i"),
+        F.col("s").cast("double").alias("d"),
+        F.col("s").cast("date").alias("dt"),
+        F.col("s").cast("boolean").alias("b")).collect()
+    assert list(out["i"].fillna(-1)) == [123, 4, -1, -1, 42, -1, -1, 123]
+    assert out["d"][1] == 4.5 and pd.isna(out["d"][2])
+    # '123' is NOT a date (Spark wants yyyy-MM-dd); '2003-01-02' is
+    assert pd.isna(out["dt"][0]) and str(out["dt"][5])[:10] == "2003-01-02"
+    assert out["b"][6] == True and pd.isna(out["b"][0])  # noqa: E712
+
+    ints = session.create_dataframe(
+        pd.DataFrame({"i": pd.array([1, None, -5], dtype="Int64"),
+                      "f": [1.5, float("nan"), float("inf")]}), 1)
+    out2 = ints.select(F.col("i").cast("string").alias("si"),
+                       F.col("f").cast("string").alias("sf")).collect()
+    assert list(out2["si"].fillna("?")) == ["1", "?", "-5"]
+    assert list(out2["sf"]) == ["1.5", "NaN", "Infinity"]

@@ -150,3 +150,32 @@ class TestShuffleFetch:
         assert env0.shuffle_catalog.buffer_ids(17, 0, 0)
         env0.shuffle_catalog.remove_shuffle(17)
         assert not env0.shuffle_catalog.buffer_ids(17, 0, 0)
+
+
+def test_inflight_bytes_throttle():
+    """The client admits a fetch larger than the window only when nothing
+    else is in flight, and blocks concurrent fetches past the cap
+    (reference: UCX transport maximumBytesInFlight throttle)."""
+    import threading
+    import time
+    from spark_rapids_tpu.shuffle.client import ShuffleClient
+
+    c = ShuffleClient.__new__(ShuffleClient)
+    c.max_bytes_in_flight = 100
+    c._inflight = 0
+    c._inflight_cv = threading.Condition()
+
+    c._acquire_inflight(150)   # oversized single fetch admitted when idle
+    admitted = threading.Event()
+
+    def second():
+        c._acquire_inflight(10)
+        admitted.set()
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not admitted.is_set()      # blocked: window full
+    c._release_inflight(150)
+    assert admitted.wait(5)           # unblocked after release
+    c._release_inflight(10)
+    assert c._inflight == 0
