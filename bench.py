@@ -18,7 +18,6 @@ Env knobs:
 import json
 import math
 import os
-import signal
 import time
 
 
@@ -26,26 +25,30 @@ class _QueryTimeout(Exception):
     pass
 
 
-class _deadline:
-    """SIGALRM watchdog: remote attachments can wedge a single compile
-    indefinitely; one stuck query must not zero out the whole benchmark."""
+def _run_with_deadline(fn, seconds: int):
+    """Run fn() in a worker thread with a hard join timeout. Remote
+    attachments can wedge a compile inside a C call that signals cannot
+    interrupt; a stuck query must not zero out the whole benchmark. The
+    hung worker is a daemon thread — it is abandoned, not joined."""
+    if seconds <= 0:
+        return fn()
+    import threading
+    box = {}
 
-    def __init__(self, seconds: int):
-        self.seconds = seconds
+    def work():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — reported by caller
+            box["error"] = e
 
-    def __enter__(self):
-        if self.seconds > 0:
-            def handler(signum, frame):
-                raise _QueryTimeout()
-            self._old = signal.signal(signal.SIGALRM, handler)
-            signal.alarm(self.seconds)
-        return self
-
-    def __exit__(self, *exc):
-        if self.seconds > 0:
-            signal.alarm(0)
-            signal.signal(signal.SIGALRM, self._old)
-        return False
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(seconds)
+    if t.is_alive():
+        raise _QueryTimeout()
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
 
 
 def _suite_tpch(session, sf, qnames):
@@ -112,19 +115,22 @@ def main():
     detail = {}
     speedups = []
     for q, fn in queries.items():
-        try:
-            with _deadline(per_query_timeout):
-                run_query(fn, True)   # warm: compile + cache kernels
-                t0 = time.perf_counter()
-                for _ in range(iters):
-                    tpu_out = run_query(fn, True)
-                tpu_s = (time.perf_counter() - t0) / iters
+        def measure(fn=fn):
+            run_query(fn, True)   # warm: compile + cache kernels
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                tpu_out = run_query(fn, True)
+            tpu_s = (time.perf_counter() - t0) / iters
 
-                run_query(fn, False)  # warm CPU caches too
-                t0 = time.perf_counter()
-                for _ in range(iters):
-                    cpu_out = run_query(fn, False)
-                cpu_s = (time.perf_counter() - t0) / iters
+            run_query(fn, False)  # warm CPU caches too
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                cpu_out = run_query(fn, False)
+            cpu_s = (time.perf_counter() - t0) / iters
+            return tpu_out, tpu_s, cpu_out, cpu_s
+        try:
+            tpu_out, tpu_s, cpu_out, cpu_s = _run_with_deadline(
+                measure, per_query_timeout)
         except _QueryTimeout:
             detail[q] = {"skipped": f"timed out after {per_query_timeout}s"}
             continue
