@@ -63,7 +63,15 @@ def segment_reduce_host(kind: str, values: np.ndarray, validity: np.ndarray,
         return data, has_valid
     if kind in ("min", "max"):
         if vs.dtype == object:
-            raise NotImplementedError("host min/max over strings")
+            # lexicographic min/max over strings; python str comparison is
+            # code-point order == UTF-8 byte order, matching Spark/cuDF
+            out = np.empty(num_groups, dtype=object)
+            pick = min if kind == "min" else max
+            for g in range(num_groups):
+                seg_valid = val_s[starts[g]:ends[g]]
+                seg = vs[starts[g]:ends[g]][seg_valid]
+                out[g] = pick(seg) if len(seg) else None
+            return out, has_valid
         if vs.dtype.kind == "f":
             neutral = np.inf if kind == "min" else -np.inf
         elif vs.dtype.kind == "b":

@@ -63,8 +63,6 @@ def resolve_descriptor(wexpr: WindowExpression, schema: Schema):
                             "is not supported")
     child = fn.children[0]
     frame_kind, lo, hi = wexpr.spec.resolved_frame(is_ranking=False)
-    bounded = lo > UNBOUNDED_PRECEDING or (CURRENT_ROW < hi <
-                                           UNBOUNDED_FOLLOWING)
     if frame_kind == "range" and (lo > UNBOUNDED_PRECEDING
                                   or (hi != CURRENT_ROW
                                       and hi < UNBOUNDED_FOLLOWING)):
@@ -72,9 +70,11 @@ def resolve_descriptor(wexpr: WindowExpression, schema: Schema):
     err = None
     if child.dtype(schema).is_string:
         err = f"window {kind} over strings is not supported on TPU"
-    elif frame_kind == "rows" and bounded and kind in ("min", "max"):
-        err = ("min/max over bounded ROW frames is not supported on TPU "
-               "(no prefix-difference form)")
+    elif (frame_kind == "rows" and kind in ("min", "max")
+          and lo > UNBOUNDED_PRECEDING and hi < UNBOUNDED_FOLLOWING
+          and (hi - lo + 1) > 256):
+        err = (f"min/max over a bounded ROW frame wider than 256 rows "
+               f"({hi - lo + 1}) is not supported on TPU")
     return ("agg", kind, None, frame_kind, lo, hi,
             wexpr.dtype(schema).name), child, err
 

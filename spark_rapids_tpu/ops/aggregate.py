@@ -91,6 +91,13 @@ def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
                 out_cols.append(DeviceColumn(out_dt, data,
                                              validity & group_live))
                 continue
+            if kind in ("min", "max", "first", "last", "first_valid",
+                        "last_valid"):
+                from spark_rapids_tpu.ops.rowops import gather_column
+                rows, has = gb.segment_select_string(kind, col, info)
+                out_cols.append(
+                    gather_column(col, rows, has & group_live))
+                continue
             raise NotImplementedError(f"string reduction {kind}")
         data, validity = gb.segment_reduce(kind, col.data, col.validity, info,
                                            out_dt.np_dtype)

@@ -133,24 +133,108 @@ def segment_reduce(kind: str, values: jnp.ndarray, validity: jnp.ndarray,
             data = data.astype(jnp.bool_)
         return data.astype(out_dtype), group_has_valid
     if kind in ("first", "last", "first_valid", "last_valid"):
-        pos = jnp.arange(capacity, dtype=jnp.int32)
-        if kind.endswith("_valid"):
-            eligible = val_s
-        else:
-            eligible = jnp.ones((capacity,), jnp.bool_)
-        big = capacity + 1
-        if kind.startswith("first"):
-            p = jnp.where(eligible, pos, big)
-            sel = seg(jax.ops.segment_min, p)
-        else:
-            p = jnp.where(eligible, pos, -1)
-            sel = seg(jax.ops.segment_max, p)
-        has = (sel >= 0) & (sel < capacity)
-        sel_c = jnp.clip(sel, 0, capacity - 1)
+        sel_c, picked = _segment_pick_pos(kind, val_s, gid, capacity)
         data = vs[sel_c].astype(out_dtype)
-        validity = jnp.where(has, val_s[sel_c], False)
+        validity = picked & val_s[sel_c]
         return data, validity
     if kind == "any":
         data = seg(jax.ops.segment_max, (vs & val_s).astype(jnp.int32)) > 0
         return data.astype(out_dtype), jnp.ones((capacity,), jnp.bool_)
     raise ValueError(f"unknown reduction kind: {kind}")
+
+
+def _segment_pick_pos(kind: str, val_s: jnp.ndarray, gid: jnp.ndarray,
+                      capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared first/last position selection in sorted-slot space. Returns
+    (sel_c clipped sorted-slot index per group, picked bool per group)."""
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    eligible = val_s if kind.endswith("_valid") else jnp.ones(
+        (capacity,), jnp.bool_)
+    if kind.startswith("first"):
+        sel = jax.ops.segment_min(jnp.where(eligible, pos, capacity + 1),
+                                  gid, num_segments=capacity)
+    else:
+        sel = jax.ops.segment_max(jnp.where(eligible, pos, -1),
+                                  gid, num_segments=capacity)
+    picked = (sel >= 0) & (sel < capacity)
+    return jnp.clip(sel, 0, capacity - 1), picked
+
+
+def segment_select_string(kind: str, col, info: GroupInfo
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Winning ORIGINAL row index per group for string reductions (the value
+    itself is materialized later with one string gather). Returns
+    (rows int32 (capacity,), has_valid bool (capacity,)).
+
+    min/max results are EXACT lexicographic byte order: the prefix-image
+    sort decides within the sort kernel's 64-byte images (+ length key),
+    and any group whose winning slot ties its neighbour on the whole
+    prefix is re-decided by the cond-gated full-length refinement below.
+    first/last are positional."""
+    from spark_rapids_tpu.ops.sortops import _string_prefix_chunks
+    capacity = col.validity.shape[0]
+    gid = info.group_id_sorted
+    val_s = col.validity[info.perm]
+    seg = lambda op, x: op(x, gid, num_segments=capacity)  # noqa: E731
+    has = seg(jax.ops.segment_max, val_s.astype(jnp.int32)) > 0
+
+    if kind in ("min", "max"):
+        want_max = kind == "max"
+        imgs = [c[info.perm] for c in _string_prefix_chunks(col)]
+        if want_max:
+            imgs = [~img for img in imgs]
+        allones = ~jnp.uint64(0)  # invalid rows sort last within the group
+        imgs = [jnp.where(val_s, img, allones) for img in imgs]
+        keys = (gid,) + tuple(imgs)
+        out = jax.lax.sort(keys + (info.perm, val_s), num_keys=len(keys),
+                           is_stable=True)
+        imgs_s, orig_new, val_new = out[1:-2], out[-2], out[-1]
+        # gid sequence is unchanged by the re-sort, so the original group
+        # boundaries still mark each group's first (= winning) slot
+        rows = seg(jax.ops.segment_sum,
+                   jnp.where(info.boundary, orig_new, 0))
+        # Exactness: the prefix images only order the first 64 bytes. If a
+        # group's winning slot ties its neighbour on the whole prefix, the
+        # true winner needs full-length compares — run a segmented doubling
+        # reduce with the exact comparator, skipped entirely (lax.cond) in
+        # the common no-tie case.
+        pos = jnp.arange(capacity, dtype=jnp.int32)
+        same_g = jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), gid[1:] == gid[:-1]])
+        tie_prev = same_g
+        for img in imgs_s:
+            tie_prev = tie_prev & jnp.concatenate(
+                [jnp.zeros((1,), jnp.bool_), img[1:] == img[:-1]])
+        both_valid = val_new & jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), val_new[:-1]])
+        tie_prev = tie_prev & both_valid
+        tie_next = jnp.concatenate([tie_prev[1:],
+                                    jnp.zeros((1,), jnp.bool_)])
+        need_refine = jnp.any(info.boundary & tie_next)
+
+        def refine(_):
+            from spark_rapids_tpu.ops import strings as string_ops
+            cand, cval = orig_new, val_new
+            s = 1
+            while s < capacity:
+                prev_c = jnp.where(pos >= s, jnp.roll(cand, s), cand)
+                prev_v = jnp.where(pos >= s, jnp.roll(cval, s), False)
+                same = (pos >= s) & (gid == jnp.roll(gid, s))
+                cmp = string_ops.compare_rows(col, prev_c, cand)
+                better = (cmp > 0) if want_max else (cmp < 0)
+                take = same & prev_v & ((~cval) | better)
+                cand = jnp.where(take, prev_c, cand)
+                cval = cval | (same & prev_v)
+                s <<= 1
+            last = jnp.concatenate([gid[1:] != gid[:-1],
+                                    jnp.ones((1,), jnp.bool_)])
+            return seg(jax.ops.segment_sum, jnp.where(last, cand, 0))
+
+        rows = jax.lax.cond(need_refine, refine, lambda _: rows, None)
+        return rows, has
+
+    if kind in ("first", "last", "first_valid", "last_valid"):
+        sel_c, picked = _segment_pick_pos(kind, val_s, gid, capacity)
+        rows = info.perm[sel_c]
+        return rows, picked & val_s[sel_c]
+    raise ValueError(f"unknown string reduction kind: {kind}")

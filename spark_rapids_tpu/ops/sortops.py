@@ -11,10 +11,11 @@ Key images:
     bit for positives) after normalizing -0.0 -> 0.0 and NaN -> canonical
     positive NaN, so NaN sorts greater than +inf — Spark's float ordering;
   * bools/dates/timestamps: via their integer representation;
-  * strings: big-endian prefix chunks (STRING_PREFIX_CHUNKS x 8 bytes).
-    Strings identical in the first 64 bytes tie — documented limitation
-    (the reference's regex restrictions are the same spirit of bounded
-    support).
+  * strings: big-endian prefix chunks (STRING_PREFIX_CHUNKS x 8 bytes of
+    raw bytes) + a length tiebreak key. Exact for strings up to 64 bytes;
+    longer strings identical in the first 64 bytes order by length —
+    documented limitation (the reference's regex restrictions are the
+    same spirit of bounded support).
 
 Null ordering is a separate leading flag per key (asc -> nulls first
 default, like Spark).
@@ -55,6 +56,12 @@ def u64_key_image(col: DeviceColumn) -> List[jnp.ndarray]:
 
 
 def _string_prefix_chunks(col: DeviceColumn) -> List[jnp.ndarray]:
+    """64-byte big-endian prefix images + a trailing length key.
+
+    Bytes pack raw into full 8-bit lanes (a +1 shift would overflow 0xff
+    into the neighbouring lane and collapse distinct strings); past-end
+    positions pack as 0x00 and the final length key settles the
+    prefix-of case ('a' < 'ab'), which is exact for raw 0-padding."""
     capacity = col.offsets.shape[0] - 1
     nchars = col.data.shape[0]
     lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
@@ -67,10 +74,9 @@ def _string_prefix_chunks(col: DeviceColumn) -> List[jnp.ndarray]:
             idx = jnp.clip(starts + pos, 0, nchars - 1)
             byte = jnp.where(pos < lens, col.data[idx],
                              jnp.asarray(0, jnp.uint8)).astype(jnp.uint64)
-            # shift 0-byte up by 1 so 'a' < 'ab' (empty-past-end sorts first)
-            byte = jnp.where(pos < lens, byte + jnp.uint64(1), jnp.uint64(0))
             img = (img << jnp.uint64(8)) | byte
         chunks.append(img)
+    chunks.append(lens.astype(jnp.uint64))
     return chunks
 
 

@@ -21,6 +21,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes
 from spark_rapids_tpu.ops import hashing
@@ -129,6 +130,116 @@ def string_equal(ctx: EvalContext, lv: DevValue, rv: DevValue):
     rh1, rh2 = hashing.string_poly_hashes(rv.offsets, rv.data, rv.validity)
     eq = (lh1 == rh1) & (lh2 == rh2) & (lengths_of(lv) == lengths_of(rv))
     return eq, lv.validity & rv.validity
+
+
+def string_compare_literal(ctx: EvalContext, col: DevCol,
+                           lit: str) -> jnp.ndarray:
+    """Exact per-row lexicographic compare of col vs a literal.
+    Returns int8 cmp in {-1, 0, 1} (sign of col <=> lit)."""
+    pat = lit.encode("utf-8")
+    m = len(pat)
+    lens = lengths_of(col)
+    starts = col.offsets[:-1].astype(jnp.int32)
+    nchars = col.data.shape[0]
+    # positions 0..m inclusive: position m catches "col longer than lit".
+    # encode past-end as 0, real bytes as byte+1 (same order trick as sort).
+    js = jnp.arange(m + 1, dtype=jnp.int32)
+    idx = jnp.clip(starts[:, None] + js[None, :], 0, nchars - 1)
+    a = jnp.where(js[None, :] < lens[:, None],
+                  col.data[idx].astype(jnp.int32) + 1, 0)
+    bvals = np.zeros(m + 1, dtype=np.int32)
+    bvals[:m] = np.frombuffer(pat, dtype=np.uint8).astype(np.int32) + 1
+    diff = a - jnp.asarray(bvals)[None, :]
+    nz = diff != 0
+    first = jnp.argmax(nz, axis=1)
+    val = jnp.take_along_axis(diff, first[:, None], axis=1)[:, 0]
+    any_nz = jnp.any(nz, axis=1)
+    return jnp.where(any_nz, jnp.sign(val), 0).astype(jnp.int8)
+
+
+def compare_extents(data_a: jnp.ndarray, sa: jnp.ndarray, la: jnp.ndarray,
+                    data_b: jnp.ndarray, sb: jnp.ndarray,
+                    lb: jnp.ndarray) -> jnp.ndarray:
+    """Exact elementwise lexicographic byte-order compare of string extents
+    (starts+lengths into char buffers). Returns int8 cmp in {-1, 0, 1}.
+    Chunked 8-bytes-at-a-time while_loop: trip count is
+    ceil(longest-undecided-extent/8), shapes all static.
+
+    Past-end positions pack as raw 0x00 (full 8-bit lanes, so a real 0xff
+    byte cannot overflow into its neighbour); the prefix-of case where all
+    compared bytes tie ('a' vs 'a\\x00') is settled by the final length
+    tiebreak, which is exact for raw 0-padding."""
+    maxlen = jnp.maximum(la, lb)
+    na, nb = data_a.shape[0], data_b.shape[0]
+
+    def pack(data, nchars, starts, lens, k):
+        img = jnp.zeros(starts.shape, dtype=jnp.uint64)
+        base = (k * 8).astype(jnp.int32)
+        for b in range(8):
+            pos = base + b
+            idx = jnp.clip(starts + pos, 0, nchars - 1)
+            byte = jnp.where(pos < lens, data[idx].astype(jnp.uint64),
+                             jnp.uint64(0))
+            img = (img << jnp.uint64(8)) | byte
+        return img
+
+    def cond(state):
+        k, cmp, done = state
+        live_max = jnp.max(jnp.where(done, 0, maxlen))
+        return (k * 8) < live_max
+
+    def body(state):
+        k, cmp, done = state
+        au = pack(data_a, na, sa, la, k)
+        bu = pack(data_b, nb, sb, lb, k)
+        newly = (~done) & (au != bu)
+        cmp = jnp.where(newly,
+                        jnp.where(au < bu, jnp.int8(-1), jnp.int8(1)), cmp)
+        done = done | (au != bu)
+        return k + 1, cmp, done
+
+    n = sa.shape[0]
+    init = (jnp.int32(0), jnp.zeros((n,), jnp.int8),
+            jnp.zeros((n,), jnp.bool_))
+    _, cmp, done = jax.lax.while_loop(cond, body, init)
+    # all compared bytes tied: one string is a 0-padded prefix of the other
+    lentie = jnp.sign(la - lb).astype(jnp.int8)
+    return jnp.where(done, cmp, lentie)
+
+
+def compare_rows(col: DevCol, rows_a: jnp.ndarray,
+                 rows_b: jnp.ndarray) -> jnp.ndarray:
+    """Exact compare of row selections a vs b of one string column."""
+    lens = lengths_of(col)
+    starts = col.offsets[:-1].astype(jnp.int32)
+    return compare_extents(col.data, starts[rows_a], lens[rows_a],
+                           col.data, starts[rows_b], lens[rows_b])
+
+
+def string_compare_columns(lv: DevCol, rv: DevCol) -> jnp.ndarray:
+    """Exact per-row lexicographic byte-order compare of two string
+    columns. Returns int8 cmp in {-1, 0, 1}."""
+    return compare_extents(
+        lv.data, lv.offsets[:-1].astype(jnp.int32), lengths_of(lv),
+        rv.data, rv.offsets[:-1].astype(jnp.int32), lengths_of(rv))
+
+
+def string_compare(ctx: EvalContext, lv: DevValue,
+                   rv: DevValue) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """General string three-way compare (column/column or column/literal).
+    Returns (cmp int8 vec, validity). Exact byte order — the device twin of
+    cuDF's string comparator (reference: stringFunctions.scala ordering ops)."""
+    validity = _validity(ctx, lv) & _validity(ctx, rv)
+    if isinstance(rv, DevScalar) and isinstance(lv, DevCol):
+        return string_compare_literal(ctx, lv, str(rv.value)), validity
+    if isinstance(lv, DevScalar) and isinstance(rv, DevCol):
+        cmp = string_compare_literal(ctx, rv, str(lv.value))
+        return (-cmp).astype(jnp.int8), validity
+    if isinstance(lv, DevScalar) and isinstance(rv, DevScalar):
+        a, b = str(lv.value), str(rv.value)
+        c = -1 if a < b else (1 if a > b else 0)
+        return jnp.full((ctx.capacity,), c, dtype=jnp.int8), validity
+    return string_compare_columns(lv, rv), validity
 
 
 def upper_ascii(col: DevCol) -> DevCol:

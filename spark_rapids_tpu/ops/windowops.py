@@ -190,16 +190,37 @@ def window_compute(batch: DeviceBatch, num_child_cols: int,
             neutral = ii.max if agg_kind == "min" else ii.min
         pre = jnp.where(m, v, neutral)
         whole = lo <= UNBOUNDED_PRECEDING and hi >= UNBOUNDED_FOLLOWING
+        pick = jnp.minimum if agg_kind == "min" else jnp.maximum
         if whole:
             op = (jax.ops.segment_min if agg_kind == "min"
                   else jax.ops.segment_max)
             by_id = op(pre, seg, num_segments=cap)
             data = by_id[seg]
-        else:
-            assert frame_kind == "range" and lo <= UNBOUNDED_PRECEDING, \
-                "min/max supports only cumulative or whole-partition frames"
+        elif frame_kind == "range":
+            assert lo <= UNBOUNDED_PRECEDING, "bounded RANGE frames unsupported"
             scanned = _segmented_scan_minmax(pre, seg, agg_kind)
             data = scanned[jnp.clip(peer_end, 0, cap - 1)]
+        elif lo <= UNBOUNDED_PRECEDING:
+            # ROWS [unbounded, pos+hi]: segmented prefix scan read at f_hi
+            scanned = _segmented_scan_minmax(pre, seg, agg_kind)
+            data = scanned[f_hi_c]
+        elif hi >= UNBOUNDED_FOLLOWING:
+            # ROWS [pos+lo, unbounded]: segmented suffix scan read at f_lo
+            rscanned = _segmented_scan_minmax(pre[::-1], seg[::-1],
+                                              agg_kind)[::-1]
+            data = rscanned[f_lo_c]
+        else:
+            # bounded ROW frame: unrolled shifted compares — O(n*w), fused
+            # by XLA; frames wider than the tag threshold fall back to CPU
+            # (resolve_descriptor). cuDF gets this from a fixed-window
+            # kernel (GpuWindowExpression.scala:139 aggregateWindows).
+            acc = jnp.full((cap,), neutral, pre.dtype)
+            for d in range(lo, hi + 1):
+                j = pos + d
+                ok = (j >= seg_start) & (j <= seg_end) & (j >= 0) & (j < cap)
+                cand = jnp.where(ok, jnp.roll(pre, -d), neutral)
+                acc = pick(acc, cand)
+            data = acc
         validity = (frame_count > 0) & live
         if dt == dtypes.BOOL:
             data = data.astype(jnp.bool_)

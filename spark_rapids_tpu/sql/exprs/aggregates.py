@@ -125,6 +125,9 @@ class Min(AggregateFunction):
     def finalize(self, refs, schema):
         return refs[0]
 
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        return None  # selection-based reductions support strings on device
+
 
 class Max(AggregateFunction):
     def __init__(self, child: Expression):
@@ -144,6 +147,9 @@ class Max(AggregateFunction):
 
     def finalize(self, refs, schema):
         return refs[0]
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        return None  # selection-based reductions support strings on device
 
 
 class Average(AggregateFunction):
@@ -191,6 +197,9 @@ class First(AggregateFunction):
     def finalize(self, refs, schema):
         return refs[0]
 
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        return None  # selection-based reductions support strings on device
+
 
 class Last(AggregateFunction):
     def __init__(self, child: Expression, ignore_nulls: bool = False):
@@ -214,6 +223,9 @@ class Last(AggregateFunction):
 
     def finalize(self, refs, schema):
         return refs[0]
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        return None  # selection-based reductions support strings on device
 
 
 def _float(e: Expression) -> Expression:

@@ -38,17 +38,6 @@ class BinaryComparison(Expression):
                 f"{self.children[1].sql_name(schema)})")
 
     def device_supported(self, schema: Schema) -> Optional[str]:
-        lt = self.children[0].dtype(schema)
-        rt = self.children[1].dtype(schema)
-        if lt.is_string or rt.is_string:
-            from spark_rapids_tpu.sql.exprs.core import Literal
-            # string vs string-literal comparisons have device kernels;
-            # general string ordering comparisons do not (yet)
-            if type(self) in (Eq, Neq) :
-                return None
-            if not isinstance(self.children[1], Literal):
-                return ("ordering comparison on two string columns is not "
-                        "supported on TPU")
         return None
 
     def compute(self, xp, a, b):
@@ -67,10 +56,13 @@ class BinaryComparison(Expression):
 
     def _eval_device_string(self, ctx: EvalContext, lv, rv) -> DevValue:
         from spark_rapids_tpu.ops import strings as string_ops
-        if not isinstance(self, (Eq, Neq)):
-            raise NotImplementedError("string ordering comparison on device")
-        eq, validity = string_ops.string_equal(ctx, lv, rv)
-        data = eq if isinstance(self, Eq) else ~eq
+        if isinstance(self, (Eq, Neq)):
+            eq, validity = string_ops.string_equal(ctx, lv, rv)
+            data = eq if isinstance(self, Eq) else ~eq
+            return DevCol(dtypes.BOOL, data, validity)
+        cmp, validity = string_ops.string_compare(ctx, lv, rv)
+        zero = jnp.int8(0)
+        data = self.compute(jnp, cmp, zero)
         return DevCol(dtypes.BOOL, data, validity)
 
     def eval_host(self, df: pd.DataFrame) -> pd.Series:

@@ -178,10 +178,11 @@ def _tag_agg(meta: ExecMeta) -> None:
             r = first_unsupported(e, plan.partial_schema)
             if r:
                 meta.will_not_work(f"result {name}: {r}")
-    # string min/max not implemented on device yet
+    _STRING_RED_KINDS = ("count_valid", "min", "max", "first", "last",
+                         "first_valid", "last_valid")
     for fn, ops in zip(plan.agg_fns, plan.update_plan):
         for kind, input_idx, idt in ops:
-            if idt.is_string and kind not in ("count_valid",):
+            if idt.is_string and kind not in _STRING_RED_KINDS:
                 meta.will_not_work(
                     f"{kind} over string values is not supported on TPU")
 

@@ -1,0 +1,157 @@
+"""String ordering on device: comparison predicates and min/max/first/last
+aggregates over string columns, TPU vs CPU differential.
+
+Reference parity: cuDF string comparator ordering ops
+(sql/rapids/stringFunctions.scala) and string min/max aggregations
+(aggregate.scala computeAggregate via cudf groupBy min/max)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from tests.querytest import assert_tpu_and_cpu_equal
+
+
+def _str_df(rng, n=300, long_ties=False):
+    words = ["apple", "Banana", "cherry", "date", "apple pie", "applf",
+             "zz", "", "éclair", "a\x00b", "a"]
+    if long_ties:
+        # shared 100-byte prefixes: exercises the exact refinement past the
+        # sort kernel's 64-byte prefix images
+        base = "longsharedprefix" * 8
+        words = words + [base + suf for suf in ("a", "b", "aa", "", "z")]
+    sv = [words[int(rng.integers(0, len(words)))] if rng.random() > 0.12
+          else None for _ in range(n)]
+    tv = [words[int(rng.integers(0, len(words)))] for _ in range(n)]
+    return pd.DataFrame({
+        "k": rng.integers(0, 6, n),
+        "s": pd.Series(sv, dtype=object),
+        "t": pd.Series(tv, dtype=object),
+        "x": rng.standard_normal(n),
+    })
+
+
+class TestStringComparisons:
+    @pytest.mark.parametrize("op", ["lt", "le", "gt", "ge"])
+    def test_column_vs_column(self, session, rng, op):
+        df = _str_df(rng)
+        cmpfn = {"lt": lambda a, b: a < b, "le": lambda a, b: a <= b,
+                 "gt": lambda a, b: a > b, "ge": lambda a, b: a >= b}[op]
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3)
+            .filter(cmpfn(F.col("s"), F.col("t")))
+            .select(F.col("s"), F.col("t")))
+
+    def test_column_vs_literal(self, session, rng):
+        df = _str_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3)
+            .filter(F.col("s") >= "banana").select(F.col("s")))
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3)
+            .filter(F.col("s") < "cherry").select(F.col("s")))
+
+    def test_projected_bool(self, session, rng):
+        df = _str_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2)
+            .select((F.col("s") < F.col("t")).alias("lt"),
+                    (F.col("s") <= "date").alias("lelit")))
+
+    def test_long_shared_prefixes(self, session, rng):
+        df = _str_df(rng, long_ties=True)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2)
+            .filter(F.col("s") < F.col("t")).select(F.col("s"), F.col("t")))
+
+
+class TestRawByteOrdering:
+    """0xff and NUL bytes must order by raw byte value — a +1 lane shift
+    in the packers would overflow 0xff into the neighbouring byte lane and
+    collapse distinct strings (regression test)."""
+
+    def _col(self, vals, cap=8):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.columnar import dtypes as dts
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+        n = len(vals)
+        offs = np.zeros(cap + 1, np.int32)
+        total = 0
+        for i, v in enumerate(vals):
+            total += len(v)
+            offs[i + 1] = total
+        offs[n + 1:] = total
+        data = np.zeros(max(16, total), np.uint8)
+        data[:total] = np.frombuffer(b"".join(vals), np.uint8)
+        valid = np.zeros(cap, bool)
+        valid[:n] = True
+        return DeviceColumn(dts.STRING, jnp.asarray(data),
+                            jnp.asarray(valid), jnp.asarray(offs))
+
+    def test_compare_extents_high_bytes(self):
+        from spark_rapids_tpu.ops import strings as S
+        pairs = [(b"a\xffx", b"b"), (b"a", b"a\x00"), (b"a\x00", b"a"),
+                 (b"abc", b"abd"), (b"\xff", b"a"), (b"same", b"same"),
+                 (b"", b""), (b"zz", b"z")]
+        a = self._col([p[0] for p in pairs])
+        b = self._col([p[1] for p in pairs])
+        cmp = np.asarray(S.string_compare_columns(a, b))[:len(pairs)]
+        exp = [-1 if x < y else (1 if x > y else 0) for x, y in pairs]
+        assert list(cmp) == exp
+
+    def test_sort_high_bytes(self):
+        import jax
+        import jax.numpy as jnp
+        from spark_rapids_tpu.columnar import dtypes as dts
+        from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+        from spark_rapids_tpu.ops.sortops import sort_batch
+        vals = [b"a\xffx", b"a", b"a\x00", b"abc", b"\xff", b"same",
+                b"", b"zz"]
+        col = self._col(vals)
+        batch = DeviceBatch(Schema(["s"], [dts.STRING]), [col],
+                            jnp.asarray(8, jnp.int32))
+        sb = sort_batch(batch, [0], [True], [True])
+        off = np.asarray(jax.device_get(sb.columns[0].offsets))
+        ch = np.asarray(jax.device_get(sb.columns[0].data))
+        got = [bytes(ch[off[i]:off[i + 1]]) for i in range(8)]
+        assert got == sorted(vals)
+
+
+class TestStringAggregates:
+    def test_group_min_max(self, session, rng):
+        df = _str_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3).group_by("k")
+            .agg(F.min("s").alias("mn"), F.max("s").alias("mx"),
+                 F.count("s").alias("c")))
+
+    def test_group_min_max_long_ties(self, session, rng):
+        # winners differ only past the 64-byte prefix — exercises the
+        # lax.cond exact-refinement path
+        df = _str_df(rng, long_ties=True)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3).group_by("k")
+            .agg(F.min("s").alias("mn"), F.max("s").alias("mx")))
+
+    def test_global_min_max(self, session, rng):
+        df = _str_df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 3)
+            .agg(F.min("s").alias("mn"), F.max("s").alias("mx")))
+
+    def test_group_min_max_all_null_group(self, session, rng):
+        df = _str_df(rng, n=60)
+        df.loc[df.k == 2, "s"] = None
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).group_by("k")
+            .agg(F.min("s").alias("mn"), F.max("s").alias("mx")))
+
+    def test_group_first_last(self, session, rng):
+        # first/last tie to row order: use a single partition and
+        # order-insensitive grouping so CPU and TPU agree deterministically
+        df = _str_df(rng, n=80).sort_values("k", kind="stable")
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 1).group_by("k")
+            .agg(F.first("s", ignorenulls=True).alias("f"),
+                 F.last("s", ignorenulls=True).alias("l")))

@@ -88,13 +88,41 @@ def test_lead_lag(session, rng):
         .with_column("prv", F.lag("v", 2).over(w)), approx=True)
 
 
+def test_bounded_row_frame_min_max(session, rng):
+    """Sliding min/max over bounded ROW frames (unrolled-shift device
+    kernel)."""
+    df = _df(rng)
+    w = (Window.partition_by("g").order_by("ts", "q")
+         .rows_between(-2, Window.currentRow))
+    w2 = (Window.partition_by("g").order_by("ts", "q")
+          .rows_between(-1, 3))
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2)
+        .with_column("mn", F.min("v").over(w))
+        .with_column("mx", F.max("q").over(w2)), approx=True)
+
+
+def test_one_sided_row_frame_min_max(session, rng):
+    """ROWS unbounded-preceding..current and current..unbounded-following
+    (segmented prefix/suffix scans)."""
+    df = _df(rng)
+    w = (Window.partition_by("g").order_by("ts", "q")
+         .rows_between(Window.unboundedPreceding, Window.currentRow))
+    w2 = (Window.partition_by("g").order_by("ts", "q")
+          .rows_between(Window.currentRow, Window.unboundedFollowing))
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2)
+        .with_column("mn", F.min("v").over(w))
+        .with_column("mx", F.max("q").over(w2)), approx=True)
+
+
 def test_window_fallback_reason(session, rng):
-    """min over a bounded ROW frame has no prefix-difference form -> the
-    plan must fall back with a readable reason (the reference's hallmark
+    """min over a bounded ROW frame wider than the device threshold falls
+    back with a readable reason (the reference's hallmark
     explain-why-not)."""
     df = _df(rng)
     w = (Window.partition_by("g").order_by("ts")
-         .rows_between(-2, Window.currentRow))
+         .rows_between(-400, Window.currentRow))
     q = lambda s: (s.create_dataframe(df, 2)  # noqa: E731
                    .with_column("m", F.min("v").over(w)))
     assert_tpu_and_cpu_equal(q, allow_non_tpu=["CpuWindowExec"],
