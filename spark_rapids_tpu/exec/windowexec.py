@@ -27,7 +27,7 @@ from spark_rapids_tpu.sql.exprs.hostutil import host_unary_values
 from spark_rapids_tpu.sql.functions import SortOrder
 from spark_rapids_tpu.sql.window import (
     CURRENT_ROW, DenseRank, LeadLag, Rank, RowNumber, UNBOUNDED_FOLLOWING,
-    UNBOUNDED_PRECEDING, WindowExpression,
+    UNBOUNDED_PRECEDING, WindowExpression, is_bounded_range,
 )
 
 _AGG_KINDS = {Sum: "sum", Count: "count", Min: "min", Max: "max",
@@ -63,20 +63,98 @@ def resolve_descriptor(wexpr: WindowExpression, schema: Schema):
                             "is not supported")
     child = fn.children[0]
     frame_kind, lo, hi = wexpr.spec.resolved_frame(is_ranking=False)
-    if frame_kind == "range" and (lo > UNBOUNDED_PRECEDING
-                                  or (hi != CURRENT_ROW
-                                      and hi < UNBOUNDED_FOLLOWING)):
-        return None, None, "bounded RANGE frames are not supported"
     err = None
+    if is_bounded_range(frame_kind, lo, hi):
+        # the reference's time-range frames
+        # (GpuWindowExpression.scala:198 aggregateWindowsOverTimeRanges)
+        orders = wexpr.spec.orders
+        if len(orders) != 1:
+            return None, None, ("a RANGE frame with offsets requires "
+                                "exactly one order-by column")
+        odt = orders[0].expr.dtype(schema)
+        if not (odt.is_numeric or odt.is_datetime):
+            return None, None, (f"RANGE frame offsets over {odt.name} "
+                                "order are not supported")
+        if not orders[0].ascending:
+            err = ("bounded RANGE over a descending order is not "
+                   "supported on TPU")
+        elif not orders[0].nulls_first:
+            err = ("bounded RANGE with nulls-last ordering is not "
+                   "supported on TPU")
+        elif odt.is_floating:
+            err = ("bounded RANGE over a floating-point order column is "
+                   "not supported on TPU")
     if child.dtype(schema).is_string:
         err = f"window {kind} over strings is not supported on TPU"
-    elif (frame_kind == "rows" and kind in ("min", "max")
-          and lo > UNBOUNDED_PRECEDING and hi < UNBOUNDED_FOLLOWING
-          and (hi - lo + 1) > 256):
-        err = (f"min/max over a bounded ROW frame wider than 256 rows "
-               f"({hi - lo + 1}) is not supported on TPU")
     return ("agg", kind, None, frame_kind, lo, hi,
             wexpr.dtype(schema).name), child, err
+
+
+def _host_bounded_range_extents(ov, om, part_b, lo, hi, asc,
+                                seg_start, seg_end):
+    """Per-row [f_lo, f_hi] sorted-position extents for a bounded RANGE
+    frame (host oracle; also the fallback executor for the device-tagged
+    gaps: descending order, nulls-last, float order columns).
+
+    Normalization: w = ov ascending / -ov descending maps both directions
+    onto 'order values in [w+lo, w+hi]' over an ascending array. Null rows
+    frame over the segment's null run; float NaN rows (sorted greatest)
+    over the NaN run — both are peer groups. UNBOUNDED ends widen to the
+    segment edge afterwards."""
+    n = len(ov)
+    lo_unb, hi_unb = lo <= UNBOUNDED_PRECEDING, hi >= UNBOUNDED_FOLLOWING
+    w = np.asarray(ov)
+    if not asc:
+        w = -w.astype(np.int64 if w.dtype.kind in "iub" else np.float64)
+    f_lo = np.empty(n, np.int64)
+    f_hi = np.empty(n, np.int64)
+    starts = np.flatnonzero(part_b)
+    ends = np.r_[starts[1:] - 1, n - 1] if len(starts) else np.array([], int)
+    for s0, e0 in zip(starts, ends):
+        sl = slice(s0, e0 + 1)
+        valid = np.asarray(om[sl], bool)
+        ww = w[sl]
+        isnan = (np.isnan(ww) & valid if ww.dtype.kind == "f"
+                 else np.zeros(len(ww), bool))
+        normal = valid & ~isnan
+        ni = np.flatnonzero(normal)
+        for runmask in (~valid, isnan):
+            ri = np.flatnonzero(runmask)
+            if len(ri):
+                f_lo[s0 + ri] = s0 + ri[0]
+                f_hi[s0 + ri] = s0 + ri[-1]
+        if len(ni):
+            vv = ww[ni]
+
+            def sat_add(x, c):
+                # saturating add for integer order values (a wrapped
+                # target would silently flip the frame empty)
+                if x.dtype.kind not in "iu":
+                    return x + c
+                with np.errstate(over="ignore"):
+                    t = x + np.int64(c)
+                ii = np.iinfo(np.int64)
+                if c > 0:
+                    return np.where(t < x, ii.max, t)
+                if c < 0:
+                    return np.where(t > x, ii.min, t)
+                return t
+
+            l = np.searchsorted(vv, sat_add(vv, lo), "left") if not lo_unb \
+                else np.zeros(len(ni), np.int64)
+            r = (np.searchsorted(vv, sat_add(vv, hi), "right") - 1) \
+                if not hi_unb else np.full(len(ni), len(ni) - 1, np.int64)
+            lo_rows = np.where(l < len(ni),
+                               ni[np.clip(l, 0, len(ni) - 1)],
+                               e0 - s0 + 1)  # sentinel: empty frame
+            hi_rows = np.where(r >= 0, ni[np.clip(r, 0, len(ni) - 1)], -1)
+            f_lo[s0 + ni] = s0 + lo_rows
+            f_hi[s0 + ni] = s0 + hi_rows
+    if lo_unb:
+        f_lo = seg_start.copy()
+    if hi_unb:
+        f_hi = seg_end.copy()
+    return f_lo, f_hi
 
 
 class CpuWindowExec(PhysicalPlan):
@@ -194,7 +272,13 @@ class CpuWindowExec(PhysicalPlan):
             else:
                 _, agg_kind, _, frame_kind, lo, hi, _ = desc
                 mm = m.copy()
-                if frame_kind == "range":
+                range_bounded = is_bounded_range(frame_kind, lo, hi)
+                if range_bounded:
+                    ovv, ovm = order_cols[0]
+                    f_lo, f_hi = _host_bounded_range_extents(
+                        ovv, ovm, part_b, lo, hi,
+                        spec.orders[0].ascending, seg_start, seg_end)
+                elif frame_kind == "range":
                     f_lo, f_hi = seg_start, (
                         seg_end if hi >= UNBOUNDED_FOLLOWING else peer_end)
                 else:
@@ -234,7 +318,8 @@ class CpuWindowExec(PhysicalPlan):
                     fn_ = np.minimum if agg_kind == "min" else np.maximum
                     whole = (lo <= UNBOUNDED_PRECEDING
                              and hi >= UNBOUNDED_FOLLOWING)
-                    if whole or frame_kind == "range":
+                    if whole or (frame_kind == "range"
+                                 and not range_bounded):
                         scan = pre.copy()
                         for i in range(1, n):
                             if not part_b[i]:
@@ -242,8 +327,7 @@ class CpuWindowExec(PhysicalPlan):
                         data = (scan[seg_end] if whole
                                 else scan[np.clip(peer_end, 0, n - 1)])
                     else:
-                        # bounded ROW frame: direct per-row reduction (CPU
-                        # oracle only; the TPU path tags this off)
+                        # bounded ROW/RANGE frame: direct per-row reduction
                         red = np.min if agg_kind == "min" else np.max
                         data = np.full(n, neutral, pre.dtype)
                         for i in range(n):

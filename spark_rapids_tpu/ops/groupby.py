@@ -202,7 +202,12 @@ def segment_select_string(kind: str, col, info: GroupInfo
         same_g = jnp.concatenate(
             [jnp.zeros((1,), jnp.bool_), gid[1:] == gid[:-1]])
         tie_prev = same_g
-        for img in imgs_s:
+        # scan the 8 byte-prefix images only — NOT the trailing length
+        # image: candidates sharing the 64-byte prefix but differing in
+        # length are length-ordered by the sort, which is wrong whenever
+        # bytes past the prefix disagree with length order, so they MUST
+        # refine (the exact comparator settles prefix-of cases too)
+        for img in imgs_s[:-1]:
             tie_prev = tie_prev & jnp.concatenate(
                 [jnp.zeros((1,), jnp.bool_), img[1:] == img[:-1]])
         both_valid = val_new & jnp.concatenate(

@@ -23,6 +23,7 @@ from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes
 from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
@@ -55,6 +56,66 @@ def _segmented_scan_minmax(vals: jnp.ndarray, seg: jnp.ndarray,
         return gb, jnp.where(ga == gb, comb, vb)
     _, out = jax.lax.associative_scan(op, (seg, vals))
     return out
+
+
+def _sparse_minmax(pre: jnp.ndarray, f_lo_c: jnp.ndarray,
+                   f_hi_c: jnp.ndarray, kind: str,
+                   neutral) -> jnp.ndarray:
+    """min/max over arbitrary per-row index ranges [f_lo_c, f_hi_c] via a
+    sparse table (log n levels of doubling windows): query = combine of two
+    overlapping power-of-two windows. O(n log n) build, O(1) per query —
+    the device replacement for cuDF's variable-window reduction. Empty
+    ranges (f_hi < f_lo) must be masked by the caller."""
+    n = pre.shape[0]
+    pick = jnp.minimum if kind == "min" else jnp.maximum
+    levels = [pre]
+    k = 1
+    while (1 << k) <= n:
+        prev = levels[-1]
+        h = 1 << (k - 1)
+        shifted = jnp.concatenate(
+            [prev[h:], jnp.full((h,), neutral, prev.dtype)])
+        levels.append(pick(prev, shifted))
+        k += 1
+    table = jnp.stack(levels).reshape(-1)  # (L*n,)
+    length = jnp.maximum(f_hi_c - f_lo_c + 1, 1).astype(jnp.int32)
+    kq = 31 - jax.lax.clz(length)          # floor(log2(length))
+    pow2 = jnp.left_shift(jnp.int32(1), kq)
+    a = table[kq * n + f_lo_c]
+    b = table[kq * n + jnp.maximum(f_hi_c - pow2 + 1, 0)]
+    return pick(a, b)
+
+
+def _range_frame_search(seg: jnp.ndarray, vflag: jnp.ndarray,
+                        ov: jnp.ndarray, ts: jnp.ndarray, tv: jnp.ndarray,
+                        tx: jnp.ndarray, strict: bool) -> jnp.ndarray:
+    """Vectorized binary search: per row, the first sorted position whose
+    composite key (seg, valid-flag, order-value) is >= (or > when strict)
+    the row's target. The sorted layout (partitions ascending, nulls
+    first, order values ascending) makes the composite nondecreasing."""
+    n = seg.shape[0]
+    iters = max(1, int(np.ceil(np.log2(n + 1))) + 1)
+    lo = jnp.zeros(ts.shape, jnp.int32)
+    hi = jnp.full(ts.shape, n, jnp.int32)
+
+    def body(_, state):
+        lo, hi = state
+        mid = (lo + hi) // 2
+        mc = jnp.clip(mid, 0, n - 1)
+        gt = ((seg[mc] > ts)
+              | ((seg[mc] == ts) & (vflag[mc] > tv))
+              | ((seg[mc] == ts) & (vflag[mc] == tv) & (ov[mc] > tx)))
+        if strict:
+            pred = gt
+        else:
+            pred = gt | ((seg[mc] == ts) & (vflag[mc] == tv)
+                         & (ov[mc] == tx))
+        hi = jnp.where(pred, mid, hi)
+        lo = jnp.where(pred, lo, mid + 1)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, iters, body, (lo, hi))
+    return lo
 
 
 def window_compute(batch: DeviceBatch, num_child_cols: int,
@@ -142,15 +203,55 @@ def window_compute(batch: DeviceBatch, num_child_cols: int,
         v = vcol.data
 
         # frame extent per row in sorted positions [f_lo, f_hi]
+        lo_unb, hi_unb = lo <= UNBOUNDED_PRECEDING, hi >= UNBOUNDED_FOLLOWING
         if frame_kind == "range":
-            # cumulative (incl. peers) or whole partition
-            f_lo = seg_start if lo <= UNBOUNDED_PRECEDING else None
-            f_hi = (seg_end if hi >= UNBOUNDED_FOLLOWING else peer_end)
-            assert f_lo is not None, "bounded RANGE frames unsupported"
+            if lo_unb and (hi_unb or hi == CURRENT_ROW):
+                # cumulative (incl. peers) or whole partition
+                f_lo = seg_start
+                f_hi = seg_end if hi_unb else peer_end
+            else:
+                # bounded RANGE over the single ascending nulls-first
+                # order column (the reference's time-range frames,
+                # GpuWindowExpression.scala:198): per-row binary search for
+                # order values in [ov+lo, ov+hi]. Null-order rows frame
+                # over the segment's null run (nulls are peers).
+                ocol = sorted_b.columns[order_idx[0]]
+                ov = ocol.data.astype(jnp.int64)
+                ovalid = ocol.validity
+                vflag = ovalid.astype(jnp.int32)
+                imax = jnp.iinfo(jnp.int64).max
+                imin = jnp.iinfo(jnp.int64).min
+
+                def sat_add(x, c):
+                    # int64 add saturating at the type bounds (a wrapped
+                    # target would silently flip the frame empty)
+                    t = x + jnp.int64(c)
+                    if c > 0:
+                        return jnp.where(t < x, imax, t)
+                    if c < 0:
+                        return jnp.where(t > x, imin, t)
+                    return t
+
+                t_lo = jnp.where(ovalid, sat_add(ov, max(lo, int(imin))),
+                                 imin) if not lo_unb else None
+                t_hi = jnp.where(ovalid, sat_add(ov, min(hi, int(imax))),
+                                 imax) if not hi_unb else None
+                if lo_unb:
+                    f_lo = seg_start
+                else:
+                    f_lo = _range_frame_search(
+                        seg, vflag, ov, seg, vflag, t_lo,
+                        strict=False).astype(jnp.int32)
+                if hi_unb:
+                    f_hi = seg_end
+                else:
+                    f_hi = (_range_frame_search(
+                        seg, vflag, ov, seg, vflag, t_hi,
+                        strict=True) - 1).astype(jnp.int32)
         else:
-            f_lo = (seg_start if lo <= UNBOUNDED_PRECEDING
+            f_lo = (seg_start if lo_unb
                     else jnp.maximum(pos + lo, seg_start))
-            f_hi = (seg_end if hi >= UNBOUNDED_FOLLOWING
+            f_hi = (seg_end if hi_unb
                     else jnp.minimum(pos + hi, seg_end))
         f_lo_c = jnp.clip(f_lo, 0, cap - 1)
         f_hi_c = jnp.clip(f_hi, -1, cap - 1)
@@ -178,8 +279,6 @@ def window_compute(batch: DeviceBatch, num_child_cols: int,
             out_cols.append(DeviceColumn(dt, data, validity))
             continue
         assert agg_kind in ("min", "max")
-        # cumulative via segmented scan (bounded row frames are tagged off
-        # for min/max — no prefix-difference trick exists)
         if jnp.issubdtype(v.dtype, jnp.floating):
             neutral = jnp.inf if agg_kind == "min" else -jnp.inf
         elif v.dtype == jnp.bool_:
@@ -196,24 +295,18 @@ def window_compute(batch: DeviceBatch, num_child_cols: int,
                   else jax.ops.segment_max)
             by_id = op(pre, seg, num_segments=cap)
             data = by_id[seg]
-        elif frame_kind == "range":
-            assert lo <= UNBOUNDED_PRECEDING, "bounded RANGE frames unsupported"
-            scanned = _segmented_scan_minmax(pre, seg, agg_kind)
-            data = scanned[jnp.clip(peer_end, 0, cap - 1)]
-        elif lo <= UNBOUNDED_PRECEDING:
-            # ROWS [unbounded, pos+hi]: segmented prefix scan read at f_hi
+        elif lo_unb:
+            # frame [seg_start, f_hi] (cumulative range incl. peers,
+            # bounded-range upper, or ROWS hi): prefix scan read at f_hi
             scanned = _segmented_scan_minmax(pre, seg, agg_kind)
             data = scanned[f_hi_c]
-        elif hi >= UNBOUNDED_FOLLOWING:
-            # ROWS [pos+lo, unbounded]: segmented suffix scan read at f_lo
+        elif hi_unb:
+            # frame [f_lo, seg_end]: segmented suffix scan read at f_lo
             rscanned = _segmented_scan_minmax(pre[::-1], seg[::-1],
                                               agg_kind)[::-1]
             data = rscanned[f_lo_c]
-        else:
-            # bounded ROW frame: unrolled shifted compares — O(n*w), fused
-            # by XLA; frames wider than the tag threshold fall back to CPU
-            # (resolve_descriptor). cuDF gets this from a fixed-window
-            # kernel (GpuWindowExpression.scala:139 aggregateWindows).
+        elif frame_kind == "rows" and (hi - lo + 1) <= 16:
+            # narrow ROW frame: unrolled shifted compares, fused by XLA
             acc = jnp.full((cap,), neutral, pre.dtype)
             for d in range(lo, hi + 1):
                 j = pos + d
@@ -221,6 +314,12 @@ def window_compute(batch: DeviceBatch, num_child_cols: int,
                 cand = jnp.where(ok, jnp.roll(pre, -d), neutral)
                 acc = pick(acc, cand)
             data = acc
+        else:
+            # wide ROW frames and bounded RANGE frames: sparse-table
+            # variable-window reduction (cuDF's aggregateWindows
+            # equivalent, GpuWindowExpression.scala:139,198)
+            data = _sparse_minmax(pre, f_lo_c, jnp.maximum(f_hi_c, 0),
+                                  agg_kind, neutral)
         validity = (frame_count > 0) & live
         if dt == dtypes.BOOL:
             data = data.astype(jnp.bool_)

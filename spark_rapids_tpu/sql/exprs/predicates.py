@@ -37,9 +37,6 @@ class BinaryComparison(Expression):
         return (f"({self.children[0].sql_name(schema)} {self.symbol} "
                 f"{self.children[1].sql_name(schema)})")
 
-    def device_supported(self, schema: Schema) -> Optional[str]:
-        return None
-
     def compute(self, xp, a, b):
         raise NotImplementedError
 

@@ -3,10 +3,13 @@
 reference: GpuWindowExec.scala (202) + GpuWindowExpression.scala (723) —
 the reference supports Count/Sum/Min/Max/RowNumber over row frames and
 time-range frames (GpuWindowExpression.scala:47-56,139,198). This build
-adds rank/dense_rank/lead/lag and general cumulative range frames; bounded
-ROW frames support sum/count/avg (prefix-sum differencing on device —
-min/max over bounded row frames is tagged off, the same bounded-support
-spirit as the reference's frame restrictions).
+adds rank/dense_rank/lead/lag and general cumulative range frames.
+Bounded ROW frames run sum/count/avg via prefix-sum differencing and
+min/max via unrolled shifts (narrow) or a sparse-table variable-window
+reduction (wide). Bounded RANGE frames (the reference's time-range
+frames) run on device over a single ascending nulls-first non-float
+order column via per-row binary search; descending / nulls-last /
+float order columns fall back to the CPU oracle with a reason.
 
 API mirrors pyspark.sql.Window:
 
@@ -26,6 +29,15 @@ from spark_rapids_tpu.sql.exprs.core import Expression
 UNBOUNDED_PRECEDING = -(1 << 62)
 UNBOUNDED_FOLLOWING = 1 << 62
 CURRENT_ROW = 0
+
+
+def is_bounded_range(frame_kind: str, lo: int, hi: int) -> bool:
+    """True for RANGE frames with numeric offsets (vs the cumulative /
+    whole-partition forms) — shared by the capability tagger and both
+    executors so frame classification cannot drift."""
+    return frame_kind == "range" and (
+        lo > UNBOUNDED_PRECEDING
+        or (hi != CURRENT_ROW and hi < UNBOUNDED_FOLLOWING))
 
 
 class WindowSpec:

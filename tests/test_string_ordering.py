@@ -134,6 +134,19 @@ class TestStringAggregates:
             lambda s: s.create_dataframe(df, 3).group_by("k")
             .agg(F.min("s").alias("mn"), F.max("s").alias("mx")))
 
+    def test_group_max_prefix_tie_different_lengths(self, session, rng):
+        # P+'z' (shorter) > P+'aa' (longer): a length-ordered winner would
+        # be wrong, so the 64-byte-prefix tie must trigger refinement even
+        # though the length key differs (regression test)
+        base = "p" * 64
+        df = pd.DataFrame({
+            "k": [1, 1, 2, 2],
+            "s": [base + "z", base + "aa", base + "b", base + "ab"],
+        })
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 1).group_by("k")
+            .agg(F.min("s").alias("mn"), F.max("s").alias("mx")))
+
     def test_global_min_max(self, session, rng):
         df = _str_df(rng)
         assert_tpu_and_cpu_equal(

@@ -116,21 +116,82 @@ def test_one_sided_row_frame_min_max(session, rng):
         .with_column("mx", F.max("q").over(w2)), approx=True)
 
 
-def test_window_fallback_reason(session, rng):
-    """min over a bounded ROW frame wider than the device threshold falls
-    back with a readable reason (the reference's hallmark
-    explain-why-not)."""
+def test_wide_bounded_row_frame_min_max(session, rng):
+    """ROW frames wider than the unroll threshold use the sparse-table
+    variable-window kernel."""
+    df = _df(rng)
+    w = (Window.partition_by("g").order_by("ts", "q")
+         .rows_between(-40, 3))
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2)
+        .with_column("mn", F.min("v").over(w))
+        .with_column("mx", F.max("q").over(w)), approx=True)
+
+
+def test_bounded_range_frame(session, rng):
+    """Bounded RANGE frames (the reference's time-range windows,
+    GpuWindowExpression.scala:198): per-row binary search on device."""
+    df = _df(rng)
+    w = Window.partition_by("g").order_by("ts").range_between(-5, 3)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2)
+        .with_column("rs", F.sum("v").over(w))
+        .with_column("rc", F.count("v").over(w))
+        .with_column("rmn", F.min("v").over(w))
+        .with_column("rmx", F.max("q").over(w))
+        .with_column("ra", F.avg("v").over(w)), approx=True)
+
+
+def test_bounded_range_nullable_order(session, rng):
+    """Null order values frame over the segment's null run (nulls are
+    peers)."""
+    df = _df(rng)
+    df["tsn"] = pd.Series(df["ts"]).astype("Int64").mask(
+        pd.Series(rng.random(len(df)) < 0.2))
+    w = Window.partition_by("g").order_by("tsn").range_between(-4, 0)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2)
+        .with_column("rs", F.sum("q").over(w)), approx=True)
+
+
+def test_bounded_range_one_sided(session, rng):
     df = _df(rng)
     w = (Window.partition_by("g").order_by("ts")
-         .rows_between(-400, Window.currentRow))
+         .range_between(Window.unboundedPreceding, 3))
+    w2 = (Window.partition_by("g").order_by("ts")
+          .range_between(-5, Window.unboundedFollowing))
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2)
+        .with_column("a", F.sum("q").over(w))
+        .with_column("b", F.min("q").over(w2)))
+
+
+def test_window_fallback_reason(session, rng):
+    """Bounded RANGE over a float order column falls back with a readable
+    reason (the reference's hallmark explain-why-not); the CPU oracle
+    executes it (incl. NaN-run peer semantics)."""
+    df = _df(rng)
+    df["fv"] = rng.uniform(0, 20, len(df))
+    w = (Window.partition_by("g").order_by("fv").range_between(-2, 2))
     q = lambda s: (s.create_dataframe(df, 2)  # noqa: E731
-                   .with_column("m", F.min("v").over(w)))
+                   .with_column("m", F.sum("q").over(w)))
     assert_tpu_and_cpu_equal(q, allow_non_tpu=["CpuWindowExec"],
                              approx=True)
     from tests.querytest import with_tpu_session
     import pytest as _pytest
     with _pytest.raises(AssertionError, match="did not run on the TPU"):
         with_tpu_session(q)
+
+
+def test_bounded_range_descending_falls_back(session, rng):
+    """Descending bounded RANGE runs correctly on the CPU oracle."""
+    df = _df(rng)
+    w = (Window.partition_by("g").order_by(F.col("ts").desc())
+         .range_between(-3, 1))
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2)
+        .with_column("m", F.sum("q").over(w)),
+        allow_non_tpu=["CpuWindowExec"])
 
 
 def test_window_over_strings_partition(session, rng):
