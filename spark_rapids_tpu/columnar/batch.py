@@ -167,24 +167,40 @@ class DeviceBatch:
         All column buffers (and the row count) ride one batched
         ``jax.device_get`` — per-buffer fetches pay a full round trip each
         on remote attachments (~hundreds of ms)."""
+        return DeviceBatch.to_pandas_many([self])[0]
+
+    @staticmethod
+    def to_pandas_many(batches: Sequence["DeviceBatch"]) -> List[pd.DataFrame]:
+        """Convert many batches with TWO total device->host round trips
+        (row counts, then every batch's buffers) — the whole-query output
+        fetch of collect() rides this, so the sync count is independent of
+        the partition count."""
         import jax
-        if self._host_rows is None:
-            self._host_rows = int(jax.device_get(self.num_rows))
-        n = self._host_rows
-        views = [col.device_views(n) for col in self.columns]
-        host = jax.device_get(views)
-        series: List[pd.Series] = []
-        for dt, col, parts in zip(self.schema.dtypes, self.columns, host):
-            values, validity = col.numpy_from_host(parts, n)
-            series.append(_numpy_to_pandas(values, validity, dt)
-                          .reset_index(drop=True))
-        if not series:
-            return pd.DataFrame(index=range(n))
-        # positional construction: join outputs may carry duplicate column
-        # names (both sides keep their key column, like Spark)
-        df = pd.concat(series, axis=1)
-        df.columns = list(self.schema.names)
-        return df
+        need = [b for b in batches if b._host_rows is None]
+        if need:
+            counts = jax.device_get([b.num_rows for b in need])
+            for b, c in zip(need, counts):
+                b._host_rows = int(c)
+        all_views = [[col.device_views(b._host_rows) for col in b.columns]
+                     for b in batches]
+        host = jax.device_get(all_views)
+        out: List[pd.DataFrame] = []
+        for b, host_cols in zip(batches, host):
+            n = b._host_rows
+            series: List[pd.Series] = []
+            for dt, col, parts in zip(b.schema.dtypes, b.columns, host_cols):
+                values, validity = col.numpy_from_host(parts, n)
+                series.append(_numpy_to_pandas(values, validity, dt)
+                              .reset_index(drop=True))
+            if not series:
+                out.append(pd.DataFrame(index=range(n)))
+                continue
+            # positional construction: join outputs may carry duplicate
+            # column names (both sides keep their key column, like Spark)
+            df = pd.concat(series, axis=1)
+            df.columns = list(b.schema.names)
+            out.append(df)
+        return out
 
     @staticmethod
     def empty(schema: Schema, capacity: int = MIN_CAPACITY) -> "DeviceBatch":
@@ -215,6 +231,10 @@ def _pandas_col_dtype(s: pd.Series) -> DType:
     if name in mapping:
         return mapping[name]
     if name.startswith("datetime64"):
+        # NOTE: logical dates also land here (host convention: dates ride
+        # as datetime64 -> micros); the srt_logical_dtype attrs marker
+        # tells date-aware consumers (Cast to string) without changing the
+        # micros unpack every datetime consumer assumes
         return dtypes.TIMESTAMP_US
     if name in ("object", "str", "string"):
         return dtypes.STRING
@@ -264,6 +284,9 @@ def _numpy_to_pandas(values: np.ndarray, validity: np.ndarray,
         s = pd.Series(out)
         if has_nulls:
             s = s.mask(~validity)
+        # pandas cannot hold datetime64[D]; mark the logical date type so
+        # host dtype dispatch (series_dtype) does not read it as timestamp
+        s.attrs["srt_logical_dtype"] = "date32"
         return s
     if dt == dtypes.TIMESTAMP_US:
         out = values.astype("datetime64[us]")

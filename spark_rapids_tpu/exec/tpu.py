@@ -566,8 +566,15 @@ class TpuShuffleExchangeExec(TpuExec):
 
         assert kind in ("hash", "range")
         n = self.partitioning[-1]
-        slice_kernel = cached_jit("slice", lambda: jax.jit(
-            lambda b, start, count: rowops.slice_batch(b, start, count)))
+
+        def slice_kernel(b: DeviceBatch, start, count, rows: int):
+            # shrink to the bucket of the KNOWN row count: post-aggregate
+            # pieces stop inheriting the pre-aggregate capacity, so the
+            # downstream merge/sort kernels run at the output's true scale
+            out_cap = bucket_capacity(max(rows, 1), growth)
+            kern = cached_jit(f"slice|{out_cap}", lambda: jax.jit(
+                lambda bb, s, c: rowops.slice_batch_to(bb, s, c, out_cap)))
+            return kern(b, start, count)
 
         # materialization barrier: partition every child batch once,
         # bucket the slices
@@ -643,7 +650,8 @@ class TpuShuffleExchangeExec(TpuExec):
                         piece = slice_kernel(
                             sorted_batch,
                             jnp.asarray(offsets[pid], jnp.int32),
-                            jnp.asarray(host_counts[pid], jnp.int32))
+                            jnp.asarray(host_counts[pid], jnp.int32),
+                            int(host_counts[pid]))
                         buckets[pid].append(piece)
             state["buckets"] = buckets
             return buckets

@@ -32,6 +32,7 @@ from spark_rapids_tpu.sql.window import (
 
 _AGG_KINDS = {Sum: "sum", Count: "count", Min: "min", Max: "max",
               Average: "avg"}
+_MICROS_PER_DAY = 86_400_000_000
 
 
 def resolve_descriptor(wexpr: WindowExpression, schema: Schema):
@@ -49,14 +50,14 @@ def resolve_descriptor(wexpr: WindowExpression, schema: Schema):
     if isinstance(fn, DenseRank):
         return ("dense_rank",), None, None
     if isinstance(fn, LeadLag):
-        if fn.default is not None:
-            return None, None, "lead/lag with a default value is not supported"
         off = fn.offset if fn.is_lead else -fn.offset
         child = fn.children[0]
+        cdt = child.dtype(schema)
         err = None
-        if child.dtype(schema).is_string:
-            err = "lead/lag over strings is not supported on TPU"
-        return ("leadlag", None, off, child.dtype(schema).name), child, err
+        if fn.default is not None and (cdt.is_string or cdt.is_datetime):
+            err = (f"lead/lag default values over {cdt.name} are not "
+                   "supported on TPU")
+        return ("leadlag", None, off, cdt.name, fn.default), child, err
     kind = _AGG_KINDS.get(type(fn))
     if kind is None:
         return None, None, (f"window function {fn.pretty_name} "
@@ -85,7 +86,16 @@ def resolve_descriptor(wexpr: WindowExpression, schema: Schema):
             err = ("bounded RANGE over a floating-point order column is "
                    "not supported on TPU")
     if child.dtype(schema).is_string:
-        err = f"window {kind} over strings is not supported on TPU"
+        whole = (lo <= UNBOUNDED_PRECEDING and hi >= UNBOUNDED_FOLLOWING)
+        if kind == "count":
+            pass  # count only consumes validity — any frame works
+        elif kind in ("min", "max") and not whole:
+            err = (f"window {kind} over strings supports only "
+                   "whole-partition frames on TPU")
+        elif kind not in ("min", "max"):
+            err = f"window {kind} over strings is not supported on TPU"
+        else:
+            err = None
     return ("agg", kind, None, frame_kind, lo, hi,
             wexpr.dtype(schema).name), child, err
 
@@ -251,6 +261,11 @@ class CpuWindowExec(PhysicalPlan):
             dt = wexpr.dtype(cs)
             if value_expr is not None:
                 v, m, _ = host_unary_values(value_expr.eval_host(sdf))
+                if value_expr.dtype(cs) == dtypes.DATE32 and \
+                        v.dtype.kind != "O":
+                    # host dates ride as midnight micros; window math and
+                    # DATE32 result columns work in days like the device
+                    v = v.astype(np.int64) // _MICROS_PER_DAY
             kind = desc[0]
             if kind == "row_number":
                 data, validity = pos - seg_start + 1, np.ones(n, bool)
@@ -263,18 +278,30 @@ class CpuWindowExec(PhysicalPlan):
                 data = pb - pb[seg_start] + 1
                 validity = np.ones(n, bool)
             elif kind == "leadlag":
-                off = desc[2]
+                off, default = desc[2], desc[4]
                 src = pos + off
                 ok = (src >= seg_start) & (src <= seg_end)
                 src_c = np.clip(src, 0, n - 1)
-                data = np.where(ok, v[src_c], np.zeros_like(v[src_c]))
                 validity = ok & m[src_c]
+                if default is not None:
+                    if dt.is_datetime:  # device tags this off; oracle runs it
+                        ns = pd.Timestamp(default).value
+                        default = (ns // (_MICROS_PER_DAY * 1000)
+                                   if dt == dtypes.DATE32 else ns // 1000)
+                    data = np.where(ok, v[src_c], default)
+                    validity = validity | ~ok
+                else:
+                    data = np.where(ok, v[src_c], np.zeros_like(v[src_c]))
             else:
                 _, agg_kind, _, frame_kind, lo, hi, _ = desc
                 mm = m.copy()
                 range_bounded = is_bounded_range(frame_kind, lo, hi)
                 if range_bounded:
                     ovv, ovm = order_cols[0]
+                    if spec.orders[0].expr.dtype(cs) == dtypes.DATE32:
+                        # offsets are DAYS for date order columns (device
+                        # kernels see int32 days; host dates ride as micros)
+                        ovv = ovv.astype(np.int64) // _MICROS_PER_DAY
                     f_lo, f_hi = _host_bounded_range_extents(
                         ovv, ovm, part_b, lo, hi,
                         spec.orders[0].ascending, seg_start, seg_end)
@@ -302,6 +329,32 @@ class CpuWindowExec(PhysicalPlan):
                     data = (s / np.maximum(fcount, 1) if agg_kind == "avg"
                             else s)
                     validity = fcount > 0
+                elif v.dtype == object:  # string min/max
+                    pick = min if agg_kind == "min" else max
+                    data = np.empty(n, dtype=object)
+                    validity = np.zeros(n, bool)
+                    whole_ = (lo <= UNBOUNDED_PRECEDING
+                              and hi >= UNBOUNDED_FOLLOWING)
+                    if whole_:
+                        sts = np.flatnonzero(part_b)
+                        eds = np.r_[sts[1:] - 1, n - 1]
+                        for s0, e0 in zip(sts, eds):
+                            vals = [x for x, ok in
+                                    zip(v[s0:e0 + 1], mm[s0:e0 + 1]) if ok]
+                            if vals:
+                                data[s0:e0 + 1] = pick(vals)
+                                validity[s0:e0 + 1] = True
+                    else:
+                        # fallback-only shape (device handles whole
+                        # frames): direct per-row frame reduction
+                        for i in range(n):
+                            if f_hi[i] >= f_lo[i]:
+                                vals = [x for x, ok in zip(
+                                    v[f_lo_c[i]:f_hi_c[i] + 1],
+                                    mm[f_lo_c[i]:f_hi_c[i] + 1]) if ok]
+                                if vals:
+                                    data[i] = pick(vals)
+                                    validity[i] = True
                 else:  # min/max cumulative or whole partition
                     if v.dtype.kind == "f":
                         neutral = np.inf if agg_kind == "min" else -np.inf
@@ -334,9 +387,13 @@ class CpuWindowExec(PhysicalPlan):
                             if f_hi[i] >= f_lo[i]:
                                 data[i] = red(pre[f_lo_c[i]:f_hi_c[i] + 1])
                     validity = fcount > 0
-            result_series.append(_numpy_to_pandas(
-                np.asarray(data).astype(dt.np_dtype, copy=False),
-                np.asarray(validity), dt))
+            if dt.is_string:
+                out_arr = np.asarray(data, dtype=object)
+                out_arr = np.where(np.asarray(validity), out_arr, None)
+            else:
+                out_arr = np.asarray(data).astype(dt.np_dtype, copy=False)
+            result_series.append(_numpy_to_pandas(out_arr,
+                                                  np.asarray(validity), dt))
         out_schema = self.output_schema()
         frame = pd.concat([s.reset_index(drop=True)
                            for s in result_series], axis=1)
@@ -421,10 +478,40 @@ class TpuWindowExec(PhysicalPlan):
         growth = ctx.conf.capacity_growth
         child_parts = self.children[0].executed_partitions(ctx)
 
+        # string min/max columns come back as winner ROW INDICES plus the
+        # sorted source column (a per-row string broadcast can exceed any
+        # static char buffer) — finish them with a sized gather here
+        str_specs = [i for i, d in enumerate(descs)
+                     if d[0] == "agg" and d[1] in ("min", "max")
+                     and d[-1] == "string"]
+
+        def finalize(raw: DeviceBatch) -> DeviceBatch:
+            if not str_specs:
+                return raw
+            import jax.numpy as jnp
+            from spark_rapids_tpu.columnar.column import _char_bucket
+            from spark_rapids_tpu.ops.rowops import gather_column
+            k = len(str_specs)
+            cols = list(raw.columns[:len(raw.columns) - k])
+            srcs = raw.columns[len(raw.columns) - k:]
+            idx_cols = [cols[nc + si] for si in str_specs]
+            totals = jax.device_get([
+                jnp.sum(jnp.where(
+                    ic.validity,
+                    (src.offsets[1:] - src.offsets[:-1])[ic.data], 0))
+                for ic, src in zip(idx_cols, srcs)])
+            for si, ic, src, tot in zip(str_specs, idx_cols, srcs, totals):
+                cc = _char_bucket(int(tot))
+                gk = cached_jit(f"wstrgather|{cc}", lambda cc=cc: jax.jit(
+                    lambda c, w, vl: gather_column(
+                        c, w, vl, out_char_capacity=cc)))
+                cols[nc + si] = gk(src, ic.data, ic.validity)
+            return DeviceBatch(out_schema, cols, raw.num_rows)
+
         def make(part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
                 batches = list(part())
                 merged = _concat_device(batches, cs, growth)
-                yield kern(merged)
+                yield finalize(kern(merged))
             return run
         return [make(p) for p in child_parts]

@@ -144,9 +144,20 @@ def slice_batch(batch: DeviceBatch, start: jnp.ndarray,
                 count: jnp.ndarray) -> DeviceBatch:
     """Rows [start, start+count) compacted to the front (zero-copy-ish slice,
     the analogue of SlicedGpuColumnVector)."""
-    capacity = batch.capacity
-    idx = jnp.arange(capacity, dtype=jnp.int32)
-    perm = jnp.clip(idx + start.astype(jnp.int32), 0, capacity - 1)
+    return slice_batch_to(batch, start, count, batch.capacity)
+
+
+def slice_batch_to(batch: DeviceBatch, start: jnp.ndarray,
+                   count: jnp.ndarray, out_capacity: int) -> DeviceBatch:
+    """slice_batch gathering into an ``out_capacity``-row batch. Callers
+    that learn row counts on the host (the exchange's bucket split) use
+    this to SHRINK capacity, so downstream kernels stop paying for the
+    pre-aggregation padding (a 4-group result inheriting a 32k-row input
+    bucket would otherwise keep every later sort/agg at 32k)."""
+    idx = jnp.arange(out_capacity, dtype=jnp.int32)
+    perm = jnp.clip(idx + start.astype(jnp.int32), 0, batch.capacity - 1)
     n = jnp.minimum(count.astype(jnp.int32),
                     jnp.maximum(batch.num_rows - start.astype(jnp.int32), 0))
-    return gather_batch(batch, perm, n)
+    live = idx < n
+    cols = [gather_column(c, perm, live) for c in batch.columns]
+    return DeviceBatch(batch.schema, cols, n.astype(jnp.int32))

@@ -554,3 +554,188 @@ def initcap_ascii(col: DevCol) -> DevCol:
     return DevCol(dtypes.STRING,
                   jnp.where(word_start, uppered, lowered).astype(jnp.uint8),
                   col.validity, col.offsets)
+
+
+# ---------------------------------------------------------------------------
+# numeric <-> string casts (reference: GpuCast.scala:240-877 string arms —
+# cuDF renders/parses these on device; same here, with static char bounds)
+
+_POW10_TABLE = np.array([10 ** k for k in range(20)], dtype=np.uint64)
+
+
+def integral_to_string(ctx: EvalContext, data: jnp.ndarray,
+                       validity: jnp.ndarray) -> DevCol:
+    """Decimal rendering of an integral/bool-free column. Static char
+    bound: 20 digits + sign per row."""
+    cap = data.shape[0]
+    v = data.astype(jnp.int64)
+    neg = v < 0
+    # magnitude in uint64 (int64 min safe: -(v+1)+1)
+    mag = jnp.where(neg, (-(v + 1)).astype(jnp.uint64) + jnp.uint64(1),
+                    v.astype(jnp.uint64))
+    pow10 = jnp.asarray(_POW10_TABLE)
+    ndig = jnp.ones((cap,), jnp.int32)
+    for k in range(1, 20):
+        ndig = ndig + (mag >= pow10[k]).astype(jnp.int32)
+    lens = jnp.where(validity, ndig + neg.astype(jnp.int32), 0)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    out_chars = cap * 21
+    k = jnp.arange(out_chars, dtype=jnp.int32)
+    row = jnp.clip(
+        jnp.searchsorted(offsets, k, side="right").astype(jnp.int32) - 1,
+        0, cap - 1)
+    pos = k - offsets[row]
+    negr = neg[row]
+    sign_char = (pos == 0) & negr
+    j = pos - negr.astype(jnp.int32)
+    exp = jnp.clip(ndig[row] - 1 - j, 0, 19)
+    digit = ((mag[row] // pow10[exp]) % jnp.uint64(10)).astype(jnp.uint8)
+    ch = jnp.where(sign_char, jnp.uint8(ord("-")),
+                   jnp.uint8(ord("0")) + digit)
+    total = offsets[cap]
+    chars = jnp.where(k < total, ch, 0).astype(jnp.uint8)
+    return DevCol(dtypes.STRING, chars, validity, offsets)
+
+
+def strings_from_choices(ctx: EvalContext, idx: jnp.ndarray,
+                         choices, validity: jnp.ndarray) -> DevCol:
+    """Per-row selection from a static list of literal strings (bool
+    rendering, month names, ...)."""
+    cap = idx.shape[0]
+    enc = [str(c).encode("utf-8") for c in choices]
+    packed = np.frombuffer(b"".join(enc), np.uint8) if any(enc) else \
+        np.zeros(1, np.uint8)
+    lit_lens = np.array([len(e) for e in enc], np.int32)
+    lit_starts = np.concatenate(
+        [[0], np.cumsum(lit_lens)[:-1]]).astype(np.int32)
+    ll, ls = jnp.asarray(lit_lens), jnp.asarray(lit_starts)
+    pk = jnp.asarray(packed)
+    sel = jnp.clip(idx.astype(jnp.int32), 0, len(enc) - 1)
+    lens = jnp.where(validity, ll[sel], 0)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    out_chars = cap * max(1, int(lit_lens.max()) if len(enc) else 1)
+    k = jnp.arange(out_chars, dtype=jnp.int32)
+    row = jnp.clip(
+        jnp.searchsorted(offsets, k, side="right").astype(jnp.int32) - 1,
+        0, cap - 1)
+    pos = k - offsets[row]
+    src = jnp.clip(ls[sel[row]] + pos, 0, pk.shape[0] - 1)
+    total = offsets[cap]
+    chars = jnp.where(k < total, pk[src], 0).astype(jnp.uint8)
+    return DevCol(dtypes.STRING, chars, validity, offsets)
+
+
+def civil_from_days(days: jnp.ndarray):
+    """days-since-epoch -> (year, month, day), Hinnant's civil_from_days
+    with floor division (correct for pre-1970)."""
+    z = days.astype(jnp.int64) + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def date_to_string(ctx: EvalContext, days: jnp.ndarray,
+                   validity: jnp.ndarray) -> DevCol:
+    """'yyyy-MM-dd' rendering (render range clamped to years 0..9999,
+    like the reference's UTC-era support taxonomy)."""
+    cap = days.shape[0]
+    y, m, d = civil_from_days(days)
+    y = jnp.clip(y, 0, 9999)
+    dash = jnp.full((cap,), ord("-"), jnp.int64)
+    zero = jnp.uint8(ord("0"))
+    comps = [zero + (y // 1000 % 10).astype(jnp.uint8),
+             zero + (y // 100 % 10).astype(jnp.uint8),
+             zero + (y // 10 % 10).astype(jnp.uint8),
+             zero + (y % 10).astype(jnp.uint8),
+             dash.astype(jnp.uint8),
+             zero + (m // 10 % 10).astype(jnp.uint8),
+             zero + (m % 10).astype(jnp.uint8),
+             dash.astype(jnp.uint8),
+             zero + (d // 10 % 10).astype(jnp.uint8),
+             zero + (d % 10).astype(jnp.uint8)]
+    table = jnp.stack(comps, axis=1).reshape(-1)  # (cap*10,)
+    lens = jnp.where(validity, 10, 0).astype(jnp.int32)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens)])
+    out_chars = cap * 10
+    k = jnp.arange(out_chars, dtype=jnp.int32)
+    row = jnp.clip(
+        jnp.searchsorted(offsets, k, side="right").astype(jnp.int32) - 1,
+        0, cap - 1)
+    pos = k - offsets[row]
+    ch = table[jnp.clip(row * 10 + pos, 0, cap * 10 - 1)]
+    total = offsets[cap]
+    chars = jnp.where(k < total, ch, 0).astype(jnp.uint8)
+    return DevCol(dtypes.STRING, chars, validity, offsets)
+
+
+def string_to_integral(ctx: EvalContext, col: DevCol, dst):
+    """Parse decimal strings -> (int64 data, validity). Accepted form:
+    optional surrounding ASCII whitespace, optional sign, >=1 integer
+    digits, optional '.digits*' tail (truncated) — the same rule as the
+    host oracle; anything else (incl. exponent forms) and out-of-range
+    values become NULL (non-ANSI)."""
+    capacity = ctx.capacity
+    nchars = col.data.shape[0]
+    i = jnp.arange(nchars, dtype=jnp.int32)
+    row_ids = _char_row_ids(col, capacity)
+    total = col.offsets[capacity]
+    live = i < total
+    data = col.data
+    is_ws = (data == 32) | (data == 9) | (data == 10) | (data == 13)
+    non_ws = (~is_ws) & live
+    big = jnp.int32(2 ** 30)
+    first = jnp.minimum(jax.ops.segment_min(
+        jnp.where(non_ws, i, big), row_ids, num_segments=capacity), big)
+    last = jnp.maximum(jax.ops.segment_max(
+        jnp.where(non_ws, i, -1), row_ids, num_segments=capacity), -1)
+    first_ch = data[jnp.clip(first, 0, nchars - 1)]
+    neg = first_ch == ord("-")
+    has_sign = neg | (first_ch == ord("+"))
+    dstart = first + has_sign.astype(jnp.int32)
+    # optional fractional tail: integer digits end before the first '.'
+    dot = jnp.minimum(jax.ops.segment_min(
+        jnp.where(live & (data == ord(".")) & (i >= dstart[row_ids])
+                  & (i <= last[row_ids]), i, big),
+        row_ids, num_segments=capacity), big)
+    has_dot = dot <= last
+    int_end = jnp.where(has_dot, dot - 1, last)
+    ndig = int_end - dstart + 1
+    is_digit = (data >= 48) & (data <= 57)
+    # every char in [dstart, last] must be a digit except the single dot
+    checked = live & (i >= dstart[row_ids]) & (i <= last[row_ids])
+    ok_char = is_digit | ((data == ord(".")) & (i == dot[row_ids]))
+    bad_any = jax.ops.segment_max(
+        (checked & ~ok_char).astype(jnp.int32), row_ids,
+        num_segments=capacity) > 0
+    pow10 = jnp.asarray(_POW10_TABLE)
+    in_int = checked & is_digit & (i <= int_end[row_ids])
+    weight = jnp.clip(int_end[row_ids] - i, 0, 19)
+    contrib = jnp.where(in_int,
+                        (data - 48).astype(jnp.uint64) * pow10[weight],
+                        jnp.uint64(0))
+    mag = jax.ops.segment_sum(contrib, row_ids, num_segments=capacity)
+    # magnitude bound counts SIGNIFICANT digits — '0000…001' is one digit
+    # no matter how many leading zeros (they contribute nothing to mag)
+    sig = jnp.minimum(jax.ops.segment_min(
+        jnp.where(in_int & (data != ord("0")), i, big), row_ids,
+        num_segments=capacity), big)
+    nsig = jnp.where(sig <= int_end, int_end - sig + 1, 0)
+    ok = (col.validity & (ndig >= 1) & (nsig <= 19) & ~bad_any)
+    lim = jnp.uint64(1) << jnp.uint64(63)
+    ok = ok & jnp.where(neg, mag <= lim, mag <= lim - jnp.uint64(1))
+    val = mag.astype(jnp.int64)
+    val = jnp.where(neg, -val, val)
+    info = np.iinfo(dst.np_dtype)
+    if info.bits < 64:
+        ok = ok & (val >= info.min) & (val <= info.max)
+    return val, ok

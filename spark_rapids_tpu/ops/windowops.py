@@ -37,7 +37,7 @@ from spark_rapids_tpu.sql.window import (
 
 # one window function descriptor (static):
 #   ("row_number",) | ("rank",) | ("dense_rank",)
-#   ("leadlag", value_idx, offset, out_dtype_name)       offset<0 = lag
+#   ("leadlag", value_idx, offset, out_dtype_name, default)  offset<0 = lag
 #   ("agg", kind, value_idx, frame_kind, lo, hi, out_dtype_name)
 #     kind in sum|count|min|max|avg; frame_kind rows|range
 
@@ -164,6 +164,7 @@ def window_compute(batch: DeviceBatch, num_child_cols: int,
     peer_end = peer_end_by_id[peer]
 
     out_cols: List[DeviceColumn] = list(sorted_b.columns[:num_child_cols])
+    post_sources: List[DeviceColumn] = []  # string-agg gather sources
 
     for spec, dt in zip(specs, out_schema.dtypes[num_child_cols:]):
         kind = spec[0]
@@ -185,14 +186,26 @@ def window_compute(batch: DeviceBatch, num_child_cols: int,
             out_cols.append(DeviceColumn(dt, data, live))
             continue
         if kind == "leadlag":
-            _, vidx, offset, _ = spec
+            _, vidx, offset, _, default = spec
             vcol = sorted_b.columns[vidx]
             src = pos + offset
             ok = (src >= seg_start) & (src <= seg_end) & live
             src_c = jnp.clip(src, 0, cap - 1)
+            if vcol.dtype.is_string:
+                from spark_rapids_tpu.ops.rowops import gather_column
+                out_cols.append(
+                    gather_column(vcol, src_c, ok & vcol.validity[src_c]))
+                continue
             data = vcol.data[src_c]
             validity = ok & vcol.validity[src_c]
-            data = jnp.where(ok, data, jnp.zeros_like(data))
+            if default is not None:
+                # Spark: default fills rows whose OFFSET ROW is outside
+                # the partition; an in-partition null stays null
+                dval = jnp.asarray(default, dt.np_dtype)
+                data = jnp.where(ok, data, dval)
+                validity = validity | (live & ~ok)
+            else:
+                data = jnp.where(ok, data, jnp.zeros_like(data))
             out_cols.append(DeviceColumn(dt, data.astype(dt.np_dtype),
                                          validity))
             continue
@@ -279,6 +292,25 @@ def window_compute(batch: DeviceBatch, num_child_cols: int,
             out_cols.append(DeviceColumn(dt, data, validity))
             continue
         assert agg_kind in ("min", "max")
+        if vcol.dtype.is_string:
+            # whole-partition string min/max (resolve_descriptor gates the
+            # frames): per-segment winner row via the group-by string
+            # selection machinery (rows are already partition-sorted, so
+            # the identity permutation makes a valid GroupInfo). The
+            # winner's bytes are NOT broadcast here — repeating a string
+            # per row can exceed any static char buffer, so this emits the
+            # winner ROW INDEX; the exec's post-gather pass sizes the char
+            # buffer from a host-synced total and materializes the column
+            # (the one string-window op that needs a second kernel).
+            from spark_rapids_tpu.ops import groupby as gbops
+            info = gbops.GroupInfo(pos, seg, part_boundary, None, None)
+            rows_by_gid, has_by_gid = gbops.segment_select_string(
+                agg_kind, vcol, info)
+            win = rows_by_gid[seg].astype(jnp.int32)
+            valid = has_by_gid[seg] & live
+            out_cols.append(DeviceColumn(dtypes.INT32, win, valid))
+            post_sources.append(vcol)
+            continue
         if jnp.issubdtype(v.dtype, jnp.floating):
             neutral = jnp.inf if agg_kind == "min" else -jnp.inf
         elif v.dtype == jnp.bool_:
@@ -325,4 +357,13 @@ def window_compute(batch: DeviceBatch, num_child_cols: int,
             data = data.astype(jnp.bool_)
         out_cols.append(DeviceColumn(dt, data.astype(dt.np_dtype), validity))
 
+    if post_sources:
+        # string-agg winner indices need an exec-level sized gather; ship
+        # the sorted source columns alongside (internal schema — the exec
+        # restores out_schema after the post-gather)
+        names = list(out_schema.names) + [
+            f"_wsrc{i}" for i in range(len(post_sources))]
+        dts = [c.dtype for c in out_cols] + [c.dtype for c in post_sources]
+        return DeviceBatch(Schema(names, dts), out_cols + post_sources,
+                           sorted_b.num_rows)
     return DeviceBatch(out_schema, out_cols, sorted_b.num_rows)

@@ -113,7 +113,6 @@ class TpuSparkSession:
         from spark_rapids_tpu.sql.overrides import (
             TpuOverrides, TransitionOverrides, assert_is_on_tpu,
         )
-        from spark_rapids_tpu.exec.transitions import DeviceToHostExec
 
         conf = self.conf
         ctx = ExecContext(conf, self)
@@ -128,12 +127,25 @@ class TpuSparkSession:
         if self.capture_plans:
             self.captured_plans.append(plan)
         # final output to host
-        if plan.columnar_output:
-            plan = DeviceToHostExec(plan)
         outs: List[pd.DataFrame] = []
-        for part in plan.executed_partitions(ctx):
-            for df in part():
-                outs.append(df)
+        if plan.columnar_output:
+            # drain every partition's device batches first, then convert
+            # with to_pandas_many: TWO device->host round trips for the
+            # whole result set instead of two per output partition
+            from spark_rapids_tpu.columnar.batch import DeviceBatch
+            final = plan
+            batches: List[DeviceBatch] = []
+            for part in final.executed_partitions(ctx):
+                try:
+                    batches.extend(part())
+                finally:
+                    if self.semaphore is not None:
+                        self.semaphore.release()
+            outs = DeviceBatch.to_pandas_many(batches)
+        else:
+            for part in plan.executed_partitions(ctx):
+                for df in part():
+                    outs.append(df)
         # per-operator SQL metrics of the last executed query (the
         # reference surfaces these in the Spark UI, GpuExec.scala:61-67)
         self.last_query_metrics = ctx.metrics

@@ -128,9 +128,14 @@ def _cast_strings_host(values, validity, src: DType, dst: DType):
                 else:
                     raise ValueError(text)
             elif dst.is_integral:
-                # Spark accepts trailing .xxx by truncating via double
-                v = int(float(text)) if "." in text or "e" in text.lower() \
-                    else int(text)
+                # accepted form shared with the device parser
+                # (ops/strings.py string_to_integral): optional sign,
+                # >=1 integer digits, optional truncated '.digits*' tail;
+                # exponent forms are NULL
+                import re
+                if not re.match(r"^[+-]?\d+(\.\d*)?$", text, re.ASCII):
+                    raise ValueError(text)
+                v = int(text.split(".")[0])
                 lo, hi = _INT_RANGE[dst.name]
                 if not (lo <= v <= hi):
                     raise ValueError(text)
@@ -178,25 +183,82 @@ class Cast(Expression):
     def sql_name(self, schema=None) -> str:
         return f"CAST({self.children[0].sql_name(schema)} AS {self.to.name})"
 
+    @staticmethod
+    def _string_to_integral_enabled() -> bool:
+        from spark_rapids_tpu.session import TpuSparkSession
+        s = TpuSparkSession._active
+        return bool(s and s.conf.get(
+            "spark.rapids.sql.castStringToInteger.enabled"))
+
     def device_supported(self, schema: Schema) -> Optional[str]:
         src = self.children[0].dtype(schema)
         if src == self.to:
             return None
-        if src.is_string or self.to.is_string:
-            return (f"cast {src} -> {self.to} involves strings and is gated "
-                    "off by default (see spark.rapids.sql.castStringTo*)")
+        if self.to.is_string:
+            # to-string renders on device like cuDF's castTo
+            # (GpuCast.scala:240-877) for integral/bool/date sources;
+            # float/timestamp formatting stays host-side
+            if src.is_integral or src == dtypes.BOOL or src == dtypes.DATE32:
+                return None
+            return (f"cast {src} -> string formatting is not supported "
+                    "on TPU")
+        if src.is_string:
+            if self.to.is_integral and self._string_to_integral_enabled():
+                return None
+            return (f"cast {src} -> {self.to} involves string parsing and "
+                    "is gated off by default "
+                    "(see spark.rapids.sql.castStringTo*)")
         if not _castable(src, self.to):
             return f"cast {src} -> {self.to} is not supported"
         return None
 
     def eval_device(self, ctx: EvalContext) -> DevValue:
         v = self.children[0].eval_device(ctx)
+        if v.dtype == self.to:
+            return v
+        if self.to.is_string or v.dtype.is_string:
+            return self._eval_device_string(ctx, v)
         if isinstance(v, DevScalar):
             data, extra = cast_data(jnp, jnp.asarray(v.value), v.dtype, self.to)
             return DevScalar(self.to, data, v.valid)
         data, extra = cast_data(jnp, v.data, v.dtype, self.to)
         validity = v.validity if extra is None else v.validity & ~extra
         return DevCol(self.to, data, validity)
+
+    def _eval_device_string(self, ctx: EvalContext, v) -> DevValue:
+        from spark_rapids_tpu.ops import strings as string_ops
+        if isinstance(v, DevScalar) and v.dtype.is_string:
+            # string literals carry concrete python str values: parse at
+            # trace time, emit a typed scalar
+            if not v.valid:
+                return DevScalar(self.to,
+                                 None if self.to.is_string else jnp.asarray(
+                                     0, dtype=self.to.np_dtype), False)
+            host, hv = _cast_strings_host(
+                np.array([v.value], dtype=object),
+                np.array([True]), v.dtype, self.to)
+            if self.to.is_string:
+                return DevScalar(self.to, host[0], bool(hv[0]))
+            return DevScalar(
+                self.to, jnp.asarray(host[0], dtype=self.to.np_dtype),
+                bool(hv[0]))
+        if isinstance(v, DevScalar):
+            # numeric/bool/date scalar -> string: the value may be a
+            # tracer, so render through the column kernels on a broadcast
+            v = ctx.broadcast(v)
+        if self.to.is_string:
+            if v.dtype == dtypes.BOOL:
+                return string_ops.strings_from_choices(
+                    ctx, v.data.astype(jnp.int32), ["false", "true"],
+                    v.validity)
+            if v.dtype == dtypes.DATE32:
+                return string_ops.date_to_string(ctx, v.data, v.validity)
+            assert v.dtype.is_integral, v.dtype
+            return string_ops.integral_to_string(ctx, v.data, v.validity)
+        assert v.dtype.is_string and self.to.is_integral, (v.dtype, self.to)
+        data, ok = string_ops.string_to_integral(ctx, v, self.to)
+        return DevCol(self.to, data.astype(self.to.np_dtype),
+                      v.validity & ok)
 
     def eval_host(self, df: pd.DataFrame) -> pd.Series:
         s = self.children[0].eval_host(df)
@@ -205,6 +267,12 @@ class Cast(Expression):
         # the logical dtype, not the unpacked numpy dtype: timestamps/dates
         # unpack to int64 micros / int32 days and would mis-dispatch
         src = series_dtype(s)
+        if (self.to.is_string and src == dtypes.TIMESTAMP_US
+                and s.attrs.get("srt_logical_dtype") == "date32"):
+            # logically a date riding as midnight micros (host convention):
+            # unpack to days so string rendering says 'yyyy-MM-dd'
+            src = dtypes.DATE32
+            values = values.astype(np.int64) // 86_400_000_000
         if src.is_string or self.to.is_string:
             data, validity = _cast_strings_host(values, validity, src,
                                                 self.to)

@@ -279,7 +279,13 @@ class BoundRef(Expression):
         return ctx.cols[self.index]
 
     def eval_host(self, df: pd.DataFrame) -> pd.Series:
-        return df.iloc[:, self.index]
+        s = df.iloc[:, self.index]
+        if self._dtype == dtypes.DATE32:
+            # host dates ride as datetime64 micros; mark the logical type
+            # for date-aware consumers (shallow copy: attrs are per-object)
+            s = s.copy(deep=False)
+            s.attrs["srt_logical_dtype"] = "date32"
+        return s
 
 
 class Alias(Expression):

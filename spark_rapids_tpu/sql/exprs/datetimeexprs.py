@@ -197,8 +197,10 @@ class DateAdd(Expression):
         # host dates ride as datetime64->micros; truncate to the day first
         # (Spark casts timestamp inputs to date) then add days in micro space
         days = days_from_micros(np, a) + b.astype(np.int64)
-        return rebuild_series(days * MICROS_PER_DAY, av & bv,
-                              dtypes.TIMESTAMP_US, index)
+        out = rebuild_series(days * MICROS_PER_DAY, av & bv,
+                             dtypes.TIMESTAMP_US, index)
+        out.attrs["srt_logical_dtype"] = "date32"
+        return out
 
 
 class Quarter(ExtractDatePart):
@@ -275,8 +277,10 @@ class LastDay(Expression):
         values, validity, index = host_unary_values(self.children[0].eval_host(df))
         days = days_from_micros(np, values)   # host datetimes ride as micros
         out_days = self._compute(np, days).astype(np.int64)
-        return rebuild_series(out_days * MICROS_PER_DAY, validity,
-                              dtypes.TIMESTAMP_US, index)
+        out = rebuild_series(out_days * MICROS_PER_DAY, validity,
+                             dtypes.TIMESTAMP_US, index)
+        out.attrs["srt_logical_dtype"] = "date32"
+        return out
 
 
 class DateSub(DateAdd):
@@ -296,8 +300,10 @@ class DateSub(DateAdd):
         a, av, index = host_unary_values(self.children[0].eval_host(df))
         b, bv, _ = host_unary_values(self.children[1].eval_host(df))
         days = days_from_micros(np, a) - b.astype(np.int64)
-        return rebuild_series(days * MICROS_PER_DAY, av & bv,
-                              dtypes.TIMESTAMP_US, index)
+        out = rebuild_series(days * MICROS_PER_DAY, av & bv,
+                             dtypes.TIMESTAMP_US, index)
+        out.attrs["srt_logical_dtype"] = "date32"
+        return out
 
 
 class DateDiff(Expression):
@@ -366,8 +372,12 @@ class ToDate(Expression):
     def eval_host(self, df: pd.DataFrame) -> pd.Series:
         values, validity, index = host_unary_values(self.children[0].eval_host(df))
         days = days_from_micros(np, values)
-        return rebuild_series(days * MICROS_PER_DAY, validity,
-                              dtypes.TIMESTAMP_US, index)
+        out = rebuild_series(days * MICROS_PER_DAY, validity,
+                             dtypes.TIMESTAMP_US, index)
+        # host dates ride as midnight micros; mark the logical type for
+        # date-aware consumers (Cast renders 'yyyy-MM-dd', not a timestamp)
+        out.attrs["srt_logical_dtype"] = "date32"
+        return out
 
 
 class FromUnixTime(Expression):

@@ -45,13 +45,25 @@ class _GreatestLeast(Expression):
         return f"{fn}({args})"
 
     def device_supported(self, schema: Schema) -> Optional[str]:
-        for c in self.children:
-            if c.dtype(schema).is_string:
-                return "string operands are not supported on TPU"
         return None
 
     def eval_device(self, ctx: EvalContext) -> DevValue:
         dt = self.dtype(_schema_of(ctx))
+        if dt.is_string:
+            from spark_rapids_tpu.ops import strings as string_ops
+            out = None
+            for c in self.children:
+                nxt = ctx.broadcast(c.eval_device(ctx))
+                if out is None:
+                    out = nxt
+                    continue
+                cmp = string_ops.string_compare_columns(nxt, out)
+                win = (cmp > 0) if self.is_greatest else (cmp < 0)
+                better = jnp.where(out.validity & nxt.validity, win,
+                                   nxt.validity & ~out.validity)
+                out = string_ops.select_strings(
+                    ctx, better, nxt, out, out.validity | nxt.validity)
+            return out
         out_data = None
         out_valid = None
         for c in self.children:
@@ -84,6 +96,25 @@ class _GreatestLeast(Expression):
             dts.append(series_dtype(series))
             parts.append(host_unary_values(series))
         dt = functools.reduce(common_type, dts)
+        if dt.is_string:
+            out_data = None
+            out_valid = None
+            for vals, valid, _index in parts:
+                # fill invalid slots before comparing (None vs str raises);
+                # fills never win thanks to the validity gating
+                data = np.where(valid, np.asarray(vals, dtype=object), "")
+                if out_data is None:
+                    out_data, out_valid = data.copy(), valid.copy()
+                    continue
+                both = out_valid & valid
+                win = np.array(
+                    [(x > y) if self.is_greatest else (x < y)
+                     for x, y in zip(data, out_data)], dtype=bool)
+                better = np.where(both, win, valid & ~out_valid)
+                out_data = np.where(better, data, out_data)
+                out_valid = out_valid | valid
+            return rebuild_series(np.where(out_valid, out_data, None),
+                                  out_valid, dt, parts[0][2])
         out_data = None
         out_valid = None
         for vals, valid, index in parts:

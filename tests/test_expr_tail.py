@@ -104,6 +104,22 @@ class TestNullTail:
                 F.least("b", "c").alias("l"),
                 F.greatest(F.col("b"), F.lit(42)).alias("g2")))
 
+    def test_greatest_least_strings(self, session, rng):
+        """n-ary string extremum on device (exact byte-order comparator)."""
+        words = ["apple", "Banana", "", "zz", "a\x00b", None,
+                 "p" * 64 + "z", "p" * 64 + "aa"]
+        n = 60
+        df = pd.DataFrame({
+            "a": [words[int(rng.integers(0, len(words)))] for _ in range(n)],
+            "b": [words[int(rng.integers(0, len(words)))] for _ in range(n)],
+            "c": [words[int(rng.integers(0, len(words)))] for _ in range(n)],
+        })
+        sdf = session.create_dataframe(df, num_partitions=2)
+        assert_tpu_and_cpu_equal(
+            lambda s: sdf.select(
+                F.greatest("a", "b", "c").alias("g"),
+                F.least("a", "b", "c").alias("l")))
+
     def test_nvl(self, session):
         df = session.create_dataframe(_nums_df(), num_partitions=2)
         assert_tpu_and_cpu_equal(

@@ -1,0 +1,120 @@
+"""String-involving casts on device (reference: GpuCast.scala:240-877
+string arms — cuDF renders integral/bool/date to string by default; string
+parsing sits behind spark.rapids.sql.castStringTo* confs)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from tests.querytest import assert_tpu_and_cpu_equal, with_tpu_session
+
+
+def _df(rng, n=120):
+    dvals = (rng.integers(-30000, 60000, n)
+             .astype("datetime64[D]").astype("datetime64[s]"))
+    ints = rng.integers(-10**18, 10**18, n)
+    ints[:6] = [0, -1, 9223372036854775807, -9223372036854775808, 10, -100]
+    texts = [str(int(x)) for x in rng.integers(-10**12, 10**12, n)]
+    texts[:10] = ["  42 ", "-17", "+8", "3.99", "abc", "", "12.", "1e3",
+                  "9223372036854775807", "-9223372036854775808"]
+    return pd.DataFrame({
+        "i": pd.Series(ints).astype("Int64")
+               .mask(pd.Series(rng.random(n) < 0.1)),
+        "i32": rng.integers(-2**31, 2**31, n).astype(np.int32),
+        "bl": pd.Series(rng.random(n) < 0.5).astype("boolean")
+                .mask(pd.Series(rng.random(n) < 0.1)),
+        "d": dvals,
+        "st": pd.Series(texts, dtype=object)
+                .mask(pd.Series(rng.random(n) < 0.1)),
+    })
+
+
+class TestToString:
+    def test_integral_to_string(self, session, rng):
+        df = _df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(
+                F.col("i").cast("string").alias("si"),
+                F.col("i32").cast("string").alias("si32")))
+
+    def test_bool_to_string(self, session, rng):
+        df = _df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(
+                F.col("bl").cast("string").alias("sb")))
+
+    def test_date_to_string(self, session, rng):
+        df = _df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(
+                F.to_date(F.col("d")).cast("string").alias("sd")))
+
+    def test_date_arith_to_string(self, session, rng):
+        """date_add/last_day results render as dates, not timestamps."""
+        df = _df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(
+                F.date_add(F.to_date(F.col("d")), 31)
+                .cast("string").alias("sa"),
+                F.last_day(F.to_date(F.col("d")))
+                .cast("string").alias("sl")))
+
+    def test_float_to_string_falls_back(self, session, rng):
+        df = _df(rng)
+        df["f"] = rng.standard_normal(len(df))
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(
+                F.col("f").cast("string").alias("sf")),
+            allow_non_tpu=["CpuProjectExec"])
+
+
+class TestStringParse:
+    CONF = {"spark.rapids.sql.castStringToInteger.enabled": True}
+
+    def test_string_to_int_gated_off_by_default(self, session, rng):
+        df = _df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(
+                F.col("st").cast("long").alias("pl")),
+            allow_non_tpu=["CpuProjectExec"])
+
+    @pytest.mark.parametrize("to", ["int", "long", "short"])
+    def test_string_to_integral(self, session, rng, to):
+        df = _df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(
+                F.col("st").cast(to).alias("p")),
+            conf=self.CONF)
+
+    def test_string_literal_to_int(self, session, rng):
+        """A string LITERAL cast renders at trace time (regression: the
+        scalar path used to fall into the numeric cast and crash)."""
+        df = _df(rng)
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(
+                (F.col("i32") + F.lit("42").cast("int")).alias("x"),
+                F.lit(7).cast("string").alias("s7")),
+            conf=self.CONF)
+
+    def test_leading_zeros_long(self, session, rng):
+        """>19 chars of leading zeros still parse (significant digits
+        bound, not raw digit count)."""
+        df = pd.DataFrame({"st": ["00000000000000000001",
+                                  "-000000000000000000009",
+                                  "0" * 30, "0" * 30 + "7"]})
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 1).select(
+                F.col("st").cast("long").alias("p")),
+            conf=self.CONF)
+
+    def test_parse_edge_forms(self, session, rng):
+        """Sign/whitespace/fraction-truncation accepted; exponents, empty
+        and non-numeric text are NULL on both paths."""
+        df = pd.DataFrame({"st": ["  7 ", "+0", "-0", "08", "1.",
+                                  ".5", "1e3", " - 5", "--3", None,
+                                  "184467440737095516150", "3.9999"]})
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 1).select(
+                F.col("st").cast("long").alias("p")),
+            conf=self.CONF)

@@ -88,6 +88,31 @@ def test_lead_lag(session, rng):
         .with_column("prv", F.lag("v", 2).over(w)), approx=True)
 
 
+def test_lead_lag_strings(session, rng):
+    """lead/lag over string columns: shifted string gather on device.
+    Order key made unique — with ties, CPU and TPU may permute peer rows
+    differently and lead/lag of a non-key column is then ambiguous."""
+    df = _df(rng)
+    df["u"] = np.arange(len(df))
+    w = Window.partition_by("g").order_by("ts", "u")
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 3)
+        .with_column("nk", F.lead("k", 1).over(w))
+        .with_column("pk", F.lag("k", 2).over(w)))
+
+
+def test_lead_lag_default(session, rng):
+    """Defaults fill rows whose offset row is outside the partition; an
+    in-partition null stays null."""
+    df = _df(rng)
+    w = Window.partition_by("g").order_by("ts", "q")
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 3)
+        .with_column("nxt", F.lead("q", 1, default=-1).over(w))
+        .with_column("prv", F.lag("v", 2, default=0.5).over(w)),
+        approx=True)
+
+
 def test_bounded_row_frame_min_max(session, rng):
     """Sliding min/max over bounded ROW frames (unrolled-shift device
     kernel)."""
@@ -154,6 +179,34 @@ def test_bounded_range_nullable_order(session, rng):
         .with_column("rs", F.sum("q").over(w)), approx=True)
 
 
+def test_bounded_range_date_order(session, rng):
+    """Date order columns interpret RANGE offsets as DAYS on both paths
+    (regression: the oracle once framed in microseconds)."""
+    df = _df(rng)
+    df["dt"] = (rng.integers(18000, 18100, len(df))
+                .astype("datetime64[D]").astype("datetime64[s]"))
+    w = (Window.partition_by("g").order_by(F.to_date(F.col("dt")))
+         .range_between(-7, 0))
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2)
+        .with_column("wk", F.sum("q").over(w)))
+
+
+def test_lead_lag_datetime_default_falls_back(session, rng):
+    """Datetime defaults fall back to the oracle, which must execute them
+    (regression: it used to crash mixing Timestamp objects into int64)."""
+    df = _df(rng)
+    df["u"] = np.arange(len(df))
+    df["dt"] = (rng.integers(0, 10**6, len(df)) * 10**9
+                ).astype("datetime64[ns]")
+    w = Window.partition_by("g").order_by("u")
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2)
+        .with_column("p", F.lag("dt", 1,
+                                default=pd.Timestamp("2020-01-01")).over(w)),
+        allow_non_tpu=["CpuWindowExec"])
+
+
 def test_bounded_range_one_sided(session, rng):
     df = _df(rng)
     w = (Window.partition_by("g").order_by("ts")
@@ -191,6 +244,29 @@ def test_bounded_range_descending_falls_back(session, rng):
     assert_tpu_and_cpu_equal(
         lambda s: s.create_dataframe(df, 2)
         .with_column("m", F.sum("q").over(w)),
+        allow_non_tpu=["CpuWindowExec"])
+
+
+def test_window_string_min_max_whole_partition(session, rng):
+    """min/max over string values, whole-partition frame: winner-index
+    kernel + exec-level sized gather."""
+    df = _df(rng)
+    w = Window.partition_by("g")
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2)
+        .with_column("mn", F.min("k").over(w))
+        .with_column("mx", F.max("k").over(w))
+        .with_column("c", F.count("k").over(w)))
+
+
+def test_window_string_min_cumulative_falls_back(session, rng):
+    """Cumulative string min falls back with a reason; the oracle computes
+    it."""
+    df = _df(rng)
+    w = Window.partition_by("g").order_by("ts")
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2)
+        .with_column("mn", F.min("k").over(w)),
         allow_non_tpu=["CpuWindowExec"])
 
 
