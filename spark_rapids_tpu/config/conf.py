@@ -185,6 +185,12 @@ ENABLE_CAST_FLOAT_TO_STRING = register(
     "Enable casting floating point to strings on the TPU; formatting differs "
     "from Java's in corner cases.")
 
+ENABLE_CAST_STRING_TO_DATE = register(
+    "spark.rapids.sql.castStringToDate.enabled", _to_bool, False,
+    "Enable casting strings to dates on the TPU (yyyy-MM-dd prefix form, "
+    "roundtrip-validated calendar). Disabled by default like the "
+    "reference's string-to-timestamp taxonomy.")
+
 # --- file formats (ref RapidsConf.scala:433-474) ---------------------------
 PARQUET_ENABLED = register(
     "spark.rapids.sql.format.parquet.enabled", _to_bool, True,

@@ -678,6 +678,29 @@ def date_to_string(ctx: EvalContext, days: jnp.ndarray,
     return DevCol(dtypes.STRING, chars, validity, offsets)
 
 
+
+def _nonws_span(col: DevCol, capacity: int):
+    """(first, last) index of each row's non-whitespace span (sentinels:
+    first=2^30, last=-1 for all-whitespace rows), plus the char iota and
+    row-id map. Whitespace = the explicit ASCII set " \\t\\n\\r\\v\\f",
+    mirrored by the host parsers (cast.py strips the same set)."""
+    nchars = col.data.shape[0]
+    i = jnp.arange(nchars, dtype=jnp.int32)
+    row_ids = _char_row_ids(col, capacity)
+    total = col.offsets[capacity]
+    live = i < total
+    data = col.data
+    is_ws = ((data == 32) | (data == 9) | (data == 10) | (data == 13)
+             | (data == 11) | (data == 12))
+    non_ws = (~is_ws) & live
+    big = jnp.int32(2 ** 30)
+    first = jnp.minimum(jax.ops.segment_min(
+        jnp.where(non_ws, i, big), row_ids, num_segments=capacity), big)
+    last = jnp.maximum(jax.ops.segment_max(
+        jnp.where(non_ws, i, -1), row_ids, num_segments=capacity), -1)
+    return first, last, i, row_ids, live
+
+
 def string_to_integral(ctx: EvalContext, col: DevCol, dst):
     """Parse decimal strings -> (int64 data, validity). Accepted form:
     optional surrounding ASCII whitespace, optional sign, >=1 integer
@@ -686,18 +709,9 @@ def string_to_integral(ctx: EvalContext, col: DevCol, dst):
     values become NULL (non-ANSI)."""
     capacity = ctx.capacity
     nchars = col.data.shape[0]
-    i = jnp.arange(nchars, dtype=jnp.int32)
-    row_ids = _char_row_ids(col, capacity)
-    total = col.offsets[capacity]
-    live = i < total
     data = col.data
-    is_ws = (data == 32) | (data == 9) | (data == 10) | (data == 13)
-    non_ws = (~is_ws) & live
     big = jnp.int32(2 ** 30)
-    first = jnp.minimum(jax.ops.segment_min(
-        jnp.where(non_ws, i, big), row_ids, num_segments=capacity), big)
-    last = jnp.maximum(jax.ops.segment_max(
-        jnp.where(non_ws, i, -1), row_ids, num_segments=capacity), -1)
+    first, last, i, row_ids, live = _nonws_span(col, capacity)
     first_ch = data[jnp.clip(first, 0, nchars - 1)]
     neg = first_ch == ord("-")
     has_sign = neg | (first_ch == ord("+"))
@@ -739,3 +753,36 @@ def string_to_integral(ctx: EvalContext, col: DevCol, dst):
     if info.bits < 64:
         ok = ok & (val >= info.min) & (val <= info.max)
     return val, ok
+
+
+def string_to_date(ctx: EvalContext, col: DevCol):
+    """Parse 'yyyy-MM-dd'-prefixed strings -> (days int32, ok). Matches the
+    host rule: strip surrounding whitespace, the first 10 chars must be
+    \\d{4}-\\d{2}-\\d{2} (trailing text ignored, like np.datetime64 on
+    text[:10] after the host regex); the calendar triple is validated by a
+    days_from_civil/civil_from_days roundtrip (month lengths, leap years)."""
+    from spark_rapids_tpu.sql.exprs.datetimeexprs import (
+        civil_from_days, days_from_civil,
+    )
+    capacity = ctx.capacity
+    nchars = col.data.shape[0]
+    data = col.data
+    first, last, _i, _row_ids, _live = _nonws_span(col, capacity)
+    has10 = (last - first + 1) >= 10
+    # gather the 10 pattern positions per row
+    ps = first[:, None] + jnp.arange(10, dtype=jnp.int32)[None, :]
+    ch = data[jnp.clip(ps, 0, nchars - 1)].astype(jnp.int32)
+    digit_pos = np.array([0, 1, 2, 3, 5, 6, 8, 9])
+    is_digit = (ch >= 48) & (ch <= 57)
+    pat_ok = (jnp.all(is_digit[:, digit_pos], axis=1)
+              & (ch[:, 4] == ord("-")) & (ch[:, 7] == ord("-")) & has10)
+    d10 = ch - 48
+    y = d10[:, 0] * 1000 + d10[:, 1] * 100 + d10[:, 2] * 10 + d10[:, 3]
+    m = d10[:, 5] * 10 + d10[:, 6]
+    d = d10[:, 8] * 10 + d10[:, 9]
+    days = days_from_civil(jnp, y.astype(jnp.int64), m.astype(jnp.int64),
+                           d.astype(jnp.int64))
+    ry, rm, rd = civil_from_days(jnp, days)
+    roundtrip = (ry == y) & (rm == m) & (rd == d)
+    ok = col.validity & pat_ok & roundtrip
+    return days.astype(jnp.int32), ok

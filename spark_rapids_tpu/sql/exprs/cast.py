@@ -117,7 +117,10 @@ def _cast_strings_host(values, validity, src: DType, dst: DType):
     for i in range(n):
         if not validity[i]:
             continue
-        text = str(values[i]).strip()
+        # the explicit ASCII whitespace set shared with the device
+        # parsers (ops/strings.py _nonws_span) — python's default strip()
+        # also removes exotic unicode spaces the device does not
+        text = str(values[i]).strip(" \t\n\r\v\f")
         try:
             if dst == dtypes.BOOL:
                 low = text.lower()
@@ -144,13 +147,13 @@ def _cast_strings_host(values, validity, src: DType, dst: DType):
                 out[i] = float(text)
             elif dst == dtypes.DATE32:
                 import re
-                if not re.match(r"^\d{4}-\d{2}-\d{2}", text):
+                if not re.match(r"^\d{4}-\d{2}-\d{2}", text, re.ASCII):
                     raise ValueError(text)  # Spark needs yyyy-MM-dd...
                 out[i] = (np.datetime64(text[:10], "D")
                           - np.datetime64(0, "D")).astype(np.int32)
             elif dst == dtypes.TIMESTAMP_US:
                 import re
-                if not re.match(r"^\d{4}-\d{2}-\d{2}", text):
+                if not re.match(r"^\d{4}-\d{2}-\d{2}", text, re.ASCII):
                     raise ValueError(text)
                 out[i] = np.datetime64(
                     text.replace(" ", "T"), "us").astype(np.int64)
@@ -184,11 +187,10 @@ class Cast(Expression):
         return f"CAST({self.children[0].sql_name(schema)} AS {self.to.name})"
 
     @staticmethod
-    def _string_to_integral_enabled() -> bool:
+    def _conf_enabled(key: str) -> bool:
         from spark_rapids_tpu.session import TpuSparkSession
         s = TpuSparkSession._active
-        return bool(s and s.conf.get(
-            "spark.rapids.sql.castStringToInteger.enabled"))
+        return bool(s and s.conf.get(key))
 
     def device_supported(self, schema: Schema) -> Optional[str]:
         src = self.children[0].dtype(schema)
@@ -203,7 +205,11 @@ class Cast(Expression):
             return (f"cast {src} -> string formatting is not supported "
                     "on TPU")
         if src.is_string:
-            if self.to.is_integral and self._string_to_integral_enabled():
+            if self.to.is_integral and self._conf_enabled(
+                    "spark.rapids.sql.castStringToInteger.enabled"):
+                return None
+            if self.to == dtypes.DATE32 and self._conf_enabled(
+                    "spark.rapids.sql.castStringToDate.enabled"):
                 return None
             return (f"cast {src} -> {self.to} involves string parsing and "
                     "is gated off by default "
@@ -255,6 +261,9 @@ class Cast(Expression):
                 return string_ops.date_to_string(ctx, v.data, v.validity)
             assert v.dtype.is_integral, v.dtype
             return string_ops.integral_to_string(ctx, v.data, v.validity)
+        if self.to == dtypes.DATE32:
+            days, ok = string_ops.string_to_date(ctx, v)
+            return DevCol(self.to, days, v.validity & ok)
         assert v.dtype.is_string and self.to.is_integral, (v.dtype, self.to)
         data, ok = string_ops.string_to_integral(ctx, v, self.to)
         return DevCol(self.to, data.astype(self.to.np_dtype),

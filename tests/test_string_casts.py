@@ -108,6 +108,18 @@ class TestStringParse:
                 F.col("st").cast("long").alias("p")),
             conf=self.CONF)
 
+    def test_string_to_date(self, session, rng):
+        """yyyy-MM-dd prefix parsing behind castStringToDate.enabled;
+        calendar-invalid dates (Feb 30, month 13) are NULL on both paths."""
+        df = pd.DataFrame({"st": [
+            "2020-01-05", " 1999-12-31 ", "2020-02-30", "2020-13-01",
+            "2021-02-28T10:00", "0001-01-01", "bad", "2020-1-5", None,
+            "2024-02-29", "2023-02-29", "9999-12-31"]})
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(
+                F.col("st").cast("date").alias("d")),
+            conf={"spark.rapids.sql.castStringToDate.enabled": True})
+
     def test_parse_edge_forms(self, session, rng):
         """Sign/whitespace/fraction-truncation accepted; exponents, empty
         and non-numeric text are NULL on both paths."""
