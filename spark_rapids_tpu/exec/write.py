@@ -113,18 +113,54 @@ def _partition_subdirs(df: pd.DataFrame, pcols: List[str]):
 
 def _write_partitioned(tables, schema: Schema, protocol: WriteCommitProtocol,
                        task_id: int, ext: str, fmt: str,
-                       pcols: List[str]) -> None:
+                       pcols: List[str]) -> dict:
+    """Write one task's tables; returns the write stats the reference's
+    BasicColumnarWriteJobStatsTracker reports (numFiles, numOutputRows,
+    numOutputBytes, numParts)."""
     import pyarrow as pa
     table = pa.concat_tables(tables)
+    stats = {"numFiles": 0, "numOutputRows": 0, "numOutputBytes": 0}
+    part_dirs = set()
+
+    def encode(tbl, path):
+        _encode_table(tbl, path, fmt)
+        stats["numFiles"] += 1
+        stats["numOutputRows"] += tbl.num_rows
+        try:
+            stats["numOutputBytes"] += os.path.getsize(path)
+        except OSError:
+            pass
+
     if not pcols:
-        _encode_table(table, protocol.task_file(task_id, ext), fmt)
-        return
+        encode(table, protocol.task_file(task_id, ext))
+        stats["partDirs"] = part_dirs
+        return stats
     keep = Schema([n for n in schema.names if n not in pcols],
                   [d for n, d in zip(schema.names, schema.dtypes)
                    if n not in pcols])
     for subdir, group in _partition_subdirs(table.to_pandas(), pcols):
-        _encode_table(_arrow_table_from_pandas(group, keep),
-                      protocol.task_file(task_id, ext, subdir), fmt)
+        encode(_arrow_table_from_pandas(group, keep),
+               protocol.task_file(task_id, ext, subdir))
+        part_dirs.add(subdir)
+    stats["partDirs"] = part_dirs
+    return stats
+
+
+
+
+def _record_write_stats(ctx: ExecContext, op: str, st: dict,
+                        state: dict) -> None:
+    """Per-task write stats -> per-op metrics (the reference's
+    BasicColumnarWriteJobStatsTracker). numParts counts DISTINCT dynamic
+    partition directories across all tasks, recorded once at the end;
+    honors the same metrics-enabled gate as the generic instrumentation."""
+    if not ctx.metrics_enabled:
+        return
+    state["parts"] |= st.pop("partDirs", set())
+    for k, v in st.items():
+        ctx.metric_add(op, k, v)
+    if state["remaining"] == 1 and state["parts"]:
+        ctx.metric_add(op, "numParts", len(state["parts"]))
 
 
 class CpuWriteExec(PhysicalPlan):
@@ -150,7 +186,8 @@ class CpuWriteExec(PhysicalPlan):
         protocol = WriteCommitProtocol(self.path)
         protocol.setup(self.mode)
         ext = _EXTENSIONS[self.fmt]
-        state = {"remaining": len(child_parts), "failed": False}
+        state = {"remaining": len(child_parts), "failed": False,
+                 "parts": set()}
 
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[pd.DataFrame]:
@@ -158,8 +195,10 @@ class CpuWriteExec(PhysicalPlan):
                     tables = [_arrow_table_from_pandas(df, schema)
                               for df in part() if len(df)]
                     if tables:
-                        _write_partitioned(tables, schema, protocol, i, ext,
-                                           self.fmt, self.partition_cols)
+                        st = _write_partitioned(tables, schema, protocol, i,
+                                                ext, self.fmt,
+                                                self.partition_cols)
+                        _record_write_stats(ctx, self.describe(), st, state)
                 except Exception:
                     state["failed"] = True
                     protocol.abort()
@@ -199,7 +238,8 @@ class TpuWriteExec(PhysicalPlan):
         protocol = WriteCommitProtocol(self.path)
         protocol.setup(self.mode)
         ext = _EXTENSIONS[self.fmt]
-        state = {"remaining": len(child_parts), "failed": False}
+        state = {"remaining": len(child_parts), "failed": False,
+                 "parts": set()}
 
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[pd.DataFrame]:
@@ -207,8 +247,10 @@ class TpuWriteExec(PhysicalPlan):
                     tables = [_arrow_table_from_batch(b)
                               for b in part() if b.num_rows_host()]
                     if tables:
-                        _write_partitioned(tables, schema, protocol, i, ext,
-                                           self.fmt, self.partition_cols)
+                        st = _write_partitioned(tables, schema, protocol, i,
+                                                ext, self.fmt,
+                                                self.partition_cols)
+                        _record_write_stats(ctx, self.describe(), st, state)
                 except Exception:
                     state["failed"] = True
                     protocol.abort()

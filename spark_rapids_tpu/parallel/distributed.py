@@ -41,8 +41,12 @@ def data_parallel_mesh(n_devices: int) -> Mesh:
 def _send_buffers(batch: DeviceBatch, key_idx: Sequence[int], n: int):
     """Partition a batch's rows into n destination buckets of fixed
     capacity (the all-to-all analogue of Table.contiguousSplit,
-    GpuPartitioning.scala:41-75). Returns per-column (n, cap) buffers plus
-    (n,) counts."""
+    GpuPartitioning.scala:41-75). Returns per-column send buffers plus
+    (n,) counts. Fixed-width columns ride as ("fixed", (n,cap) data,
+    (n,cap) validity); string columns as ("string", (n,cap) lens,
+    (n,cap) validity, (n,char_cap) char slab, (n,) char counts) — rows
+    sorted by destination make each destination's chars contiguous, so
+    the slab is one masked gather."""
     cap = batch.capacity
     h1, _ = row_hashes(batch, key_idx)
     pid = (h1 % jnp.uint64(n)).astype(jnp.int32)
@@ -60,10 +64,21 @@ def _send_buffers(batch: DeviceBatch, key_idx: Sequence[int], n: int):
     buffers = []
     for col in sorted_batch.columns:
         if col.dtype.is_string:
-            raise NotImplementedError(
-                "string columns ride as hash+code pairs in the distributed "
-                "path")
-        buffers.append((col.data[idx], col.validity[idx] & live))
+            lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+            row_lens = jnp.where(live, lens[idx], 0)
+            char_start = col.offsets[offsets[:n]].astype(jnp.int32)
+            char_cnt = (col.offsets[offsets[1:]].astype(jnp.int32)
+                        - char_start)
+            ccap = col.data.shape[0]
+            k = jnp.arange(ccap, dtype=jnp.int32)
+            cidx = jnp.clip(char_start[:, None] + k[None, :], 0, ccap - 1)
+            slab = jnp.where(k[None, :] < char_cnt[:, None],
+                             col.data[cidx], 0).astype(jnp.uint8)
+            buffers.append(("string", row_lens, col.validity[idx] & live,
+                            slab, char_cnt))
+        else:
+            buffers.append(("fixed", col.data[idx],
+                            col.validity[idx] & live))
     return buffers, counts
 
 
@@ -84,34 +99,62 @@ def distributed_hash_aggregate_step(mesh: Mesh, schema: Schema,
         flat_cols = [a[0] for a in flat_cols]
         num_rows = num_rows[0]
         cols = []
-        for dt, data, validity in zip(schema.dtypes, flat_cols[0::2],
-                                      flat_cols[1::2]):
-            cols.append(DeviceColumn(dt, data, validity))
+        it = iter(flat_cols)
+        for dt in schema.dtypes:
+            if dt.is_string:
+                chars, validity, offs = next(it), next(it), next(it)
+                cols.append(DeviceColumn(dt, chars, validity, offs))
+            else:
+                data, validity = next(it), next(it)
+                cols.append(DeviceColumn(dt, data, validity))
         batch = DeviceBatch(schema, cols, num_rows)
         partial = aggregate_update(batch, key_exprs, update_inputs,
                                    update_reductions, partial_schema)
         # exchange: hash-partition partial rows across the mesh
         buffers, counts = _send_buffers(partial, list(range(num_keys)), n)
+        a2a = functools.partial(jax.lax.all_to_all, axis_name="dp",
+                                split_axis=0, concat_axis=0, tiled=False)
         received = []
-        for data, validity in buffers:
-            rd = jax.lax.all_to_all(data, "dp", split_axis=0, concat_axis=0,
-                                    tiled=False)
-            rv = jax.lax.all_to_all(validity, "dp", split_axis=0,
-                                    concat_axis=0, tiled=False)
-            received.append((rd, rv))
+        for buf in buffers:
+            if buf[0] == "string":
+                _, row_lens, validity, slab, char_cnt = buf
+                received.append((
+                    "string", a2a(row_lens), a2a(validity), a2a(slab),
+                    jax.lax.all_to_all(char_cnt, "dp", split_axis=0,
+                                       concat_axis=0, tiled=True)))
+            else:
+                received.append(("fixed", a2a(buf[1]), a2a(buf[2])))
         rcounts = jax.lax.all_to_all(counts, "dp", split_axis=0,
                                      concat_axis=0, tiled=True)
-        # flatten received (n, cap) buffers into one batch, compacted
-        rcap = received[0][0].shape[0] * received[0][0].shape[1]
-        live = (jnp.arange(received[0][0].shape[1], dtype=jnp.int32)[None, :]
+        # flatten received (n, cap) buffers into one batch, compacted.
+        # Stable liveness sorts keep source-major order, so row buffers
+        # and char slabs stay aligned after their separate compactions.
+        from spark_rapids_tpu.ops.pallas_kernels import compact_permutation
+        shard_cap = received[0][1].shape[1]
+        rcap = n * shard_cap
+        live = (jnp.arange(shard_cap, dtype=jnp.int32)[None, :]
                 < rcounts[:, None]).reshape(rcap)
-        perm = jnp.argsort(~live, stable=True).astype(jnp.int32)
+        perm, _ = compact_permutation(live)
         total = rcounts.sum().astype(jnp.int32)
         cols2 = []
-        for dt, (data, validity) in zip(partial_schema.dtypes, received):
-            d = data.reshape(rcap)[perm]
-            v = (validity.reshape(rcap) & live)[perm]
-            cols2.append(DeviceColumn(dt, d, v))
+        for dt, buf in zip(partial_schema.dtypes, received):
+            if buf[0] == "string":
+                _, rlens, rvalid, rslab, rcc = buf
+                lens_flat = rlens.reshape(rcap)[perm]
+                new_offsets = jnp.concatenate(
+                    [jnp.zeros((1,), jnp.int32),
+                     jnp.cumsum(lens_flat).astype(jnp.int32)])
+                ccap = rslab.shape[1]
+                ck = jnp.arange(n * ccap, dtype=jnp.int32)
+                clive = (ck % ccap) < rcc[ck // ccap]
+                cperm, _ = compact_permutation(clive)
+                chars = rslab.reshape(n * ccap)[cperm]
+                v = (rvalid.reshape(rcap) & live)[perm]
+                cols2.append(DeviceColumn(dt, chars, v, new_offsets))
+            else:
+                d = buf[1].reshape(rcap)[perm]
+                v = (buf[2].reshape(rcap) & live)[perm]
+                cols2.append(DeviceColumn(dt, d, v))
         rbatch = DeviceBatch(partial_schema, cols2, total)
         merged = aggregate_merge(rbatch, num_keys, merge_reductions,
                                  partial_schema)
@@ -119,10 +162,16 @@ def distributed_hash_aggregate_step(mesh: Mesh, schema: Schema,
         for c in merged.columns:
             out.append(c.data[None, :])
             out.append(c.validity[None, :])
+            if c.dtype.is_string:
+                out.append(c.offsets[None, :])
         return tuple(out)
 
-    in_specs = tuple([P("dp", None)] * (2 * len(schema.dtypes)) + [P("dp")])
-    out_specs = tuple([P("dp")] + [P("dp", None)] * (2 * len(partial_schema.dtypes)))
+    def _arrays_per_col(sch: Schema) -> int:
+        return sum(3 if dt.is_string else 2 for dt in sch.dtypes)
+
+    in_specs = tuple([P("dp", None)] * _arrays_per_col(schema) + [P("dp")])
+    out_specs = tuple([P("dp")]
+                      + [P("dp", None)] * _arrays_per_col(partial_schema))
     fn = shard_map(local_step, mesh=mesh, in_specs=in_specs,
                    out_specs=out_specs, check_vma=False)
     return jax.jit(fn)
@@ -138,20 +187,25 @@ def dryrun_distributed_q1(n_devices: int, rows_per_shard: int = 512) -> None:
     from spark_rapids_tpu.exec.aggutil import AggPlan
     from spark_rapids_tpu.sql.planner import _bind_non_agg
 
+    from spark_rapids_tpu.columnar.column import DeviceColumn as _DC
+
     mesh = data_parallel_mesh(n_devices)
     n = n_devices
     rng = np.random.default_rng(3)
     total_rows = n * rows_per_shard
 
-    # lineitem-shaped data with integer key codes (strings ride hashed in
-    # the distributed path)
+    # lineitem-shaped data grouped by REAL string keys (the returnflag x
+    # linestatus combos), exercising the string all-to-all transport
+    key_pool = np.array(["A|F", "N|O", "R|F", "A|O", "N|F", "R|O"],
+                        dtype=object)
+    key_vals = key_pool[rng.integers(0, len(key_pool), total_rows)]
     schema = Schema(
-        ["key_code", "l_quantity", "l_extendedprice", "l_discount", "l_tax",
-         "ship_days"],
-        [dtypes.INT32, dtypes.FLOAT64, dtypes.FLOAT64, dtypes.FLOAT64,
+        ["flag_status", "l_quantity", "l_extendedprice", "l_discount",
+         "l_tax", "ship_days"],
+        [dtypes.STRING, dtypes.FLOAT64, dtypes.FLOAT64, dtypes.FLOAT64,
          dtypes.FLOAT64, dtypes.INT32])
     data = {
-        "key_code": rng.integers(0, 6, total_rows).astype(np.int32),
+        "flag_status": key_vals,
         "l_quantity": rng.integers(1, 51, total_rows).astype(np.float64),
         "l_extendedprice": rng.uniform(900, 105000, total_rows),
         "l_discount": rng.integers(0, 11, total_rows) * 0.01,
@@ -159,11 +213,12 @@ def dryrun_distributed_q1(n_devices: int, rows_per_shard: int = 512) -> None:
         "ship_days": rng.integers(8000, 10600, total_rows).astype(np.int32),
     }
 
-    grouping = [("key_code", bind_references(F.col("key_code").expr, schema))]
+    grouping = [("flag_status",
+                 bind_references(F.col("flag_status").expr, schema))]
     disc_price = F.col("l_extendedprice") * (1 - F.col("l_discount"))
     charge = disc_price * (1 + F.col("l_tax"))
     results = [
-        ("key_code", F.col("key_code").expr),
+        ("flag_status", F.col("flag_status").expr),
         ("sum_qty", F.sum("l_quantity").expr),
         ("sum_disc_price", F.sum(disc_price).expr),
         ("sum_charge", F.sum(charge).expr),
@@ -181,10 +236,28 @@ def dryrun_distributed_q1(n_devices: int, rows_per_shard: int = 512) -> None:
         mesh, schema, [e for _, e in plan.grouping], plan.update_inputs,
         update_reds, merge_reds, plan.partial_schema, rows_per_shard)
 
-    # lay out inputs sharded over dp
+    # lay out inputs sharded over dp; string columns ship as stacked
+    # per-shard (chars, validity, offsets) buffers with one shared char
+    # capacity
     args = []
     shard = NamedSharding(mesh, P("dp", None))
     for name, dt in zip(schema.names, schema.dtypes):
+        if dt.is_string:
+            vals = data[name].reshape(n, rows_per_shard)
+            ccap = 16
+            while any(sum(len(v) for v in vals[s]) > ccap for s in range(n)):
+                ccap <<= 1
+            chs, vs, offs = [], [], []
+            for s in range(n):
+                c, v, o = _DC.build_host_buffers(
+                    vals[s], None, dt, rows_per_shard, char_capacity=ccap)
+                chs.append(c)
+                vs.append(v)
+                offs.append(o)
+            args.append(jax.device_put(np.stack(chs), shard))
+            args.append(jax.device_put(np.stack(vs), shard))
+            args.append(jax.device_put(np.stack(offs), shard))
+            continue
         arr = data[name].reshape(n, rows_per_shard)
         args.append(jax.device_put(arr, shard))
         args.append(jax.device_put(
@@ -196,11 +269,25 @@ def dryrun_distributed_q1(n_devices: int, rows_per_shard: int = 512) -> None:
     out = step(*args)
     num_rows = np.asarray(out[0])
     # verify: the distributed group count matches a host groupby
-    expected_groups = len(np.unique(data["key_code"]))
+    expected_groups = len(np.unique(list(data["flag_status"])))
     got_groups = int(num_rows.sum())
     assert got_groups == expected_groups, (got_groups, expected_groups)
+    # map output positions (string columns emit chars/validity/offsets)
+    pos, out_map = 1, {}
+    for nm, dt in zip(plan.partial_schema.names, plan.partial_schema.dtypes):
+        out_map[nm] = pos
+        pos += 3 if dt.is_string else 2
+    # verify the string keys survive the exchange+merge byte-exact
+    kidx = out_map["flag_status"]
+    kch, kval, koff = (np.asarray(out[kidx]), np.asarray(out[kidx + 1]),
+                       np.asarray(out[kidx + 2]))
+    got_keys = set()
+    for s in range(n):
+        for r in range(int(num_rows[s])):
+            got_keys.add(bytes(kch[s][koff[s][r]:koff[s][r + 1]]).decode())
+    assert got_keys == set(key_pool), (got_keys, set(key_pool))
     # verify a global sum survives the exchange+merge exactly once
-    sum_col_idx = 1 + 2 * plan.partial_schema.names.index("_agg0")
+    sum_col_idx = out_map["_agg0"]
     sums = np.asarray(out[sum_col_idx])
     valid = np.asarray(out[sum_col_idx + 1])
     got = sums[valid].sum()

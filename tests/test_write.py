@@ -176,3 +176,49 @@ def test_partitioned_write_null_partition_value(session, tmp_path):
     assert back["k"].isna().sum() == 1
     got = back[back["k"].isna()]["v"].iloc[0]
     assert float(got) == 3.0
+
+
+def test_write_stats_metrics(session, rng, tmp_path):
+    """Write execs report the reference's BasicColumnarWriteJobStatsTracker
+    stats (numFiles / numOutputRows / numOutputBytes) as per-op metrics."""
+    df = _df(rng)
+    out = str(tmp_path / "stats_out")
+    from tests.querytest import with_tpu_session
+
+    class _Done:
+        def collect(self):
+            return pd.DataFrame()
+
+    def write(s):
+        s.create_dataframe(df, 3).write.mode("overwrite").parquet(out)
+        return _Done()
+    with_tpu_session(write)
+    metrics = session.last_query_metrics
+    write_ops = {k: v for k, v in metrics.items() if "WriteExec" in k}
+    assert write_ops, metrics.keys()
+    stats = next(iter(write_ops.values()))
+    assert stats["numOutputRows"] == len(df)
+    assert stats["numFiles"] >= 1
+    assert stats["numOutputBytes"] > 0
+
+
+def test_write_stats_distinct_parts(session, rng, tmp_path):
+    """numParts counts DISTINCT dynamic partitions, not per-task writes."""
+    df = _df(rng)
+    df["p"] = [("a" if i % 2 else "b") for i in range(len(df))]
+    out = str(tmp_path / "parts_out")
+    from tests.querytest import with_tpu_session
+
+    class _Done:
+        def collect(self):
+            return pd.DataFrame()
+
+    def write(s):
+        (s.create_dataframe(df, 3).write.mode("overwrite")
+         .partition_by("p").parquet(out))
+        return _Done()
+    with_tpu_session(write)
+    metrics = session.last_query_metrics
+    stats = next(v for k, v in metrics.items() if "WriteExec" in k)
+    assert stats["numParts"] == 2, stats
+    assert stats["numFiles"] >= 2
