@@ -769,20 +769,66 @@ def string_to_date(ctx: EvalContext, col: DevCol):
     data = col.data
     first, last, _i, _row_ids, _live = _nonws_span(col, capacity)
     has10 = (last - first + 1) >= 10
-    # gather the 10 pattern positions per row
-    ps = first[:, None] + jnp.arange(10, dtype=jnp.int32)[None, :]
-    ch = data[jnp.clip(ps, 0, nchars - 1)].astype(jnp.int32)
-    digit_pos = np.array([0, 1, 2, 3, 5, 6, 8, 9])
-    is_digit = (ch >= 48) & (ch <= 57)
-    pat_ok = (jnp.all(is_digit[:, digit_pos], axis=1)
-              & (ch[:, 4] == ord("-")) & (ch[:, 7] == ord("-")) & has10)
-    d10 = ch - 48
-    y = d10[:, 0] * 1000 + d10[:, 1] * 100 + d10[:, 2] * 10 + d10[:, 3]
-    m = d10[:, 5] * 10 + d10[:, 6]
-    d = d10[:, 8] * 10 + d10[:, 9]
+    y, m, d, ymd_ok = _parse_ymd_at(data, nchars, first)
+    pat_ok = ymd_ok & has10
     days = days_from_civil(jnp, y.astype(jnp.int64), m.astype(jnp.int64),
                            d.astype(jnp.int64))
     ry, rm, rd = civil_from_days(jnp, days)
     roundtrip = (ry == y) & (rm == m) & (rd == d)
     ok = col.validity & pat_ok & roundtrip
     return days.astype(jnp.int32), ok
+
+
+def _parse_ymd_at(data: jnp.ndarray, nchars: int, first: jnp.ndarray):
+    """Parse \\d{4}-\\d{2}-\\d{2} at per-row offsets. Returns
+    (y, m, d, pattern_ok)."""
+    ps = first[:, None] + jnp.arange(10, dtype=jnp.int32)[None, :]
+    ch = data[jnp.clip(ps, 0, nchars - 1)].astype(jnp.int32)
+    digit_pos = np.array([0, 1, 2, 3, 5, 6, 8, 9])
+    is_digit = (ch >= 48) & (ch <= 57)
+    pat_ok = (jnp.all(is_digit[:, digit_pos], axis=1)
+              & (ch[:, 4] == ord("-")) & (ch[:, 7] == ord("-")))
+    d10 = ch - 48
+    y = d10[:, 0] * 1000 + d10[:, 1] * 100 + d10[:, 2] * 10 + d10[:, 3]
+    m = d10[:, 5] * 10 + d10[:, 6]
+    d = d10[:, 8] * 10 + d10[:, 9]
+    return y, m, d, pat_ok
+
+
+def string_to_unix_ts(ctx: EvalContext, col: DevCol, with_time: bool):
+    """Parse 'yyyy-MM-dd' (with_time=False) or 'yyyy-MM-dd HH:mm:ss'
+    strings -> (epoch seconds int64, ok). Whitespace-trimmed EXACT-length
+    match (the host twin uses strptime, which rejects trailing text);
+    calendar triples roundtrip-validated, time fields range-checked."""
+    from spark_rapids_tpu.sql.exprs.datetimeexprs import (
+        civil_from_days, days_from_civil,
+    )
+    capacity = ctx.capacity
+    nchars = col.data.shape[0]
+    data = col.data
+    first, last, _i, _row_ids, _live = _nonws_span(col, capacity)
+    want = 19 if with_time else 10
+    exact = (last - first + 1) == want
+    y, m, d, pat_ok = _parse_ymd_at(data, nchars, first)
+    days = days_from_civil(jnp, y.astype(jnp.int64), m.astype(jnp.int64),
+                           d.astype(jnp.int64))
+    ry, rm, rd = civil_from_days(jnp, days)
+    # y >= 1: the host oracle's strptime rejects proleptic year 0
+    ok = (col.validity & exact & pat_ok & (y >= 1)
+          & (ry == y) & (rm == m) & (rd == d))
+    secs = days * 86400
+    if with_time:
+        ts = first[:, None] + jnp.arange(10, 19, dtype=jnp.int32)[None, :]
+        tch = data[jnp.clip(ts, 0, nchars - 1)].astype(jnp.int32)
+        tdig = (tch >= 48) & (tch <= 57)
+        tpat = (jnp.all(tdig[:, np.array([1, 2, 4, 5, 7, 8])], axis=1)
+                & (tch[:, 0] == ord(" ")) & (tch[:, 3] == ord(":"))
+                & (tch[:, 6] == ord(":")))
+        td = tch - 48
+        hh = td[:, 1] * 10 + td[:, 2]
+        mi = td[:, 4] * 10 + td[:, 5]
+        ss = td[:, 7] * 10 + td[:, 8]
+        ok = ok & tpat & (hh < 24) & (mi < 60) & (ss < 60)
+        secs = secs + hh.astype(jnp.int64) * 3600 \
+            + mi.astype(jnp.int64) * 60 + ss.astype(jnp.int64)
+    return secs, ok

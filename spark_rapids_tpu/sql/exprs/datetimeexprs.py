@@ -357,21 +357,40 @@ class ToDate(Expression):
         return f"to_date({self.children[0].sql_name(schema)})"
 
     def device_supported(self, schema: Schema) -> Optional[str]:
-        if not self.children[0].dtype(schema).is_datetime:
-            return "to_date requires a date/timestamp input (string parsing "\
-                   "is not supported on TPU)"
+        t = self.children[0].dtype(schema)
+        if t.is_string:
+            # to_date(string) == cast(string as date) in Spark: same
+            # device gate as the cast
+            from spark_rapids_tpu.sql.exprs.cast import Cast
+            if Cast._conf_enabled(
+                    "spark.rapids.sql.castStringToDate.enabled"):
+                return None
+            return ("to_date over strings parses dates and is gated off "
+                    "by default (spark.rapids.sql.castStringToDate.enabled)")
+        if not t.is_datetime:
+            return f"to_date requires a date/timestamp/string input, got {t}"
         return None
 
     def eval_device(self, ctx: EvalContext) -> DevValue:
         v = ctx.broadcast(self.children[0].eval_device(ctx))
         if v.dtype == dtypes.DATE32:
             return v
+        if v.dtype.is_string:
+            from spark_rapids_tpu.ops import strings as string_ops
+            days, ok = string_ops.string_to_date(ctx, v)
+            return DevCol(dtypes.DATE32, days, v.validity & ok)
         days = days_from_micros(jnp, v.data).astype(jnp.int32)
         return DevCol(dtypes.DATE32, days, v.validity)
 
     def eval_host(self, df: pd.DataFrame) -> pd.Series:
         values, validity, index = host_unary_values(self.children[0].eval_host(df))
-        days = days_from_micros(np, values)
+        if values.dtype == object:  # string input: cast-to-date semantics
+            from spark_rapids_tpu.sql.exprs.cast import _cast_strings_host
+            days, validity = _cast_strings_host(values, validity,
+                                                dtypes.STRING, dtypes.DATE32)
+            days = days.astype(np.int64)
+        else:
+            days = days_from_micros(np, values)
         out = rebuild_series(days * MICROS_PER_DAY, validity,
                              dtypes.TIMESTAMP_US, index)
         # host dates ride as midnight micros; mark the logical type for
@@ -402,3 +421,95 @@ class FromUnixTime(Expression):
         values, validity, index = host_unary_values(self.children[0].eval_host(df))
         data = values.astype(np.int64) * MICROS_PER_SEC
         return rebuild_series(data, validity, dtypes.TIMESTAMP_US, index)
+
+
+class UnixTimestampFromString(Expression):
+    """unix_timestamp(string, fmt) -> long epoch seconds (UTC), NULL on
+    parse failure (reference: UnixTimeExprMeta's strf-pattern subset —
+    the device supports the two fixed-width forms; other formats fall
+    back to the host's strptime)."""
+
+    _DEVICE_FMTS = ("yyyy-MM-dd", "yyyy-MM-dd HH:mm:ss")
+    _JAVA_TO_PY = (("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
+                   ("HH", "%H"), ("mm", "%M"), ("ss", "%S"))
+
+    def __init__(self, child: Expression, fmt: str):
+        super().__init__([child])
+        self.fmt = fmt
+        # reject format tokens neither side implements at construction —
+        # an unmapped token would silently parse nothing (all NULLs)
+        import re
+        residual = fmt
+        for j, _ in self._JAVA_TO_PY:
+            residual = residual.replace(j, "")
+        if re.search(r"[A-Za-z]", residual):
+            raise ValueError(
+                f"unsupported unix_timestamp format token in {fmt!r} "
+                f"(supported tokens: yyyy MM dd HH mm ss)")
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT64
+
+    def sql_name(self, schema=None) -> str:
+        return (f"unix_timestamp({self.children[0].sql_name(schema)}, "
+                f"{self.fmt!r})")
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        t = self.children[0].dtype(schema)
+        if t.is_datetime:
+            return None  # format is ignored for date/timestamp inputs
+        if not t.is_string:
+            return (f"unix_timestamp requires a string or date/timestamp "
+                    f"input, got {t}")
+        if self.fmt not in self._DEVICE_FMTS:
+            return (f"unix_timestamp format {self.fmt!r} is not supported "
+                    f"on TPU (supported: {', '.join(self._DEVICE_FMTS)})")
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        from spark_rapids_tpu.ops import strings as string_ops
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        if v.dtype.is_datetime:  # Spark ignores fmt for these inputs
+            if v.dtype == dtypes.DATE32:
+                secs = v.data.astype(jnp.int64) * 86400
+            else:
+                secs = jnp.floor_divide(v.data.astype(jnp.int64),
+                                        MICROS_PER_SEC)
+            return DevCol(dtypes.INT64, secs, v.validity)
+        secs, ok = string_ops.string_to_unix_ts(
+            ctx, v, with_time=" " in self.fmt)
+        return DevCol(dtypes.INT64, secs, v.validity & ok)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        import calendar
+        import datetime as _dt
+        import re
+        values, validity, index = host_unary_values(
+            self.children[0].eval_host(df))
+        pyfmt = self.fmt
+        for j, p in self._JAVA_TO_PY:
+            pyfmt = pyfmt.replace(j, p)
+        # fixed-width pre-check: strptime leniently accepts '1:02:03' for
+        # %H:%M:%S, the device kernels require the pattern's digit widths
+        strict = re.escape(pyfmt)
+        strict = strict.replace(re.escape("%Y"), r"\d{4}")
+        for tok in ("%m", "%d", "%H", "%M", "%S"):
+            strict = strict.replace(re.escape(tok), r"\d{2}")
+        strict_re = re.compile("^" + strict + "$", re.ASCII)
+        if values.dtype != object:  # date/timestamp input: fmt ignored
+            secs = np.floor_divide(values.astype(np.int64), MICROS_PER_SEC)
+            return rebuild_series(secs, validity, dtypes.INT64, index)
+        out = np.zeros(len(values), np.int64)
+        ok = validity.copy()
+        for i, v in enumerate(values):
+            if not validity[i]:
+                continue
+            try:
+                t = str(v).strip(" \t\n\r\v\f")
+                if not strict_re.match(t):
+                    raise ValueError(t)
+                tm = _dt.datetime.strptime(t, pyfmt)
+                out[i] = calendar.timegm(tm.timetuple())
+            except ValueError:
+                ok[i] = False
+        return rebuild_series(out, ok, dtypes.INT64, index)

@@ -209,7 +209,10 @@ def dayofweek(c): return Column(dt.DayOfWeek(_c(c)))
 def hour(c): return Column(dt.Hour(_c(c)))
 def minute(c): return Column(dt.Minute(_c(c)))
 def second(c): return Column(dt.Second(_c(c)))
-def unix_timestamp(c): return Column(dt.UnixTimestampFromTs(_c(c)))
+def unix_timestamp(c, fmt: str = None):
+    if fmt is None:
+        return Column(dt.UnixTimestampFromTs(_c(c)))
+    return Column(dt.UnixTimestampFromString(_c(c), fmt))
 def date_add(c, days): return Column(dt.DateAdd(_c(c), _expr(days)))
 
 

@@ -91,6 +91,16 @@ PROJECTIONS = {
     "date_add": lambda c: F.date_add(c("dt").cast("date"), 30),
     "date_quarter": lambda c: F.quarter(c("dt")),
     "hash_multi": lambda c: F.hash(c("i"), c("s"), c("d")),
+    # string ordering comparisons (exact byte-order device kernel)
+    "str_cmp_lt": lambda c: c("s") < c("k"),
+    "str_cmp_ge_lit": lambda c: c("s") >= "M",
+    "str_greatest": lambda c: F.greatest(c("s"), c("k")),
+    # to-string casts (device rendering)
+    "cast_int_string": lambda c: c("i").cast("string"),
+    "cast_bool_string": lambda c: c("b").cast("string"),
+    "cast_date_string": lambda c: F.to_date(c("dt")).cast("string"),
+    "unix_ts_string": lambda c: F.unix_timestamp(
+        F.to_date(c("dt")).cast("string"), "yyyy-MM-dd"),
 }
 
 

@@ -130,3 +130,70 @@ class TestStringParse:
             lambda s: s.create_dataframe(df, 1).select(
                 F.col("st").cast("long").alias("p")),
             conf=self.CONF)
+
+
+class TestUnixTimestampParse:
+    """unix_timestamp(string, fmt) — the reference's UnixTimeExprMeta
+    strf subset; fixed-width parse, NULL on failure."""
+
+    def test_date_format(self, session, rng):
+        df = pd.DataFrame({"d": ["2020-01-05", " 1970-01-01 ", "2020-02-30",
+                                 "bad", None, "2024-02-29", "2020-1-5"]})
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(
+                F.unix_timestamp(F.col("d"), "yyyy-MM-dd").alias("u")))
+
+    def test_datetime_format(self, session, rng):
+        df = pd.DataFrame({"t": ["2020-01-05 12:34:56", "1970-01-01 00:00:00",
+                                 "2020-01-05 24:00:00", "2020-01-05 1:02:03",
+                                 None, "1999-12-31 23:59:59",
+                                 "2020-01-05T12:34:56"]})
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 2).select(
+                F.unix_timestamp(F.col("t"),
+                                 "yyyy-MM-dd HH:mm:ss").alias("u")))
+
+    def test_unsupported_format_falls_back(self, session, rng):
+        df = pd.DataFrame({"d": ["05/01/2020", "31/12/1999", "bad", None]})
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(df, 1).select(
+                F.unix_timestamp(F.col("d"), "dd/MM/yyyy").alias("u")),
+            allow_non_tpu=["CpuProjectExec"])
+
+
+def test_to_date_on_strings(session, rng):
+    """to_date(string) == cast(string as date), device behind the same
+    conf; composable with date extraction downstream."""
+    df = pd.DataFrame({"st": ["2020-01-05", "1999-12-31", "2020-02-30",
+                              "bad", None, "2024-02-29"]})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2).select(
+            F.to_date(F.col("st")).alias("d"),
+            F.year(F.to_date(F.col("st"))).alias("y")),
+        conf={"spark.rapids.sql.castStringToDate.enabled": True})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 2).select(
+            F.to_date(F.col("st")).alias("d")),
+        allow_non_tpu=["CpuProjectExec"])
+
+
+def test_year_zero_is_null(session, rng):
+    """strptime (host) rejects proleptic year 0; device must agree."""
+    df = pd.DataFrame({"d": ["0000-01-05", "0001-01-01", "2020-06-15"]})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 1).select(
+            F.unix_timestamp(F.col("d"), "yyyy-MM-dd").alias("u")))
+
+def test_datetime_input_ignores_format(session, rng):
+    """unix_timestamp(date_or_ts, fmt): fmt is ignored, like Spark."""
+    df = pd.DataFrame({"t": pd.to_datetime(
+        ["2020-01-05 12:00:00", "1970-01-01 00:00:01", None])})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(df, 1).select(
+            F.unix_timestamp(F.col("t"), "yyyy-MM-dd").alias("u")))
+
+def test_unmapped_token_raises(session, rng):
+    """Format tokens nobody implements fail fast at construction, not
+    as silent all-NULL results."""
+    with pytest.raises(ValueError, match="unsupported unix_timestamp"):
+        F.unix_timestamp(F.col("d"), "EEE, dd MMM yyyy")
