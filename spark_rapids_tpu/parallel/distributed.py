@@ -177,6 +177,13 @@ def distributed_hash_aggregate_step(mesh: Mesh, schema: Schema,
     return jax.jit(fn)
 
 
+def dryrun_multichip_full(n_devices: int) -> None:
+    """Driver-facing multichip validation: every distributed path we ship,
+    executed once on an n-device mesh with tiny shapes. Grows as engine
+    paths gain mesh execution (VERDICT r1 items 2 and 4)."""
+    dryrun_distributed_q1(n_devices)
+
+
 def dryrun_distributed_q1(n_devices: int, rows_per_shard: int = 512) -> None:
     """The driver's multichip validation: a full distributed TPC-H-Q1-shaped
     aggregation step (dp sharding + all-to-all shuffle + merge) on an
