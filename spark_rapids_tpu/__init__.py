@@ -26,9 +26,31 @@ CPU fallback for anything unsupported.
 TPU executes s64/f64 via XLA emulation; hot paths can opt into 32-bit via conf.
 """
 
+import os as _os
+
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA executable cache: kernel compiles on the remote TPU
+# attachment cost seconds each and the per-process kernel cache
+# (utils/kernelcache.py) cannot carry them across runs. Verified to work
+# through the axon remote-compile path. NOT enabled when the process is
+# pinned to the CPU backend (tests): XLA:CPU AOT reload warns about
+# machine-feature mismatches (prefer-no-scatter et al.) with SIGILL risk.
+# Override dir (or disable with an empty value) via SRT_XLA_CACHE_DIR.
+_cache_dir = _os.environ.get(
+    "SRT_XLA_CACHE_DIR",
+    _os.path.join(_os.path.expanduser("~"), ".cache", "srt_xla_cache"))
+_cpu_pinned = (_os.environ.get("JAX_PLATFORMS") == "cpu"
+               or _jax.config.jax_platforms == "cpu")
+if _cache_dir and not _cpu_pinned:
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass
 
 __version__ = "0.1.0"
 

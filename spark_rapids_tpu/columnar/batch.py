@@ -170,13 +170,21 @@ class DeviceBatch:
         return DeviceBatch.to_pandas_many([self])[0]
 
     @staticmethod
-    def to_pandas_many(batches: Sequence["DeviceBatch"]) -> List[pd.DataFrame]:
-        """Convert many batches with TWO total device->host round trips
-        (row counts, then every batch's buffers) — the whole-query output
-        fetch of collect() rides this, so the sync count is independent of
-        the partition count."""
+    def to_pandas_many(batches: Sequence["DeviceBatch"],
+                       fused_fetch_bytes: int = 4 << 20) -> List[pd.DataFrame]:
+        """Convert many batches with at most TWO total device->host round
+        trips (row counts, then every batch's buffers) — the whole-query
+        output fetch of collect() rides this, so the sync count is
+        independent of the partition count. When the padded buffers fit
+        under ``fused_fetch_bytes`` the counts and full-capacity buffers
+        ride ONE round trip instead (and no per-length device slice
+        programs need compiling); each round trip costs ~100-250 ms on a
+        tunneled attachment, which dominates small-result collects."""
         import jax
         need = [b for b in batches if b._host_rows is None]
+        total_padded = sum(b.device_memory_size() for b in batches)
+        if need and total_padded <= fused_fetch_bytes:
+            return DeviceBatch._to_pandas_fused(batches)
         if need:
             counts = jax.device_get([b.num_rows for b in need])
             for b, c in zip(need, counts):
@@ -197,6 +205,39 @@ class DeviceBatch:
                 continue
             # positional construction: join outputs may carry duplicate
             # column names (both sides keep their key column, like Spark)
+            df = pd.concat(series, axis=1)
+            df.columns = list(b.schema.names)
+            out.append(df)
+        return out
+
+    @staticmethod
+    def _to_pandas_fused(batches: Sequence["DeviceBatch"]) -> List[pd.DataFrame]:
+        """One device_get of (num_rows + full-capacity buffers) for every
+        batch, trimmed to the fetched row counts host-side."""
+        import jax
+        payload = [(b.num_rows,
+                    [(c.data, c.validity, c.offsets) if c.dtype.is_string
+                     else (c.data, c.validity) for c in b.columns])
+                   for b in batches]
+        host = jax.device_get(payload)
+        out: List[pd.DataFrame] = []
+        for b, (count, host_cols) in zip(batches, host):
+            n = int(count)
+            b._host_rows = n
+            series: List[pd.Series] = []
+            for dt, col, parts in zip(b.schema.dtypes, b.columns, host_cols):
+                if dt.is_string:
+                    chars, validity, offsets = (np.asarray(p) for p in parts)
+                    trimmed = (validity[:n], offsets[:n + 1], chars)
+                else:
+                    data, validity = (np.asarray(p) for p in parts)
+                    trimmed = (data[:n], validity[:n])
+                values, validity = col.numpy_from_host(trimmed, n)
+                series.append(_numpy_to_pandas(values, validity, dt)
+                              .reset_index(drop=True))
+            if not series:
+                out.append(pd.DataFrame(index=range(n)))
+                continue
             df = pd.concat(series, axis=1)
             df.columns = list(b.schema.names)
             out.append(df)

@@ -150,6 +150,24 @@ CAPACITY_GROWTH = register(
     "power-of-two bucketing; smaller values trade recompiles for padding.",
     validator=_fraction(1.1, 4.0))
 
+SHUFFLE_LOCAL_COLLAPSE = register(
+    "spark.rapids.sql.shuffle.localCollapse", _to_bool, True,
+    "When no device mesh is configured, collapse device-side shuffle "
+    "exchanges to a single output partition instead of materializing n "
+    "hash/range buckets. On one chip the buckets are pure overhead (they "
+    "serialize anyway) and bucket-count readback costs a device->host "
+    "round trip per window; the collapsed exchange is one fused concat "
+    "with zero synchronization. Multi-chip meshes ignore this and "
+    "exchange for real over ICI collectives.")
+
+COLLECT_FUSED_FETCH_BYTES = register(
+    "spark.rapids.sql.collect.fusedFetchBytes", _to_bytes, 4 << 20,
+    "collect() fetches results in one device->host round trip (row counts "
+    "and full-capacity buffers together) when the padded result size is "
+    "under this threshold; larger results use two round trips (counts, "
+    "then exact-length buffers). Tunes the latency/bandwidth trade on "
+    "remote device attachments.")
+
 # --- op enable/disable incl. incompat (ref RapidsConf.scala:339-430) -------
 INCOMPATIBLE_OPS = register(
     "spark.rapids.sql.incompatibleOps.enabled", _to_bool, False,

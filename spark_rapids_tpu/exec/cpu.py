@@ -371,6 +371,9 @@ def sort_key_arrays(df: pd.DataFrame, orders: Sequence[SortOrder]):
             uniq, inv = np.unique(filled.astype(str), return_inverse=True)
             img = inv.astype(np.int64)
         elif vals.dtype.kind == "f":
+            # exact host image (the CPU oracle models Spark, which orders
+            # denormals properly; only the DEVICE image flushes them, an
+            # unavoidable TPU FTZ property — ops/floatbits.py)
             f = vals.astype(np.float64)
             f = np.where(f == 0.0, 0.0, f)
             f = np.where(np.isnan(f), np.nan, f)

@@ -295,14 +295,23 @@ class TpuSortExec(TpuExec):
 
 
 class TpuLocalLimitExec(TpuExec):
-    """reference: GpuLocalLimitExec / GpuGlobalLimitExec (limit.scala)."""
+    """reference: GpuLocalLimitExec / GpuGlobalLimitExec (limit.scala).
+
+    ``remaining`` stays a device scalar threaded through one fused
+    slice-and-decrement kernel per batch — the per-batch row-count readback
+    the round-1 version paid (a full device->host round trip each) is gone.
+    Later batches past the limit yield empty slices instead of breaking
+    the loop; on a high-latency attachment the extra enqueues are far
+    cheaper than one sync."""
 
     def __init__(self, child: PhysicalPlan, limit: int):
         super().__init__([child])
         self.limit = limit
-        self._kernel = cached_jit("slice0", lambda: jax.jit(
-            lambda b, remaining: rowops.slice_batch(
-                b, jnp.asarray(0, jnp.int32), remaining)))
+
+        def step(b, remaining):
+            out = rowops.slice_batch(b, jnp.asarray(0, jnp.int32), remaining)
+            return out, remaining - out.num_rows
+        self._kernel = cached_jit("limitstep", lambda: jax.jit(step))
 
     def output_schema(self) -> Schema:
         return self.children[0].output_schema()
@@ -312,13 +321,16 @@ class TpuLocalLimitExec(TpuExec):
 
         def make(part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
-                remaining = self.limit
-                for batch in part():
-                    if remaining <= 0:
+                import numpy as np
+                remaining = np.asarray(self.limit, np.int32)
+                # early-exit check every 8 batches: one round trip per 8
+                # upstream batches at most, instead of either one per batch
+                # (round 1) or none at all (which would drain an unbounded
+                # upstream under LIMIT k)
+                for i, batch in enumerate(part()):
+                    if (i + 1) % 8 == 0 and int(remaining) <= 0:
                         break
-                    out = self._kernel(batch, jnp.asarray(remaining, jnp.int32))
-                    n = out.num_rows_host()
-                    remaining -= n
+                    out, remaining = self._kernel(batch, remaining)
                     yield out
             return run
         return [make(p) for p in child_parts]
@@ -537,7 +549,57 @@ class TpuShuffleExchangeExec(TpuExec):
         growth = ctx.conf.capacity_growth
         kind = self.partitioning[0]
 
-        if kind == "single":
+        # single-device collapse: with no mesh there is one chip, so n
+        # hash/range/roundrobin buckets only serialize onto it anyway —
+        # while costing a bucket-count device->host sync and n x padded
+        # capacity. Collapse to one fused concat (zero syncs); real
+        # partitioning happens on the mesh path (parallel/distributed.py)
+        # where the exchange is an all_to_all over ICI. The reference has
+        # no single-device analogue (GPUs shuffle between executors even
+        # locally, RapidsShuffleInternalManager.scala:186-362); this is
+        # the latency-driven TPU redesign.
+        mesh = getattr(ctx.session, "mesh", None) if ctx.session else None
+        # roundrobin is exempt: it IS the user-visible repartition(n) shape
+        # (output file count of a following write), and its local path
+        # never touches the device anyway
+        collapse = (mesh is None and kind in ("hash", "range")
+                    and ctx.conf.get_bool(
+                        "spark.rapids.sql.shuffle.localCollapse", True))
+
+        if mesh is not None and kind == "hash":
+            # distributed exchange: one fused shard_map program whose core
+            # is an ICI all_to_all (parallel/distributed.py), replacing the
+            # reference's UCX transfers (RapidsShuffleInternalManager.scala)
+            key_idx = list(self.partitioning[1])
+            n_dev = mesh.devices.size
+            state = {"shards": None}
+
+            def shards():
+                if state["shards"] is None:
+                    from spark_rapids_tpu.parallel.distributed import (
+                        mesh_exchange_hash,
+                    )
+                    batches = [b for p in child_parts for b in p()]
+                    merged = _concat_device(batches, schema, growth) \
+                        if batches else DeviceBatch.empty(schema)
+                    # mesh resharding reshapes capacity into n row blocks,
+                    # so pad tiny batches up to a multiple of n
+                    if merged.capacity % n_dev:
+                        target = -(-merged.capacity // n_dev) * n_dev
+                        merged = rowops.slice_batch_to(
+                            merged, jnp.asarray(0, jnp.int32),
+                            merged.num_rows, target)
+                    state["shards"] = mesh_exchange_hash(
+                        mesh, schema, key_idx, merged)
+                return state["shards"]
+
+            def make_mesh_part(i: int) -> Partition:
+                def run() -> Iterator[DeviceBatch]:
+                    yield shards()[i]
+                return run
+            return [make_mesh_part(i) for i in range(n_dev)]
+
+        if kind == "single" or collapse:
             def single() -> Iterator[DeviceBatch]:
                 batches = [b for p in child_parts for b in p()]
                 if not batches:

@@ -115,7 +115,18 @@ def _host_bounded_range_extents(ov, om, part_b, lo, hi, asc,
     lo_unb, hi_unb = lo <= UNBOUNDED_PRECEDING, hi >= UNBOUNDED_FOLLOWING
     w = np.asarray(ov)
     if not asc:
-        w = -w.astype(np.int64 if w.dtype.kind in "iub" else np.float64)
+        if w.dtype.kind in "iub":
+            w = w.astype(np.int64)
+            # -INT64_MIN wraps back to itself and would sort FIRST in the
+            # negated (ascending) space instead of last; saturate it to
+            # INT64_MAX so it stays the extreme (it collapses with
+            # -(INT64_MIN+1), acceptable for a bounded-range frame at the
+            # far edge of the domain)
+            imin = np.iinfo(np.int64).min
+            with np.errstate(over="ignore"):
+                w = np.where(w == imin, np.iinfo(np.int64).max, -w)
+        else:
+            w = -w.astype(np.float64)
     f_lo = np.empty(n, np.int64)
     f_hi = np.empty(n, np.int64)
     starts = np.flatnonzero(part_b)

@@ -151,16 +151,30 @@ def _write_partitioned(tables, schema: Schema, protocol: WriteCommitProtocol,
 def _record_write_stats(ctx: ExecContext, op: str, st: dict,
                         state: dict) -> None:
     """Per-task write stats -> per-op metrics (the reference's
-    BasicColumnarWriteJobStatsTracker). numParts counts DISTINCT dynamic
-    partition directories across all tasks, recorded once at the end;
-    honors the same metrics-enabled gate as the generic instrumentation."""
+    BasicColumnarWriteJobStatsTracker). Callers hold state["lock"].
+    numParts (distinct dynamic partition dirs across all tasks) is
+    recorded by _finish_write_task when the last task completes — tying
+    it to stats recording dropped it whenever the final partition was
+    empty and never produced tables."""
     if not ctx.metrics_enabled:
         return
     state["parts"] |= st.pop("partDirs", set())
     for k, v in st.items():
         ctx.metric_add(op, k, v)
-    if state["remaining"] == 1 and state["parts"]:
-        ctx.metric_add(op, "numParts", len(state["parts"]))
+
+
+def _finish_write_task(ctx: ExecContext, op: str, state: dict,
+                       protocol) -> None:
+    """Last-task bookkeeping: decrement under the lock, then commit and
+    emit numParts exactly once, whether or not the final task wrote."""
+    with state["lock"]:
+        state["remaining"] -= 1
+        done = state["remaining"] == 0 and not state["failed"]
+        parts = len(state["parts"])
+    if done:
+        if ctx.metrics_enabled and parts:
+            ctx.metric_add(op, "numParts", parts)
+        protocol.commit()
 
 
 class CpuWriteExec(PhysicalPlan):
@@ -186,8 +200,9 @@ class CpuWriteExec(PhysicalPlan):
         protocol = WriteCommitProtocol(self.path)
         protocol.setup(self.mode)
         ext = _EXTENSIONS[self.fmt]
+        import threading
         state = {"remaining": len(child_parts), "failed": False,
-                 "parts": set()}
+                 "parts": set(), "lock": threading.Lock()}
 
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[pd.DataFrame]:
@@ -198,14 +213,14 @@ class CpuWriteExec(PhysicalPlan):
                         st = _write_partitioned(tables, schema, protocol, i,
                                                 ext, self.fmt,
                                                 self.partition_cols)
-                        _record_write_stats(ctx, self.describe(), st, state)
+                        with state["lock"]:
+                            _record_write_stats(ctx, self.describe(), st,
+                                                state)
                 except Exception:
                     state["failed"] = True
                     protocol.abort()
                     raise
-                state["remaining"] -= 1
-                if state["remaining"] == 0 and not state["failed"]:
-                    protocol.commit()
+                _finish_write_task(ctx, self.describe(), state, protocol)
                 yield pd.DataFrame()
             return run
         return [make(i, p) for i, p in enumerate(child_parts)]
@@ -238,8 +253,9 @@ class TpuWriteExec(PhysicalPlan):
         protocol = WriteCommitProtocol(self.path)
         protocol.setup(self.mode)
         ext = _EXTENSIONS[self.fmt]
+        import threading
         state = {"remaining": len(child_parts), "failed": False,
-                 "parts": set()}
+                 "parts": set(), "lock": threading.Lock()}
 
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[pd.DataFrame]:
@@ -250,14 +266,14 @@ class TpuWriteExec(PhysicalPlan):
                         st = _write_partitioned(tables, schema, protocol, i,
                                                 ext, self.fmt,
                                                 self.partition_cols)
-                        _record_write_stats(ctx, self.describe(), st, state)
+                        with state["lock"]:
+                            _record_write_stats(ctx, self.describe(), st,
+                                                state)
                 except Exception:
                     state["failed"] = True
                     protocol.abort()
                     raise
-                state["remaining"] -= 1
-                if state["remaining"] == 0 and not state["failed"]:
-                    protocol.commit()
+                _finish_write_task(ctx, self.describe(), state, protocol)
                 yield pd.DataFrame()
             return run
         return [make(i, p) for i, p in enumerate(child_parts)]

@@ -183,12 +183,17 @@ def segment_select_string(kind: str, col, info: GroupInfo
         imgs = [c[info.perm] for c in _string_prefix_chunks(col)]
         if want_max:
             imgs = [~img for img in imgs]
-        allones = ~jnp.uint64(0)  # invalid rows sort last within the group
+        allones = ~jnp.uint64(0)
         imgs = [jnp.where(val_s, img, allones) for img in imgs]
-        keys = (gid,) + tuple(imgs)
+        # invalid rows must sort strictly last within the group: the image
+        # sentinel alone cannot guarantee it for max, where a valid empty
+        # string's inverted image is also all-ones and an earlier null row
+        # would stably win the boundary slot
+        invalid_key = (~val_s).astype(jnp.uint8)
+        keys = (gid, invalid_key) + tuple(imgs)
         out = jax.lax.sort(keys + (info.perm, val_s), num_keys=len(keys),
                            is_stable=True)
-        imgs_s, orig_new, val_new = out[1:-2], out[-2], out[-1]
+        imgs_s, orig_new, val_new = out[2:-2], out[-2], out[-1]
         # gid sequence is unchanged by the re-sort, so the original group
         # boundaries still mark each group's first (= winning) slot
         rows = seg(jax.ops.segment_sum,

@@ -41,11 +41,11 @@ def hash_fixed_width(data: jnp.ndarray, validity: jnp.ndarray) -> jnp.ndarray:
     elif jnp.issubdtype(data.dtype, jnp.floating):
         # normalize -0.0 == 0.0 and all NaN bit patterns before hashing so
         # grouping matches CPU equality semantics
-        # (reference: NormalizeFloatingNumbers.scala)
-        f64 = data.astype(jnp.float64)
-        f64 = jnp.where(f64 == 0.0, 0.0, f64)
-        f64 = jnp.where(jnp.isnan(f64), jnp.nan, f64)
-        bits = f64.view(jnp.uint64)
+        # (reference: NormalizeFloatingNumbers.scala). f64_bits applies both
+        # normalizations and avoids the float64 bitcast the TPU AOT
+        # compiler rejects (ops/floatbits.py).
+        from spark_rapids_tpu.ops.floatbits import f64_bits
+        bits = f64_bits(data)
     else:
         bits = data.astype(jnp.int64).view(jnp.uint64) if data.dtype != jnp.uint64 else data
     h = splitmix64(bits)
@@ -120,10 +120,8 @@ def np_hash_fixed_width(data: np.ndarray, validity: np.ndarray) -> np.ndarray:
     if data.dtype == np.bool_:
         bits = data.astype(np.uint64)
     elif np.issubdtype(data.dtype, np.floating):
-        f64 = data.astype(np.float64).copy()
-        f64[f64 == 0.0] = 0.0
-        f64[np.isnan(f64)] = np.nan
-        bits = f64.view(np.uint64)
+        from spark_rapids_tpu.ops.floatbits import np_f64_bits
+        bits = np_f64_bits(data)
     else:
         bits = data.astype(np.int64).view(np.uint64)
     h = np_splitmix64(bits)

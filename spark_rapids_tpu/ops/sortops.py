@@ -43,10 +43,10 @@ def u64_key_image(col: DeviceColumn) -> List[jnp.ndarray]:
     if d.dtype == jnp.bool_:
         return [d.astype(jnp.uint64)]
     if jnp.issubdtype(d.dtype, jnp.floating):
-        f = d.astype(jnp.float64)
-        f = jnp.where(f == 0.0, 0.0, f)          # -0.0 == 0.0
-        f = jnp.where(jnp.isnan(f), jnp.nan, f)  # canonical +NaN (sorts last)
-        bits = f.view(jnp.uint64)
+        # arithmetic IEEE bits (normalizes -0.0/NaN itself) — the TPU AOT
+        # compiler rejects float64 bitcasts outright (ops/floatbits.py)
+        from spark_rapids_tpu.ops.floatbits import f64_bits
+        bits = f64_bits(d)
         sign = bits >> jnp.uint64(63)
         img = jnp.where(sign == 1, ~bits, bits | jnp.uint64(1) << jnp.uint64(63))
         return [img]

@@ -645,10 +645,13 @@ def civil_from_days(days: jnp.ndarray):
 
 def date_to_string(ctx: EvalContext, days: jnp.ndarray,
                    validity: jnp.ndarray) -> DevCol:
-    """'yyyy-MM-dd' rendering (render range clamped to years 0..9999,
-    like the reference's UTC-era support taxonomy)."""
+    """'yyyy-MM-dd' rendering. Years outside 0..9999 cannot be rendered in
+    this fixed format, so those rows become NULL rather than silently
+    rendering a clamped wrong year (the host oracle renders 5-digit and
+    negative years, so a clamp would diverge from it)."""
     cap = days.shape[0]
     y, m, d = civil_from_days(days)
+    validity = validity & (y >= 0) & (y <= 9999)
     y = jnp.clip(y, 0, 9999)
     dash = jnp.full((cap,), ord("-"), jnp.int64)
     zero = jnp.uint8(ord("0"))

@@ -82,6 +82,170 @@ def _send_buffers(batch: DeviceBatch, key_idx: Sequence[int], n: int):
     return buffers, counts
 
 
+def _a2a_exchange(buffers, counts):
+    """all_to_all every send buffer over the dp axis. Returns (received
+    buffers, received counts) in the same per-column tagged layout."""
+    a2a = functools.partial(jax.lax.all_to_all, axis_name="dp",
+                            split_axis=0, concat_axis=0, tiled=False)
+    received = []
+    for buf in buffers:
+        if buf[0] == "string":
+            _, row_lens, validity, slab, char_cnt = buf
+            received.append((
+                "string", a2a(row_lens), a2a(validity), a2a(slab),
+                jax.lax.all_to_all(char_cnt, "dp", split_axis=0,
+                                   concat_axis=0, tiled=True)))
+        else:
+            received.append(("fixed", a2a(buf[1]), a2a(buf[2])))
+    rcounts = jax.lax.all_to_all(counts, "dp", split_axis=0,
+                                 concat_axis=0, tiled=True)
+    return received, rcounts
+
+
+def _compact_received(dtypes_, received, rcounts, n):
+    """Flatten per-source (n, cap) received buffers into one compacted
+    local batch. Stable liveness sorts keep source-major order, so row
+    buffers and char slabs stay aligned after their separate compactions."""
+    from spark_rapids_tpu.ops.pallas_kernels import compact_permutation
+    shard_cap = received[0][1].shape[1]
+    rcap = n * shard_cap
+    live = (jnp.arange(shard_cap, dtype=jnp.int32)[None, :]
+            < rcounts[:, None]).reshape(rcap)
+    perm, _ = compact_permutation(live)
+    total = rcounts.sum().astype(jnp.int32)
+    cols = []
+    for dt, buf in zip(dtypes_, received):
+        if buf[0] == "string":
+            _, rlens, rvalid, rslab, rcc = buf
+            lens_flat = rlens.reshape(rcap)[perm]
+            new_offsets = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.cumsum(lens_flat).astype(jnp.int32)])
+            ccap = rslab.shape[1]
+            ck = jnp.arange(n * ccap, dtype=jnp.int32)
+            clive = (ck % ccap) < rcc[ck // ccap]
+            cperm, _ = compact_permutation(clive)
+            chars = rslab.reshape(n * ccap)[cperm]
+            v = (rvalid.reshape(rcap) & live)[perm]
+            cols.append(DeviceColumn(dt, chars, v, new_offsets))
+        else:
+            d = buf[1].reshape(rcap)[perm]
+            v = (buf[2].reshape(rcap) & live)[perm]
+            cols.append(DeviceColumn(dt, d, v))
+    return cols, total
+
+
+def mesh_exchange_hash(mesh: Mesh, schema: Schema, key_idx: Sequence[int],
+                       batch: DeviceBatch) -> List[DeviceBatch]:
+    """The engine exchange over the mesh: hash-partition ``batch``'s rows
+    across the dp axis with ONE fused shard_map program whose core is an
+    ICI ``all_to_all`` — the TPU-native replacement for the reference's
+    UCX peer-to-peer shuffle serving every query
+    (RapidsShuffleInternalManager.scala:186-362). Returns one DeviceBatch
+    per mesh device (rows whose key-hash lands on that device).
+
+    The input batch is resharded over the mesh (row-block per device) by
+    reshaping its capacity into (n, capacity/n); on a real pod slice
+    upstream stages would already hold their shard resident, making the
+    device_put a no-op placement."""
+    n = mesh.devices.size
+    cap = batch.capacity
+    assert cap % n == 0, (cap, n)
+    shard_cap = cap // n
+    key_idx = list(key_idx)
+
+    # --- host-side prep: one jitted reshape/gather into (n, ...) blocks ---
+    def prep(b: DeviceBatch):
+        out = []
+        base = jnp.arange(n, dtype=jnp.int32) * shard_cap
+        shard_rows = jnp.clip(b.num_rows - base, 0, shard_cap)
+        for c in b.columns:
+            if c.dtype.is_string:
+                lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int32)
+                ccap = c.data.shape[0]
+                start = c.offsets[base].astype(jnp.int32)
+                cnt = (c.offsets[jnp.concatenate(
+                    [base[1:], jnp.asarray([cap], jnp.int32)])]
+                    .astype(jnp.int32) - start)
+                k = jnp.arange(ccap, dtype=jnp.int32)
+                cidx = jnp.clip(start[:, None] + k[None, :], 0, ccap - 1)
+                slab = jnp.where(k[None, :] < cnt[:, None],
+                                 c.data[cidx], 0).astype(jnp.uint8)
+                out.extend([lens.reshape(n, shard_cap),
+                            c.validity.reshape(n, shard_cap), slab, cnt])
+            else:
+                out.extend([c.data.reshape(n, shard_cap),
+                            c.validity.reshape(n, shard_cap)])
+        out.append(shard_rows)
+        return tuple(out)
+
+    flat = jax.jit(prep)(batch)
+
+    # --- lay out over the mesh ---
+    row_sh = NamedSharding(mesh, P("dp", None))
+    vec_sh = NamedSharding(mesh, P("dp"))
+    flat_in, in_specs = [], []
+    for arr in flat:
+        nd = arr.ndim
+        flat_in.append(jax.device_put(arr, row_sh if nd == 2 else vec_sh))
+        in_specs.append(P("dp", None) if nd == 2 else P("dp"))
+
+    def local(*args):
+        it = iter(args[:-1])
+        rows = args[-1][0]
+        cols = []
+        for dt in schema.dtypes:
+            if dt.is_string:
+                lens, validity, slab, cnt = (next(it), next(it), next(it),
+                                             next(it))
+                lens, validity, slab = lens[0], validity[0], slab[0]
+                offsets = jnp.concatenate(
+                    [jnp.zeros((1,), jnp.int32),
+                     jnp.cumsum(lens).astype(jnp.int32)])
+                cols.append(DeviceColumn(dt, slab, validity, offsets))
+            else:
+                data, validity = next(it)[0], next(it)[0]
+                cols.append(DeviceColumn(dt, data, validity))
+        local_batch = DeviceBatch(Schema(schema.names, schema.dtypes),
+                                  cols, rows)
+        buffers, counts = _send_buffers(local_batch, key_idx, n)
+        received, rcounts = _a2a_exchange(buffers, counts)
+        out_cols, total = _compact_received(schema.dtypes, received,
+                                            rcounts, n)
+        out = [total[None]]
+        for c in out_cols:
+            out.append(c.data[None])
+            out.append(c.validity[None])
+            if c.dtype.is_string:
+                out.append(c.offsets[None])
+        return tuple(out)
+
+    n_out = 1 + sum(3 if dt.is_string else 2 for dt in schema.dtypes)
+    out_specs = tuple([P("dp")] + [P("dp", None)] * (n_out - 1))
+    fn = jax.jit(shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                           out_specs=out_specs, check_vma=False))
+    outs = fn(*flat_in)
+
+    # unstack: shard i's arrays -> one DeviceBatch per mesh device
+    totals = outs[0]
+    results: List[DeviceBatch] = []
+    for i in range(n):
+        pos = 1
+        cols = []
+        for dt in schema.dtypes:
+            if dt.is_string:
+                chars, validity, offsets = (outs[pos][i], outs[pos + 1][i],
+                                            outs[pos + 2][i])
+                cols.append(DeviceColumn(dt, chars, validity, offsets))
+                pos += 3
+            else:
+                data, validity = outs[pos][i], outs[pos + 1][i]
+                cols.append(DeviceColumn(dt, data, validity))
+                pos += 2
+        results.append(DeviceBatch(schema, cols, totals[i]))
+    return results
+
+
 def distributed_hash_aggregate_step(mesh: Mesh, schema: Schema,
                                     key_exprs, update_inputs,
                                     update_reductions, merge_reductions,
@@ -112,49 +276,9 @@ def distributed_hash_aggregate_step(mesh: Mesh, schema: Schema,
                                    update_reductions, partial_schema)
         # exchange: hash-partition partial rows across the mesh
         buffers, counts = _send_buffers(partial, list(range(num_keys)), n)
-        a2a = functools.partial(jax.lax.all_to_all, axis_name="dp",
-                                split_axis=0, concat_axis=0, tiled=False)
-        received = []
-        for buf in buffers:
-            if buf[0] == "string":
-                _, row_lens, validity, slab, char_cnt = buf
-                received.append((
-                    "string", a2a(row_lens), a2a(validity), a2a(slab),
-                    jax.lax.all_to_all(char_cnt, "dp", split_axis=0,
-                                       concat_axis=0, tiled=True)))
-            else:
-                received.append(("fixed", a2a(buf[1]), a2a(buf[2])))
-        rcounts = jax.lax.all_to_all(counts, "dp", split_axis=0,
-                                     concat_axis=0, tiled=True)
-        # flatten received (n, cap) buffers into one batch, compacted.
-        # Stable liveness sorts keep source-major order, so row buffers
-        # and char slabs stay aligned after their separate compactions.
-        from spark_rapids_tpu.ops.pallas_kernels import compact_permutation
-        shard_cap = received[0][1].shape[1]
-        rcap = n * shard_cap
-        live = (jnp.arange(shard_cap, dtype=jnp.int32)[None, :]
-                < rcounts[:, None]).reshape(rcap)
-        perm, _ = compact_permutation(live)
-        total = rcounts.sum().astype(jnp.int32)
-        cols2 = []
-        for dt, buf in zip(partial_schema.dtypes, received):
-            if buf[0] == "string":
-                _, rlens, rvalid, rslab, rcc = buf
-                lens_flat = rlens.reshape(rcap)[perm]
-                new_offsets = jnp.concatenate(
-                    [jnp.zeros((1,), jnp.int32),
-                     jnp.cumsum(lens_flat).astype(jnp.int32)])
-                ccap = rslab.shape[1]
-                ck = jnp.arange(n * ccap, dtype=jnp.int32)
-                clive = (ck % ccap) < rcc[ck // ccap]
-                cperm, _ = compact_permutation(clive)
-                chars = rslab.reshape(n * ccap)[cperm]
-                v = (rvalid.reshape(rcap) & live)[perm]
-                cols2.append(DeviceColumn(dt, chars, v, new_offsets))
-            else:
-                d = buf[1].reshape(rcap)[perm]
-                v = (buf[2].reshape(rcap) & live)[perm]
-                cols2.append(DeviceColumn(dt, d, v))
+        received, rcounts = _a2a_exchange(buffers, counts)
+        cols2, total = _compact_received(partial_schema.dtypes, received,
+                                         rcounts, n)
         rbatch = DeviceBatch(partial_schema, cols2, total)
         merged = aggregate_merge(rbatch, num_keys, merge_reductions,
                                  partial_schema)
@@ -182,6 +306,51 @@ def dryrun_multichip_full(n_devices: int) -> None:
     executed once on an n-device mesh with tiny shapes. Grows as engine
     paths gain mesh execution (VERDICT r1 items 2 and 4)."""
     dryrun_distributed_q1(n_devices)
+    dryrun_session_mesh(n_devices)
+
+
+def dryrun_session_mesh(n_devices: int) -> None:
+    """Engine-integrated mesh execution: a group-by aggregate AND a
+    shuffled hash join run through the *session* API with the exchange
+    riding mesh_exchange_hash (all_to_all over the dp axis), checked
+    against the CPU oracle."""
+    import numpy as np
+    import pandas as pd
+    from spark_rapids_tpu.session import TpuSparkSession
+    from spark_rapids_tpu.sql import functions as F
+
+    s = TpuSparkSession.builder().config(
+        "spark.rapids.sql.enabled", True).get_or_create()
+    s.set_mesh(n_devices)
+    saved = dict(s.conf._settings)
+    try:
+        s.set_conf("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+        rng = np.random.default_rng(7)
+        rows = 64 * n_devices
+        left = pd.DataFrame({
+            "k": rng.integers(0, 9, rows).astype(np.int64),
+            "v": rng.random(rows),
+        })
+        right = pd.DataFrame({"k": np.arange(9, dtype=np.int64),
+                              "tag": [f"t{i}" for i in range(9)]})
+
+        def q(sess):
+            l = sess.create_dataframe(left, 2)
+            r = sess.create_dataframe(right, 2)
+            return (l.join(r, on="k", how="inner")
+                     .group_by("tag")
+                     .agg(F.sum("v").alias("sv"), F.count("*").alias("n")))
+
+        tpu = q(s).collect().sort_values("tag").reset_index(drop=True)
+        s.set_conf("spark.rapids.sql.enabled", False)
+        cpu = q(s).collect().sort_values("tag").reset_index(drop=True)
+        assert list(tpu["n"]) == list(cpu["n"]), (tpu, cpu)
+        np.testing.assert_allclose(tpu["sv"].to_numpy(dtype=np.float64),
+                                   cpu["sv"].to_numpy(dtype=np.float64),
+                                   rtol=1e-9)
+    finally:
+        s.conf._settings = saved
+        s.set_mesh(None)
 
 
 def dryrun_distributed_q1(n_devices: int, rows_per_shard: int = 512) -> None:

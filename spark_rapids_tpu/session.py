@@ -40,9 +40,24 @@ class TpuSparkSession:
         self.capture_plans = False
         # device-resident scan batches (spark.rapids.sql.cacheDeviceScans)
         self.device_scan_cache: dict = {}
+        # device mesh for distributed execution (None = single-device);
+        # when set, TpuShuffleExchangeExec exchanges over it with an ICI
+        # all_to_all instead of collapsing locally (parallel/distributed.py)
+        self.mesh = None
 
     def clear_device_cache(self) -> None:
         self.device_scan_cache.clear()
+
+    def set_mesh(self, n_devices: Optional[int]) -> None:
+        """Configure an n-device data-parallel mesh for distributed
+        exchanges (the session-level analogue of enabling the reference's
+        RapidsShuffleManager, GpuShuffleEnv.scala:27-136). ``None`` returns
+        to single-device execution."""
+        if n_devices is None:
+            self.mesh = None
+            return
+        from spark_rapids_tpu.parallel.distributed import data_parallel_mesh
+        self.mesh = data_parallel_mesh(n_devices)
 
     # --- builder -----------------------------------------------------------
     class Builder:
@@ -141,7 +156,9 @@ class TpuSparkSession:
                 finally:
                     if self.semaphore is not None:
                         self.semaphore.release()
-            outs = DeviceBatch.to_pandas_many(batches)
+            outs = DeviceBatch.to_pandas_many(
+                batches, fused_fetch_bytes=int(conf.get(
+                    "spark.rapids.sql.collect.fusedFetchBytes", 4 << 20)))
         else:
             for part in plan.executed_partitions(ctx):
                 for df in part():

@@ -506,8 +506,12 @@ def assert_is_on_tpu(plan: PhysicalPlan, conf: TpuConf) -> None:
     """Test-mode enforcement (GpuTransitionOverrides.assertIsOnTheGpu,
     GpuTransitionOverrides.scala:225-263): fail the query if a
     non-allow-listed operator stayed on the CPU."""
+    # only the transitions themselves are implicitly allowed; a scan that
+    # stayed on the CPU must be named via spark.rapids.sql.test.allowedNonTpu
+    # exactly like any other fallback (the reference asserts scans too,
+    # GpuTransitionOverrides.scala:225-263)
     allowed = set(conf.test_allowed_nontpu) | {
-        "HostToDeviceExec", "DeviceToHostExec", "CpuScanExec",
+        "HostToDeviceExec", "DeviceToHostExec",
     }
     offenders = []
     for node in plan.walk():

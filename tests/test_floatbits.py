@@ -1,0 +1,79 @@
+"""f64_bits must reproduce normalize-then-view bit-for-bit (it feeds both
+sort-key images and row hashes, whose numpy twins use the real bitcast).
+The arithmetic no-bitcast path (what real TPU runs) is tested explicitly
+with its documented flush-to-zero denormal semantics."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.ops.floatbits import (
+    f64_bits, f64_bits_arith, np_f64_bits,
+)
+
+
+EDGE_VALUES = np.array([
+    0.0, -0.0, 1.0, -1.0, 1.5, -1.5, 2.0, 0.5, 0.75,
+    np.inf, -np.inf, np.nan, -np.nan,
+    np.finfo(np.float64).max, -np.finfo(np.float64).max,
+    np.finfo(np.float64).tiny, -np.finfo(np.float64).tiny,       # 2^-1022
+    np.finfo(np.float64).tiny / 2,                                # denormal
+    5e-324, -5e-324,                                              # min denormal
+    np.nextafter(np.finfo(np.float64).tiny, 0.0),                 # max denormal
+    np.nextafter(np.finfo(np.float64).tiny, 1.0),                 # min normal+1
+    np.nextafter(0.0, 1.0), np.nextafter(0.0, -1.0),
+    np.nextafter(1.0, 2.0), np.nextafter(1.0, 0.0),
+    np.nextafter(np.inf, 0.0), np.nextafter(-np.inf, 0.0),
+    np.pi, -np.pi, 1e-300, -1e-300, 1e300, -1e300,
+    123.456, -123.456, 2.0 ** 52, 2.0 ** 53, 2.0 ** 1023,
+], dtype=np.float64)
+
+
+def _check(fn, vals: np.ndarray, ref: np.ndarray):
+    got = np.asarray(jax.jit(fn)(jnp.asarray(vals)))
+    bad = got != ref
+    assert not bad.any(), [
+        (v, hex(int(g)), hex(int(r)))
+        for v, g, r in zip(vals[bad][:5], got[bad][:5], ref[bad][:5])]
+
+
+def _ref_bits_ftz(vals):
+    return np_f64_bits(vals)
+
+
+def test_edge_values():
+    _check(f64_bits, EDGE_VALUES, np_f64_bits(EDGE_VALUES))
+
+
+def test_every_exponent_band(rng):
+    # one random mantissa per binary exponent across the whole f64 range
+    mant = rng.random(2200) + 1.0          # [1, 2)
+    exps = np.arange(-1100, 1100)
+    vals = np.ldexp(mant, exps)            # underflows to denormals/zero
+    vals = np.concatenate([vals, -vals])
+    _check(f64_bits_arith, vals, _ref_bits_ftz(vals))
+
+
+def test_random_bit_patterns(rng):
+    raw = rng.integers(0, 2 ** 64, 50_000, dtype=np.uint64)
+    vals = raw.view(np.float64)
+    _check(f64_bits_arith, vals, _ref_bits_ftz(vals))
+
+
+def test_ordering_matches_total_order(rng):
+    # the sort image built from these bits must order like the CPU oracle:
+    # -inf < finite < +inf < NaN, with -0 == +0
+    vals = np.concatenate([
+        rng.standard_normal(1000) * 10.0 ** rng.integers(-300, 300, 1000),
+        EDGE_VALUES,
+    ])
+    bits = np.asarray(jax.jit(f64_bits_arith)(jnp.asarray(vals)))
+    sign = bits >> np.uint64(63)
+    img = np.where(sign == 1, ~bits, bits | (np.uint64(1) << np.uint64(63)))
+    order = np.argsort(img, kind="stable")
+    sorted_vals = vals[order]
+    nonnan = sorted_vals[~np.isnan(sorted_vals)]
+    assert not np.isnan(sorted_vals[: len(nonnan)]).any()  # NaN strictly last
+    # FTZ: denormals order as zero, so compare on the flushed values
+    flushed = np.where(np.abs(nonnan) < 2.0 ** -1022, 0.0, nonnan)
+    assert (np.diff(flushed) >= 0).all()
