@@ -51,7 +51,12 @@ def _concat_device(batches: List[DeviceBatch], schema: Schema,
     # char capacity 0 = per-column sum computed inside concat_batches
     kernel = cached_jit("concat", lambda: jax.jit(
         rowops.concat_batches, static_argnums=(1, 2)))
-    return kernel(batches, out_cap, 0)
+    out = kernel(batches, out_cap, 0)
+    from spark_rapids_tpu.memory.device import TpuDeviceManager
+    dm = TpuDeviceManager.current()
+    if dm is not None:
+        dm.meter_batch(out)
+    return out
 
 
 def _split_by_pid(batch: DeviceBatch, pid: jnp.ndarray, n: int):
@@ -472,23 +477,49 @@ class TpuScanExec(TpuExec):
                     sem.acquire_if_necessary()
                 if cache is not None and i in cache:
                     # replay with each batch's origin file restored so
-                    # input_file_name() stays correct on cache hits
-                    for fname, batch in cache[i]:
+                    # input_file_name() stays correct on cache hits; the
+                    # catalog faults spilled batches back to the device
+                    catalog = ctx.session.buffer_catalog
+                    for fname, bid in cache[i]:
                         taskctx.set_input_file(fname)
-                        yield batch
+                        yield catalog.acquire_batch(bid)
                     taskctx.clear_input_file()
                     return
                 out = [] if cache is not None else None
-                for df in part():
-                    for lo in range(0, max(len(df), 1), max_rows):
-                        chunk = df.iloc[lo:lo + max_rows]
-                        batch = DeviceBatch.from_pandas(
-                            chunk.reset_index(drop=True), schema=schema)
-                        if out is not None:
-                            out.append((taskctx.input_file(), batch))
-                        yield batch
-                if out is not None:
-                    cache[i] = out
+                dm = ctx.session.device_manager if ctx.session else None
+                try:
+                    for df in part():
+                        for lo in range(0, max(len(df), 1), max_rows):
+                            chunk = df.iloc[lo:lo + max_rows]
+                            batch = DeviceBatch.from_pandas(
+                                chunk.reset_index(drop=True), schema=schema)
+                            if out is not None:
+                                # cached batches live in the spillable
+                                # catalog (budget-metered, evictable)
+                                from spark_rapids_tpu.memory.spill import (
+                                    SpillPriorities,
+                                )
+                                bid = ctx.session.buffer_catalog.add_batch(
+                                    batch, SpillPriorities.CACHED_SCAN)
+                                out.append((taskctx.input_file(), bid))
+                            elif dm is not None:
+                                dm.meter_batch(batch)
+                            yield batch
+                    if out is not None:
+                        if i in cache:  # concurrent filler won the publish
+                            out, published = None, out
+                            for _f, bid in published:
+                                ctx.session.buffer_catalog.remove(bid)
+                        else:
+                            cache[i] = out
+                except BaseException:
+                    # abandoned/failed scan: unpublished bids would leak
+                    # catalog buffers forever (clear_device_cache only
+                    # walks published entries)
+                    if out is not None and cache.get(i) is not out:
+                        for _f, bid in out:
+                            ctx.session.buffer_catalog.remove(bid)
+                    raise
             return run
         return [make(i, p) for i, p in enumerate(cpu_parts)]
 
@@ -559,10 +590,13 @@ class TpuShuffleExchangeExec(TpuExec):
         # locally, RapidsShuffleInternalManager.scala:186-362); this is
         # the latency-driven TPU redesign.
         mesh = getattr(ctx.session, "mesh", None) if ctx.session else None
+        manager_on = (ctx.session is not None and ctx.conf.get_bool(
+            "spark.rapids.shuffle.transport.enabled", False))
         # roundrobin is exempt: it IS the user-visible repartition(n) shape
         # (output file count of a following write), and its local path
         # never touches the device anyway
-        collapse = (mesh is None and kind in ("hash", "range")
+        collapse = (mesh is None and not manager_on
+                    and kind in ("hash", "range")
                     and ctx.conf.get_bool(
                         "spark.rapids.sql.shuffle.localCollapse", True))
 
@@ -679,44 +713,90 @@ class TpuShuffleExchangeExec(TpuExec):
                 bounds = [np.zeros((n - 1,), np.uint64) for _ in range(k)]
             return tuple(jnp.asarray(b) for b in bounds)
 
-        def materialize():
-            if state["buckets"] is not None:
-                return state["buckets"]
-            buckets: List[List[DeviceBatch]] = [[] for _ in range(n)]
-            if kind == "range":
-                all_batches = [b for p in child_parts for b in p()]
-                bounds = compute_range_bounds(all_batches)
-                split_iter = (self._pkernel(b, bounds) for b in all_batches)
-            else:
-                split_iter = (self._pkernel(b) for p in child_parts
-                              for b in p())
-            # fetch bucket counts in windows: one device->host round trip
-            # per WINDOW batches (per-batch scalar syncs each pay a full
-            # round trip; one giant window would pin every split output in
-            # device memory at once)
+        def split_to_slices(batches, bounds):
+            """Split each batch by partition id and yield
+            (batch_index, pid, piece) — the shared core of both exchange
+            materializations. Bucket counts are fetched in windows: one
+            device->host round trip per WINDOW batches (per-batch scalar
+            syncs each pay a full round trip; one giant window would pin
+            every split output in device memory at once)."""
             import itertools
             import jax
             import numpy as np
+            split_iter = ((bi, (self._pkernel(b, bounds) if kind == "range"
+                                else self._pkernel(b)))
+                          for bi, b in enumerate(batches))
             WINDOW = 16
             windowed = iter(lambda: list(itertools.islice(split_iter,
                                                           WINDOW)), [])
             for window in windowed:
-                window_counts = jax.device_get([c for _, c in window])
-                for (sorted_batch, counts), host_counts in zip(
+                window_counts = jax.device_get([c for _, (_s, c) in window])
+                for (bi, (sorted_batch, _c)), host_counts in zip(
                         window, window_counts):
                     host_counts = np.asarray(host_counts)
                     offsets = np.concatenate([[0], np.cumsum(host_counts)])
                     for pid in range(n):
                         if host_counts[pid] == 0:
                             continue
-                        piece = slice_kernel(
+                        yield bi, pid, slice_kernel(
                             sorted_batch,
                             jnp.asarray(offsets[pid], jnp.int32),
                             jnp.asarray(host_counts[pid], jnp.int32),
                             int(host_counts[pid]))
-                        buckets[pid].append(piece)
+
+        def materialize():
+            if state["buckets"] is not None:
+                return state["buckets"]
+            buckets: List[List[DeviceBatch]] = [[] for _ in range(n)]
+            all_batches = [b for p in child_parts for b in p()]
+            bounds = (compute_range_bounds(all_batches)
+                      if kind == "range" else None)
+            for _bi, pid, piece in split_to_slices(all_batches, bounds):
+                buckets[pid].append(piece)
             state["buckets"] = buckets
             return buckets
+
+        if manager_on:
+            # accelerated shuffle manager path: map-side slices register
+            # as spillable shuffle blocks via CachingShuffleWriter; the
+            # reduce side reads them back through CachingShuffleReader
+            # over the (in-process) transport — the engine-integrated
+            # RapidsShuffleInternalManager.scala:74-362 flow
+            from spark_rapids_tpu.shuffle.manager import (
+                CachingShuffleReader, CachingShuffleWriter,
+            )
+            mstate = {"statuses": None}
+
+            def materialize_manager():
+                if mstate["statuses"] is not None:
+                    return mstate["statuses"]
+                env = ctx.session.shuffle_env
+                shuffle_id = ctx.session.next_shuffle_id()
+                per_map_batches = [list(p()) for p in child_parts]
+                bounds = (compute_range_bounds(
+                    [b for bs in per_map_batches for b in bs])
+                    if kind == "range" else None)
+                statuses = []
+                for mi, batches in enumerate(per_map_batches):
+                    per_pid: List[List[DeviceBatch]] = [[] for _ in range(n)]
+                    for _bi, pid, piece in split_to_slices(batches, bounds):
+                        per_pid[pid].append(piece)
+                    writer = CachingShuffleWriter(env, shuffle_id, mi)
+                    statuses.append(writer.write(per_pid))
+                mstate["statuses"] = (shuffle_id, statuses)
+                return mstate["statuses"]
+
+            def make_manager(pid: int) -> Partition:
+                def run() -> Iterator[DeviceBatch]:
+                    shuffle_id, statuses = materialize_manager()
+                    reader = CachingShuffleReader(ctx.session.shuffle_env)
+                    batches = list(reader.read(shuffle_id, pid, statuses))
+                    if not batches:
+                        yield DeviceBatch.empty(schema)
+                        return
+                    yield _concat_device(batches, schema, growth)
+                return run
+            return [make_manager(i) for i in range(n)]
 
         def make(pid: int) -> Partition:
             def run() -> Iterator[DeviceBatch]:

@@ -174,8 +174,15 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                                    for c in sizes[1 + n_s:])
                     out_cap = bucket_capacity(total, growth)
                     emitted = True
-                    yield self._expand(build, stream, counts, bstart, bperm,
-                                       out_cap, s_caps, b_caps)
+                    expanded = self._expand(build, stream, counts, bstart,
+                                            bperm, out_cap, s_caps, b_caps)
+                    from spark_rapids_tpu.memory.device import (
+                        TpuDeviceManager,
+                    )
+                    dm = TpuDeviceManager.current()
+                    if dm is not None:
+                        dm.meter_batch(expanded)
+                    yield expanded
                 if jt == "full":
                     if matched_acc is None:
                         matched_acc = jnp.zeros((build.capacity,), jnp.bool_)

@@ -67,22 +67,44 @@ class HostToDeviceExec(PhysicalPlan):
                 if sem is not None:
                     sem.acquire_if_necessary()
                 if cache is not None and i in cache:
-                    for fname, batch in cache[i]:
+                    catalog = ctx.session.buffer_catalog
+                    for fname, bid in cache[i]:
                         taskctx.set_input_file(fname)
-                        yield batch
+                        yield catalog.acquire_batch(bid)
                     taskctx.clear_input_file()
                     return
                 out = [] if cache is not None else None
-                for df in part():
-                    for lo in range(0, max(len(df), 1), max_rows):
-                        chunk = df.iloc[lo:lo + max_rows]
-                        batch = DeviceBatch.from_pandas(
-                            chunk.reset_index(drop=True), schema=schema)
-                        if out is not None:
-                            out.append((taskctx.input_file(), batch))
-                        yield batch
-                if out is not None:
-                    cache[i] = out
+                dm = ctx.session.device_manager if ctx.session else None
+                try:
+                    for df in part():
+                        for lo in range(0, max(len(df), 1), max_rows):
+                            chunk = df.iloc[lo:lo + max_rows]
+                            batch = DeviceBatch.from_pandas(
+                                chunk.reset_index(drop=True), schema=schema)
+                            if out is not None:
+                                from spark_rapids_tpu.memory.spill import (
+                                    SpillPriorities,
+                                )
+                                bid = ctx.session.buffer_catalog.add_batch(
+                                    batch, SpillPriorities.CACHED_SCAN)
+                                out.append((taskctx.input_file(), bid))
+                            elif dm is not None:
+                                dm.meter_batch(batch)
+                            yield batch
+                    if out is not None:
+                        if i in cache:  # concurrent filler won the publish
+                            out, published = None, out
+                            for _f, bid in published:
+                                ctx.session.buffer_catalog.remove(bid)
+                        else:
+                            cache[i] = out
+                except BaseException:
+                    # abandoned/failed scan: unpublished bids would leak
+                    # catalog buffers forever
+                    if out is not None and cache.get(i) is not out:
+                        for _f, bid in out:
+                            ctx.session.buffer_catalog.remove(bid)
+                    raise
             return run
         return [make(i, p) for i, p in enumerate(child_parts)]
 

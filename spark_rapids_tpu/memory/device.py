@@ -41,9 +41,26 @@ class TpuDeviceManager:
             return cls._instance
 
     @classmethod
+    def current(cls) -> Optional["TpuDeviceManager"]:
+        """The live instance, or None before any session exists — lets
+        layer-agnostic code meter allocations without creating one."""
+        return cls._instance
+
+    @classmethod
     def reset(cls) -> None:
         with cls._lock:
             cls._instance = None
+
+    def meter_batch(self, batch) -> None:
+        """Meter a transient engine batch against the HBM budget, freeing
+        automatically when the batch is garbage collected (streaming
+        batches have no close() discipline of their own; catalog-registered
+        buffers are metered by DeviceStore.add_batch instead)."""
+        import weakref
+        size = batch.device_memory_size()
+        if size:
+            self.track_alloc(size)
+            weakref.finalize(batch, self.track_free, size)
 
     def _probe_hbm_bytes(self) -> int:
         try:
@@ -58,7 +75,12 @@ class TpuDeviceManager:
     # --- budget accounting (the Rmm pool + event-handler contract,
     # DeviceMemoryEventHandler.scala:37-93) -------------------------------
     def register_oom_handler(self, handler) -> None:
-        self._oom_handlers.append(handler)
+        if handler not in self._oom_handlers:
+            self._oom_handlers.append(handler)
+
+    def unregister_oom_handler(self, handler) -> None:
+        if handler in self._oom_handlers:
+            self._oom_handlers.remove(handler)
 
     def track_alloc(self, nbytes: int) -> None:
         """Meter a framework allocation against the HBM budget; drive spill

@@ -50,6 +50,7 @@ class SpillPriorities:
     """Priority bands (reference: SpillPriorities.scala:26-50). Lower
     spills first."""
     OUTPUT_FOR_READ = -100
+    CACHED_SCAN = -50   # re-faultable device scan cache: cheap to evict
     OUTPUT_FOR_WRITE = 0
     ACTIVE_BATCH = 100
     INPUT = 2 ** 62  # last resort

@@ -5,11 +5,18 @@ TPU-first design: cuDF probes a device hash table (data-dependent memory,
 which XLA cannot express). Instead the join runs as sort + sorted search,
 everything shape-static:
 
-  1. hash both sides' key columns to 128 bits (same double-hash the
-     group-by uses, ops/groupby.py);
-  2. one fused ``lax.sort`` over the *union* of both sides' hash pairs
-     assigns every row a joint dense key id (int32) — exact equality on the
-     128-bit pair, no verification pass needed at these collision odds;
+  1. build the EXACT order-preserving u64 key images of both sides' key
+     columns (the same images the sort kernels use, ops/sortops.py) —
+     fixed-width types get one image carrying the full value, strings get
+     64-byte prefix chunks + length + the two independent 64-bit poly
+     hashes as tiebreaks;
+  2. one fused ``lax.sort`` over the *union* of both sides' image vectors
+     assigns every row a joint dense key id (int32). Equality is exact for
+     every fixed-width type (the image IS the value) and for strings up to
+     64 bytes; longer strings additionally need prefix+length+both-hash
+     agreement (cuDF compares full keys, GpuHashJoin.scala:217-233 — the
+     residual gap is documented incompat territory, far beyond the
+     reference's own float-order caveats);
   3. sort the build side by key id; probe = two ``searchsorted`` calls per
      stream row giving the match range [bstart, bend);
   4. count-then-expand: match counts are summed on device, one host sync
@@ -18,8 +25,10 @@ everything shape-static:
      over the count prefix sum.
 
 Null keys never match (SQL semantics): rows with any invalid key column are
-parked outside the id space. Output capacity is the only data-dependent
-quantity and costs exactly one device->host sync per stream batch.
+parked outside the id space; float keys follow Spark's join-key equality
+(-0.0 == 0.0, NaN == NaN) via the image normalization. Output capacity is
+the only data-dependent quantity and costs exactly one device->host sync
+per stream batch.
 """
 
 from __future__ import annotations
@@ -31,7 +40,6 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
 from spark_rapids_tpu.columnar.column import DeviceColumn
-from spark_rapids_tpu.ops.groupby import row_hashes
 from spark_rapids_tpu.ops.rowops import filter_batch, gather_column
 
 
@@ -40,6 +48,22 @@ def _key_valid(batch: DeviceBatch, key_idx: Sequence[int]) -> jnp.ndarray:
     for ki in key_idx:
         v = v & batch.columns[ki].validity
     return v
+
+
+def _key_images(batch: DeviceBatch,
+                key_idx: Sequence[int]) -> List[jnp.ndarray]:
+    """Exact per-row equality-image vectors for the join keys (one or more
+    u64 arrays per key column; see module docstring)."""
+    from spark_rapids_tpu.ops.hashing import string_poly_hashes
+    from spark_rapids_tpu.ops.sortops import u64_key_image
+    imgs: List[jnp.ndarray] = []
+    for ki in key_idx:
+        col = batch.columns[ki]
+        imgs.extend(u64_key_image(col))
+        if col.dtype.is_string:
+            h1, h2 = string_poly_hashes(col.offsets, col.data, col.validity)
+            imgs.extend([h1, h2])
+    return imgs
 
 
 def join_probe(build: DeviceBatch, stream: DeviceBatch,
@@ -60,22 +84,26 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
             is_stable=True)
         return counts, bstart, bperm
 
-    bh1, bh2 = row_hashes(build, build_keys)
-    sh1, sh2 = row_hashes(stream, stream_keys)
+    b_imgs = _key_images(build, build_keys)
+    s_imgs = _key_images(stream, stream_keys)
+    assert len(b_imgs) == len(s_imgs), (len(b_imgs), len(s_imgs))
     bkv = _key_valid(build, build_keys)
     skv = _key_valid(stream, stream_keys)
 
-    h1 = jnp.concatenate([bh1, sh1])
-    h2 = jnp.concatenate([bh2, sh2])
+    imgs = [jnp.concatenate([bi, si]) for bi, si in zip(b_imgs, s_imgs)]
     invalid = (~jnp.concatenate([bkv, skv])).astype(jnp.uint8)
     pos = jnp.arange(nb + ns, dtype=jnp.int32)
-    inv_s, h1_s, h2_s, perm = jax.lax.sort((invalid, h1, h2, pos),
-                                           num_keys=3, is_stable=True)
+    out = jax.lax.sort((invalid,) + tuple(imgs) + (pos,),
+                       num_keys=1 + len(imgs), is_stable=True)
+    inv_s, imgs_s, perm = out[0], out[1:-1], out[-1]
     valid_s = inv_s == 0
-    prev1 = jnp.concatenate([h1_s[:1] ^ jnp.uint64(1), h1_s[:-1]])
-    prev2 = jnp.concatenate([h2_s[:1], h2_s[:-1]])
-    boundary = ((h1_s != prev1) | (h2_s != prev2)) & valid_s
-    boundary = boundary.at[0].set(valid_s[0])
+    # position 0 is always a group start; later positions start a group
+    # when any image differs from the previous row's
+    differs = jnp.zeros(inv_s.shape, jnp.bool_).at[0].set(True)
+    for img_s in imgs_s:
+        differs = differs | jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), img_s[1:] != img_s[:-1]])
+    boundary = differs & valid_s
     pid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     pid = jnp.where(valid_s, pid, -1)
     ids = jnp.zeros((nb + ns,), jnp.int32).at[perm].set(pid)

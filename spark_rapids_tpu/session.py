@@ -32,8 +32,21 @@ class TpuSparkSession:
         self._base_settings = dict(conf._settings)
         from spark_rapids_tpu.memory.device import TpuDeviceManager
         from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+        from spark_rapids_tpu.memory.spill import (
+            BufferCatalog, MemoryEventHandler,
+        )
         self.device_manager = TpuDeviceManager.get(conf)
         self.semaphore = TpuSemaphore.get(conf.concurrent_tpu_tasks)
+        # spillable-buffer runtime wired into execution: cached scan
+        # batches register here and over-budget allocations spill them
+        # device->host->disk (reference: GpuShuffleEnv.initStorage,
+        # GpuShuffleEnv.scala:51-72 + DeviceMemoryEventHandler.scala:65-89)
+        self.buffer_catalog = BufferCatalog(
+            conf.host_spill_storage_size,
+            device_manager=self.device_manager)
+        self.memory_event_handler = MemoryEventHandler(
+            self.buffer_catalog.device_store)
+        self.device_manager.register_oom_handler(self.memory_event_handler)
         # test hook: captured executed physical plans
         # (reference: ExecutionPlanCaptureCallback, Plugin.scala:144-233)
         self.captured_plans: List = []
@@ -44,9 +57,48 @@ class TpuSparkSession:
         # when set, TpuShuffleExchangeExec exchanges over it with an ICI
         # all_to_all instead of collapsing locally (parallel/distributed.py)
         self.mesh = None
+        # accelerated shuffle manager (spark.rapids.shuffle.transport.
+        # enabled): lazily built; shares the session catalog so shuffle
+        # buffers are spillable (RapidsShuffleInternalManager.scala:74-178)
+        self._shuffle_env = None
+        self._shuffle_id_counter = 0
+        self._active_shuffles: List[int] = []
 
     def clear_device_cache(self) -> None:
+        for _source, parts in self.device_scan_cache.values():
+            for entries in parts.values():
+                for _fname, bid in entries:
+                    self.buffer_catalog.remove(bid)
         self.device_scan_cache.clear()
+
+    @property
+    def shuffle_env(self):
+        if self._shuffle_env is None:
+            from spark_rapids_tpu.shuffle.manager import ShuffleEnv
+            from spark_rapids_tpu.shuffle.transport import InProcessTransport
+            bsize = int(self.conf.get(
+                "spark.rapids.shuffle.bounceBuffers.size", 4 << 20))
+            bcount = int(self.conf.get(
+                "spark.rapids.shuffle.bounceBuffers.count", 16))
+            self._shuffle_env = ShuffleEnv(
+                "local-exec", InProcessTransport("local-exec"),
+                bounce_buffer_size=bsize, bounce_buffer_count=bcount,
+                buffer_catalog=self.buffer_catalog)
+        return self._shuffle_env
+
+    def next_shuffle_id(self) -> int:
+        self._shuffle_id_counter += 1
+        self._active_shuffles.append(self._shuffle_id_counter)
+        return self._shuffle_id_counter
+
+    def release_active_shuffles(self) -> None:
+        """Unregister every shuffle the last query registered (the
+        reference's unregisterShuffle path)."""
+        if self._shuffle_env is None:
+            return
+        for sid in self._active_shuffles:
+            self._shuffle_env.shuffle_catalog.remove_shuffle(sid)
+        self._active_shuffles.clear()
 
     def set_mesh(self, n_devices: Optional[int]) -> None:
         """Configure an n-device data-parallel mesh for distributed
@@ -93,6 +145,22 @@ class TpuSparkSession:
         if s is None:
             s = TpuSparkSession.builder().get_or_create()
         return s
+
+    def stop(self) -> None:
+        """Tear the session down (SparkSession.stop parity): release
+        cached/spilled buffers, detach the memory event handler from the
+        process-wide device manager (a later session registers its own),
+        and clear the singleton."""
+        self.clear_device_cache()
+        self.release_active_shuffles()
+        if self._shuffle_env is not None:
+            self._shuffle_env.close()
+            self._shuffle_env = None
+        self.device_manager.unregister_oom_handler(self.memory_event_handler)
+        self.buffer_catalog.close()
+        with TpuSparkSession._lock:
+            if TpuSparkSession._active is self:
+                TpuSparkSession._active = None
 
     # --- conf --------------------------------------------------------------
     def set_conf(self, key: str, value) -> None:
@@ -143,6 +211,28 @@ class TpuSparkSession:
             self.captured_plans.append(plan)
         # final output to host
         outs: List[pd.DataFrame] = []
+        try:
+            outs = self._drain(plan, ctx, conf)
+        finally:
+            self.release_active_shuffles()
+        # per-operator SQL metrics of the last executed query (the
+        # reference surfaces these in the Spark UI, GpuExec.scala:61-67),
+        # plus the memory runtime's counters (allocated/spill activity —
+        # the reference's gpuOpTime/spill metrics, GpuMetricNames)
+        if ctx.metrics_enabled:
+            cat = self.buffer_catalog
+            ctx.metrics["memory"] = {
+                "allocatedBytes": self.device_manager.allocated,
+                "spillCount": self.memory_event_handler.spill_count,
+                "deviceStoreBytes": cat.device_store.total_size,
+                "hostStoreBytes": cat.host_store.total_size,
+                "diskStoreBytes": cat.disk_store.total_size,
+            }
+        self.last_query_metrics = ctx.metrics
+        return plan, outs
+
+    def _drain(self, plan, ctx, conf) -> List[pd.DataFrame]:
+        outs: List[pd.DataFrame] = []
         if plan.columnar_output:
             # drain every partition's device batches first, then convert
             # with to_pandas_many: TWO device->host round trips for the
@@ -163,10 +253,7 @@ class TpuSparkSession:
             for part in plan.executed_partitions(ctx):
                 for df in part():
                     outs.append(df)
-        # per-operator SQL metrics of the last executed query (the
-        # reference surfaces these in the Spark UI, GpuExec.scala:61-67)
-        self.last_query_metrics = ctx.metrics
-        return plan, outs
+        return outs
 
 
 class DataFrameWriter:

@@ -45,11 +45,16 @@ class ShuffleEnv:
                  host_limit_bytes: int = 1 << 30,
                  bounce_buffer_size: int = 1 << 20,
                  bounce_buffer_count: int = 4,
-                 disk_dir: Optional[str] = None, device_manager=None):
+                 disk_dir: Optional[str] = None, device_manager=None,
+                 buffer_catalog: Optional[BufferCatalog] = None):
         self.executor_id = executor_id
         self.transport = transport
-        self.buffer_catalog = BufferCatalog(host_limit_bytes, disk_dir,
-                                            device_manager)
+        # an engine-integrated env shares the session's catalog so shuffle
+        # buffers ride the same spill tiers as everything else
+        # (GpuShuffleEnv.scala:51-72); standalone envs build their own
+        self._owns_catalog = buffer_catalog is None
+        self.buffer_catalog = buffer_catalog if buffer_catalog is not None \
+            else BufferCatalog(host_limit_bytes, disk_dir, device_manager)
         self.shuffle_catalog = ShuffleBufferCatalog(self.buffer_catalog)
         self.received_catalog = ReceivedBufferCatalog(self.buffer_catalog)
         self.bounce = BounceBufferManager(bounce_buffer_size,
@@ -72,7 +77,8 @@ class ShuffleEnv:
             return c
 
     def close(self) -> None:
-        self.buffer_catalog.close()
+        if self._owns_catalog:
+            self.buffer_catalog.close()
         self.transport.shutdown()
 
 

@@ -185,3 +185,39 @@ def test_join_date_key(session, rng):
     assert_tpu_and_cpu_equal(
         lambda s: s.create_dataframe(left, 2).join(
             s.create_dataframe(right, 1), on="d", how="left"))
+
+
+def test_join_exact_key_images(session, rng):
+    """Exact-value join ids (no hash probabilism): adjacent int64 extremes
+    must not collide, Spark float key equality must hold (NaN == NaN,
+    -0.0 == 0.0), and >64-byte string keys must still match correctly."""
+    imax = np.iinfo(np.int64).max
+    left = pd.DataFrame({
+        "k": pd.array([imax, imax - 1, 0, -1, imax, None], dtype="Int64"),
+        "v": np.arange(6)})
+    right = pd.DataFrame({
+        "k": pd.array([imax, imax - 1, -1, None], dtype="Int64"),
+        "w": np.arange(4)})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(left, 2).join(
+            s.create_dataframe(right, 1), on="k", how="left"))
+
+    fleft = pd.DataFrame({
+        "k": np.array([np.nan, -0.0, 0.0, 1.5, np.inf, -np.inf]),
+        "v": np.arange(6)})
+    fright = pd.DataFrame({
+        "k": np.array([np.nan, 0.0, np.inf, 2.5]),
+        "w": np.arange(4)})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(fleft, 2).join(
+            s.create_dataframe(fright, 1), on="k", how="inner"))
+
+    long_a = "x" * 70 + "a"
+    long_b = "x" * 70 + "b"
+    sleft = pd.DataFrame({"k": np.array([long_a, long_b, "short", long_a]),
+                          "v": np.arange(4)})
+    sright = pd.DataFrame({"k": np.array([long_a, "short", long_b + "c"]),
+                           "w": np.arange(3)})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(sleft, 2).join(
+            s.create_dataframe(sright, 1), on="k", how="left"))

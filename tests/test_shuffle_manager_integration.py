@@ -1,0 +1,99 @@
+"""Accelerated shuffle manager wired into the exchange (VERDICT r1 item 6).
+
+With spark.rapids.shuffle.transport.enabled the engine's shuffle exchange
+registers map-side slices as spillable shuffle blocks (CachingShuffleWriter)
+and reduce tasks read them back via CachingShuffleReader — differential
+suite must stay green and the blocks must participate in the spill tiers.
+Reference flow: RapidsShuffleInternalManager.scala:74-362."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from querytest import assert_tpu_and_cpu_equal
+
+MANAGER_CONF = {
+    "spark.rapids.shuffle.transport.enabled": True,
+    # disable broadcast so joins actually shuffle
+    "spark.rapids.sql.autoBroadcastJoinThreshold": -1,
+}
+
+
+def _frame(rng, n=5000):
+    return pd.DataFrame({
+        "k": rng.integers(0, 40, n),
+        "name": np.array(["grp%d" % g for g in rng.integers(0, 12, n)]),
+        "v": rng.random(n) * 100.0,
+    })
+
+
+def test_manager_groupby(session, rng):
+    pdf = _frame(rng)
+    assert_tpu_and_cpu_equal(
+        lambda s: (s.create_dataframe(pdf, 4).group_by("name")
+                   .agg(F.sum("v").alias("sv"), F.count("*").alias("n"))),
+        conf=MANAGER_CONF, approx=True)
+
+
+def test_manager_join(session, rng):
+    left = _frame(rng)
+    right = pd.DataFrame({"k": np.arange(40),
+                          "tag": ["t%d" % i for i in range(40)]})
+    assert_tpu_and_cpu_equal(
+        lambda s: (s.create_dataframe(left, 3)
+                   .join(s.create_dataframe(right, 2), on="k", how="inner")
+                   .group_by("tag").agg(F.sum("v").alias("sv"))),
+        conf=MANAGER_CONF, approx=True)
+
+
+def test_manager_global_sort(session, rng):
+    pdf = _frame(rng, 2000)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(pdf, 3).order_by("v", "k"),
+        conf=MANAGER_CONF, ignore_order=False, approx=True)
+
+
+def test_manager_blocks_registered_and_cleaned(session, rng):
+    pdf = _frame(rng, 3000)
+    session.set_conf("spark.rapids.sql.enabled", True)
+    session.set_conf("spark.rapids.shuffle.transport.enabled", True)
+    try:
+        q = (session.create_dataframe(pdf, 3).group_by("name")
+             .agg(F.sum("v").alias("sv")))
+        q.collect()
+        env = session.shuffle_env
+        # blocks were registered during the query and unregistered after
+        assert session._shuffle_id_counter > 0
+        assert not session._active_shuffles
+        assert not env.shuffle_catalog._blocks
+    finally:
+        session.set_conf("spark.rapids.shuffle.transport.enabled", False)
+
+
+def test_manager_blocks_spill(session, rng):
+    # a raw-row join shuffle: both sides' full rows become shuffle blocks
+    # (post-aggregate shuffles are too small to pressure any budget)
+    n = 20000
+    left = pd.DataFrame({"k": np.arange(n), "v": rng.random(n)})
+    right = pd.DataFrame({"k": np.arange(0, n, 2), "w": rng.random(n // 2)})
+    dm = session.device_manager
+    saved = dm.hbm_budget
+    session.set_conf("spark.rapids.sql.enabled", True)
+    session.set_conf("spark.rapids.shuffle.transport.enabled", True)
+    session.set_conf("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    try:
+        dm.hbm_budget = 64 << 10
+        before = session.memory_event_handler.spill_count
+        q = (session.create_dataframe(left, 3)
+             .join(session.create_dataframe(right, 2), on="k", how="inner")
+             .group_by().agg(F.count("*").alias("n")))
+        out = q.collect()
+        assert int(out["n"][0]) == n // 2
+        # shuffle blocks hit the spill tiers under the tiny budget
+        assert session.memory_event_handler.spill_count > before
+    finally:
+        dm.hbm_budget = saved
+        session.set_conf("spark.rapids.shuffle.transport.enabled", False)
+        session.set_conf("spark.rapids.sql.autoBroadcastJoinThreshold",
+                         10 << 20)

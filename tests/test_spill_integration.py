@@ -1,0 +1,96 @@
+"""Spill wired into execution (VERDICT r1 item 5, SURVEY §4 gate 5).
+
+A real query under an artificially small HBM budget must (a) trigger
+device->host (and with a small host tier, ->disk) spills through the
+TpuDeviceManager budget meter + MemoryEventHandler, (b) fault spilled
+scan batches back in on re-execution, and (c) still match the CPU oracle.
+Reference contract: GpuShuffleEnv.scala:51-72 +
+DeviceMemoryEventHandler.scala:65-89."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.memory.spill import StorageTier
+from spark_rapids_tpu.sql import functions as F
+from querytest import assert_frames_equal, with_cpu_session
+
+
+@pytest.fixture
+def tight_budget(session):
+    dm = session.device_manager
+    saved_budget = dm.hbm_budget
+    saved_host = session.buffer_catalog.host_store.limit_bytes
+    session.set_conf("spark.rapids.sql.cacheDeviceScans", True)
+    yield session
+    dm.hbm_budget = saved_budget
+    session.buffer_catalog.host_store.limit_bytes = saved_host
+    session.clear_device_cache()
+    session.set_conf("spark.rapids.sql.cacheDeviceScans", False)
+
+
+def _table(rng, n=20000):
+    return pd.DataFrame({
+        "k": np.array(["g%02d" % g for g in rng.integers(0, 25, n)]),
+        "v": rng.random(n) * 10.0,
+        "w": rng.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+def test_query_spills_and_matches_oracle(tight_budget, rng):
+    session = tight_budget
+    pdf = _table(rng)
+
+    def q(s):
+        return (s.create_dataframe(pdf, 4)
+                 .filter(F.col("w") > 100)
+                 .group_by("k")
+                 .agg(F.sum("v").alias("sv"), F.count("*").alias("n")))
+
+    cpu = with_cpu_session(q)
+
+    # budget far below the cached scan footprint -> allocations overflow
+    # and the event handler must spill cached batches down the tiers
+    session.device_manager.hbm_budget = 64 << 10
+    session.buffer_catalog.host_store.limit_bytes = 128 << 10
+
+    session.set_conf("spark.rapids.sql.enabled", True)
+    before = session.memory_event_handler.spill_count
+    tpu1 = q(session).collect()
+    mm = session.last_query_metrics["memory"]
+    assert session.memory_event_handler.spill_count > before, mm
+    tiers = {session.buffer_catalog.buffer_tier(bid)
+             for _src, parts in session.device_scan_cache.values()
+             for entries in parts.values() for _f, bid in entries}
+    assert StorageTier.HOST in tiers or StorageTier.DISK in tiers, tiers
+    # the tiny host tier forces the second hop too
+    assert StorageTier.DISK in tiers, tiers
+    assert mm["spillCount"] > 0
+
+    # re-execution faults spilled scan batches back in and still agrees
+    tpu2 = q(session).collect()
+    assert_frames_equal(tpu1, cpu, ignore_order=True, approx=True)
+    assert_frames_equal(tpu2, cpu, ignore_order=True, approx=True)
+
+
+def test_budget_restores_after_query(tight_budget, rng):
+    session = tight_budget
+    pdf = _table(rng, n=4000)
+
+    def q(s):
+        return s.create_dataframe(pdf, 2).group_by("k").agg(
+            F.sum("v").alias("sv"))
+
+    # transient-metering check: caching would pin every new source's
+    # batches in the catalog by design
+    session.set_conf("spark.rapids.sql.cacheDeviceScans", False)
+    session.set_conf("spark.rapids.sql.enabled", True)
+    q(session).collect()
+    alloc_after_first = session.device_manager.allocated
+    # transient batches are weakref-metered: allocation must not grow
+    # unboundedly across repeated executions of the same query
+    for _ in range(3):
+        q(session).collect()
+    import gc
+    gc.collect()
+    assert session.device_manager.allocated <= alloc_after_first * 3
