@@ -22,6 +22,18 @@ from spark_rapids_tpu.columnar.batch import Schema
 Partition = Callable[[], Iterator]  # yields pd.DataFrame or DeviceBatch
 
 
+def group_contiguous(parts: Sequence[Partition],
+                     n: int) -> List[List[Partition]]:
+    """Contiguous partition grouping for CoalesceExec (like Spark's
+    DefaultPartitionCoalescer), shared by the CPU and TPU operators."""
+    n = min(max(1, int(n)), max(len(parts), 1))
+    per = -(-len(parts) // n) if parts else 0
+    groups: List[List[Partition]] = [[] for _ in range(n)]
+    for i, p in enumerate(parts):
+        groups[min(i // max(per, 1), n - 1)].append(p)
+    return groups
+
+
 class PhysicalPlan:
     """Base physical operator."""
 

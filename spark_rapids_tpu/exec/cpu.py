@@ -52,6 +52,9 @@ class CpuScanExec(PhysicalPlan):
         super().__init__()
         self.source = source
         self._schema = schema
+        # statistics-answerable filter conjuncts the planner pushed down
+        # (sql/pushdown.py); file sources use them to prune splits
+        self.pushed_filters = None
 
     def output_schema(self) -> Schema:
         return self._schema
@@ -60,6 +63,8 @@ class CpuScanExec(PhysicalPlan):
         return f"CpuScanExec({self.source.describe()})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
+        if self.pushed_filters and hasattr(self.source, "prune_splits"):
+            return self.source.cpu_partitions(ctx, self.pushed_filters)
         return self.source.cpu_partitions(ctx)
 
 
@@ -710,3 +715,66 @@ class CpuBroadcastNestedLoopJoinExec(PhysicalPlan):
                 yield out
             return run
         return [make(lp, rp) for lp, rp in zip(left_parts, right_parts)]
+
+
+class CpuCoalescePartitionsExec(PhysicalPlan):
+    """Narrow partition merge, no shuffle (Spark CoalesceExec; reference
+    rule GpuOverrides.scala:1611-1615)."""
+
+    def __init__(self, child: PhysicalPlan, n: int):
+        super().__init__([child])
+        self.n = max(1, int(n))
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"CpuCoalescePartitionsExec({self.n})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        from spark_rapids_tpu.exec.base import group_contiguous
+        child_parts = self.children[0].executed_partitions(ctx)
+        groups = group_contiguous(child_parts, self.n)
+
+        def make(group: List[Partition]) -> Partition:
+            def run():
+                got = False
+                for p in group:
+                    for df in p():
+                        got = True
+                        yield df
+                if not got:
+                    yield _empty_df(self.output_schema())
+            return run
+        return [make(g) for g in groups]
+
+
+class CpuCollectLimitExec(PhysicalPlan):
+    """Root-position limit: take the first ``limit`` rows across child
+    partitions in order (reference: GpuCollectLimitExec)."""
+
+    def __init__(self, child: PhysicalPlan, limit: int):
+        super().__init__([child])
+        self.limit = int(limit)
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"CpuCollectLimitExec({self.limit})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].executed_partitions(ctx)
+
+        def run():
+            remaining = self.limit
+            for p in child_parts:
+                if remaining <= 0:
+                    return
+                for df in p():
+                    if remaining <= 0:
+                        return
+                    take = df.head(remaining)
+                    remaining -= len(take)
+                    yield take
+        return [run]

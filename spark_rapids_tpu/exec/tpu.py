@@ -345,6 +345,62 @@ class TpuGlobalLimitExec(TpuLocalLimitExec):
     pass
 
 
+class TpuCollectLimitExec(TpuLocalLimitExec):
+    """Root-position limit (reference: GpuCollectLimitExec,
+    GpuOverrides.scala:1641-1643): one output partition draining children
+    in order with the device-scalar remaining count."""
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].executed_partitions(ctx)
+
+        def run() -> Iterator[DeviceBatch]:
+            import numpy as np
+            remaining = np.asarray(self.limit, np.int32)
+            i = 0
+            for part in child_parts:
+                for batch in part():
+                    if (i + 1) % 8 == 0 and int(remaining) <= 0:
+                        return
+                    i += 1
+                    out, remaining = self._kernel(batch, remaining)
+                    yield out
+        return [run]
+
+
+class TpuCoalescePartitionsExec(TpuExec):
+    """Narrow partition merge (Spark CoalesceExec; reference rule
+    GpuOverrides.scala:1611-1615): group child partitions contiguously,
+    no device work at all."""
+
+    def __init__(self, child: PhysicalPlan, n: int):
+        super().__init__([child])
+        self.n = max(1, int(n))
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"TpuCoalescePartitionsExec({self.n})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        from spark_rapids_tpu.exec.base import group_contiguous
+        child_parts = self.children[0].executed_partitions(ctx)
+        groups = group_contiguous(child_parts, self.n)
+        schema = self.output_schema()
+
+        def make(group: List[Partition]) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                got = False
+                for p in group:
+                    for b in p():
+                        got = True
+                        yield b
+                if not got:
+                    yield DeviceBatch.empty(schema)
+            return run
+        return [make(g) for g in groups]
+
+
 class TpuUnionExec(TpuExec):
     """reference: GpuUnionExec."""
 
@@ -447,10 +503,11 @@ class TpuScanExec(TpuExec):
     parses footers and rebuilds file buffers on the CPU,
     GpuParquetScan.scala:316-373) + device upload per batch."""
 
-    def __init__(self, source, schema: Schema):
+    def __init__(self, source, schema: Schema, pushed_filters=None):
         super().__init__()
         self.source = source
         self._schema = schema
+        self.pushed_filters = pushed_filters
 
     def output_schema(self) -> Schema:
         return self._schema
@@ -459,7 +516,10 @@ class TpuScanExec(TpuExec):
         return f"TpuScanExec({self.source.describe()})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        cpu_parts = self.source.cpu_partitions(ctx)
+        if self.pushed_filters and hasattr(self.source, "prune_splits"):
+            cpu_parts = self.source.cpu_partitions(ctx, self.pushed_filters)
+        else:
+            cpu_parts = self.source.cpu_partitions(ctx)
         max_rows = ctx.conf.batch_size_rows
         schema = self._schema
 
@@ -467,7 +527,8 @@ class TpuScanExec(TpuExec):
         # skip the re-upload when the same source is scanned again — the
         # HBM analogue of a cached DataFrame
         from spark_rapids_tpu.exec.transitions import scan_cache_for
-        cache = scan_cache_for(ctx, self.source, schema, max_rows)
+        cache = scan_cache_for(ctx, self.source, schema, max_rows,
+                               self.pushed_filters)
 
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
@@ -538,7 +599,19 @@ class TpuShuffleExchangeExec(TpuExec):
         self.partitioning = partitioning
 
         kind = partitioning[0]
-        if kind == "hash":
+        if kind == "roundrobin":
+            n = partitioning[-1]
+
+            def rr_kernel(batch: DeviceBatch):
+                # row-level round robin like Spark's repartition(n) —
+                # every output partition receives an even share of each
+                # batch's rows
+                pid = (jnp.arange(batch.capacity, dtype=jnp.int32)
+                       % jnp.int32(n))
+                return _split_by_pid(batch, pid, n)
+            self._pkernel = cached_jit(
+                f"exchrr|{n}", lambda: jax.jit(rr_kernel))
+        elif kind == "hash":
             key_idx = tuple(partitioning[1])
             n = partitioning[2]
 
@@ -593,8 +666,7 @@ class TpuShuffleExchangeExec(TpuExec):
         manager_on = (ctx.session is not None and ctx.conf.get_bool(
             "spark.rapids.shuffle.transport.enabled", False))
         # roundrobin is exempt: it IS the user-visible repartition(n) shape
-        # (output file count of a following write), and its local path
-        # never touches the device anyway
+        # (output partition/file count of a following write)
         collapse = (mesh is None and not manager_on
                     and kind in ("hash", "range")
                     and ctx.conf.get_bool(
@@ -642,25 +714,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 yield _concat_device(batches, schema, growth)
             return [single]
 
-        if kind == "roundrobin":
-            n = self.partitioning[-1]
-            assigned: List[List] = [[] for _ in range(n)]
-            for i, p in enumerate(child_parts):
-                assigned[i % n].append(p)
-
-            def make(pid: int) -> Partition:
-                def run() -> Iterator[DeviceBatch]:
-                    got = False
-                    for p in assigned[pid]:
-                        for b in p():
-                            got = True
-                            yield b
-                    if not got:
-                        yield DeviceBatch.empty(schema)
-                return run
-            return [make(i) for i in range(n)]
-
-        assert kind in ("hash", "range")
+        assert kind in ("hash", "range", "roundrobin")
         n = self.partitioning[-1]
 
         def slice_kernel(b: DeviceBatch, start, count, rows: int):

@@ -18,17 +18,24 @@ from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
 
 
 def scan_cache_for(ctx: ExecContext, source, schema: Schema,
-                   max_rows: int):
+                   max_rows: int, pushed_filters=None):
     """Per-source device-batch cache (spark.rapids.sql.cacheDeviceScans),
     or None when disabled. The entry holds a strong reference to the
     source object: keys include id(source), and without the reference a
     GC'd source's id could be reused by a different dataset and serve its
-    cached batches. Entries live until session.clear_device_cache()."""
+    cached batches. Pushed filters are part of the key: a scan pruned for
+    one predicate must not serve a query that needs more row groups.
+    Entries live until session.clear_device_cache()."""
     if ctx.session is None or not ctx.conf.get_bool(
             "spark.rapids.sql.cacheDeviceScans", False):
         return None
     store = ctx.session.device_scan_cache
-    key = (id(source), tuple(schema.names), max_rows)
+    fkey = tuple(pushed_filters) if pushed_filters else None
+    # pruned-column views are fresh objects per query; key on the base
+    # source identity so re-executions hit (schema names in the key keep
+    # distinct projections apart)
+    base = getattr(source, "_base", source)
+    key = (id(base), tuple(schema.names), max_rows, fkey)
     if key not in store:
         store[key] = (source, {})
     return store[key][1]
@@ -58,7 +65,8 @@ class HostToDeviceExec(PhysicalPlan):
         cache = None
         from spark_rapids_tpu.exec.cpu import CpuScanExec
         if isinstance(child, CpuScanExec):
-            cache = scan_cache_for(ctx, child.source, schema, max_rows)
+            cache = scan_cache_for(ctx, child.source, schema, max_rows,
+                                   getattr(child, "pushed_filters", None))
 
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:

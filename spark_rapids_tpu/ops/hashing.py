@@ -77,11 +77,19 @@ def string_poly_hashes(offsets: jnp.ndarray, chars: jnp.ndarray,
 
     import jax
     hashes = []
+    nbits = max(int(nchars - 1).bit_length(), 1)
     for p, salt in ((P1, SALT1), (P2, SALT2)):
-        # pows[k] = p^k (mod 2^64)
-        pows = jnp.concatenate([jnp.ones((1,), _U64),
-                                jnp.cumprod(jnp.full((nchars - 1,), p, dtype=_U64))])
-        term = jnp.where(live, chars.astype(_U64) * pows[exp], jnp.asarray(0, _U64))
+        # p^exp (mod 2^64) by exponentiation-over-bits: ~20 vector
+        # multiplies instead of a u64 cumprod scan — emulated-64-bit scans
+        # take the TPU AOT compiler minutes at large char capacities
+        pw = jnp.ones(exp.shape, _U64)
+        sq = p & _M64
+        for i in range(nbits):
+            bit = (exp >> i) & 1
+            pw = pw * jnp.where(bit == 1, jnp.asarray(sq, _U64),
+                                jnp.asarray(1, _U64))
+            sq = (sq * sq) & _M64
+        term = jnp.where(live, chars.astype(_U64) * pw, jnp.asarray(0, _U64))
         acc = jax.ops.segment_sum(term, row_ids, num_segments=capacity)
         h = splitmix64(acc + jnp.asarray(salt, _U64) + lengths)
         null_h = jnp.asarray(0x7E57AB1E5EED5EED, _U64)

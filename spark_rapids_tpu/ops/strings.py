@@ -835,3 +835,185 @@ def string_to_unix_ts(ctx: EvalContext, col: DevCol, with_time: bool):
         secs = secs + hh.astype(jnp.int64) * 3600 \
             + mi.astype(jnp.int64) * 60 + ss.astype(jnp.int64)
     return secs, ok
+
+
+# --- round-2 kernel additions (VERDICT r1 item 8 expression breadth) -------
+
+def reverse_string(ctx: EvalContext, col: DevCol) -> DevCol:
+    """Byte reversal per row (exact for ASCII, like the case maps)."""
+    capacity = ctx.capacity
+    lens = lengths_of(col)
+    nchars = col.data.shape[0]
+    k = jnp.arange(nchars, dtype=jnp.int32)
+    row = _char_row_ids(col, capacity)
+    rel = k - col.offsets[:-1][row].astype(jnp.int32)
+    src = (col.offsets[:-1][row].astype(jnp.int32)
+           + (lens[row] - 1 - rel))
+    total = col.offsets[capacity]
+    out = jnp.where(k < total,
+                    col.data[jnp.clip(src, 0, nchars - 1)], 0)
+    return DevCol(dtypes.STRING, out.astype(jnp.uint8), col.validity,
+                  col.offsets)
+
+
+def repeat_string(ctx: EvalContext, col: DevCol, n: int) -> DevCol:
+    """repeat(str, n): n <= 0 -> empty string."""
+    capacity = ctx.capacity
+    n = max(int(n), 0)
+    lens = lengths_of(col)
+    new_len = lens * n
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(new_len).astype(jnp.int32)])
+    out_cap = max(int(col.data.shape[0]) * n, 16)
+    k = jnp.arange(out_cap, dtype=jnp.int32)
+    out_row = jnp.clip(
+        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
+        0, capacity - 1)
+    rel = k - new_offsets[out_row]
+    safe_len = jnp.maximum(lens[out_row], 1)
+    src = (col.offsets[:-1][out_row].astype(jnp.int32) + rel % safe_len)
+    nchars = col.data.shape[0]
+    total = new_offsets[capacity]
+    out = jnp.where(k < total,
+                    col.data[jnp.clip(src, 0, nchars - 1)], 0)
+    return DevCol(dtypes.STRING, out.astype(jnp.uint8), col.validity,
+                  new_offsets)
+
+
+def ascii_first(ctx: EvalContext, col: DevCol) -> DevCol:
+    """ascii(str): code of the first byte, 0 for empty."""
+    lens = lengths_of(col)
+    nchars = col.data.shape[0]
+    first = col.data[jnp.clip(col.offsets[:-1].astype(jnp.int32), 0,
+                              max(nchars - 1, 0))]
+    data = jnp.where(lens > 0, first.astype(jnp.int32), 0)
+    return DevCol(dtypes.INT32, data, col.validity)
+
+
+def chr_from_int(ctx: EvalContext, data: jnp.ndarray,
+                 validity: jnp.ndarray) -> DevCol:
+    """chr(n): the character with code n % 256 (negative -> empty string),
+    UTF-8 encoded — codes 128..255 emit their two-byte encoding so the
+    result decodes exactly like the host's chr()."""
+    capacity = ctx.capacity
+    code = (data.astype(jnp.int64) % 256).astype(jnp.int32)
+    neg = data < 0
+    two_byte = (code >= 128) & ~neg
+    lens = jnp.where(neg | ~validity, 0,
+                     jnp.where(two_byte, 2, 1)).astype(jnp.int32)
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(lens).astype(jnp.int32)])
+    out_cap = _char_capacity_for(2 * capacity)
+    k = jnp.arange(out_cap, dtype=jnp.int32)
+    out_row = jnp.clip(
+        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
+        0, capacity - 1)
+    rel = k - new_offsets[out_row]
+    c = code[out_row]
+    first = jnp.where(two_byte[out_row], 0xC0 | (c >> 6), c)
+    second = 0x80 | (c & 0x3F)
+    total = new_offsets[capacity]
+    out = jnp.where(k < total,
+                    jnp.where(rel == 0, first, second), 0).astype(jnp.uint8)
+    return DevCol(dtypes.STRING, out, validity, new_offsets)
+
+
+def _char_capacity_for(capacity: int, minimum: int = 16) -> int:
+    cap = minimum
+    while cap < capacity:
+        cap <<= 1
+    return cap
+
+
+def concat_ws_columns(ctx: EvalContext, sep: str, cols) -> DevCol:
+    """concat_ws(sep, s1, s2, ...): joins the NON-NULL parts with sep;
+    result is never NULL (all-null row -> empty string) — Spark
+    semantics."""
+    capacity = ctx.capacity
+    sep_bytes = np.frombuffer(sep.encode("utf-8"), dtype=np.uint8)
+    sep_arr = jnp.asarray(sep_bytes if len(sep_bytes) else
+                          np.zeros(1, np.uint8))
+    sep_len = len(sep_bytes)
+    # parts: for each input column, an optional separator (when a valid
+    # part precedes) then the column's bytes (when valid)
+    lens = [lengths_of(c) for c in cols]
+    part_lens = []
+    any_before = jnp.zeros((capacity,), jnp.bool_)
+    for c, ln in zip(cols, lens):
+        sep_here = jnp.where(any_before & c.validity, sep_len, 0)
+        part_lens.append(sep_here.astype(jnp.int32))
+        part_lens.append(jnp.where(c.validity, ln, 0).astype(jnp.int32))
+        any_before = any_before | c.validity
+    total_len = part_lens[0]
+    for pl in part_lens[1:]:
+        total_len = total_len + pl
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(total_len).astype(jnp.int32)])
+    # worst case: every column valid in every row -> one separator per
+    # row per gap, plus every input byte
+    out_cap = (sum(int(c.data.shape[0]) for c in cols)
+               + sep_len * max(len(cols) - 1, 0) * capacity)
+    out_cap = _char_capacity_for(max(out_cap, 16), 16)
+    k = jnp.arange(out_cap, dtype=jnp.int32)
+    out_row = jnp.clip(
+        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
+        0, capacity - 1)
+    rel = k - new_offsets[out_row]
+    out = jnp.zeros((out_cap,), dtype=jnp.uint8)
+    part_start = jnp.zeros((capacity,), dtype=jnp.int32)
+    pi = 0
+    for c in cols:
+        for is_sep in (True, False):
+            pl = part_lens[pi]
+            pi += 1
+            in_part = ((rel >= part_start[out_row])
+                       & (rel < part_start[out_row] + pl[out_row]))
+            off = rel - part_start[out_row]
+            if is_sep:
+                vals = sep_arr[jnp.clip(off, 0, max(sep_len - 1, 0))]
+            else:
+                src = c.offsets[:-1][out_row].astype(jnp.int32) + off
+                nc = c.data.shape[0]
+                vals = c.data[jnp.clip(src, 0, nc - 1)]
+            out = jnp.where(in_part, vals, out)
+            part_start = part_start + pl
+    total_new = new_offsets[capacity]
+    out = jnp.where(k < total_new, out, 0).astype(jnp.uint8)
+    validity = jnp.ones((capacity,), jnp.bool_) & ctx.row_mask
+    return DevCol(dtypes.STRING, out, validity, new_offsets)
+
+
+def translate_string(ctx: EvalContext, col: DevCol, matching: str,
+                     replace: str) -> DevCol:
+    """translate(str, matching, replace): per-byte mapping; matching bytes
+    beyond len(replace) are deleted (Spark semantics, ASCII-exact)."""
+    capacity = ctx.capacity
+    lut = np.arange(256, dtype=np.int16)
+    mb = matching.encode("utf-8")
+    rb = replace.encode("utf-8")
+    for i, ch in enumerate(mb):
+        lut[ch] = rb[i] if i < len(rb) else -1  # -1 = delete
+    lut_arr = jnp.asarray(lut)
+    nchars = col.data.shape[0]
+    mapped = lut_arr[col.data.astype(jnp.int32)]
+    k = jnp.arange(nchars, dtype=jnp.int32)
+    row = _char_row_ids(col, capacity)
+    total = col.offsets[capacity]
+    live = (k < total) & (mapped >= 0)
+    # stable compaction of surviving chars keeps row-major order
+    from spark_rapids_tpu.ops.pallas_kernels import compact_permutation
+    perm, _cnt = compact_permutation(live)
+    new_chars = jnp.where(jnp.arange(nchars) <
+                          jnp.cumsum(live.astype(jnp.int32))[-1],
+                          mapped[perm].astype(jnp.uint8), 0)
+    import jax
+    keep_per_row = jax.ops.segment_sum(
+        jnp.where(live, 1, 0), row, num_segments=capacity)
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(keep_per_row).astype(jnp.int32)])
+    return DevCol(dtypes.STRING, new_chars.astype(jnp.uint8), col.validity,
+                  new_offsets)

@@ -199,7 +199,16 @@ class TpuSparkSession:
 
         conf = self.conf
         ctx = ExecContext(conf, self)
-        cpu_plan = Planner(conf).plan(logical)
+        # projection pushdown: mark file scans with the query's referenced
+        # column subset before planning (sql/pushdown.py)
+        from spark_rapids_tpu.sql.pushdown import annotate_scan_pruning
+        annotate_scan_pruning(logical)
+        planner = Planner(conf)
+        if isinstance(logical, lp.LogicalLimit):
+            # root-position limit plans as one CollectLimit operator
+            cpu_plan = planner.plan_collect_limit(logical)
+        else:
+            cpu_plan = planner.plan(logical)
         if conf.sql_enabled:
             plan = TpuOverrides(conf).apply(cpu_plan)
             plan = TransitionOverrides(conf).apply(plan)
@@ -712,8 +721,10 @@ class DataFrame:
                              (n, col_fn(n).expr) for n in self.schema.names]))
 
     def repartition(self, n: int) -> "DataFrame":
-        # exposed for parity; exchange planning handles placement
-        return self
+        return DataFrame(self.session, lp.LogicalRepartition(self._plan, n))
+
+    def coalesce(self, n: int) -> "DataFrame":
+        return DataFrame(self.session, lp.LogicalCoalesce(self._plan, n))
 
     # --- actions -----------------------------------------------------------
     def collect(self) -> pd.DataFrame:

@@ -513,3 +513,223 @@ class UnixTimestampFromString(Expression):
             except ValueError:
                 ok[i] = False
         return rebuild_series(out, ok, dtypes.INT64, index)
+
+
+def _civil_add_months(xp, days, months):
+    """day-count -> day-count, adding calendar months with end-of-month
+    clamping (Spark's add_months)."""
+    y, m, d = civil_from_days(xp, days.astype(np.int64))
+    total = (y * 12 + (m - 1)) + months.astype(np.int64)
+    ny = total // 12
+    nm = total % 12 + 1
+    # clamp day to the target month's length
+    is_leap = ((ny % 4 == 0) & (ny % 100 != 0)) | (ny % 400 == 0)
+    mdays_tbl = np.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                         dtype=np.int64)
+    mdays = xp.asarray(mdays_tbl)[nm - 1]
+    mdays = xp.where((nm == 2) & is_leap, 29, mdays)
+    nd = xp.minimum(d, mdays)
+    return days_from_civil(xp, ny, nm, nd)
+
+
+class AddMonths(Expression):
+    """add_months(date, n) (reference: GpuOverrides datetime rules)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.DATE32
+
+    def sql_name(self, schema=None) -> str:
+        return (f"add_months({self.children[0].sql_name(schema)}, "
+                f"{self.children[1].sql_name(schema)})")
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if not self.children[0].dtype(schema).is_datetime:
+            return "add_months requires a date input"
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = ctx.broadcast(self.children[0].eval_device(ctx))
+        rv = ctx.broadcast(self.children[1].eval_device(ctx))
+        days = (lv.data.astype(jnp.int64) if lv.dtype == dtypes.DATE32
+                else days_from_micros(jnp, lv.data))
+        out = _civil_add_months(jnp, days, rv.data)
+        return DevCol(dtypes.DATE32, out.astype(jnp.int32),
+                      lv.validity & rv.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        b, bv, _ = host_unary_values(self.children[1].eval_host(df))
+        days = days_from_micros(np, a)
+        out = _civil_add_months(np, days, b.astype(np.int64))
+        s = rebuild_series(out * MICROS_PER_DAY, av & bv,
+                           dtypes.TIMESTAMP_US, index)
+        s.attrs["srt_logical_dtype"] = "date32"
+        return s
+
+
+class MonthsBetween(Expression):
+    """months_between(end, start): whole-month difference + fractional
+    31-day remainder; both-last-day pairs count as whole months."""
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.FLOAT64
+
+    def sql_name(self, schema=None) -> str:
+        return (f"months_between({self.children[0].sql_name(schema)}, "
+                f"{self.children[1].sql_name(schema)})")
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        for c in self.children:
+            if not c.dtype(schema).is_datetime:
+                return "months_between requires date inputs"
+        return None
+
+    @staticmethod
+    def _compute(xp, d_end, d_start):
+        y1, m1, day1 = civil_from_days(xp, d_end.astype(np.int64))
+        y2, m2, day2 = civil_from_days(xp, d_start.astype(np.int64))
+        # last-day-of-month flags
+        next1 = civil_from_days(xp, d_end.astype(np.int64) + 1)[2]
+        next2 = civil_from_days(xp, d_start.astype(np.int64) + 1)[2]
+        last1 = next1 == 1
+        last2 = next2 == 1
+        months = (y1 - y2) * 12 + (m1 - m2)
+        frac = (day1 - day2) / 31.0
+        whole = (day1 == day2) | (last1 & last2)
+        return xp.where(whole, months.astype(np.float64),
+                        months.astype(np.float64) + frac)
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        lv = ctx.broadcast(self.children[0].eval_device(ctx))
+        rv = ctx.broadcast(self.children[1].eval_device(ctx))
+        d1 = (lv.data.astype(jnp.int64) if lv.dtype == dtypes.DATE32
+              else days_from_micros(jnp, lv.data))
+        d2 = (rv.data.astype(jnp.int64) if rv.dtype == dtypes.DATE32
+              else days_from_micros(jnp, rv.data))
+        out = self._compute(jnp, d1, d2)
+        return DevCol(dtypes.FLOAT64, out, lv.validity & rv.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        b, bv, _ = host_unary_values(self.children[1].eval_host(df))
+        out = self._compute(np, days_from_micros(np, a),
+                            days_from_micros(np, b))
+        return rebuild_series(out, av & bv, dtypes.FLOAT64, index)
+
+
+class TruncDate(Expression):
+    """trunc(date, 'year'|'month'|'week') -> first day of the period."""
+
+    SUPPORTED = {"year": "year", "yyyy": "year", "yy": "year",
+                 "month": "month", "mon": "month", "mm": "month",
+                 "week": "week", "quarter": "quarter"}
+
+    def __init__(self, child: Expression, fmt: str):
+        super().__init__([child])
+        self.fmt = str(fmt).lower()
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.DATE32
+
+    def sql_name(self, schema=None) -> str:
+        return f"trunc({self.children[0].sql_name(schema)}, {self.fmt!r})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if self.fmt not in self.SUPPORTED:
+            return f"trunc format {self.fmt!r} is not supported"
+        if not self.children[0].dtype(schema).is_datetime:
+            return "trunc requires a date input"
+        return None
+
+    def _compute(self, xp, days):
+        kind = self.SUPPORTED[self.fmt]
+        y, m, d = civil_from_days(xp, days.astype(np.int64))
+        if kind == "year":
+            return days_from_civil(xp, y, xp.ones_like(m), xp.ones_like(m))
+        if kind == "month":
+            return days_from_civil(xp, y, m, xp.ones_like(m))
+        if kind == "quarter":
+            qm = ((m - 1) // 3) * 3 + 1
+            return days_from_civil(xp, y, qm, xp.ones_like(m))
+        # week: previous (or same) Monday; 1970-01-01 was a Thursday
+        dow = (days.astype(np.int64) + 3) % 7  # 0 = Monday
+        return days.astype(np.int64) - dow
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        days = (v.data.astype(jnp.int64) if v.dtype == dtypes.DATE32
+                else days_from_micros(jnp, v.data))
+        out = self._compute(jnp, days)
+        return DevCol(dtypes.DATE32, out.astype(jnp.int32), v.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        if self.fmt not in self.SUPPORTED:
+            # Spark: invalid trunc format -> NULL
+            av = np.zeros_like(av)
+            out = np.zeros(len(a), dtype=np.int64)
+        else:
+            out = self._compute(np, days_from_micros(np, a))
+        s = rebuild_series(out * MICROS_PER_DAY, av,
+                           dtypes.TIMESTAMP_US, index)
+        s.attrs["srt_logical_dtype"] = "date32"
+        return s
+
+
+class NextDay(Expression):
+    """next_day(date, 'mon'..'sun'): the next date after ``date`` that is
+    the given day of week."""
+
+    DOW = {"mon": 0, "monday": 0, "tue": 1, "tuesday": 1, "wed": 2,
+           "wednesday": 2, "thu": 3, "thursday": 3, "fri": 4, "friday": 4,
+           "sat": 5, "saturday": 5, "sun": 6, "sunday": 6}
+
+    def __init__(self, child: Expression, day: str):
+        super().__init__([child])
+        self.day = str(day).lower()
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.DATE32
+
+    def sql_name(self, schema=None) -> str:
+        return f"next_day({self.children[0].sql_name(schema)}, {self.day!r})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if self.day not in self.DOW:
+            return f"next_day day {self.day!r} is not supported"
+        if not self.children[0].dtype(schema).is_datetime:
+            return "next_day requires a date input"
+        return None
+
+    def _compute(self, xp, days):
+        target = self.DOW[self.day]
+        dow = (days.astype(np.int64) + 3) % 7  # 0 = Monday
+        ahead = (target - dow) % 7
+        ahead = xp.where(ahead == 0, 7, ahead)
+        return days.astype(np.int64) + ahead
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = ctx.broadcast(self.children[0].eval_device(ctx))
+        days = (v.data.astype(jnp.int64) if v.dtype == dtypes.DATE32
+                else days_from_micros(jnp, v.data))
+        out = self._compute(jnp, days)
+        return DevCol(dtypes.DATE32, out.astype(jnp.int32), v.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        a, av, index = host_unary_values(self.children[0].eval_host(df))
+        if self.day not in self.DOW:
+            # Spark: invalid day-of-week name -> NULL
+            av = np.zeros_like(av)
+            out = np.zeros(len(a), dtype=np.int64)
+        else:
+            out = self._compute(np, days_from_micros(np, a))
+        s = rebuild_series(out * MICROS_PER_DAY, av,
+                           dtypes.TIMESTAMP_US, index)
+        s.attrs["srt_logical_dtype"] = "date32"
+        return s

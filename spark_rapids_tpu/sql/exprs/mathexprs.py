@@ -247,3 +247,18 @@ class Round(Expression):
             else dtypes.FLOAT64
         return rebuild_series(np.asarray(out).astype(dt.np_dtype), validity,
                               dt, index)
+
+
+class BRound(Round):
+    """bround(x, scale): HALF_EVEN (banker's) rounding — numpy/XLA rint IS
+    half-even, so the kernel is rint(x * 10^s) / 10^s (reference:
+    GpuBRound in GpuOverrides round rules)."""
+
+    def sql_name(self, schema=None) -> str:
+        return f"bround({self.children[0].sql_name(schema)}, {self.scale})"
+
+    def _compute(self, xp, x, integral: bool):
+        if integral and self.scale >= 0:
+            return x
+        p = float(10.0 ** self.scale)
+        return xp.rint(x.astype(np.float64) * p) / p

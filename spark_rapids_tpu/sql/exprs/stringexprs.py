@@ -538,3 +538,180 @@ def make_regexp_replace(child: Expression, pattern: str,
     if lit is not None and "$" not in replacement:
         return StringReplace(child, lit, replacement)
     return RegexpReplace(child, pattern, replacement)
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, s1, s2, ...): joins NON-NULL parts; never NULL
+    (reference: GpuConcatWs, GpuOverrides string rules)."""
+
+    def __init__(self, sep: str, children: List[Expression]):
+        super().__init__(children)
+        self.sep = str(sep)
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        parts = ", ".join(c.sql_name(schema) for c in self.children)
+        return f"concat_ws({self.sep!r}, {parts})"
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if not self.children:
+            return "concat_ws with no arguments"
+        for c in self.children:
+            if not c.dtype(schema).is_string:
+                return "concat_ws over non-string inputs"
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        cols = [ctx.broadcast(c.eval_device(ctx)) for c in self.children]
+        return string_ops.concat_ws_columns(ctx, self.sep, cols)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        n = len(df)
+        if not self.children:
+            return rebuild_series(np.full(n, "", dtype=object),
+                                  np.ones(n, np.bool_), dtypes.STRING,
+                                  df.index)
+        parts = [host_unary_values(c.eval_host(df)) for c in self.children]
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            vals = [str(p[0][i]) for p in parts if p[1][i]]
+            out[i] = self.sep.join(vals)
+        return rebuild_series(out, np.ones(n, np.bool_), dtypes.STRING,
+                              parts[0][2])
+
+
+class Translate(Expression):
+    """translate(str, matching, replace) with literal maps."""
+
+    def __init__(self, child: Expression, matching: str, replace: str):
+        super().__init__([child])
+        self.matching = str(matching)
+        self.replace = str(replace)
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return (f"translate({self.children[0].sql_name(schema)}, "
+                f"{self.matching!r}, {self.replace!r})")
+
+    def device_supported(self, schema: Schema) -> Optional[str]:
+        if any(ord(c) > 127 for c in self.matching + self.replace):
+            return "translate with non-ASCII map is not supported on TPU"
+        return None
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        col = ctx.broadcast(v)
+        return string_ops.translate_string(ctx, col, self.matching,
+                                           self.replace)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(
+            self.children[0].eval_host(df))
+        table = {ord(m): (self.replace[i] if i < len(self.replace) else None)
+                 for i, m in enumerate(self.matching)}
+        out = np.empty(len(values), dtype=object)
+        for i, s in enumerate(values):
+            out[i] = s.translate(table) if validity[i] else None
+        return rebuild_series(out, validity, dtypes.STRING, index)
+
+
+class StringReverse(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return f"reverse({self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        col = ctx.broadcast(self.children[0].eval_device(ctx))
+        return string_ops.reverse_string(ctx, col)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(
+            self.children[0].eval_host(df))
+        out = np.array([s[::-1] if v else None
+                        for s, v in zip(values, validity)], dtype=object)
+        return rebuild_series(out, validity, dtypes.STRING, index)
+
+
+class StringRepeat(Expression):
+    def __init__(self, child: Expression, n: int):
+        super().__init__([child])
+        self.n = int(n)
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return f"repeat({self.children[0].sql_name(schema)}, {self.n})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        col = ctx.broadcast(self.children[0].eval_device(ctx))
+        return string_ops.repeat_string(ctx, col, self.n)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(
+            self.children[0].eval_host(df))
+        out = np.array([s * max(self.n, 0) if v else None
+                        for s, v in zip(values, validity)], dtype=object)
+        return rebuild_series(out, validity, dtypes.STRING, index)
+
+
+class Ascii(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.INT32
+
+    def sql_name(self, schema=None) -> str:
+        return f"ascii({self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        col = ctx.broadcast(self.children[0].eval_device(ctx))
+        return string_ops.ascii_first(ctx, col)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(
+            self.children[0].eval_host(df))
+        out = np.array([(ord(s[0]) if s else 0) if v else 0
+                        for s, v in zip(values, validity)], dtype=np.int32)
+        return rebuild_series(out, validity, dtypes.INT32, index)
+
+
+class Chr(Expression):
+    """chr(n) over the ASCII/byte range (n % 256; negative -> '')."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    def dtype(self, schema: Schema) -> DType:
+        return dtypes.STRING
+
+    def sql_name(self, schema=None) -> str:
+        return f"char({self.children[0].sql_name(schema)})"
+
+    def eval_device(self, ctx: EvalContext) -> DevValue:
+        v = self.children[0].eval_device(ctx)
+        col = ctx.broadcast(v)
+        return string_ops.chr_from_int(ctx, col.data, col.validity)
+
+    def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        values, validity, index = host_unary_values(
+            self.children[0].eval_host(df))
+        out = np.empty(len(values), dtype=object)
+        for i, (x, v) in enumerate(zip(values, validity)):
+            if not v:
+                out[i] = None
+            elif int(x) < 0:
+                out[i] = ""
+            else:
+                out[i] = chr(int(x) % 256)
+        return rebuild_series(out, validity, dtypes.STRING, index)

@@ -382,3 +382,30 @@ def explode(c: Column) -> Column:
 def posexplode(c: Column) -> Column:
     from spark_rapids_tpu.sql.exprs.generators import ExplodeSplit
     return Column(ExplodeSplit(_expr(c), with_pos=True))
+
+
+# --- round-2 expression breadth (VERDICT r1 item 8) -------------------------
+
+def concat_ws(sep: str, *cs) -> Column:
+    return Column(st.ConcatWs(sep, [_c(c) for c in cs]))
+def translate(c, matching: str, replace: str) -> Column:
+    return Column(st.Translate(_c(c), matching, replace))
+def reverse(c) -> Column: return Column(st.StringReverse(_c(c)))
+def repeat(c, n: int) -> Column: return Column(st.StringRepeat(_c(c), n))
+def ascii(c) -> Column: return Column(st.Ascii(_c(c)))  # noqa: A001
+def chr_(c) -> Column: return Column(st.Chr(_c(c)))
+char = chr_
+def left(c, n: int) -> Column:
+    return Column(st.Substring(_c(c), 1, int(n)))
+def right(c, n: int) -> Column:
+    return Column(st.Substring(_c(c), -int(n), int(n)))
+def bround(c, scale: int = 0) -> Column:
+    return Column(m.BRound(_c(c), scale))
+def add_months(c, n) -> Column:
+    return Column(dt.AddMonths(_c(c), _expr(n)))
+def months_between(end, start) -> Column:
+    return Column(dt.MonthsBetween(_c(end), _c(start)))
+def trunc(c, fmt: str) -> Column:
+    return Column(dt.TruncDate(_c(c), fmt))
+def next_day(c, day: str) -> Column:
+    return Column(dt.NextDay(_c(c), day))

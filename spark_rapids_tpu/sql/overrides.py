@@ -236,7 +236,8 @@ def _tag_scan(meta: ExecMeta) -> None:
 
 
 def _convert_scan(meta: ExecMeta, children) -> PhysicalPlan:
-    return tpu.TpuScanExec(meta.plan.source, meta.plan.output_schema())
+    return tpu.TpuScanExec(meta.plan.source, meta.plan.output_schema(),
+                           getattr(meta.plan, "pushed_filters", None))
 
 
 def _tag_join(meta: ExecMeta) -> None:
@@ -431,6 +432,16 @@ _register(ExecRule(cpu.CpuLocalLimitExec, "local limit", _tag_nothing,
                    lambda m, ch: tpu.TpuLocalLimitExec(ch[0], m.plan.limit)))
 _register(ExecRule(cpu.CpuGlobalLimitExec, "global limit", _tag_nothing,
                    lambda m, ch: tpu.TpuGlobalLimitExec(ch[0], m.plan.limit)))
+_register(ExecRule(cpu.CpuCollectLimitExec,
+                   "collect limit (reference GpuOverrides.scala:1641-1643)",
+                   _tag_nothing,
+                   lambda m, ch: tpu.TpuCollectLimitExec(ch[0],
+                                                         m.plan.limit)))
+_register(ExecRule(cpu.CpuCoalescePartitionsExec,
+                   "partition coalesce (reference GpuOverrides.scala:1611)",
+                   _tag_nothing,
+                   lambda m, ch: tpu.TpuCoalescePartitionsExec(ch[0],
+                                                               m.plan.n)))
 _register(ExecRule(cpu.CpuUnionExec, "columnar union", _tag_nothing,
                    lambda m, ch: tpu.TpuUnionExec(ch)))
 _register(ExecRule(cpu.CpuRangeExec, "device range source", _tag_nothing,

@@ -34,6 +34,11 @@ class LogicalPlan:
             return self.children[0].estimated_size_bytes()
         return None
 
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
 
 class LogicalScan(LogicalPlan):
     def __init__(self, source):
@@ -107,6 +112,30 @@ class LogicalLimit(LogicalPlan):
     def __init__(self, child: LogicalPlan, limit: int):
         super().__init__([child])
         self.limit = limit
+
+    def schema(self) -> Schema:
+        return self.children[0].schema()
+
+
+class LogicalRepartition(LogicalPlan):
+    """repartition(n): full round-robin row redistribution (Spark's
+    RepartitionByExpression-less form)."""
+
+    def __init__(self, child: LogicalPlan, n: int):
+        super().__init__([child])
+        self.n = max(1, int(n))
+
+    def schema(self) -> Schema:
+        return self.children[0].schema()
+
+
+class LogicalCoalesce(LogicalPlan):
+    """coalesce(n): merge adjacent partitions, no shuffle (Spark's
+    CoalesceExec; reference rule GpuOverrides.scala:1611-1615)."""
+
+    def __init__(self, child: LogicalPlan, n: int):
+        super().__init__([child])
+        self.n = max(1, int(n))
 
     def schema(self) -> Schema:
         return self.children[0].schema()

@@ -31,7 +31,29 @@ class Planner:
         return fn(node)
 
     def _plan_LogicalScan(self, node: lp.LogicalScan) -> PhysicalPlan:
-        return cpu.CpuScanExec(node.source, node.source.schema)
+        source = node.source
+        pruned_cols = getattr(node, "_pruned_columns", None)
+        if pruned_cols is not None and hasattr(source, "with_columns"):
+            source = source.with_columns(pruned_cols)
+        return cpu.CpuScanExec(source, source.schema)
+
+    def _plan_LogicalFilter(self, node: lp.LogicalFilter) -> PhysicalPlan:
+        child = self.plan(node.children[0])
+        cs = child.output_schema()
+        cond = bind_references(node.condition, cs)
+        # predicate pushdown: statistics-answerable conjuncts reach the
+        # file source for row-group/stripe/partition pruning, the in-plan
+        # filter still applies them exactly (ParquetFilters,
+        # GpuParquetScan.scala:204-246; sql/rapids/OrcFilters.scala)
+        if isinstance(child, cpu.CpuScanExec) and hasattr(
+                child.source, "prune_splits"):
+            from spark_rapids_tpu.sql.pushdown import (
+                extract_pushable_filters,
+            )
+            pushed = extract_pushable_filters(node.condition)
+            if pushed:
+                child.pushed_filters = pushed
+        return cpu.CpuFilterExec(child, cond)
 
     def _plan_LogicalRange(self, node: lp.LogicalRange) -> PhysicalPlan:
         return cpu.CpuRangeExec(node.start, node.end, node.step,
@@ -43,10 +65,6 @@ class Planner:
         exprs = [(n, bind_references(e, cs)) for n, e in node.exprs]
         return cpu.CpuProjectExec(child, exprs)
 
-    def _plan_LogicalFilter(self, node: lp.LogicalFilter) -> PhysicalPlan:
-        child = self.plan(node.children[0])
-        cs = child.output_schema()
-        return cpu.CpuFilterExec(child, bind_references(node.condition, cs))
 
     def _plan_LogicalAggregate(self, node: lp.LogicalAggregate) -> PhysicalPlan:
         child = self.plan(node.children[0])
@@ -88,6 +106,21 @@ class Planner:
         local = cpu.CpuLocalLimitExec(child, node.limit)
         single = cpu.CpuShuffleExchangeExec(local, ("single",))
         return cpu.CpuGlobalLimitExec(single, node.limit)
+
+    def plan_collect_limit(self, node: lp.LogicalLimit) -> PhysicalPlan:
+        """Root-position limit: one CollectLimit operator instead of
+        local-limit + exchange + global-limit (reference:
+        GpuCollectLimitExec, GpuOverrides.scala:1641-1643)."""
+        child = self.plan(node.children[0])
+        return cpu.CpuCollectLimitExec(child, node.limit)
+
+    def _plan_LogicalRepartition(self, node) -> PhysicalPlan:
+        child = self.plan(node.children[0])
+        return cpu.CpuShuffleExchangeExec(child, ("roundrobin", node.n))
+
+    def _plan_LogicalCoalesce(self, node) -> PhysicalPlan:
+        child = self.plan(node.children[0])
+        return cpu.CpuCoalescePartitionsExec(child, node.n)
 
     def _plan_LogicalJoin(self, node: lp.LogicalJoin) -> PhysicalPlan:
         left = self.plan(node.children[0])

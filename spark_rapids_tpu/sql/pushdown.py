@@ -1,0 +1,238 @@
+"""Scan pushdown: predicate -> row-group/stripe pruning, projection ->
+column pruning.
+
+The reference pushes filter conjuncts into the Parquet footer reader
+(ParquetFilters, GpuParquetScan.scala:204-246) and into ORC search
+arguments (sql/rapids/OrcFilters.scala), and prunes read columns to the
+plan's projection. Here the same decisions run host-side against pyarrow
+footer statistics:
+
+  * ``extract_pushable_filters`` splits a filter condition into conjuncts
+    and keeps the shapes statistics can answer: ``col <op> literal``,
+    ``IsNull/IsNotNull(col)``, ``col IN (literals)``;
+  * ``maybe_matches`` is the conservative three-valued test a split's
+    (min, max, null_count) statistics give — True means "may contain
+    matching rows" (the filter above the scan still runs; pruning only
+    removes splits that provably match nothing);
+  * ``required_scan_columns`` walks a logical tree and returns every
+    column name the query references, so file scans read only those
+    (pyarrow column projection) — the host-decode analogue of the
+    reference's readSchema clipping.
+
+ORC note: pyarrow exposes no per-stripe statistics, so OrcSource builds a
+lazy stripe min/max index by reading just the filtered column once per
+file (a one-time indexing cost amortized across queries), rather than
+decoding every stripe of every file on every query.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.sql.exprs.core import Col, Expression, Literal
+from spark_rapids_tpu.sql.exprs.predicates import (
+    And, Eq, Ge, Gt, In, IsNotNull, IsNull, Le, Lt, Neq,
+)
+
+# (column_name, op, value); op in < <= > >= == != isnull isnotnull in
+PushedFilter = Tuple[str, str, Any]
+
+_CMP_OPS = {Lt: "<", Le: "<=", Gt: ">", Ge: ">=", Eq: "==", Neq: "!="}
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==",
+         "!=": "!="}
+
+
+def _literal_value(e: Expression):
+    if isinstance(e, Literal):
+        return e.value
+    return None
+
+
+def extract_pushable_filters(cond: Expression) -> List[PushedFilter]:
+    """Conjuncts of ``cond`` a footer-statistics test can answer. Anything
+    else is ignored (the in-plan filter still applies it)."""
+    out: List[PushedFilter] = []
+
+    def visit(e: Expression) -> None:
+        if isinstance(e, And):
+            visit(e.children[0])
+            visit(e.children[1])
+            return
+        if isinstance(e, IsNull) and isinstance(e.children[0], Col):
+            out.append((e.children[0].name, "isnull", None))
+            return
+        if isinstance(e, IsNotNull) and isinstance(e.children[0], Col):
+            out.append((e.children[0].name, "isnotnull", None))
+            return
+        if isinstance(e, In) and isinstance(e.children[0], Col):
+            # a NULL in the list never equals anything, so pruning on the
+            # non-null values is safe
+            vals = tuple(v for v in e.values if v is not None)
+            if vals:
+                out.append((e.children[0].name, "in", vals))
+            return
+        for cls, op in _CMP_OPS.items():
+            if isinstance(e, cls):
+                l, r = e.children
+                if isinstance(l, Col) and _literal_value(r) is not None:
+                    out.append((l.name, op, _literal_value(r)))
+                elif isinstance(r, Col) and _literal_value(l) is not None:
+                    out.append((r.name, _FLIP[op], _literal_value(l)))
+                return
+
+    visit(cond)
+    return out
+
+
+def _coerce_pair(a, b):
+    """Best-effort comparable pair; raises on incomparable types (caller
+    treats that as 'cannot prune')."""
+    import pandas as pd
+    if isinstance(a, (np.datetime64, pd.Timestamp)) or isinstance(
+            b, (np.datetime64, pd.Timestamp)):
+        return pd.Timestamp(a), pd.Timestamp(b)
+    if isinstance(a, str) or isinstance(b, str):
+        return str(a), str(b)
+    return a, b
+
+
+def maybe_matches(mn, mx, null_count, num_values, op: str, value) -> bool:
+    """Conservative test: can a split with these column statistics contain
+    a row satisfying (col op value)? Unknown statistics -> True."""
+    try:
+        if op == "isnull":
+            return null_count is None or null_count > 0
+        if op == "isnotnull":
+            return num_values is None or num_values > 0 or mn is not None
+        if mn is None or mx is None:
+            return True
+        if op == "in":
+            return any(maybe_matches(mn, mx, null_count, num_values,
+                                     "==", v) for v in value)
+        lo, v = _coerce_pair(mn, value)
+        hi, _ = _coerce_pair(mx, value)
+        if op == "<":
+            return lo < v
+        if op == "<=":
+            return lo <= v
+        if op == ">":
+            return hi > v
+        if op == ">=":
+            return hi >= v
+        if op == "==":
+            return lo <= v <= hi
+        if op == "!=":
+            return not (lo == hi == v)
+    except Exception:
+        return True
+    return True
+
+
+def partition_value_matches(pval, op: str, value) -> bool:
+    """Exact test for hive partition-key values (partition pruning — the
+    layer Spark itself does for the reference)."""
+    try:
+        if op == "isnull":
+            return pval is None
+        if op == "isnotnull":
+            return pval is not None
+        if pval is None:
+            return False
+        if op == "in":
+            return any(partition_value_matches(pval, "==", v)
+                       for v in value)
+        a, b = _coerce_pair(pval, value)
+        return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b,
+                "==": a == b, "!=": a != b}[op]
+    except Exception:
+        return True
+
+
+def annotate_scan_pruning(root) -> None:
+    """Per-query scan annotation: mark each file scan with the column
+    subset the query actually references (cleared when the query shape
+    forbids pruning). The planner consults the mark."""
+    from spark_rapids_tpu.sql import plan as lp
+    cols = required_scan_columns(root)
+    for node in root.walk():
+        if not isinstance(node, lp.LogicalScan):
+            continue
+        node._pruned_columns = None
+        if cols is None or not hasattr(node.source, "with_columns"):
+            continue
+        keep = [c for c in node.source.schema.names if c in cols]
+        if keep and len(keep) < len(node.source.schema.names):
+            node._pruned_columns = keep
+
+
+def required_scan_columns(root) -> Optional[set]:
+    """Every column name referenced by any expression in the tree, or None
+    when some subtree forwards a scan's full schema to the output
+    unprojected (bare collect / select *): then nothing may be pruned."""
+    from spark_rapids_tpu.sql import plan as lp
+
+    names: set = set()
+    narrowing = (lp.LogicalProject, lp.LogicalAggregate)
+
+    def exprs_of(node) -> List[Expression]:
+        out = []
+        for attr in ("exprs", "grouping", "results", "window_exprs"):
+            for item in getattr(node, attr, ()) or ():
+                out.append(item[1] if isinstance(item, tuple) else item)
+        if getattr(node, "condition", None) is not None:
+            out.append(node.condition)
+        for key in getattr(node, "left_keys", ()) or ():
+            out.append(key)
+        for key in getattr(node, "right_keys", ()) or ():
+            out.append(key)
+        for o in getattr(node, "orders", ()) or ():
+            out.append(o.expr)
+        for name in getattr(node, "partition_cols", ()) or ():
+            if isinstance(name, str):
+                out.append(Col(name))
+        for proj in getattr(node, "projections", ()) or ():
+            out.extend(e for _n, e in proj)
+        if getattr(node, "source", None) is not None and isinstance(
+                getattr(node, "source"), Expression):
+            out.append(node.source)
+        return out
+
+    def collect_cols(e) -> None:
+        if isinstance(e, Col):
+            names.add(e.name)
+        from spark_rapids_tpu.sql.window import WindowExpression
+        if isinstance(e, WindowExpression):
+            for c in e.spec.partition_cols:
+                collect_cols(c)
+            for o in e.spec.orders:
+                collect_cols(o.expr)
+            collect_cols(e.fn)
+            return
+        for c in getattr(e, "children", ()):
+            collect_cols(c)
+
+    def narrowed(node) -> bool:
+        """True if every path from a scan below ``node`` to the output
+        passes a projection/aggregation that names its columns."""
+        if isinstance(node, narrowing):
+            return True
+        if isinstance(node, lp.LogicalScan):
+            return False
+        kids = getattr(node, "children", ())
+        if not kids:
+            return True
+        return all(narrowed(c) for c in kids)
+
+    any_scan = False
+    for node in root.walk():
+        if isinstance(node, lp.LogicalScan):
+            any_scan = True
+        for e in exprs_of(node):
+            collect_cols(e)
+    if not any_scan:
+        return None
+    if not narrowed(root):
+        return None
+    return names

@@ -8,7 +8,7 @@ pyarrow performs the host decode, the HostToDevice transition uploads.
 from __future__ import annotations
 
 import math
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 import pandas as pd
@@ -41,6 +41,20 @@ class InMemorySource(DataSource):
 
     def describe(self) -> str:
         return f"InMemory[{len(self.df)} rows x {len(self.df.columns)} cols]"
+
+    def with_columns(self, columns: List[str]) -> "InMemorySource":
+        """Projection-pushdown view: scan only the referenced columns.
+        Cheap (pandas column view, no copy) and it keeps every later
+        device kernel — filters especially — at the query's true width."""
+        keep = [c for c in self.df.columns if c in columns]
+        src = InMemorySource.__new__(InMemorySource)
+        src.df = self.df[keep]
+        src.num_partitions = self.num_partitions
+        src.schema = Schema(
+            keep, [self.schema.dtypes[self.schema.index_of(c)]
+                   for c in keep])
+        src._base = getattr(self, "_base", self)
+        return src
 
     def estimated_size_bytes(self) -> Optional[int]:
         # deep=True so object/string columns count their payload, not just
@@ -138,7 +152,7 @@ class ParquetSource(DataSource):
                 continue
             names.append(field.name)
             dts.append(dtmod.from_arrow(field.type))
-        self.columns = names
+        self.columns = list(names)  # data columns only (pkeys append below)
         # partition-value columns appended after data columns, typed by
         # inference over EVERY directory value (mixed kinds -> string)
         self._pkeys = sorted({k for _, pv in self._files for k in pv})
@@ -164,8 +178,78 @@ class ParquetSource(DataSource):
         import os
         return sum(os.path.getsize(p) for p in self.paths)
 
-    def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
+    def with_columns(self, columns: List[str]) -> "ParquetSource":
+        """Cheap projection view (no footer re-parse): read only
+        ``columns`` (data columns clipped; partition keys kept if named)."""
+        import copy
+        src = copy.copy(self)
+        src._base = getattr(self, "_base", self)
+        src.columns = [c for c in self.columns if c in columns]
+        src._pkeys = [k for k in self._pkeys if k in columns]
+        names = list(src.columns) + list(src._pkeys)
+        idx = {n: i for i, n in enumerate(self.schema.names)}
+        src.schema = Schema(names,
+                            [self.schema.dtypes[idx[n]] for n in names])
+        return src
+
+    def _rg_stats(self, path: str, rg: int):
+        """{col: (min, max, null_count, num_values)} from the footer."""
+        base = getattr(self, "_base", self)
+        cache = base.__dict__.setdefault("_stats_cache", {})
+        if (path, rg) not in cache:
+            md = self._pq.ParquetFile(path).metadata.row_group(rg)
+            stats = {}
+            for ci in range(md.num_columns):
+                col = md.column(ci)
+                s = col.statistics
+                if s is None:
+                    stats[col.path_in_schema] = (None, None, None, None)
+                else:
+                    stats[col.path_in_schema] = (
+                        s.min if s.has_min_max else None,
+                        s.max if s.has_min_max else None,
+                        s.null_count, s.num_values)
+            cache[(path, rg)] = stats
+        return cache[(path, rg)]
+
+    def prune_splits(self, filters) -> Tuple[list, int]:
+        """(surviving splits, pruned count): row-group statistics +
+        partition-value pruning for the pushed conjuncts
+        (ParquetFilters, GpuParquetScan.scala:204-246)."""
+        from spark_rapids_tpu.sql.pushdown import (
+            maybe_matches, partition_value_matches,
+        )
+        keep = []
+        for (p, rg, pvals) in self.splits:
+            ok = True
+            for name, op, value in filters:
+                if name in self._pkeys:
+                    pv = (_infer_partition_value(pvals[name])
+                          if name in pvals else None)
+                    if not partition_value_matches(pv, op, value):
+                        ok = False
+                        break
+                    continue
+                if name not in self.columns:
+                    continue
+                mn, mx, nulls, nvals = self._rg_stats(p, rg).get(
+                    name, (None, None, None, None))
+                if not maybe_matches(mn, mx, nulls, nvals, op, value):
+                    ok = False
+                    break
+            if ok:
+                keep.append((p, rg, pvals))
+        return keep, len(self.splits) - len(keep)
+
+    def cpu_partitions(self, ctx: ExecContext,
+                       filters=None) -> List[Partition]:
         pq = self._pq
+        splits = self.splits
+        if filters:
+            splits, pruned = self.prune_splits(filters)
+            if ctx.metrics_enabled:
+                ctx.metric_add(self.describe(), "numRowGroupsPruned",
+                               pruned)
 
         def make(path: str, rg: int, pvals) -> Partition:
             def run():
@@ -188,11 +272,11 @@ class ParquetSource(DataSource):
                 yield df
                 taskctx.clear_input_file()
             return run
-        if not self.splits:
+        if not splits:
             def empty():
                 yield _empty_from_schema(self.schema)
             return [empty]
-        return [make(p, rg, pv) for p, rg, pv in self.splits]
+        return [make(p, rg, pv) for p, rg, pv in splits]
 
 
 class CsvSource(DataSource):
@@ -268,8 +352,69 @@ class OrcSource(DataSource):
         import os
         return sum(os.path.getsize(p) for p in self.paths)
 
-    def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
+    def with_columns(self, columns: List[str]) -> "OrcSource":
+        import copy
+        src = copy.copy(self)
+        src._base = getattr(self, "_base", self)
+        src.columns = [c for c in self.columns if c in columns]
+        idx = {n: i for i, n in enumerate(self.schema.names)}
+        src.schema = Schema(list(src.columns),
+                            [self.schema.dtypes[idx[n]]
+                             for n in src.columns])
+        return src
+
+    def _stripe_index(self, col: str):
+        """{(path, stripe): (min, max, null_count, num_values)} for one
+        column, built lazily by reading just that column per stripe once —
+        pyarrow's ORC reader exposes no footer stripe statistics (the
+        reference reads them natively, sql/rapids/OrcFilters.scala), so
+        this one-time index plays their role across queries."""
+        base = getattr(self, "_base", self)
+        cache = base.__dict__.setdefault("_stripe_stats", {})
+        if col not in cache:
+            import pyarrow.compute as pc
+            idx = {}
+            for p in self.paths:
+                fh = self._paorc.ORCFile(p)
+                for s in range(fh.nstripes):
+                    t = fh.read_stripe(s, columns=[col])
+                    arr = t.column(0) if hasattr(t, "column") else t[0]
+                    n = len(arr)
+                    nulls = arr.null_count
+                    if n - nulls > 0:
+                        mn = pc.min(arr).as_py()
+                        mx = pc.max(arr).as_py()
+                    else:
+                        mn = mx = None
+                    idx[(p, s)] = (mn, mx, nulls, n - nulls)
+            cache[col] = idx
+        return cache[col]
+
+    def prune_splits(self, filters) -> Tuple[list, int]:
+        from spark_rapids_tpu.sql.pushdown import maybe_matches
+        keep = []
+        for (p, s) in self.splits:
+            ok = True
+            for name, op, value in filters:
+                if name not in self.columns:
+                    continue
+                mn, mx, nulls, nvals = self._stripe_index(name).get(
+                    (p, s), (None, None, None, None))
+                if not maybe_matches(mn, mx, nulls, nvals, op, value):
+                    ok = False
+                    break
+            if ok:
+                keep.append((p, s))
+        return keep, len(self.splits) - len(keep)
+
+    def cpu_partitions(self, ctx: ExecContext,
+                       filters=None) -> List[Partition]:
         paorc = self._paorc
+        splits = self.splits
+        if filters:
+            splits, pruned = self.prune_splits(filters)
+            if ctx.metrics_enabled:
+                ctx.metric_add(self.describe(), "numStripesPruned", pruned)
 
         def make(path: str, stripe: int) -> Partition:
             def run():
@@ -283,11 +428,11 @@ class OrcSource(DataSource):
                 yield _arrow_to_pandas(table)
                 taskctx.clear_input_file()
             return run
-        if not self.splits:
+        if not splits:
             def empty():
                 yield _empty_from_schema(self.schema)
             return [empty]
-        return [make(p, s) for p, s in self.splits]
+        return [make(p, s) for p, s in splits]
 
 
 def _arrow_to_pandas(table) -> pd.DataFrame:
