@@ -10,7 +10,7 @@ BASELINE.md). vs_baseline normalizes the geomean against that 4x typical.
 
 Env knobs:
   BENCH_SUITE   tpch | tpcxbb | mortgage | all   (default tpch)
-  BENCH_SF      scale factor          (default 0.05)
+  BENCH_SF      scale factor          (default 0.5 — lineitem 3M rows)
   BENCH_ITERS   timed iterations      (default 3)
   BENCH_QUERIES comma list overriding the suite default (tpch/tpcxbb only)
 """
@@ -86,7 +86,7 @@ SUITES = {"tpch": _suite_tpch, "tpcxbb": _suite_tpcxbb,
 
 def main():
     suite_names = os.environ.get("BENCH_SUITE", "tpch")
-    sf = float(os.environ.get("BENCH_SF", "0.05"))
+    sf = float(os.environ.get("BENCH_SF", "0.5"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     qenv = os.environ.get("BENCH_QUERIES")
     qnames = [q.strip() for q in qenv.split(",")] if qenv else None
@@ -129,8 +129,22 @@ def main():
             cpu_s = (time.perf_counter() - t0) / iters
             return tpu_out, tpu_s, cpu_out, cpu_s
         try:
-            tpu_out, tpu_s, cpu_out, cpu_s = _run_with_deadline(
-                measure, per_query_timeout)
+            try:
+                tpu_out, tpu_s, cpu_out, cpu_s = _run_with_deadline(
+                    measure, per_query_timeout)
+            except _QueryTimeout:
+                raise
+            except Exception as first:  # noqa: BLE001
+                # the tunneled attachment's remote_compile can fail
+                # transiently (dropped HTTP body); one retry rides the
+                # now-warm persistent compile cache. The first error is
+                # the real signal for deterministic failures — keep it.
+                import sys
+                print(f"bench: {q} first attempt failed "
+                      f"({type(first).__name__}: {first}); retrying",
+                      file=sys.stderr)
+                tpu_out, tpu_s, cpu_out, cpu_s = _run_with_deadline(
+                    measure, per_query_timeout)
         except _QueryTimeout:
             detail[q] = {"skipped": f"timed out after {per_query_timeout}s"}
             continue

@@ -38,21 +38,37 @@ class DeviceColumn:
 
     def __init__(self, dtype: DType, data: jnp.ndarray,
                  validity: jnp.ndarray,
-                 offsets: Optional[jnp.ndarray] = None):
+                 offsets: Optional[jnp.ndarray] = None,
+                 prefix8: Optional[jnp.ndarray] = None):
         self.dtype = dtype
         self.data = data
         self.validity = validity
         self.offsets = offsets
+        # optional per-row big-endian image of the first 8 bytes (uint64,
+        # (capacity,)): computed host-side at upload for scanned string
+        # columns and propagated through gathers, it lets grouping/sorting
+        # read key bytes without per-row char gathers (which lower to
+        # seconds-per-million-rows scalar loops on TPU). Derived string
+        # columns may carry None.
+        self.prefix8 = prefix8
 
     # --- pytree protocol ---------------------------------------------------
     def tree_flatten(self):
         if self.dtype.is_string:
-            return (self.data, self.validity, self.offsets), self.dtype
-        return (self.data, self.validity), self.dtype
+            if self.prefix8 is not None:
+                return ((self.data, self.validity, self.offsets,
+                         self.prefix8), (self.dtype, True))
+            return ((self.data, self.validity, self.offsets),
+                    (self.dtype, False))
+        return (self.data, self.validity), (self.dtype, False)
 
     @classmethod
-    def tree_unflatten(cls, dtype, children):
+    def tree_unflatten(cls, aux, children):
+        dtype, has_prefix = aux if isinstance(aux, tuple) else (aux, False)
         if dtype.is_string:
+            if has_prefix:
+                data, validity, offsets, prefix8 = children
+                return cls(dtype, data, validity, offsets, prefix8)
             data, validity, offsets = children
             return cls(dtype, data, validity, offsets)
         data, validity = children
@@ -125,7 +141,8 @@ class DeviceColumn:
                 chars[:total] = np.frombuffer(
                     data_buf, dtype=np.uint8,
                     count=total, offset=src_off[0])
-            return (chars, vpad, offsets)
+            prefix8 = _np_prefix8(chars, offsets, capacity)
+            return (chars, vpad, offsets, prefix8)
 
         fill = dtypes.null_fill_value(dtype)
         dpad = np.full(capacity, fill, dtype=dtype.np_dtype)
@@ -183,6 +200,22 @@ class DeviceColumn:
             return out, validity
         data, validity = (np.asarray(p) for p in host_parts)
         return data, validity
+
+
+def _np_prefix8(chars: np.ndarray, offsets: np.ndarray,
+                capacity: int) -> np.ndarray:
+    """Big-endian uint64 image of each row's first 8 bytes (0-padded past
+    the end), vectorized on the host — the order-preserving prefix the
+    device sort/group kernels would otherwise re-derive with per-row char
+    gathers (see DeviceColumn.prefix8)."""
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int64)
+    starts = offsets[:-1].astype(np.int64)
+    nc = max(len(chars), 1)
+    idx = starts[:, None] + np.arange(8)[None, :]
+    in_row = np.arange(8)[None, :] < lens[:, None]
+    b = np.where(in_row, chars[np.clip(idx, 0, nc - 1)], 0).astype(np.uint64)
+    shifts = np.uint64(8) * np.arange(7, -1, -1, dtype=np.uint64)
+    return (b << shifts[None, :]).sum(axis=1, dtype=np.uint64)
 
 
 def _char_bucket(n: int, minimum: int = 16) -> int:

@@ -707,11 +707,35 @@ class TpuShuffleExchangeExec(TpuExec):
 
         if kind == "single" or collapse:
             def single() -> Iterator[DeviceBatch]:
+                import jax as _jax
                 batches = [b for p in child_parts for b in p()]
                 if not batches:
                     yield DeviceBatch.empty(schema)
                     return
-                yield _concat_device(batches, schema, growth)
+                # capacity shrink: post-aggregate partials carry their
+                # pre-aggregate input capacity as padding; ONE batched
+                # row-count fetch lets each piece drop to its true bucket
+                # so every downstream kernel compiles and runs at the
+                # real scale instead of the padded one
+                need = [b for b in batches if b._host_rows is None]
+                if need:
+                    counts = _jax.device_get([b.num_rows for b in need])
+                    for b, c in zip(need, counts):
+                        b._host_rows = int(c)
+                shrunk = []
+                for b in batches:
+                    target = bucket_capacity(max(b._host_rows, 1), growth)
+                    if target < b.capacity:
+                        kern = cached_jit(
+                            f"shrink|{target}", lambda t=target: jax.jit(
+                                lambda bb, c: rowops.slice_batch_to(
+                                    bb, jnp.asarray(0, jnp.int32), c, t)))
+                        sb = kern(b, jnp.asarray(b._host_rows, jnp.int32))
+                        sb._host_rows = b._host_rows
+                        shrunk.append(sb)
+                    else:
+                        shrunk.append(b)
+                yield _concat_device(shrunk, schema, growth)
             return [single]
 
         assert kind in ("hash", "range", "roundrobin")

@@ -30,8 +30,13 @@ def aggregate_update(batch: DeviceBatch,
                      out_schema: Schema) -> DeviceBatch:
     """Partial aggregation of one batch: group by evaluated keys, reduce
     evaluated inputs. reductions: (kind, input_index, out_dtype)."""
+    from spark_rapids_tpu.sql.exprs.core import BoundRef
     ctx = make_context(batch)
-    key_cols = [to_device_column(ctx, e.eval_device(ctx)) for e in key_exprs]
+    # plain column-reference keys pass the ORIGINAL DeviceColumn through so
+    # upload-computed metadata (prefix8) survives the expression bridge
+    key_cols = [batch.columns[e.index] if isinstance(e, BoundRef)
+                else to_device_column(ctx, e.eval_device(ctx))
+                for e in key_exprs]
     input_cols = [to_device_column(ctx, e.eval_device(ctx))
                   for e in input_exprs]
     work_schema = Schema(
@@ -55,51 +60,401 @@ def aggregate_merge(batch: DeviceBatch, num_keys: int,
                            out_schema, force_single_group=num_keys == 0)
 
 
+# group-slot width of the fast aggregation branch: segment reductions at
+# capacity width cost the TPU seconds per call (scatter cost scales with
+# the output width), at 64Ki slots they are ~20x cheaper. Queries whose
+# per-batch group count exceeds this fall back to the exact-width branch
+# inside the same compiled program (lax.cond).
+GROUP_SLOTS = 65536
+
+
 def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
                     reductions: List[Tuple[str, int, DType]],
                     out_schema: Schema,
                     force_single_group: bool) -> DeviceBatch:
-    capacity = batch.capacity
-    if key_idx:
-        info = gb.group_rows(batch, key_idx)
-        num_groups = info.num_groups
-    else:
-        # global aggregate: every live row in group 0; always one group,
-        # even over empty input (SQL: global agg of empty = one row)
-        live = batch.row_mask()
-        idx = jnp.arange(capacity, dtype=jnp.int32)
-        dead = (~live).astype(jnp.uint8)
-        dead_s, perm = jax.lax.sort((dead, idx), num_keys=1, is_stable=True)
-        boundary = jnp.zeros((capacity,), jnp.bool_).at[0].set(True)
-        gid = jnp.zeros((capacity,), jnp.int32)
-        info = gb.GroupInfo(perm, gid, boundary,
-                            jnp.asarray(1, jnp.int32),
-                            jnp.zeros((capacity,), jnp.int32))
-        num_groups = info.num_groups
+    if not key_idx:
+        return _single_group_reduce(batch, reductions, out_schema)
+    has_string_reduction = any(
+        batch.columns[ci].dtype.is_string and kind != "count_valid"
+        for kind, ci, _dt in reductions)
+    if has_string_reduction:
+        return _sorted_space_reduce(batch, key_idx, reductions, out_schema)
+    return _rowspace_reduce(batch, key_idx, reductions, out_schema)
 
+
+def _single_group_reduce(batch: DeviceBatch,
+                         reductions: List[Tuple[str, int, DType]],
+                         out_schema: Schema) -> DeviceBatch:
+    """Global aggregate: plain masked vector reductions, no sort, no
+    segments, no gathers (SQL: global agg of empty input = one row)."""
+    capacity = batch.capacity
+    live = batch.row_mask()
+    pos = jnp.arange(capacity, dtype=jnp.int32)
     out_cols: List[DeviceColumn] = []
-    key_out = gb.gather_keys(batch, key_idx, info)
-    out_cols.extend(key_out)
+    slot0 = pos == 0
+
+    def place(scalar, valid_scalar, out_dt):
+        data = jnp.zeros((capacity,), out_dt.np_dtype).at[0].set(
+            scalar.astype(out_dt.np_dtype))
+        validity = jnp.zeros((capacity,), jnp.bool_).at[0].set(valid_scalar)
+        return DeviceColumn(out_dt, data, validity)
+
+    for kind, col_idx, out_dt in reductions:
+        col = batch.columns[col_idx]
+        if col.dtype.is_string:
+            if kind == "count_valid":
+                cnt = jnp.sum((col.validity & live).astype(jnp.int64))
+                out_cols.append(place(cnt, jnp.asarray(True), out_dt))
+                continue
+            # string min/max/first/last over one group: pick the winning
+            # row with the select machinery over a trivial GroupInfo
+            from spark_rapids_tpu.ops.rowops import gather_column
+            info = _trivial_group_info(batch)
+            rows, has = gb.segment_select_string(kind, col, info)
+            out_cols.append(gather_column(col, rows, has & slot0))
+            continue
+        valid = col.validity & live
+        vs = col.data
+        any_valid = jnp.any(valid)
+        if kind == "count_valid":
+            out_cols.append(place(jnp.sum(valid.astype(jnp.int64)),
+                                  jnp.asarray(True), out_dt))
+        elif kind == "sum":
+            x = jnp.where(valid, vs, 0).astype(out_dt.np_dtype)
+            out_cols.append(place(jnp.sum(x), any_valid, out_dt))
+        elif kind in ("min", "max"):
+            vs, neutral = gb.minmax_operands(vs, kind)
+            x = jnp.where(valid, vs, neutral)
+            red = jnp.min(x) if kind == "min" else jnp.max(x)
+            if out_dt.np_dtype == jnp.bool_:
+                red = red.astype(jnp.bool_)
+            out_cols.append(place(red.astype(out_dt.np_dtype), any_valid,
+                                  out_dt))
+        elif kind in ("first", "last", "first_valid", "last_valid"):
+            eligible = valid if kind.endswith("_valid") else live
+            big = capacity + 1
+            if kind.startswith("first"):
+                sel = jnp.min(jnp.where(eligible, pos, big))
+            else:
+                sel = jnp.max(jnp.where(eligible, pos, -1))
+            picked = (sel >= 0) & (sel < capacity)
+            sel_c = jnp.clip(sel, 0, capacity - 1)
+            out_cols.append(place(vs[sel_c].astype(out_dt.np_dtype),
+                                  picked & valid[sel_c], out_dt))
+        elif kind == "any":
+            out_cols.append(place(
+                jnp.any(vs & valid).astype(out_dt.np_dtype),
+                jnp.asarray(True), out_dt))
+        else:
+            raise ValueError(f"unknown reduction kind: {kind}")
+    return DeviceBatch(out_schema, out_cols, jnp.asarray(1, jnp.int32))
+
+
+def _trivial_group_info(batch: DeviceBatch) -> "gb.GroupInfo":
+    capacity = batch.capacity
+    live = batch.row_mask()
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    dead = (~live).astype(jnp.uint8)
+    _dead_s, perm = jax.lax.sort((dead, idx), num_keys=1, is_stable=True)
+    boundary = jnp.zeros((capacity,), jnp.bool_).at[0].set(True)
+    gid = jnp.zeros((capacity,), jnp.int32)
+    return gb.GroupInfo(perm, gid, boundary, jnp.asarray(1, jnp.int32),
+                        jnp.zeros((capacity,), jnp.int32))
+
+
+def _sorted_space_reduce(batch: DeviceBatch, key_idx: List[int],
+                         reductions: List[Tuple[str, int, DType]],
+                         out_schema: Schema) -> DeviceBatch:
+    """The original sorted-space path (string reductions need the ordered
+    slots of segment_select_string)."""
+    capacity = batch.capacity
+    info = gb.group_rows(batch, key_idx)
+    num_groups = info.num_groups
+    out_cols: List[DeviceColumn] = []
+    out_cols.extend(gb.gather_keys(batch, key_idx, info))
     group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
     for kind, col_idx, out_dt in reductions:
         col = batch.columns[col_idx]
         if col.dtype.is_string:
-            if kind in ("count_valid",):
-                data, validity = gb.segment_reduce(kind, col.validity, # count only needs validity
-                                                   col.validity, info,
-                                                   out_dt.np_dtype)
+            if kind == "count_valid":
+                data, validity = gb.segment_reduce(
+                    kind, col.validity, col.validity, info, out_dt.np_dtype)
                 out_cols.append(DeviceColumn(out_dt, data,
                                              validity & group_live))
                 continue
-            if kind in ("min", "max", "first", "last", "first_valid",
-                        "last_valid"):
-                from spark_rapids_tpu.ops.rowops import gather_column
-                rows, has = gb.segment_select_string(kind, col, info)
-                out_cols.append(
-                    gather_column(col, rows, has & group_live))
-                continue
-            raise NotImplementedError(f"string reduction {kind}")
-        data, validity = gb.segment_reduce(kind, col.data, col.validity, info,
-                                           out_dt.np_dtype)
+            from spark_rapids_tpu.ops.rowops import gather_column
+            rows, has = gb.segment_select_string(kind, col, info)
+            out_cols.append(gather_column(col, rows, has & group_live))
+            continue
+        data, validity = gb.segment_reduce(kind, col.data, col.validity,
+                                           info, out_dt.np_dtype)
         out_cols.append(DeviceColumn(out_dt, data, validity & group_live))
+    return DeviceBatch(out_schema, out_cols, num_groups)
+
+
+# slot count of the sort-free hash-table branch (the cuDF hash-aggregation
+# analogue): row key-images scatter into this many slots; exact per-key
+# image equality over each used slot proves the slot is a true group
+SLOT_TABLE = 8192
+
+
+def _slot_hash_attempt(batch: DeviceBatch, key_idx: List[int]):
+    """Sort-free group assignment attempt: map each row's exact 64-bit key
+    images to a slot (mixed image % SLOT_TABLE) and verify per-key image
+    equality within every used slot. Returns (fast_ok bool scalar, slot id
+    per row (dead -> SLOT_TABLE), rep_row per slot, used mask, n_used).
+
+    Exactness: fixed-width key images carry the full value; string images
+    carry the first 8 bytes + length and are only trusted when every live
+    string is <= 8 bytes (checked). A slot shared by two distinct key
+    tuples makes some per-key (min != max) -> fast_ok False and the caller
+    takes the sort-based branch — collisions and >SLOT_TABLE-group batches
+    degrade, never corrupt."""
+    from spark_rapids_tpu.ops.hashing import splitmix64
+    capacity = batch.capacity
+    live = batch.row_mask()
+    T = min(SLOT_TABLE, capacity)
+    # per key column: (key index, [exact equality image vectors]) — every
+    # image of a key must agree slot-wide for the slot to be a true group
+    key_images = []
+    ok_short = jnp.asarray(True)
+    for ki in key_idx:
+        col = batch.columns[ki]
+        if col.dtype.is_string:
+            lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+            if getattr(col, "prefix8", None) is not None:
+                # host-computed at upload, gather-propagated: zero char
+                # reads here
+                img = col.prefix8
+            else:
+                starts = col.offsets[:-1].astype(jnp.int32)
+                nc = col.data.shape[0]
+                img = jnp.zeros((capacity,), jnp.uint64)
+                for bpos in range(8):
+                    idxb = jnp.clip(starts + bpos, 0, max(nc - 1, 0))
+                    byte = jnp.where(bpos < lens, col.data[idxb],
+                                     jnp.asarray(0, jnp.uint8))
+                    img = (img << jnp.uint64(8)) | byte.astype(jnp.uint64)
+            # the raw prefix is injective over the bytes, but 0-padding
+            # aliases 'a' with 'a\x00' — the length joins the agreement
+            # check as its OWN image (XOR-folding it into one 64-bit word
+            # would reintroduce probabilistic equality)
+            per_key = [img, lens.astype(jnp.uint64)]
+            ok_short = ok_short & jnp.all(
+                jnp.where(live, lens, 0) <= 8)
+        else:
+            from spark_rapids_tpu.ops.sortops import u64_key_image
+            per_key = [u64_key_image(col)[0]]
+        # null keys get a distinct image band (exactness against a real
+        # value sharing the sentinel comes from the validity agreement
+        # check below)
+        per_key = [jnp.where(col.validity, im,
+                             jnp.uint64(0x9E3779B97F4A7C15))
+                   for im in per_key]
+        key_images.append((ki, per_key))
+    rid = jnp.asarray(0x243F6A8885A308D3, jnp.uint64)
+    for _ki, per_key in key_images:
+        for img in per_key:
+            rid = splitmix64(rid ^ img)
+    slot = jnp.where(live, (rid % jnp.uint64(T)).astype(jnp.int32), T)
+
+    def seg(op, x):
+        return op(x, slot, num_segments=T + 1)[:T]
+
+    used_cnt = seg(jax.ops.segment_sum, jnp.ones((capacity,), jnp.int32))
+    used = used_cnt > 0
+    collide = jnp.asarray(False)
+    for ki, per_key in key_images:
+        for img in per_key:
+            smin = seg(jax.ops.segment_min,
+                       jnp.where(live, img, ~jnp.uint64(0)))
+            smax = seg(jax.ops.segment_max,
+                       jnp.where(live, img, jnp.uint64(0)))
+            collide = collide | jnp.any(used & (smin != smax))
+        # a real value whose image happens to equal the null sentinel
+        # would merge with nulls undetected by the image test alone —
+        # require slot-wide validity agreement too
+        v = batch.columns[ki].validity.astype(jnp.int32)
+        vmin = seg(jax.ops.segment_min, jnp.where(live, v, 2))
+        vmax = seg(jax.ops.segment_max, jnp.where(live, v, -1))
+        collide = collide | jnp.any(used & (vmin != vmax))
+    fast_ok = ok_short & ~collide
+    n_used = used.sum().astype(jnp.int32)
+    return fast_ok, slot, used, n_used
+
+
+def _rowspace_reduce(batch: DeviceBatch, key_idx: List[int],
+                     reductions: List[Tuple[str, int, DType]],
+                     out_schema: Schema) -> DeviceBatch:
+    """Keyed aggregation with NO per-column permutation gathers: one packed
+    scatter bridges the hash-sorted group assignment back to row space,
+    then every reduction runs directly on the unpermuted columns. When the
+    batch's group count fits GROUP_SLOTS (the overwhelmingly common case)
+    the segment reductions run at slot width — ~20x cheaper than
+    capacity-wide scatters on TPU; the exact capacity-wide branch lives in
+    the same program behind a lax.cond."""
+    capacity = batch.capacity
+    gs = min(capacity, GROUP_SLOTS)
+    live = batch.row_mask()
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+
+    def reduce_core(width: int, seg_id, order_vec, to_row, num_groups,
+                    slot_perm=None):
+        """All outputs at ``width`` segment slots, padded to capacity.
+        seg_id: per-row segment (width = parked); order_vec: per-row
+        ordering for first/last; to_row: map a selected order value back
+        to an original row index; slot_perm: optional slot compaction
+        (used hash-table slots to the front)."""
+        nseg = width + 1  # parked slot for dead/overflow rows
+
+        def pad(x):
+            if width == capacity:
+                return x
+            return jnp.concatenate(
+                [x, jnp.zeros((capacity - width,), x.dtype)])
+
+        def seg(op, x):
+            r = op(x, seg_id, num_segments=nseg)[:width]
+            return r[slot_perm] if slot_perm is not None else r
+
+        # representative (first) row per group, for key gathering
+        big = capacity + 1
+        rep_slot = seg(jax.ops.segment_min,
+                       jnp.where(live, order_vec, big))
+        rep_row = to_row(jnp.clip(rep_slot, 0, capacity - 1))
+        group_live = jnp.arange(width, dtype=jnp.int32) < num_groups
+
+        outs = []
+        from spark_rapids_tpu.ops.rowops import gather_column
+        for ki in key_idx:
+            kcol = gather_column(batch.columns[ki], rep_row, group_live)
+            if kcol.dtype.is_string and kcol.prefix8 is not None:
+                # group outputs are tiny; drop the image so the cond's
+                # flat-leaf layout stays fixed (3 leaves per string col)
+                kcol = DeviceColumn(kcol.dtype, kcol.data, kcol.validity,
+                                    kcol.offsets)
+            if width != capacity:
+                if kcol.dtype.is_string:
+                    last = kcol.offsets[width]
+                    off_pad = jnp.full((capacity - width,), 0, jnp.int32) + last
+                    kcol = DeviceColumn(
+                        kcol.dtype, kcol.data,
+                        pad(kcol.validity),
+                        jnp.concatenate([kcol.offsets, off_pad]))
+                else:
+                    kcol = DeviceColumn(kcol.dtype, pad(kcol.data),
+                                        pad(kcol.validity))
+            outs.append(kcol)
+
+        for kind, col_idx, out_dt in reductions:
+            col = batch.columns[col_idx]
+            if col.dtype.is_string:  # only count_valid reaches here
+                cnt = seg(jax.ops.segment_sum,
+                          (col.validity & live).astype(jnp.int64))
+                outs.append(DeviceColumn(
+                    out_dt, pad(cnt.astype(out_dt.np_dtype)),
+                    pad(jnp.ones((width,), jnp.bool_) & group_live)))
+                continue
+            valid = col.validity & live
+            vs = col.data
+            has_valid = seg(jax.ops.segment_max,
+                            valid.astype(jnp.int32)) > 0
+            if kind == "count_valid":
+                data = seg(jax.ops.segment_sum, valid.astype(jnp.int64))
+                outs.append(DeviceColumn(
+                    out_dt, pad(data.astype(out_dt.np_dtype)),
+                    pad(jnp.ones((width,), jnp.bool_) & group_live)))
+            elif kind == "sum":
+                x = jnp.where(valid, vs, 0).astype(out_dt.np_dtype)
+                data = seg(jax.ops.segment_sum, x)
+                outs.append(DeviceColumn(out_dt, pad(data),
+                                         pad(has_valid & group_live)))
+            elif kind in ("min", "max"):
+                v2, neutral = gb.minmax_operands(vs, kind)
+                x = jnp.where(valid, v2, neutral)
+                op = (jax.ops.segment_min if kind == "min"
+                      else jax.ops.segment_max)
+                data = seg(op, x)
+                if out_dt.np_dtype == jnp.bool_:
+                    data = data.astype(jnp.bool_)
+                outs.append(DeviceColumn(
+                    out_dt, pad(data.astype(out_dt.np_dtype)),
+                    pad(has_valid & group_live)))
+            elif kind in ("first", "last", "first_valid", "last_valid"):
+                eligible = valid if kind.endswith("_valid") else live
+                big2 = capacity + 1
+                if kind.startswith("first"):
+                    sel = seg(jax.ops.segment_min,
+                              jnp.where(eligible, order_vec, big2))
+                else:
+                    sel = seg(jax.ops.segment_max,
+                              jnp.where(eligible, order_vec, -1))
+                picked = (sel >= 0) & (sel < capacity)
+                rowsel = to_row(jnp.clip(sel, 0, capacity - 1))
+                data = vs[rowsel].astype(out_dt.np_dtype)
+                validity = picked & valid[rowsel] & group_live
+                outs.append(DeviceColumn(out_dt, pad(data), pad(validity)))
+            elif kind == "any":
+                data = seg(jax.ops.segment_max,
+                           (vs & valid).astype(jnp.int32)) > 0
+                outs.append(DeviceColumn(
+                    out_dt, pad(data.astype(out_dt.np_dtype)),
+                    pad(jnp.ones((width,), jnp.bool_) & group_live)))
+            else:
+                raise ValueError(f"unknown reduction kind: {kind}")
+        return tuple(jax.tree_util.tree_leaves(outs))
+
+    def slot_branch():
+        _fast_ok, slot, used, n_used = _slot_state
+        width = min(SLOT_TABLE, capacity)
+        from spark_rapids_tpu.ops.pallas_kernels import compact_permutation
+        slot_perm, _cnt = compact_permutation(used)
+        leaves = reduce_core(width, slot, pos, lambda x: x, n_used,
+                             slot_perm=slot_perm)
+        return leaves + (n_used,)
+
+    def sort_branch():
+        info = gb.group_rows(batch, key_idx, compute_rep=False)
+        num_groups = info.num_groups
+        # one scatter carries (group id, sorted position) per original row
+        packed = jnp.zeros((capacity,), jnp.int64).at[info.perm].set(
+            info.group_id_sorted.astype(jnp.int64) * (capacity + 1)
+            + pos.astype(jnp.int64))
+        gid_row = (packed // (capacity + 1)).astype(jnp.int32)
+        inv_pos = (packed % (capacity + 1)).astype(jnp.int32)
+
+        def at(width: int):
+            sid = jnp.where(live & (gid_row < width),
+                            jnp.clip(gid_row, 0, width - 1), width)
+            return reduce_core(
+                width, sid, inv_pos,
+                lambda x: info.perm[jnp.clip(x, 0, capacity - 1)],
+                num_groups)
+        if gs == capacity:
+            return at(capacity) + (num_groups,)
+        return jax.lax.cond(
+            num_groups <= gs, lambda: at(gs) + (num_groups,),
+            lambda: at(capacity) + (num_groups,))
+
+    # sort-free hash-table attempt first (the cuDF hash-agg analogue):
+    # exact via per-key image agreement, falls back to the sort path for
+    # collisions, long string keys, or > SLOT_TABLE groups
+    _slot_state = _slot_hash_attempt(batch, key_idx)
+    leaves = jax.lax.cond(_slot_state[0], slot_branch, sort_branch)
+    num_groups = leaves[-1]
+    leaves = leaves[:-1]
+    # rebuild columns from the flattened leaves (cond needs flat outputs)
+    out_cols: List[DeviceColumn] = []
+    it = iter(leaves)
+    for ki in key_idx:
+        dt = batch.columns[ki].dtype
+        if dt.is_string:
+            chars, validity, offsets = next(it), next(it), next(it)
+            out_cols.append(DeviceColumn(dt, chars, validity, offsets))
+        else:
+            data, validity = next(it), next(it)
+            out_cols.append(DeviceColumn(dt, data, validity))
+    for _kind, _ci, out_dt in reductions:
+        data, validity = next(it), next(it)
+        out_cols.append(DeviceColumn(out_dt, data, validity))
     return DeviceBatch(out_schema, out_cols, num_groups)

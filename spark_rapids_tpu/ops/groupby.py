@@ -63,7 +63,8 @@ class GroupInfo:
                                             # group's first row (capacity,)
 
 
-def group_rows(batch: DeviceBatch, key_indices: Sequence[int]) -> GroupInfo:
+def group_rows(batch: DeviceBatch, key_indices: Sequence[int],
+               compute_rep: bool = True) -> GroupInfo:
     capacity = batch.capacity
     live = batch.row_mask()
     h1, h2 = row_hashes(batch, key_indices)
@@ -80,10 +81,13 @@ def group_rows(batch: DeviceBatch, key_indices: Sequence[int]) -> GroupInfo:
     group_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     group_id = jnp.where(live_s, group_id, capacity - 1)  # park dead rows
     num_groups = boundary.sum().astype(jnp.int32)
-    # original row of each group's first sorted row
-    pos = jnp.arange(capacity, dtype=jnp.int32)
-    rep_rows = jax.ops.segment_sum(
-        jnp.where(boundary, perm, 0), group_id, num_segments=capacity)
+    rep_rows = None
+    if compute_rep:
+        # original row of each group's first sorted row (capacity-wide
+        # scatter: the row-space reduce path skips this, computing reps
+        # at group-slot width instead — ops/aggregate.py)
+        rep_rows = jax.ops.segment_sum(
+            jnp.where(boundary, perm, 0), group_id, num_segments=capacity)
     return GroupInfo(perm, group_id, boundary, num_groups, rep_rows)
 
 
@@ -93,6 +97,18 @@ def gather_keys(batch: DeviceBatch, key_indices: Sequence[int],
     live = jnp.arange(batch.capacity, dtype=jnp.int32) < info.num_groups
     return [gather_column(batch.columns[ki], info.rep_rows, live)
             for ki in key_indices]
+
+
+def minmax_operands(vs, kind: str):
+    """Shared (values, neutral) selection for min/max reductions — one
+    definition consumed by the sorted-space, row-space/slot, and
+    single-group aggregation paths so their semantics cannot diverge."""
+    if jnp.issubdtype(vs.dtype, jnp.floating):
+        return vs, (jnp.inf if kind == "min" else -jnp.inf)
+    if vs.dtype == jnp.bool_:
+        return vs.astype(jnp.int32), (1 if kind == "min" else 0)
+    info_ = jnp.iinfo(vs.dtype)
+    return vs, (info_.max if kind == "min" else info_.min)
 
 
 def segment_reduce(kind: str, values: jnp.ndarray, validity: jnp.ndarray,
@@ -118,14 +134,7 @@ def segment_reduce(kind: str, values: jnp.ndarray, validity: jnp.ndarray,
         data = seg(jax.ops.segment_sum, x)
         return data, group_has_valid
     if kind in ("min", "max"):
-        if jnp.issubdtype(vs.dtype, jnp.floating):
-            neutral = jnp.inf if kind == "min" else -jnp.inf
-        elif vs.dtype == jnp.bool_:
-            vs = vs.astype(jnp.int32)
-            neutral = 1 if kind == "min" else 0
-        else:
-            info_ = jnp.iinfo(vs.dtype)
-            neutral = info_.max if kind == "min" else info_.min
+        vs, neutral = minmax_operands(vs, kind)
         x = jnp.where(val_s, vs, neutral)
         op = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
         data = seg(op, x)

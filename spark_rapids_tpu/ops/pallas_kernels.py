@@ -39,11 +39,14 @@ _BLK = _ROWS * _LANES  # 2048 elements per grid step
 
 
 def _mode() -> str:
-    """auto = the XLA cumsum path: on tunneled/remote-compile TPU
-    attachments Mosaic compiles are unreliable (a standalone probe can
-    pass while the same kernel embedded in a larger program fails to
-    legalize), so the pallas path is explicit opt-in for directly
-    attached chips."""
+    """auto = the XLA cumsum path. Re-verified round 2: this attachment's
+    chipless AOT compile helper (TpuAotCompiler via remote_compile)
+    rejects Mosaic programs outright — even a standalone
+    compact_permutation probe fails with a compile-helper crash, same
+    class of failure as the float64-bitcast rejection (ops/floatbits.py).
+    The pallas path therefore stays explicit opt-in
+    (SPARK_RAPIDS_TPU_PALLAS=1) for directly attached chips, where Mosaic
+    compiles in-process."""
     env = os.environ.get("SPARK_RAPIDS_TPU_PALLAS", "auto")
     if env in ("0", "off", "jnp", "auto"):
         return "jnp"

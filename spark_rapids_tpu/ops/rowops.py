@@ -43,7 +43,13 @@ def gather_column(col: DeviceColumn, perm: jnp.ndarray,
         gathered = col.data[jnp.clip(src_idx, 0, nchars - 1)]
         new_chars = jnp.where(k < total_new, gathered, 0).astype(jnp.uint8)
         validity = col.validity[perm] & live
-        return DeviceColumn(col.dtype, new_chars, validity, new_offsets)
+        prefix8 = None
+        if col.prefix8 is not None:
+            # rows reorder; the 8-byte prefix image rides along (one
+            # fixed-width gather instead of re-deriving from chars later)
+            prefix8 = jnp.where(live, col.prefix8[perm], jnp.uint64(0))
+        return DeviceColumn(col.dtype, new_chars, validity, new_offsets,
+                            prefix8)
     data = col.data[perm]
     validity = col.validity[perm] & live
     return DeviceColumn(col.dtype, data, validity)
@@ -108,14 +114,19 @@ def _concat_string_cols(parts: List[DeviceColumn], counts,
     idx = jnp.arange(out_capacity, dtype=jnp.int32)
     out_len = jnp.zeros((out_capacity,), jnp.int32)
     out_val = jnp.zeros((out_capacity,), jnp.bool_)
+    has_prefix = all(p.prefix8 is not None for p in parts)
+    prefix8 = jnp.zeros((out_capacity,), jnp.uint64) if has_prefix else None
     row_offset = jnp.asarray(0, jnp.int32)
-    # first pass: lengths and validity
+    # first pass: lengths, validity (and the prefix image, which shares
+    # the same masks)
     for part, n in zip(parts, counts):
         lens = (part.offsets[1:] - part.offsets[:-1]).astype(jnp.int32)
         src = jnp.clip(idx - row_offset, 0, part.capacity - 1)
         in_range = (idx >= row_offset) & (idx < row_offset + n)
         out_len = jnp.where(in_range, lens[src], out_len)
         out_val = jnp.where(in_range, part.validity[src], out_val)
+        if has_prefix:
+            prefix8 = jnp.where(in_range, part.prefix8[src], prefix8)
         row_offset = row_offset + n
     new_offsets = jnp.concatenate([
         jnp.zeros((1,), jnp.int32), jnp.cumsum(out_len).astype(jnp.int32)])
@@ -137,7 +148,8 @@ def _concat_string_cols(parts: List[DeviceColumn], counts,
         row_offset = row_offset + n
     total_chars = new_offsets[out_capacity]
     out_chars = jnp.where(k < total_chars, out_chars, 0).astype(jnp.uint8)
-    return DeviceColumn(parts[0].dtype, out_chars, out_val, new_offsets)
+    return DeviceColumn(parts[0].dtype, out_chars, out_val, new_offsets,
+                        prefix8)
 
 
 def slice_batch(batch: DeviceBatch, start: jnp.ndarray,

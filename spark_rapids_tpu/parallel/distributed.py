@@ -425,7 +425,7 @@ def dryrun_distributed_q1(n_devices: int, rows_per_shard: int = 512) -> None:
                 ccap <<= 1
             chs, vs, offs = [], [], []
             for s in range(n):
-                c, v, o = _DC.build_host_buffers(
+                c, v, o, _p = _DC.build_host_buffers(
                     vals[s], None, dt, rows_per_shard, char_capacity=ccap)
                 chs.append(c)
                 vs.append(v)

@@ -80,3 +80,44 @@ def test_device_memory_size():
     batch = DeviceBatch.from_pandas(df)
     # 128 capacity * 8 bytes + 128 validity bytes + 4 num_rows
     assert batch.device_memory_size() >= 128 * 8
+
+
+def test_prefix8_upload_and_propagation(rng):
+    """The host-computed 8-byte prefix image matches the bytes, and rides
+    through filters (gather) and concats."""
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.ops import rowops
+
+    vals = np.array(["", "a", "abcdefgh", "abcdefghi", "zz", None] * 20,
+                    dtype=object)
+    df = pd.DataFrame({"s": vals, "x": np.arange(len(vals))})
+    b = DeviceBatch.from_pandas(df)
+    col = b.columns[0]
+    assert col.prefix8 is not None
+    got = np.asarray(col.prefix8)[: len(vals)]
+
+    def ref(v):
+        if v is None:
+            return 0
+        raw = v.encode()[:8].ljust(8, b"\x00")
+        return int.from_bytes(raw, "big")
+    expect = np.array([ref(v) for v in vals], dtype=np.uint64)
+    valid = np.array([v is not None for v in vals])
+    assert (got[valid] == expect[valid]).all()
+
+    keep = b.columns[1].data % 3 == 0
+    filtered = jax.jit(lambda bb, k: rowops.filter_batch(bb, k))(b, keep)
+    fcol = filtered.columns[0]
+    assert fcol.prefix8 is not None
+    n = int(jax.device_get(filtered.num_rows))
+    fp = np.asarray(fcol.prefix8)[:n]
+    fv = np.asarray(fcol.validity)[:n]
+    kept_vals = [v for v, k in zip(vals, np.asarray(keep)[: len(vals)]) if k]
+    fe = np.array([ref(v) for v in kept_vals], dtype=np.uint64)
+    fvalid = np.array([v is not None for v in kept_vals])
+    assert (fp[fv] == fe[fvalid]).all()
+
+    merged = jax.jit(lambda a, c: rowops.concat_batches([a, c], 512))(b, b)
+    assert merged.columns[0].prefix8 is not None
