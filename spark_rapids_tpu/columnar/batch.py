@@ -138,9 +138,19 @@ class DeviceBatch:
     # --- conversion --------------------------------------------------------
     @staticmethod
     def from_pandas(df: pd.DataFrame, capacity: Optional[int] = None,
-                    schema: Optional[Schema] = None) -> "DeviceBatch":
+                    schema: Optional[Schema] = None,
+                    dict_encode: bool = True,
+                    dict_state: Optional[dict] = None) -> "DeviceBatch":
         """Host -> device transition (reference: GpuRowToColumnarExec /
-        HostColumnarToGpu, GpuRowToColumnarExec.scala:45-502)."""
+        HostColumnarToGpu, GpuRowToColumnarExec.scala:45-502).
+
+        ``dict_encode``: probe each column for low cardinality and attach a
+        host-computed dictionary (codes + static values) — the aggregation
+        fast path's direct slot addressing rides it (see
+        DeviceColumn.dict_codes). ``dict_state``: a mutable per-scan
+        registry making every batch of one scan share one dictionary (see
+        host_dict_encode_stateful)."""
+        from spark_rapids_tpu.columnar.column import host_dict_encode_stateful
         if schema is None:
             schema = Schema.from_pandas(df)
         n = len(df)
@@ -149,15 +159,30 @@ class DeviceBatch:
         # the whole batch in ONE device_put (per-buffer uploads each pay a
         # round trip on remote attachments)
         host_bufs = []
+        dict_metas = []
         # positional iteration: join outputs may carry duplicate column names
         for i, dt in enumerate(schema.dtypes):
             values, validity = _pandas_to_numpy(df.iloc[:, i], dt)
-            host_bufs.append(DeviceColumn.build_host_buffers(
-                values, validity, dt, cap))
+            bufs = DeviceColumn.build_host_buffers(values, validity, dt, cap)
+            enc = host_dict_encode_stateful(values, validity, dt, cap,
+                                            dict_state, i) \
+                if dict_encode else None
+            if enc is not None:
+                codes, vals = enc
+                bufs = bufs + (codes,)
+                dict_metas.append(vals)
+            else:
+                dict_metas.append(None)
+            host_bufs.append(bufs)
         dev = jax.device_put((host_bufs, np.asarray(n, np.int32)))
         dev_bufs, num_rows = dev
-        cols = [DeviceColumn(dt, *bufs)
-                for dt, bufs in zip(schema.dtypes, dev_bufs)]
+        cols = []
+        for dt, bufs, dvals in zip(schema.dtypes, dev_bufs, dict_metas):
+            if dvals is not None:
+                cols.append(DeviceColumn(dt, *bufs[:-1], dict_codes=bufs[-1],
+                                         dict_values=dvals))
+            else:
+                cols.append(DeviceColumn(dt, *bufs))
         batch = DeviceBatch(schema, cols, num_rows)
         batch._host_rows = n
         return batch

@@ -39,7 +39,9 @@ class DeviceColumn:
     def __init__(self, dtype: DType, data: jnp.ndarray,
                  validity: jnp.ndarray,
                  offsets: Optional[jnp.ndarray] = None,
-                 prefix8: Optional[jnp.ndarray] = None):
+                 prefix8: Optional[jnp.ndarray] = None,
+                 dict_codes: Optional[jnp.ndarray] = None,
+                 dict_values: Optional[tuple] = None):
         self.dtype = dtype
         self.data = data
         self.validity = validity
@@ -51,28 +53,57 @@ class DeviceColumn:
         # seconds-per-million-rows scalar loops on TPU). Derived string
         # columns may carry None.
         self.prefix8 = prefix8
+        # optional host-computed dictionary encoding (low-cardinality
+        # columns): ``dict_codes`` int32 (capacity,) with values in
+        # [0, card], where card = len(dict_values) encodes NULL (and row
+        # padding); ``dict_values`` is a STATIC tuple of python values in
+        # canonical sorted order. Being pytree aux data, the dictionary is
+        # a compile-time constant — the aggregation fast path uses it for
+        # direct slot addressing and rebuilds group-key outputs from host
+        # constants with zero device char reads (the TPU answer to cuDF's
+        # dictionary columns the reference leans on for strings).
+        self.dict_codes = dict_codes
+        self.dict_values = dict_values
 
     # --- pytree protocol ---------------------------------------------------
     def tree_flatten(self):
+        leaves = [self.data, self.validity]
         if self.dtype.is_string:
-            if self.prefix8 is not None:
-                return ((self.data, self.validity, self.offsets,
-                         self.prefix8), (self.dtype, True))
-            return ((self.data, self.validity, self.offsets),
-                    (self.dtype, False))
-        return (self.data, self.validity), (self.dtype, False)
+            leaves.append(self.offsets)
+        has_prefix = self.dtype.is_string and self.prefix8 is not None
+        if has_prefix:
+            leaves.append(self.prefix8)
+        if self.dict_values is not None:
+            leaves.append(self.dict_codes)
+        return tuple(leaves), (self.dtype, has_prefix, self.dict_values)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        dtype, has_prefix = aux if isinstance(aux, tuple) else (aux, False)
+        if isinstance(aux, tuple):
+            dtype, has_prefix, dict_values = (aux if len(aux) == 3
+                                              else (*aux, None))
+        else:
+            dtype, has_prefix, dict_values = aux, False, None
+        it = list(children)
+        data, validity = it[0], it[1]
+        pos = 2
+        offsets = prefix8 = dict_codes = None
         if dtype.is_string:
-            if has_prefix:
-                data, validity, offsets, prefix8 = children
-                return cls(dtype, data, validity, offsets, prefix8)
-            data, validity, offsets = children
-            return cls(dtype, data, validity, offsets)
-        data, validity = children
-        return cls(dtype, data, validity)
+            offsets = it[pos]
+            pos += 1
+        if has_prefix:
+            prefix8 = it[pos]
+            pos += 1
+        if dict_values is not None:
+            dict_codes = it[pos]
+        return cls(dtype, data, validity, offsets, prefix8,
+                   dict_codes, dict_values)
+
+    @property
+    def dict_card(self) -> int:
+        """Number of real dictionary values (code == dict_card is NULL)."""
+        assert self.dict_values is not None
+        return len(self.dict_values)
 
     # --- properties --------------------------------------------------------
     @property
@@ -216,6 +247,126 @@ def _np_prefix8(chars: np.ndarray, offsets: np.ndarray,
     b = np.where(in_row, chars[np.clip(idx, 0, nc - 1)], 0).astype(np.uint64)
     shifts = np.uint64(8) * np.arange(7, -1, -1, dtype=np.uint64)
     return (b << shifts[None, :]).sum(axis=1, dtype=np.uint64)
+
+
+# host dictionary encoding, applied at upload. Cardinality cap keeps the
+# static dictionaries small enough to ride jit cache keys; the sample
+# probe keeps the cost near-zero for high-cardinality columns (factorize
+# of a 750k-row column costs ~50-100 ms — only paid when the sample says
+# the column is plausibly low-cardinality).
+DICT_MAX_CARD = 256
+_DICT_PROBE = 4096
+
+
+def host_dict_encode(values: np.ndarray, validity: Optional[np.ndarray],
+                     dtype: DType, capacity: int):
+    """Host-side dictionary probe+encode of a column being uploaded.
+
+    Returns (codes int32 (capacity,), values tuple) or None. Codes are in
+    [0, card] with card = NULL/padding; ``values`` is sorted so identical
+    value SETS across batches produce identical (compile-key) dictionaries.
+    """
+    import pandas as pd
+    n = len(values)
+    if n == 0:
+        return None
+    probe = values[:_DICT_PROBE]
+    try:
+        nu = pd.unique(probe[~pd.isna(probe)] if dtype.is_string
+                       else probe)
+    except TypeError:
+        return None
+    if len(nu) > DICT_MAX_CARD or len(nu) > max(64, len(probe) // 4):
+        return None
+    codes, uniques = pd.factorize(values, use_na_sentinel=True)
+    card = len(uniques)
+    if card > DICT_MAX_CARD or card == 0:
+        return None
+    if dtype.is_string:
+        if any(not isinstance(u, str) for u in uniques):
+            return None  # mixed/NA uniques: not a clean string dictionary
+        vals = [str(u) for u in uniques]
+        sort_key = np.asarray(vals, dtype=object)
+    else:
+        arr = np.asarray(uniques, dtype=dtype.np_dtype)
+        if np.issubdtype(arr.dtype, np.floating):
+            # NaN is a grouping VALUE (SQL NaN, not NULL) but factorize
+            # maps it to the NA sentinel, which would collapse NaN keys
+            # into the NULL group — and a NaN dictionary entry would also
+            # break aux-data equality (NaN != NaN churns the jit cache).
+            # Check the VALID rows, not the uniques (factorize never
+            # surfaces NaN as a unique).
+            vrows = np.asarray(values[:n], dtype=np.float64)
+            if validity is not None:
+                vrows = vrows[validity[:n]]
+            if np.isnan(vrows).any():
+                return None
+        # python scalars: hashable, stable across numpy versions
+        vals = arr.tolist()
+        sort_key = arr
+    # canonical order: identical value SETS across batches -> identical
+    # dictionaries -> one compiled program
+    order = np.argsort(sort_key, kind="stable")
+    remap = np.empty(card + 1, dtype=np.int32)
+    remap[order] = np.arange(card, dtype=np.int32)
+    remap[card] = card  # null sentinel maps to itself
+    new_codes = remap[np.where(codes < 0, card, codes)]
+    if validity is not None:
+        # factorize saw canonicalized fill values at null rows as real
+        # values; override their codes with the null sentinel (the fill
+        # value's dictionary slot simply goes unused if no valid row
+        # carries it)
+        new_codes = np.where(validity[:n], new_codes, card)
+    out = np.full(capacity, card, dtype=np.int32)
+    out[:n] = new_codes.astype(np.int32)
+    return out, tuple(vals[i] for i in order)
+
+
+def host_dict_encode_stateful(values: np.ndarray,
+                              validity: Optional[np.ndarray], dtype: DType,
+                              capacity: int, state: Optional[dict],
+                              key) -> Optional[tuple]:
+    """host_dict_encode with a per-scan registry: the FIRST batch of a scan
+    establishes the dictionary and every later batch encodes against it,
+    so all batches of one scan share one static dictionary (one compiled
+    aggregation program, no per-batch retraces). A later batch holding a
+    value outside the established dictionary switches the column off for
+    the remainder of the scan (bounded structure churn: at most two
+    program shapes per scan)."""
+    st = state.get(key) if state is not None else None
+    if st is False:
+        return None
+    if st is None:
+        enc = host_dict_encode(values, validity, dtype, capacity)
+        if state is not None:
+            state[key] = enc[1] if enc is not None else False
+        return enc
+    n = len(values)
+    card = len(st)
+    out = np.full(capacity, card, dtype=np.int32)
+    if n == 0:
+        return out, st
+    arr = np.asarray(list(st),
+                     dtype=object if dtype.is_string else dtype.np_dtype)
+    need = (np.asarray(validity[:n], dtype=bool) if validity is not None
+            else np.ones(n, dtype=bool))
+    vals_n = np.asarray(values[:n],
+                        dtype=object if dtype.is_string else dtype.np_dtype)
+    # null slots may hold None/NaN fills that break object comparisons;
+    # park them on a real dictionary entry (their codes are overridden)
+    vals_n = np.where(need, vals_n, arr[0])
+    try:
+        idx = np.searchsorted(arr, vals_n)
+    except TypeError:
+        state[key] = False
+        return None
+    idx_c = np.clip(idx, 0, card - 1)
+    ok = arr[idx_c] == vals_n
+    if not bool(np.all(ok | ~need)):
+        state[key] = False  # unseen value: dictionary closed for this scan
+        return None
+    out[:n] = np.where(need, idx_c, card).astype(np.int32)
+    return out, st
 
 
 def _char_bucket(n: int, minimum: int = 16) -> int:

@@ -529,6 +529,11 @@ class TpuScanExec(TpuExec):
         from spark_rapids_tpu.exec.transitions import scan_cache_for
         cache = scan_cache_for(ctx, self.source, schema, max_rows,
                                self.pushed_filters)
+        # one dictionary registry per scan: every batch of this scan
+        # encodes against the first batch's dictionaries, so the
+        # aggregation fast path compiles ONE program per scan (a racing
+        # concurrent partition at worst costs one extra retrace)
+        dict_state: dict = {}
 
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
@@ -553,7 +558,8 @@ class TpuScanExec(TpuExec):
                         for lo in range(0, max(len(df), 1), max_rows):
                             chunk = df.iloc[lo:lo + max_rows]
                             batch = DeviceBatch.from_pandas(
-                                chunk.reset_index(drop=True), schema=schema)
+                                chunk.reset_index(drop=True), schema=schema,
+                                dict_state=dict_state)
                             if out is not None:
                                 # cached batches live in the spillable
                                 # catalog (budget-metered, evictable)

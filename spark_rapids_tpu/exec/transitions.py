@@ -67,6 +67,9 @@ class HostToDeviceExec(PhysicalPlan):
         if isinstance(child, CpuScanExec):
             cache = scan_cache_for(ctx, child.source, schema, max_rows,
                                    getattr(child, "pushed_filters", None))
+        # shared dictionary registry across every batch of this transition
+        # (see TpuScanExec: bounds program-shape churn to one dict/scan)
+        dict_state: dict = {}
 
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
@@ -88,7 +91,8 @@ class HostToDeviceExec(PhysicalPlan):
                         for lo in range(0, max(len(df), 1), max_rows):
                             chunk = df.iloc[lo:lo + max_rows]
                             batch = DeviceBatch.from_pandas(
-                                chunk.reset_index(drop=True), schema=schema)
+                                chunk.reset_index(drop=True), schema=schema,
+                                dict_state=dict_state)
                             if out is not None:
                                 from spark_rapids_tpu.memory.spill import (
                                     SpillPriorities,

@@ -68,6 +68,38 @@ def aggregate_merge(batch: DeviceBatch, num_keys: int,
 GROUP_SLOTS = 65536
 
 
+# cap on the direct dictionary slot table (product of per-key
+# cardinalities): bounds the one-hot matmul's minor dimension
+DICT_SLOT_MAX = 4096
+
+
+def _dict_path_info(batch: DeviceBatch, key_idx: List[int]):
+    """Static probe: every key column dictionary-encoded at upload and the
+    joint slot table small -> (cards, strides, T), else None. All inputs to
+    this decision are pytree aux data, so the branch is resolved at trace
+    time (no lax.cond)."""
+    from spark_rapids_tpu.ops import densered
+    if batch.capacity > densered.MAX_EXACT_CAPACITY:
+        return None  # the f32-exactness argument caps the batch size
+    cards = []
+    for ki in key_idx:
+        col = batch.columns[ki]
+        if col.dict_values is None:
+            return None
+        cards.append(col.dict_card + 1)  # +1: the NULL code
+    T = 1
+    for c in cards:
+        T *= c
+    if T > DICT_SLOT_MAX:
+        return None
+    strides = []
+    acc = 1
+    for c in reversed(cards):
+        strides.append(acc)
+        acc *= c
+    return cards, list(reversed(strides)), T
+
+
 def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
                     reductions: List[Tuple[str, int, DType]],
                     out_schema: Schema,
@@ -79,7 +111,116 @@ def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
         for kind, ci, _dt in reductions)
     if has_string_reduction:
         return _sorted_space_reduce(batch, key_idx, reductions, out_schema)
+    dict_info = _dict_path_info(batch, key_idx)
+    if dict_info is not None:
+        return _dict_matmul_reduce(batch, key_idx, reductions, out_schema,
+                                   dict_info)
     return _rowspace_reduce(batch, key_idx, reductions, out_schema)
+
+
+def _dict_matmul_reduce(batch: DeviceBatch, key_idx: List[int],
+                        reductions: List[Tuple[str, int, DType]],
+                        out_schema: Schema, dict_info) -> DeviceBatch:
+    """Direct-addressed aggregation over dictionary codes: slot id is pure
+    arithmetic on the host-computed codes (no hashing, no collision or
+    agreement checks — codes are exact by construction), every sum/count
+    rides ONE one-hot matmul (ops/densered.py), and the group-key output
+    columns are HOST CONSTANTS decoded from the static dictionary (zero
+    device char reads). Output capacity shrinks to the slot-table bucket,
+    so downstream exchange/merge/sort stop paying the input batch's
+    padding. This is the cuDF hash-aggregation analogue rebuilt around the
+    MXU (reference: aggregate.scala:338-396)."""
+    import numpy as np
+    from spark_rapids_tpu.columnar.batch import bucket_capacity
+    from spark_rapids_tpu.ops import densered
+    from spark_rapids_tpu.ops.pallas_kernels import compact_permutation
+    from spark_rapids_tpu.ops.rowops import gather_column
+
+    cards, strides, T = dict_info
+    capacity = batch.capacity
+    live = batch.row_mask()
+    slot = jnp.zeros((capacity,), jnp.int32)
+    for ki, stride in zip(key_idx, strides):
+        slot = slot + batch.columns[ki].dict_codes * jnp.int32(stride)
+    slot = jnp.where(live, slot, T)  # park dead rows outside the table
+
+    dense_jobs = []
+    dense_pos = {}  # reduction index -> dense job index
+    for ri, (kind, ci, out_dt) in enumerate(reductions):
+        col = batch.columns[ci]
+        if kind in densered.DENSE_KINDS and (
+                kind == "count_valid"
+                or not col.dtype.is_string
+                and densered.dense_supported(kind, col.data.dtype)):
+            dense_pos[ri] = len(dense_jobs)
+            dense_jobs.append((kind, col.validity if kind == "count_valid"
+                               else col.data, col.validity,
+                               out_dt.np_dtype))
+    dense_res, row_count = densered.slot_reduce_dense(slot, live, T,
+                                                      dense_jobs)
+    used = row_count > 0
+    slot_perm, n_used = compact_permutation(used)
+    out_cap = bucket_capacity(T)
+    pad_n = out_cap - T
+    perm_pad = jnp.concatenate(
+        [slot_perm, jnp.zeros((pad_n,), jnp.int32)]) if pad_n else slot_perm
+    group_live = jnp.arange(out_cap, dtype=jnp.int32) < n_used
+
+    def place(data_t, valid_t):
+        """(T,) slot-space result -> (out_cap,) compacted group rows."""
+        if pad_n:
+            data_t = jnp.concatenate(
+                [data_t, jnp.zeros((pad_n,), data_t.dtype)])
+            valid_t = jnp.concatenate(
+                [valid_t, jnp.zeros((pad_n,), jnp.bool_)])
+        return data_t[perm_pad], valid_t[perm_pad] & group_live
+
+    out_cols: List[DeviceColumn] = []
+    # key columns: decoded from the static dictionary on the HOST at trace
+    # time; only the T-row compaction gather runs on device
+    for ki, stride, card1 in zip(key_idx, strides, cards):
+        col = batch.columns[ki]
+        card = card1 - 1
+        code_of_slot = (np.arange(out_cap) // stride) % card1
+        code_of_slot[T:] = card
+        validity = code_of_slot < card
+        if col.dtype.is_string:
+            vals = np.array(
+                [col.dict_values[c] if c < card else None
+                 for c in code_of_slot], dtype=object)
+        else:
+            fill = col.dict_values[0]
+            vals = np.array(
+                [col.dict_values[c] if c < card else fill
+                 for c in code_of_slot], dtype=col.dtype.np_dtype)
+        bufs = DeviceColumn.build_host_buffers(vals, validity, col.dtype,
+                                               out_cap)
+        const_col = DeviceColumn(
+            col.dtype, *(jnp.asarray(b) for b in bufs),
+            dict_codes=jnp.asarray(code_of_slot.astype(np.int32)),
+            dict_values=col.dict_values)
+        out_cols.append(gather_column(const_col, perm_pad, group_live))
+
+    def seg(op, x):
+        return op(x, slot, num_segments=T + 1)[:T]
+
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    for ri, (kind, ci, out_dt) in enumerate(reductions):
+        if ri in dense_pos:
+            data_t, valid_t = dense_res[dense_pos[ri]]
+            d, v = place(data_t, valid_t)
+            out_cols.append(DeviceColumn(out_dt, d, v))
+            continue
+        # tail kinds (min/max/first/last/any, dtypes the dense engine
+        # declined): T-width segment ops — one indexed pass each, only
+        # paid when the query uses them
+        col = batch.columns[ci]
+        data_t, valid_t = _seg_reduce_kind(
+            kind, col.data, col.validity & live, live, seg, pos,
+            lambda x: x, capacity, T, out_dt)
+        d, v = place(data_t, valid_t)
+        out_cols.append(DeviceColumn(out_dt, d, v))
+    return DeviceBatch(out_schema, out_cols, n_used.astype(jnp.int32))
 
 
 def _single_group_reduce(batch: DeviceBatch,
@@ -190,6 +331,51 @@ def _sorted_space_reduce(batch: DeviceBatch, key_idx: List[int],
                                            info, out_dt.np_dtype)
         out_cols.append(DeviceColumn(out_dt, data, validity & group_live))
     return DeviceBatch(out_schema, out_cols, num_groups)
+
+
+def _seg_reduce_kind(kind: str, vs, valid, live, seg, order_vec, to_row,
+                     capacity: int, width: int, out_dt: DType):
+    """One non-string reduction kind over a segment closure — the SINGLE
+    definition of per-kind null/tie semantics shared by the row-space
+    reduce_core (slot and sort branches) and the dictionary tail path, so
+    they cannot diverge. ``valid`` must already be masked to live rows;
+    ``seg(op, x)`` reduces (capacity,) -> (width,); ``order_vec``/``to_row``
+    define first/last ordering and map a selected order value back to an
+    original row index. Returns (data (width,), validity (width,)) — the
+    caller ANDs its group-liveness mask into validity."""
+    has_valid = seg(jax.ops.segment_max, valid.astype(jnp.int32)) > 0
+    if kind == "count_valid":
+        data = seg(jax.ops.segment_sum, valid.astype(jnp.int64))
+        return (data.astype(out_dt.np_dtype),
+                jnp.ones((width,), jnp.bool_))
+    if kind == "sum":
+        x = jnp.where(valid, vs, 0).astype(out_dt.np_dtype)
+        return seg(jax.ops.segment_sum, x), has_valid
+    if kind in ("min", "max"):
+        v2, neutral = gb.minmax_operands(vs, kind)
+        x = jnp.where(valid, v2, neutral)
+        op = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
+        data = seg(op, x)
+        if out_dt.np_dtype == jnp.bool_:
+            data = data.astype(jnp.bool_)
+        return data.astype(out_dt.np_dtype), has_valid
+    if kind in ("first", "last", "first_valid", "last_valid"):
+        eligible = valid if kind.endswith("_valid") else live
+        big = capacity + 1
+        if kind.startswith("first"):
+            sel = seg(jax.ops.segment_min,
+                      jnp.where(eligible, order_vec, big))
+        else:
+            sel = seg(jax.ops.segment_max,
+                      jnp.where(eligible, order_vec, -1))
+        picked = (sel >= 0) & (sel < capacity)
+        rowsel = to_row(jnp.clip(sel, 0, capacity - 1))
+        data = vs[rowsel].astype(out_dt.np_dtype)
+        return data, picked & valid[rowsel]
+    if kind == "any":
+        data = seg(jax.ops.segment_max, (vs & valid).astype(jnp.int32)) > 0
+        return data.astype(out_dt.np_dtype), jnp.ones((width,), jnp.bool_)
+    raise ValueError(f"unknown reduction kind: {kind}")
 
 
 # slot count of the sort-free hash-table branch (the cuDF hash-aggregation
@@ -328,9 +514,10 @@ def _rowspace_reduce(batch: DeviceBatch, key_idx: List[int],
         from spark_rapids_tpu.ops.rowops import gather_column
         for ki in key_idx:
             kcol = gather_column(batch.columns[ki], rep_row, group_live)
-            if kcol.dtype.is_string and kcol.prefix8 is not None:
-                # group outputs are tiny; drop the image so the cond's
-                # flat-leaf layout stays fixed (3 leaves per string col)
+            if kcol.prefix8 is not None or kcol.dict_values is not None:
+                # group outputs are tiny; drop the prefix image and the
+                # dictionary so the cond's flat-leaf layout stays fixed
+                # (3 leaves per string col, 2 per fixed-width)
                 kcol = DeviceColumn(kcol.dtype, kcol.data, kcol.validity,
                                     kcol.offsets)
             if width != capacity:
@@ -355,53 +542,11 @@ def _rowspace_reduce(batch: DeviceBatch, key_idx: List[int],
                     out_dt, pad(cnt.astype(out_dt.np_dtype)),
                     pad(jnp.ones((width,), jnp.bool_) & group_live)))
                 continue
-            valid = col.validity & live
-            vs = col.data
-            has_valid = seg(jax.ops.segment_max,
-                            valid.astype(jnp.int32)) > 0
-            if kind == "count_valid":
-                data = seg(jax.ops.segment_sum, valid.astype(jnp.int64))
-                outs.append(DeviceColumn(
-                    out_dt, pad(data.astype(out_dt.np_dtype)),
-                    pad(jnp.ones((width,), jnp.bool_) & group_live)))
-            elif kind == "sum":
-                x = jnp.where(valid, vs, 0).astype(out_dt.np_dtype)
-                data = seg(jax.ops.segment_sum, x)
-                outs.append(DeviceColumn(out_dt, pad(data),
-                                         pad(has_valid & group_live)))
-            elif kind in ("min", "max"):
-                v2, neutral = gb.minmax_operands(vs, kind)
-                x = jnp.where(valid, v2, neutral)
-                op = (jax.ops.segment_min if kind == "min"
-                      else jax.ops.segment_max)
-                data = seg(op, x)
-                if out_dt.np_dtype == jnp.bool_:
-                    data = data.astype(jnp.bool_)
-                outs.append(DeviceColumn(
-                    out_dt, pad(data.astype(out_dt.np_dtype)),
-                    pad(has_valid & group_live)))
-            elif kind in ("first", "last", "first_valid", "last_valid"):
-                eligible = valid if kind.endswith("_valid") else live
-                big2 = capacity + 1
-                if kind.startswith("first"):
-                    sel = seg(jax.ops.segment_min,
-                              jnp.where(eligible, order_vec, big2))
-                else:
-                    sel = seg(jax.ops.segment_max,
-                              jnp.where(eligible, order_vec, -1))
-                picked = (sel >= 0) & (sel < capacity)
-                rowsel = to_row(jnp.clip(sel, 0, capacity - 1))
-                data = vs[rowsel].astype(out_dt.np_dtype)
-                validity = picked & valid[rowsel] & group_live
-                outs.append(DeviceColumn(out_dt, pad(data), pad(validity)))
-            elif kind == "any":
-                data = seg(jax.ops.segment_max,
-                           (vs & valid).astype(jnp.int32)) > 0
-                outs.append(DeviceColumn(
-                    out_dt, pad(data.astype(out_dt.np_dtype)),
-                    pad(jnp.ones((width,), jnp.bool_) & group_live)))
-            else:
-                raise ValueError(f"unknown reduction kind: {kind}")
+            data, validity = _seg_reduce_kind(
+                kind, col.data, col.validity & live, live, seg, order_vec,
+                to_row, capacity, width, out_dt)
+            outs.append(DeviceColumn(out_dt, pad(data),
+                                     pad(validity & group_live)))
         return tuple(jax.tree_util.tree_leaves(outs))
 
     def slot_branch():

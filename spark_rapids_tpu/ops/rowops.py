@@ -48,11 +48,31 @@ def gather_column(col: DeviceColumn, perm: jnp.ndarray,
             # rows reorder; the 8-byte prefix image rides along (one
             # fixed-width gather instead of re-deriving from chars later)
             prefix8 = jnp.where(live, col.prefix8[perm], jnp.uint64(0))
+        codes, vals = _gather_dict(col, perm, live)
         return DeviceColumn(col.dtype, new_chars, validity, new_offsets,
-                            prefix8)
+                            prefix8, codes, vals)
     data = col.data[perm]
     validity = col.validity[perm] & live
-    return DeviceColumn(col.dtype, data, validity)
+    codes, vals = _gather_dict(col, perm, live)
+    return DeviceColumn(col.dtype, data, validity,
+                        dict_codes=codes, dict_values=vals)
+
+
+def _gather_dict(col: DeviceColumn, perm, live):
+    """Dictionary codes reorder with the rows (dead slots -> null code)."""
+    if col.dict_values is None:
+        return None, None
+    card = jnp.asarray(col.dict_card, jnp.int32)
+    return jnp.where(live, col.dict_codes[perm], card), col.dict_values
+
+
+def _shared_dict(parts: Sequence[DeviceColumn]):
+    """The dictionary all ``parts`` share, or None: a concat result keeps
+    codes only when every input encodes against the SAME static values."""
+    if parts[0].dict_values is None or any(
+            p.dict_values != parts[0].dict_values for p in parts):
+        return None
+    return parts[0].dict_values
 
 
 def gather_batch(batch: DeviceBatch, perm: jnp.ndarray,
@@ -89,10 +109,12 @@ def concat_batches(batches: Sequence[DeviceBatch],
             cols.append(_concat_string_cols(parts, [b.num_rows for b in batches],
                                             out_capacity, out_char_capacity))
         else:
-            datas, vals = [], []
             offset = jnp.asarray(0, jnp.int32)
             out_data = jnp.zeros((out_capacity,), dtype=parts[0].data.dtype)
             out_val = jnp.zeros((out_capacity,), dtype=jnp.bool_)
+            shared = _shared_dict(parts)
+            out_codes = (jnp.full((out_capacity,), len(shared), jnp.int32)
+                         if shared is not None else None)
             idx = jnp.arange(out_capacity, dtype=jnp.int32)
             for part, b in zip(parts, batches):
                 n = b.num_rows
@@ -101,8 +123,13 @@ def concat_batches(batches: Sequence[DeviceBatch],
                 in_range = (idx >= offset) & (idx < offset + n)
                 out_data = jnp.where(in_range, part.data[src], out_data)
                 out_val = jnp.where(in_range, part.validity[src], out_val)
+                if shared is not None:
+                    out_codes = jnp.where(in_range, part.dict_codes[src],
+                                          out_codes)
                 offset = offset + n
-            cols.append(DeviceColumn(dt, out_data, out_val))
+            cols.append(DeviceColumn(dt, out_data, out_val,
+                                     dict_codes=out_codes,
+                                     dict_values=shared))
     return DeviceBatch(schema, cols, total.astype(jnp.int32))
 
 
@@ -116,9 +143,12 @@ def _concat_string_cols(parts: List[DeviceColumn], counts,
     out_val = jnp.zeros((out_capacity,), jnp.bool_)
     has_prefix = all(p.prefix8 is not None for p in parts)
     prefix8 = jnp.zeros((out_capacity,), jnp.uint64) if has_prefix else None
+    shared = _shared_dict(parts)
+    out_codes = (jnp.full((out_capacity,), len(shared), jnp.int32)
+                 if shared is not None else None)
     row_offset = jnp.asarray(0, jnp.int32)
-    # first pass: lengths, validity (and the prefix image, which shares
-    # the same masks)
+    # first pass: lengths, validity (and the prefix image / dictionary
+    # codes, which share the same masks)
     for part, n in zip(parts, counts):
         lens = (part.offsets[1:] - part.offsets[:-1]).astype(jnp.int32)
         src = jnp.clip(idx - row_offset, 0, part.capacity - 1)
@@ -127,6 +157,8 @@ def _concat_string_cols(parts: List[DeviceColumn], counts,
         out_val = jnp.where(in_range, part.validity[src], out_val)
         if has_prefix:
             prefix8 = jnp.where(in_range, part.prefix8[src], prefix8)
+        if shared is not None:
+            out_codes = jnp.where(in_range, part.dict_codes[src], out_codes)
         row_offset = row_offset + n
     new_offsets = jnp.concatenate([
         jnp.zeros((1,), jnp.int32), jnp.cumsum(out_len).astype(jnp.int32)])
@@ -149,7 +181,7 @@ def _concat_string_cols(parts: List[DeviceColumn], counts,
     total_chars = new_offsets[out_capacity]
     out_chars = jnp.where(k < total_chars, out_chars, 0).astype(jnp.uint8)
     return DeviceColumn(parts[0].dtype, out_chars, out_val, new_offsets,
-                        prefix8)
+                        prefix8, out_codes, shared)
 
 
 def slice_batch(batch: DeviceBatch, start: jnp.ndarray,

@@ -1,0 +1,233 @@
+"""Dense one-hot matmul aggregation path (ops/densered.py +
+ops/aggregate._dict_matmul_reduce): exactness and special values.
+
+Reference behavior being matched: cuDF hash aggregation under
+GpuHashAggregateExec (reference aggregate.scala:338-396), incl. Spark's
+int64 wraparound sum semantics and IEEE float sums.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.sql import functions as F
+
+
+def _roundtrip(session, df, agg_fn, sort_cols):
+    sdf = agg_fn(session.create_dataframe(df, 2))
+    session.set_conf("spark.rapids.sql.enabled", True)
+    tpu = sdf.collect().sort_values(sort_cols).reset_index(drop=True)
+    session.set_conf("spark.rapids.sql.enabled", False)
+    cpu = sdf.collect().sort_values(sort_cols).reset_index(drop=True)
+    session.set_conf("spark.rapids.sql.enabled", True)
+    return tpu, cpu
+
+
+def test_dict_encoding_attached_and_propagated(session):
+    rng = np.random.default_rng(3)
+    n = 4000
+    df = pd.DataFrame({
+        "k": rng.choice(["x", "y", "z"], n),
+        "hi": [f"s{i}" for i in range(n)],  # high cardinality: no dict
+        "v": rng.uniform(0, 10, n),
+    })
+    b = DeviceBatch.from_pandas(df)
+    assert b.column("k").dict_values == ("x", "y", "z")
+    assert b.column("hi").dict_values is None
+    # codes survive a filter's gather
+    from spark_rapids_tpu.ops.rowops import filter_batch
+    import jax.numpy as jnp
+    kept = filter_batch(b, b.column("v").data > 5.0)
+    kc = kept.column("k")
+    assert kc.dict_values == ("x", "y", "z")
+    n2 = int(kept.num_rows)
+    codes = np.asarray(kc.dict_codes)[:n2]
+    vals = np.array(["x", "y", "z"])[codes]
+    got, _ = kc.to_numpy(n2)
+    assert (vals == got).all()
+
+
+def test_int64_sum_exact_wraparound(session):
+    # values big enough that a float64 segment sum would lose ulps and a
+    # plain int64 sum overflows (Spark semantics: wrap mod 2^64)
+    big = (1 << 62) + 12345
+    df = pd.DataFrame({
+        "k": ["a"] * 4 + ["b"] * 3,
+        "v": np.array([big, big, big, 7, -big, -3, 11], dtype=np.int64),
+    })
+    tpu, cpu = _roundtrip(
+        session, df,
+        lambda d: d.group_by("k").agg(F.sum("v").alias("s")), ["k"])
+    want = np.array([(3 * big + 7) % (1 << 64), (-big + 8) % (1 << 64)],
+                    dtype=np.uint64).astype(np.int64)
+    assert (tpu.s.values.astype(np.int64) == want).all()
+    assert (cpu.s.values.astype(np.int64) == want).all()
+
+
+def test_float_sum_nan_inf_isolated_per_group(session):
+    df = pd.DataFrame({
+        "k": ["a", "a", "b", "c", "c", "d", "d", "e"],
+        "v": [1.0, np.nan, 2.5, np.inf, 1.0, np.inf, -np.inf, 3.25],
+    })
+    tpu, cpu = _roundtrip(
+        session, df,
+        lambda d: d.group_by("k").agg(F.sum("v").alias("s")), ["k"])
+    t = tpu.s.values.astype(float)
+    assert np.isnan(t[0]) and np.isclose(t[1], 2.5) and t[2] == np.inf
+    assert np.isnan(t[3]) and np.isclose(t[4], 3.25)
+    c = cpu.s.values.astype(float)
+    assert all((np.isnan(a) and np.isnan(b)) or np.isclose(a, b)
+               for a, b in zip(t, c))
+
+
+def test_nan_float_key_not_collapsed_into_null(session):
+    df = pd.DataFrame({"k": [1.0, 1.0, np.nan, np.nan, 2.0],
+                       "v": [1, 2, 4, 8, 16]})
+    tpu, cpu = _roundtrip(
+        session, df,
+        lambda d: d.group_by("k").agg(F.sum("v").alias("s")), ["s"])
+    assert sorted(tpu.s.tolist()) == sorted(cpu.s.tolist()) == [3, 12, 16]
+
+
+def test_null_keys_and_all_null_groups(session):
+    df = pd.DataFrame({
+        "k": pd.array(["a", None, "a", None, "b"], dtype=object),
+        "v": pd.array([1, 2, None, 4, None], dtype="Int64"),
+    })
+    tpu, cpu = _roundtrip(
+        session, df,
+        lambda d: d.group_by("k").agg(F.sum("v").alias("s"),
+                                      F.count("v").alias("c")),
+        ["k"])
+    assert tpu.c.tolist() == cpu.c.tolist()
+    assert tpu.s.tolist() == cpu.s.tolist()
+    assert len(tpu) == 3
+
+
+def test_high_cardinality_falls_back(session):
+    # > DICT_MAX_CARD distinct keys: no dictionary, the hash/sort paths
+    # still answer correctly
+    n = 3000
+    rng = np.random.default_rng(5)
+    df = pd.DataFrame({
+        "k": [f"key{i % 700}" for i in range(n)],
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+    b = DeviceBatch.from_pandas(df)
+    assert b.column("k").dict_values is None
+    tpu, cpu = _roundtrip(
+        session, df,
+        lambda d: d.group_by("k").agg(F.sum("v").alias("s")), ["k"])
+    assert (tpu.s.values == cpu.s.values).all()
+    assert len(tpu) == 700
+
+
+def test_mixed_dense_and_tail_kinds(session):
+    rng = np.random.default_rng(11)
+    n = 5000
+    df = pd.DataFrame({
+        "k": rng.choice(["p", "q"], n),
+        "k2": rng.choice([10, 20, 30], n).astype(np.int64),
+        "f": rng.uniform(-1e6, 1e6, n),
+        "i": rng.integers(-1000, 1000, n).astype(np.int32),
+    })
+    tpu, cpu = _roundtrip(
+        session, df,
+        lambda d: d.group_by("k", "k2").agg(
+            F.sum("f").alias("sf"), F.min("f").alias("mnf"),
+            F.max("i").alias("mxi"), F.count("i").alias("ci"),
+            F.avg("f").alias("af")),
+        ["k", "k2"])
+    assert len(tpu) == len(cpu) == 6
+    assert (tpu.ci.values == cpu.ci.values).all()
+    assert (tpu.mxi.values == cpu.mxi.values).all()
+    np.testing.assert_allclose(tpu.sf.values.astype(float),
+                               cpu.sf.values.astype(float), rtol=1e-9)
+    np.testing.assert_allclose(tpu.af.values.astype(float),
+                               cpu.af.values.astype(float), rtol=1e-9)
+    np.testing.assert_allclose(tpu.mnf.values.astype(float),
+                               cpu.mnf.values.astype(float), rtol=0)
+
+
+def test_stateful_dict_registry():
+    """Batches of one scan share the first batch's dictionary; an unseen
+    value closes the dictionary for the rest of the scan."""
+    from spark_rapids_tpu.columnar.column import host_dict_encode_stateful
+    from spark_rapids_tpu.columnar import dtypes
+    state = {}
+    v1 = np.array(["b", "a", "b"], dtype=object)
+    enc1 = host_dict_encode_stateful(v1, None, dtypes.STRING, 8, state, 0)
+    assert enc1 is not None and enc1[1] == ("a", "b")
+    # second batch with a SUBSET of values reuses the same dictionary
+    v2 = np.array(["a", "a"], dtype=object)
+    enc2 = host_dict_encode_stateful(v2, None, dtypes.STRING, 8, state, 0)
+    assert enc2 is not None and enc2[1] == ("a", "b")
+    assert enc2[0][:2].tolist() == [0, 0]
+    # third batch with an unseen value closes the column's dictionary
+    v3 = np.array(["z"], dtype=object)
+    assert host_dict_encode_stateful(v3, None, dtypes.STRING, 8,
+                                     state, 0) is None
+    assert state[0] is False
+    assert host_dict_encode_stateful(v2, None, dtypes.STRING, 8,
+                                     state, 0) is None
+
+
+def test_mixed_magnitude_float_sums():
+    """Two-word fixed point: groups orders of magnitude below the batch
+    absmax keep their sums (a single-word image would zero them)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import densered
+    cap = 1 << 10
+    slot_h = np.array([0, 1, 1] + [2] * 5)
+    n = len(slot_h)
+    slot = jnp.concatenate([jnp.asarray(slot_h, dtype=jnp.int32),
+                            jnp.full((cap - n,), 3, jnp.int32)])
+    live = jnp.arange(cap) < n
+    v = np.zeros(cap)
+    v[:n] = [2.0 ** 60, 1.0, 1.0, 1e-3, 2e-3, 3e-3, 4e-3, 5e-3]
+    jobs = [("sum", jnp.asarray(v), jnp.ones(cap, dtype=bool), np.float64)]
+    res, _ = densered.slot_reduce_dense(slot, live, 3, jobs)
+    got = np.asarray(res[0][0], dtype=np.float64)
+    assert got[0] == 2.0 ** 60
+    np.testing.assert_allclose(got[1], 2.0, rtol=1e-12)
+    # 2^60 vs 1e-3 spans ~2^70 of the 86-bit two-word range: ~16 bits of
+    # precision remain for the smallest group (design limit, documented in
+    # _float_fixedpoint)
+    np.testing.assert_allclose(got[2], 15e-3, rtol=1e-4)
+
+
+def test_limb_engine_direct():
+    """slot_reduce_dense standalone: exactness across dtypes and widths."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import densered
+    rng = np.random.default_rng(17)
+    cap = 1 << 14
+    n = 10000
+    T = 37
+    slot_h = rng.integers(0, T, n).astype(np.int32)
+    slot = jnp.concatenate([jnp.asarray(slot_h),
+                            jnp.full((cap - n,), T, jnp.int32)])
+    live = jnp.arange(cap) < n
+    i64 = rng.integers(-(1 << 60), 1 << 60, cap).astype(np.int64)
+    i32 = rng.integers(-(1 << 30), 1 << 30, cap).astype(np.int32)
+    f64 = rng.normal(0, 1e8, cap)
+    valid = rng.random(cap) > 0.1
+    jobs = [
+        ("sum", jnp.asarray(i64), jnp.asarray(valid), np.int64),
+        ("sum", jnp.asarray(i32), jnp.asarray(valid), np.int64),
+        ("sum", jnp.asarray(f64), jnp.asarray(valid), np.float64),
+        ("count_valid", jnp.asarray(valid), jnp.asarray(valid), np.int64),
+    ]
+    res, row_count = densered.slot_reduce_dense(slot, live, T, jobs)
+    m = valid[:n]
+    for t in range(T):
+        sel = (slot_h == t) & m
+        exp64 = np.sum(i64[:n][sel].astype(np.uint64)).astype(np.int64)
+        assert int(res[0][0][t]) == int(exp64), t
+        assert int(res[1][0][t]) == int(i32[:n][sel].astype(np.int64).sum())
+        np.testing.assert_allclose(float(res[2][0][t]),
+                                   float(f64[:n][sel].sum()),
+                                   rtol=1e-10, atol=1e-4)
+        assert int(res[3][0][t]) == int(sel.sum())
+        assert int(row_count[t]) == int((slot_h == t).sum())

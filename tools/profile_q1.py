@@ -1,0 +1,34 @@
+"""One-off q1 profile: per-op totalTime on the real TPU (warm)."""
+import json
+import sys
+import time
+
+from spark_rapids_tpu.session import TpuSparkSession
+from spark_rapids_tpu.models.tpch import QUERIES, TpchTables
+
+qname = sys.argv[1] if len(sys.argv) > 1 else "q1"
+sf = float(sys.argv[2]) if len(sys.argv) > 2 else 0.5
+
+session = TpuSparkSession.builder().config(
+    "spark.rapids.sql.enabled", True).config(
+    "spark.rapids.sql.cacheDeviceScans", True).get_or_create()
+tables = TpchTables.generate(session, sf, num_partitions=4)
+
+df = QUERIES[qname](session, tables)
+t0 = time.perf_counter()
+df.collect()
+print(f"cold: {time.perf_counter() - t0:.2f}s", flush=True)
+for i in range(2):
+    df = QUERIES[qname](session, tables)
+    t0 = time.perf_counter()
+    df.collect()
+    print(f"warm {i}: {time.perf_counter() - t0:.2f}s", flush=True)
+
+m = session.last_query_metrics
+rows = []
+for op, d in (m or {}).items():
+    rows.append((d.get("totalTime", 0.0), op, d.get("numOutputRows", 0),
+                 d.get("numOutputBatches", 0)))
+rows.sort(reverse=True)
+for t, op, r, b in rows:
+    print(f"{t:8.3f}s  rows={r:>9} batches={b:>3}  {op[:110]}")
