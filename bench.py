@@ -25,6 +25,16 @@ class _QueryTimeout(Exception):
     pass
 
 
+def _is_transient(exc: BaseException) -> bool:
+    """The tunneled attachment's known-transient failure class: dropped
+    remote_compile HTTP bodies / relay hiccups. Matched by message because
+    the axon plugin surfaces them as generic RuntimeErrors."""
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(tok in text for tok in (
+        "remote_compile", "http", "connection", "timed out", "timeout",
+        "unavailable", "transport"))
+
+
 def _run_with_deadline(fn, seconds: int):
     """Run fn() in a worker thread with a hard join timeout. Remote
     attachments can wedge a compile inside a C call that signals cannot
@@ -54,10 +64,13 @@ def _run_with_deadline(fn, seconds: int):
 def _suite_tpch(session, sf, qnames):
     from spark_rapids_tpu.models.tpch import QUERIES, TpchTables
     tables = TpchTables.generate(session, sf, num_partitions=4)
-    # default sweep: scan-agg (q1), join+agg (q3), scan-filter-agg (q6) —
-    # representative operator mix that completes in bounded time even on
-    # high-latency remote attachments; widen via BENCH_QUERIES
-    names = qnames or ["q1", "q3", "q6"]
+    # default sweep: 12 queries spanning the operator surface — scan-agg
+    # (q1), multi-join (q3/q5/q10), scan-filter-agg (q6/q14/q19), semi/
+    # anti joins (q4), join+agg+filter (q12), big agg (q18), distinct agg
+    # (q16), sort-heavy correlated shape (q2). The smoke subset q1/q3/q6
+    # rides BENCH_QUERIES=q1,q3,q6.
+    names = qnames or ["q1", "q2", "q3", "q4", "q5", "q6", "q10", "q12",
+                       "q14", "q16", "q18", "q19"]
     return {q: (lambda s, q=q: QUERIES[q](s, tables)) for q in names}
 
 
@@ -128,6 +141,7 @@ def main():
                 cpu_out = run_query(fn, False)
             cpu_s = (time.perf_counter() - t0) / iters
             return tpu_out, tpu_s, cpu_out, cpu_s
+        retried = False
         try:
             try:
                 tpu_out, tpu_s, cpu_out, cpu_s = _run_with_deadline(
@@ -136,13 +150,17 @@ def main():
                 raise
             except Exception as first:  # noqa: BLE001
                 # the tunneled attachment's remote_compile can fail
-                # transiently (dropped HTTP body); one retry rides the
-                # now-warm persistent compile cache. The first error is
-                # the real signal for deterministic failures — keep it.
+                # transiently (dropped HTTP body); ONE retry — but only
+                # for that known-transient class, so a deterministic
+                # failure surfaces immediately instead of costing a
+                # second full run and being silently absorbed.
+                if not _is_transient(first):
+                    raise
                 import sys
-                print(f"bench: {q} first attempt failed "
+                print(f"bench: {q} transient failure "
                       f"({type(first).__name__}: {first}); retrying",
                       file=sys.stderr)
+                retried = True
                 tpu_out, tpu_s, cpu_out, cpu_s = _run_with_deadline(
                     measure, per_query_timeout)
         except _QueryTimeout:
@@ -158,6 +176,8 @@ def main():
         speedups.append(sp)
         detail[q] = {"cpu_s": round(cpu_s, 4), "tpu_s": round(tpu_s, 4),
                      "speedup": round(sp, 3)}
+        if retried:
+            detail[q]["retried"] = True
 
     if not speedups:
         print(json.dumps({
@@ -173,7 +193,13 @@ def main():
         "value": round(geomean, 4),
         "unit": "x",
         "vs_baseline": round(geomean / 4.0, 4),
-        "detail": {"sf": sf, "iters": iters, "queries": detail},
+        # baseline label: the CPU side is this framework's own pandas
+        # oracle path, NOT CPU Apache Spark (which does not exist in this
+        # environment); vs_baseline normalizes against the reference's
+        # "4x typical" GPU-vs-CPU-Spark claim (docs/FAQ.md:62-66)
+        "detail": {"sf": sf, "iters": iters,
+                   "cpu_path": "framework-pandas-oracle (not CPU Spark)",
+                   "queries": detail},
     }))
 
 

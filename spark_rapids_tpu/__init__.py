@@ -35,20 +35,34 @@ _jax.config.update("jax_enable_x64", True)
 # Persistent XLA executable cache: kernel compiles on the remote TPU
 # attachment cost seconds each and the per-process kernel cache
 # (utils/kernelcache.py) cannot carry them across runs. Verified to work
-# through the axon remote-compile path. NOT enabled when the process is
-# pinned to the CPU backend (tests): XLA:CPU AOT reload warns about
-# machine-feature mismatches (prefer-no-scatter et al.) with SIGILL risk.
+# through the axon remote-compile path. NOT enabled on the CPU backend:
+# XLA:CPU AOT reload warns about machine-feature mismatches
+# (prefer-no-scatter et al.) with SIGILL risk. The decision needs the
+# RESOLVED backend (env pinning alone misses the no-TPU-present case), so
+# it runs lazily at device-manager init, after backend resolution.
 # Override dir (or disable with an empty value) via SRT_XLA_CACHE_DIR.
-_cache_dir = _os.environ.get(
-    "SRT_XLA_CACHE_DIR",
-    _os.path.join(_os.path.expanduser("~"), ".cache", "srt_xla_cache"))
-_cpu_pinned = (_os.environ.get("JAX_PLATFORMS") == "cpu"
-               or _jax.config.jax_platforms == "cpu")
-if _cache_dir and not _cpu_pinned:
+_cache_enabled = False
+
+
+def enable_persistent_cache_if_accelerated() -> None:
+    """Turn on the persistent compile cache iff the resolved jax backend
+    is not XLA:CPU. Called once the backend is known (memory/device.py);
+    idempotent and best-effort."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    cache_dir = _os.environ.get(
+        "SRT_XLA_CACHE_DIR",
+        _os.path.join(_os.path.expanduser("~"), ".cache", "srt_xla_cache"))
+    if not cache_dir:
+        return
     try:
-        _os.makedirs(_cache_dir, exist_ok=True)
-        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        if _jax.default_backend() == "cpu":
+            return
+        _os.makedirs(cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", cache_dir)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _cache_enabled = True
     except Exception:  # pragma: no cover - cache is best-effort
         pass
 

@@ -168,10 +168,14 @@ class TpuHashAggregateExec(TpuExec):
     per-batch update, then concat + merge of the (small) partial results —
     the reference's exact loop shape, each step one fused XLA program."""
 
-    def __init__(self, child: PhysicalPlan, plan: AggPlan, mode: str):
+    def __init__(self, child: PhysicalPlan, plan: AggPlan, mode: str,
+                 pre_mask: Optional[Expression] = None):
         super().__init__([child])
         self.plan = plan
         self.mode = mode
+        # fused pre-filter predicate (exec/fusion.py): evaluated inside the
+        # update kernel, replacing a standalone Filter's compaction gathers
+        self.pre_mask = pre_mask
         p = self.plan
         if mode == "partial":
             key_exprs = [e for _, e in p.grouping]
@@ -179,11 +183,13 @@ class TpuHashAggregateExec(TpuExec):
             for ops in p.update_plan:
                 for kind, input_idx, idt in ops:
                     reductions.append((kind, input_idx, idt))
+            mask_sig = ("|mask=" + expr_signature(pre_mask)
+                        if pre_mask is not None else "")
             self._kernel = cached_jit(
-                "aggupd|" + p.signature,
+                "aggupd|" + p.signature + mask_sig,
                 lambda: jax.jit(lambda b: agg_ops.aggregate_update(
                     b, key_exprs, p.update_inputs, reductions,
-                    p.partial_schema)))
+                    p.partial_schema, mask_expr=pre_mask)))
             # merging partials within the partition uses merge kinds
             self._merge_kernel = self._make_merge_kernel()
         else:
@@ -212,7 +218,9 @@ class TpuHashAggregateExec(TpuExec):
 
     def describe(self) -> str:
         keys = ", ".join(n for n, _ in self.plan.grouping)
-        return f"TpuHashAggregateExec(mode={self.mode}, keys=[{keys}])"
+        fused = (f", fused_filter={self.pre_mask!r}"
+                 if self.pre_mask is not None else "")
+        return f"TpuHashAggregateExec(mode={self.mode}, keys=[{keys}]{fused})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child_parts = self.children[0].executed_partitions(ctx)

@@ -25,6 +25,11 @@ class TpuDeviceManager:
     def __init__(self, conf):
         self.conf = conf
         devices = jax.devices()
+        # backend is resolved now: safe point to decide the persistent
+        # compile cache (XLA:CPU AOT reload has SIGILL risk, so CPU-only
+        # processes keep it off — see package __init__)
+        from spark_rapids_tpu import enable_persistent_cache_if_accelerated
+        enable_persistent_cache_if_accelerated()
         self.device = devices[0]
         self.num_local_devices = len(devices)
         self.hbm_total = self._probe_hbm_bytes()

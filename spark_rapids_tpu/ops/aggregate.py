@@ -27,13 +27,26 @@ def aggregate_update(batch: DeviceBatch,
                      key_exprs: Sequence[Expression],
                      input_exprs: Sequence[Expression],
                      reductions: Sequence[Tuple[str, int, DType]],
-                     out_schema: Schema) -> DeviceBatch:
+                     out_schema: Schema,
+                     mask_expr: Expression = None) -> DeviceBatch:
     """Partial aggregation of one batch: group by evaluated keys, reduce
-    evaluated inputs. reductions: (kind, input_index, out_dtype)."""
+    evaluated inputs. reductions: (kind, input_index, out_dtype).
+
+    ``mask_expr``: optional fused pre-filter predicate evaluated over the
+    INPUT batch; failing rows are excluded from every group without the
+    row-compaction gather a standalone Filter would pay (one gather per
+    column at ~5M rows/s on this TPU — the fusion's whole point; the
+    reference instead relies on cuDF's cheap gathers,
+    basicPhysicalOperators.scala GpuFilterExec:126)."""
     from spark_rapids_tpu.sql.exprs.core import BoundRef
     ctx = make_context(batch)
+    live = None
+    if mask_expr is not None:
+        pred = to_device_column(ctx, mask_expr.eval_device(ctx))
+        live = pred.data & pred.validity & batch.row_mask()
     # plain column-reference keys pass the ORIGINAL DeviceColumn through so
-    # upload-computed metadata (prefix8) survives the expression bridge
+    # upload-computed metadata (prefix8, dict codes) survives the
+    # expression bridge
     key_cols = [batch.columns[e.index] if isinstance(e, BoundRef)
                 else to_device_column(ctx, e.eval_device(ctx))
                 for e in key_exprs]
@@ -48,7 +61,8 @@ def aggregate_update(batch: DeviceBatch,
                            [(kind, len(key_cols) + idx, dt)
                             for kind, idx, dt in reductions],
                            out_schema,
-                           force_single_group=len(key_cols) == 0)
+                           force_single_group=len(key_cols) == 0,
+                           live=live)
 
 
 def aggregate_merge(batch: DeviceBatch, num_keys: int,
@@ -103,24 +117,27 @@ def _dict_path_info(batch: DeviceBatch, key_idx: List[int]):
 def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
                     reductions: List[Tuple[str, int, DType]],
                     out_schema: Schema,
-                    force_single_group: bool) -> DeviceBatch:
+                    force_single_group: bool,
+                    live=None) -> DeviceBatch:
     if not key_idx:
-        return _single_group_reduce(batch, reductions, out_schema)
+        return _single_group_reduce(batch, reductions, out_schema, live)
     has_string_reduction = any(
         batch.columns[ci].dtype.is_string and kind != "count_valid"
         for kind, ci, _dt in reductions)
     if has_string_reduction:
-        return _sorted_space_reduce(batch, key_idx, reductions, out_schema)
+        return _sorted_space_reduce(batch, key_idx, reductions, out_schema,
+                                    live)
     dict_info = _dict_path_info(batch, key_idx)
     if dict_info is not None:
         return _dict_matmul_reduce(batch, key_idx, reductions, out_schema,
-                                   dict_info)
-    return _rowspace_reduce(batch, key_idx, reductions, out_schema)
+                                   dict_info, live)
+    return _rowspace_reduce(batch, key_idx, reductions, out_schema, live)
 
 
 def _dict_matmul_reduce(batch: DeviceBatch, key_idx: List[int],
                         reductions: List[Tuple[str, int, DType]],
-                        out_schema: Schema, dict_info) -> DeviceBatch:
+                        out_schema: Schema, dict_info,
+                        live=None) -> DeviceBatch:
     """Direct-addressed aggregation over dictionary codes: slot id is pure
     arithmetic on the host-computed codes (no hashing, no collision or
     agreement checks — codes are exact by construction), every sum/count
@@ -138,7 +155,8 @@ def _dict_matmul_reduce(batch: DeviceBatch, key_idx: List[int],
 
     cards, strides, T = dict_info
     capacity = batch.capacity
-    live = batch.row_mask()
+    if live is None:
+        live = batch.row_mask()
     slot = jnp.zeros((capacity,), jnp.int32)
     for ki, stride in zip(key_idx, strides):
         slot = slot + batch.columns[ki].dict_codes * jnp.int32(stride)
@@ -225,11 +243,12 @@ def _dict_matmul_reduce(batch: DeviceBatch, key_idx: List[int],
 
 def _single_group_reduce(batch: DeviceBatch,
                          reductions: List[Tuple[str, int, DType]],
-                         out_schema: Schema) -> DeviceBatch:
+                         out_schema: Schema, live=None) -> DeviceBatch:
     """Global aggregate: plain masked vector reductions, no sort, no
     segments, no gathers (SQL: global agg of empty input = one row)."""
     capacity = batch.capacity
-    live = batch.row_mask()
+    if live is None:
+        live = batch.row_mask()
     pos = jnp.arange(capacity, dtype=jnp.int32)
     out_cols: List[DeviceColumn] = []
     slot0 = pos == 0
@@ -250,7 +269,7 @@ def _single_group_reduce(batch: DeviceBatch,
             # string min/max/first/last over one group: pick the winning
             # row with the select machinery over a trivial GroupInfo
             from spark_rapids_tpu.ops.rowops import gather_column
-            info = _trivial_group_info(batch)
+            info = _trivial_group_info(batch, live)
             rows, has = gb.segment_select_string(kind, col, info)
             out_cols.append(gather_column(col, rows, has & slot0))
             continue
@@ -291,25 +310,32 @@ def _single_group_reduce(batch: DeviceBatch,
     return DeviceBatch(out_schema, out_cols, jnp.asarray(1, jnp.int32))
 
 
-def _trivial_group_info(batch: DeviceBatch) -> "gb.GroupInfo":
+def _trivial_group_info(batch: DeviceBatch, live=None) -> "gb.GroupInfo":
     capacity = batch.capacity
-    live = batch.row_mask()
+    if live is None:
+        live = batch.row_mask()
     idx = jnp.arange(capacity, dtype=jnp.int32)
     dead = (~live).astype(jnp.uint8)
-    _dead_s, perm = jax.lax.sort((dead, idx), num_keys=1, is_stable=True)
-    boundary = jnp.zeros((capacity,), jnp.bool_).at[0].set(True)
-    gid = jnp.zeros((capacity,), jnp.int32)
+    dead_s, perm = jax.lax.sort((dead, idx), num_keys=1, is_stable=True)
+    live_s = dead_s == 0
+    boundary = jnp.zeros((capacity,), jnp.bool_).at[0].set(live_s[0])
+    # dead rows MUST be parked outside group 0 (same convention as
+    # group_rows): they can be VALID rows excluded by a fused filter mask,
+    # and with gid 0 they would compete in string min/max and win
+    # positional first/last (also fixes padding rows nulling a global
+    # last(string))
+    gid = jnp.where(live_s, 0, capacity - 1)
     return gb.GroupInfo(perm, gid, boundary, jnp.asarray(1, jnp.int32),
                         jnp.zeros((capacity,), jnp.int32))
 
 
 def _sorted_space_reduce(batch: DeviceBatch, key_idx: List[int],
                          reductions: List[Tuple[str, int, DType]],
-                         out_schema: Schema) -> DeviceBatch:
+                         out_schema: Schema, live=None) -> DeviceBatch:
     """The original sorted-space path (string reductions need the ordered
     slots of segment_select_string)."""
     capacity = batch.capacity
-    info = gb.group_rows(batch, key_idx)
+    info = gb.group_rows(batch, key_idx, live=live)
     num_groups = info.num_groups
     out_cols: List[DeviceColumn] = []
     out_cols.extend(gb.gather_keys(batch, key_idx, info))
@@ -384,7 +410,7 @@ def _seg_reduce_kind(kind: str, vs, valid, live, seg, order_vec, to_row,
 SLOT_TABLE = 8192
 
 
-def _slot_hash_attempt(batch: DeviceBatch, key_idx: List[int]):
+def _slot_hash_attempt(batch: DeviceBatch, key_idx: List[int], live=None):
     """Sort-free group assignment attempt: map each row's exact 64-bit key
     images to a slot (mixed image % SLOT_TABLE) and verify per-key image
     equality within every used slot. Returns (fast_ok bool scalar, slot id
@@ -398,7 +424,8 @@ def _slot_hash_attempt(batch: DeviceBatch, key_idx: List[int]):
     degrade, never corrupt."""
     from spark_rapids_tpu.ops.hashing import splitmix64
     capacity = batch.capacity
-    live = batch.row_mask()
+    if live is None:
+        live = batch.row_mask()
     T = min(SLOT_TABLE, capacity)
     # per key column: (key index, [exact equality image vectors]) — every
     # image of a key must agree slot-wide for the slot to be a true group
@@ -471,7 +498,7 @@ def _slot_hash_attempt(batch: DeviceBatch, key_idx: List[int]):
 
 def _rowspace_reduce(batch: DeviceBatch, key_idx: List[int],
                      reductions: List[Tuple[str, int, DType]],
-                     out_schema: Schema) -> DeviceBatch:
+                     out_schema: Schema, live=None) -> DeviceBatch:
     """Keyed aggregation with NO per-column permutation gathers: one packed
     scatter bridges the hash-sorted group assignment back to row space,
     then every reduction runs directly on the unpermuted columns. When the
@@ -481,7 +508,8 @@ def _rowspace_reduce(batch: DeviceBatch, key_idx: List[int],
     the same program behind a lax.cond."""
     capacity = batch.capacity
     gs = min(capacity, GROUP_SLOTS)
-    live = batch.row_mask()
+    if live is None:
+        live = batch.row_mask()
     pos = jnp.arange(capacity, dtype=jnp.int32)
 
     def reduce_core(width: int, seg_id, order_vec, to_row, num_groups,
@@ -559,7 +587,8 @@ def _rowspace_reduce(batch: DeviceBatch, key_idx: List[int],
         return leaves + (n_used,)
 
     def sort_branch():
-        info = gb.group_rows(batch, key_idx, compute_rep=False)
+        info = gb.group_rows(batch, key_idx, compute_rep=False,
+                              live=live)
         num_groups = info.num_groups
         # one scatter carries (group id, sorted position) per original row
         packed = jnp.zeros((capacity,), jnp.int64).at[info.perm].set(
@@ -584,7 +613,7 @@ def _rowspace_reduce(batch: DeviceBatch, key_idx: List[int],
     # sort-free hash-table attempt first (the cuDF hash-agg analogue):
     # exact via per-key image agreement, falls back to the sort path for
     # collisions, long string keys, or > SLOT_TABLE groups
-    _slot_state = _slot_hash_attempt(batch, key_idx)
+    _slot_state = _slot_hash_attempt(batch, key_idx, live)
     leaves = jax.lax.cond(_slot_state[0], slot_branch, sort_branch)
     num_groups = leaves[-1]
     leaves = leaves[:-1]

@@ -64,9 +64,10 @@ class GroupInfo:
 
 
 def group_rows(batch: DeviceBatch, key_indices: Sequence[int],
-               compute_rep: bool = True) -> GroupInfo:
+               compute_rep: bool = True, live=None) -> GroupInfo:
     capacity = batch.capacity
-    live = batch.row_mask()
+    if live is None:
+        live = batch.row_mask()
     h1, h2 = row_hashes(batch, key_indices)
     # dead rows sort last
     dead = (~live).astype(jnp.uint8)

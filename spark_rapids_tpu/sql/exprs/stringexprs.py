@@ -620,6 +620,12 @@ class Translate(Expression):
 
 
 class StringReverse(Expression):
+    """BYTE-oriented reverse, exact for ASCII (the framework's string
+    kernels are byte-indexed, see ops/strings.py); multi-byte UTF-8 input
+    reverses bytes, not codepoints — a documented divergence from Spark.
+    The host twin mirrors the byte semantics so the differential oracle
+    agrees with the device."""
+
     def __init__(self, child: Expression):
         super().__init__([child])
 
@@ -636,8 +642,10 @@ class StringReverse(Expression):
     def eval_host(self, df: pd.DataFrame) -> pd.Series:
         values, validity, index = host_unary_values(
             self.children[0].eval_host(df))
-        out = np.array([s[::-1] if v else None
-                        for s, v in zip(values, validity)], dtype=object)
+        out = np.array(
+            [s.encode("utf-8")[::-1].decode("utf-8", errors="replace")
+             if v else None for s, v in zip(values, validity)],
+            dtype=object)
         return rebuild_series(out, validity, dtypes.STRING, index)
 
 
@@ -665,6 +673,12 @@ class StringRepeat(Expression):
 
 
 class Ascii(Expression):
+    """First BYTE of the UTF-8 encoding, exact for ASCII (byte-indexed
+    kernels, see ops/strings.py); for multi-byte leading characters Spark
+    returns the codepoint while this returns the lead byte — a documented
+    divergence. The host twin mirrors the byte semantics so the
+    differential oracle agrees with the device."""
+
     def __init__(self, child: Expression):
         super().__init__([child])
 
@@ -681,7 +695,7 @@ class Ascii(Expression):
     def eval_host(self, df: pd.DataFrame) -> pd.Series:
         values, validity, index = host_unary_values(
             self.children[0].eval_host(df))
-        out = np.array([(ord(s[0]) if s else 0) if v else 0
+        out = np.array([(s.encode("utf-8")[0] if s else 0) if v else 0
                         for s, v in zip(values, validity)], dtype=np.int32)
         return rebuild_series(out, validity, dtypes.INT32, index)
 

@@ -491,7 +491,12 @@ class TransitionOverrides:
 
     def apply(self, plan: PhysicalPlan) -> PhysicalPlan:
         from spark_rapids_tpu.exec.coalesce import insert_coalesce
-        return insert_coalesce(self._apply(plan), self.conf)
+        from spark_rapids_tpu.exec.fusion import fuse_filter_into_aggregate
+        # fuse BEFORE coalesce insertion: a fused-away Filter is no longer
+        # a fragmenting producer, so no coalesce node appears above it
+        return insert_coalesce(
+            fuse_filter_into_aggregate(self._apply(plan), self.conf),
+            self.conf)
 
     def _apply(self, plan: PhysicalPlan) -> PhysicalPlan:
         # a TPU operator consumes device batches; a CPU operator consumes

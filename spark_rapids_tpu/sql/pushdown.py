@@ -93,6 +93,13 @@ def _coerce_pair(a, b):
     if isinstance(a, (np.datetime64, pd.Timestamp)) or isinstance(
             b, (np.datetime64, pd.Timestamp)):
         return pd.Timestamp(a), pd.Timestamp(b)
+    # binary-physical parquet statistics arrive as bytes; str(b'x') would
+    # yield "b'x'" and silently mis-compare (wrong pruning). Strict decode
+    # only — an undecodable value raises and the caller keeps the split.
+    if isinstance(a, bytes):
+        a = a.decode("utf-8", "strict")
+    if isinstance(b, bytes):
+        b = b.decode("utf-8", "strict")
     if isinstance(a, str) or isinstance(b, str):
         return str(a), str(b)
     return a, b

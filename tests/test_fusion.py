@@ -1,0 +1,139 @@
+"""Filter->Aggregate fusion (exec/fusion.py): correctness across reduction
+kinds, the unfusable-abort paths, and the config gate.
+
+The fusion replaces a TpuFilterExec's per-column compaction gathers with a
+live-mask inside the aggregation kernel; these tests pin that masked-out
+rows are excluded from EVERY reduction path (dense matmul, rowspace,
+sorted string, single-group), which the reference gets for free by
+physically filtering (GpuFilterExec, basicPhysicalOperators.scala:126).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+
+
+def _both(session, q, sort_cols):
+    session.set_conf("spark.rapids.sql.enabled", True)
+    tpu = q.collect().sort_values(sort_cols).reset_index(drop=True)
+    session.set_conf("spark.rapids.sql.enabled", False)
+    cpu = q.collect().sort_values(sort_cols).reset_index(drop=True)
+    session.set_conf("spark.rapids.sql.enabled", True)
+    return tpu, cpu
+
+
+def _fused_plan_count(session):
+    return sum(
+        1 for node in session.captured_plans[-1].walk()
+        if getattr(node, "pre_mask", None) is not None)
+
+
+def test_fused_keyless_string_minmax_first_last(session):
+    # regression: mask-dead rows used to compete in the keyless string
+    # select path (they carry validity=True, unlike padding)
+    df = pd.DataFrame({"s": ["aaa", "bbb", "ccc", "ddd"],
+                       "x": [1.0, 2.0, 3.0, 4.0]})
+    q = (session.create_dataframe(df, 1).filter(F.col("x") > 1.5)
+         .agg(F.min("s").alias("mn"), F.max("s").alias("mx")))
+    tpu, cpu = _both(session, q, ["mn"])
+    assert tpu.mn[0] == cpu.mn[0] == "bbb"
+    assert tpu.mx[0] == cpu.mx[0] == "ddd"
+    q2 = (session.create_dataframe(df, 1).filter(F.col("x") < 3.5)
+          .agg(F.first("s").alias("f"), F.last("s").alias("l")))
+    tpu2, cpu2 = _both(session, q2, ["f"])
+    assert tpu2.f[0] == cpu2.f[0] == "aaa"
+    assert tpu2.l[0] == cpu2.l[0] == "ccc"
+
+
+def test_fused_keyed_string_reduction(session):
+    rng = np.random.default_rng(9)
+    n = 2000
+    df = pd.DataFrame({
+        "k": rng.choice(["a", "b"], n),
+        "s": [f"s{i:05d}" for i in rng.integers(0, 10000, n)],
+        "x": rng.uniform(0, 1, n),
+    })
+    q = (session.create_dataframe(df, 2).filter(F.col("x") > 0.5)
+         .group_by("k").agg(F.min("s").alias("mn"), F.max("s").alias("mx"),
+                            F.count("s").alias("c")))
+    tpu, cpu = _both(session, q, ["k"])
+    assert tpu.mn.tolist() == cpu.mn.tolist()
+    assert tpu.mx.tolist() == cpu.mx.tolist()
+    assert tpu.c.tolist() == cpu.c.tolist()
+
+
+def test_fused_all_kinds_keyed_numeric(session):
+    rng = np.random.default_rng(10)
+    n = 5000
+    df = pd.DataFrame({
+        "k": rng.choice(["p", "q", "r"], n),
+        "v": rng.uniform(-10, 10, n),
+        "w": rng.integers(-100, 100, n).astype(np.int64),
+    })
+    q = (session.create_dataframe(df, 3).filter(F.col("v") > 0)
+         .group_by("k").agg(
+             F.sum("v").alias("sv"), F.count("*").alias("c"),
+             F.min("w").alias("mnw"), F.max("v").alias("mxv"),
+             F.avg("w").alias("aw")))
+    tpu, cpu = _both(session, q, ["k"])
+    assert tpu.c.tolist() == cpu.c.tolist()
+    assert tpu.mnw.tolist() == cpu.mnw.tolist()
+    np.testing.assert_allclose(tpu.sv.values.astype(float),
+                               cpu.sv.values.astype(float), rtol=1e-9)
+    np.testing.assert_allclose(tpu.aw.values.astype(float),
+                               cpu.aw.values.astype(float), rtol=1e-9)
+    np.testing.assert_allclose(tpu.mxv.values.astype(float),
+                               cpu.mxv.values.astype(float), rtol=0)
+
+
+def test_fusion_engages_and_conf_gate(session):
+    df = pd.DataFrame({"k": ["a", "b"] * 20, "v": np.arange(40.0)})
+    q = (session.create_dataframe(df, 1).filter(F.col("v") > 5)
+         .group_by("k").agg(F.sum("v").alias("s")))
+    session.capture_plans = True
+    try:
+        session.set_conf("spark.rapids.sql.enabled", True)
+        out_on = q.collect()
+        assert _fused_plan_count(session) >= 1, "fusion should engage"
+        session.set_conf("spark.rapids.sql.agg.fuseFilter", False)
+        out_off = q.collect()
+        assert _fused_plan_count(session) == 0, "conf gate should disable"
+        pd.testing.assert_frame_equal(
+            out_on.sort_values("k").reset_index(drop=True),
+            out_off.sort_values("k").reset_index(drop=True))
+    finally:
+        session.capture_plans = False
+        session.set_conf("spark.rapids.sql.agg.fuseFilter", True)
+
+
+def test_fusion_skips_nondeterministic_filter(session):
+    df = pd.DataFrame({"k": ["a", "b"] * 20, "v": np.arange(40.0)})
+    q = (session.create_dataframe(df, 1)
+         .filter(F.rand(seed=1) >= 0.0)  # nondeterministic: must not fuse
+         .group_by("k").agg(F.count("*").alias("c")))
+    session.capture_plans = True
+    try:
+        session.set_conf("spark.rapids.sql.enabled", True)
+        out = q.collect()
+        assert _fused_plan_count(session) == 0
+        assert sorted(out.c.tolist()) == [20, 20]
+    finally:
+        session.capture_plans = False
+
+
+def test_fused_project_chain(session):
+    rng = np.random.default_rng(12)
+    n = 3000
+    df = pd.DataFrame({"k": rng.choice(["u", "v"], n),
+                       "a": rng.uniform(1, 2, n)})
+    q = (session.create_dataframe(df, 2).filter(F.col("a") < 1.7)
+         .with_column("b", F.col("a") * 3.0)
+         .with_column("c", F.col("b") + 1.0)
+         .group_by("k").agg(F.sum("c").alias("sc"),
+                            F.count("*").alias("n")))
+    tpu, cpu = _both(session, q, ["k"])
+    assert tpu.n.tolist() == cpu.n.tolist()
+    np.testing.assert_allclose(tpu.sc.values.astype(float),
+                               cpu.sc.values.astype(float), rtol=1e-9)
