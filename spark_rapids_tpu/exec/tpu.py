@@ -658,6 +658,28 @@ class TpuShuffleExchangeExec(TpuExec):
     def output_schema(self) -> Schema:
         return self.children[0].output_schema()
 
+    @staticmethod
+    def _padded_producer(node: PhysicalPlan) -> bool:
+        """Does the subtree below (up to the next exchange) contain an
+        operator whose batches systematically carry far more capacity than
+        rows? Aggregates always do; limits and semi/anti joins compact
+        hard within unchanged capacity. Plain filters are deliberately NOT
+        counted: at moderate selectivity the shrink's count-fetch sync +
+        gathers measured slower than just concatenating (a very selective
+        filter below a join is the accepted trade-off)."""
+        from spark_rapids_tpu.exec.tpujoin import TpuShuffledHashJoinExec
+        if isinstance(node, TpuHashAggregateExec):
+            return True
+        if isinstance(node, TpuLocalLimitExec):
+            return True
+        if (isinstance(node, TpuShuffledHashJoinExec)
+                and node.join_type in ("leftsemi", "leftanti")):
+            return True
+        if isinstance(node, TpuShuffleExchangeExec):
+            return False  # already shrunk at that boundary
+        return any(TpuShuffleExchangeExec._padded_producer(c)
+                   for c in node.children)
+
     def describe(self) -> str:
         return f"TpuShuffleExchangeExec({self.partitioning[0]})"
 
@@ -720,6 +742,24 @@ class TpuShuffleExchangeExec(TpuExec):
             return [make_mesh_part(i) for i in range(n_dev)]
 
         if kind == "single" or collapse:
+            # sync-free collapse: when no aggregate feeds this exchange,
+            # the producer batches are NOT systematically over-padded, so
+            # the count-fetch sync + per-batch shrink gathers cost more
+            # than they save — ONE capacity-based concat (zero round
+            # trips) hands the consumer a single big batch, keeping joins
+            # and aggregates on one wide kernel instead of per-fragment
+            # dispatches. Aggregate producers keep the shrink (their
+            # outputs carry pre-agg padding worth removing before the
+            # merge/sort).
+            if not self._padded_producer(self.children[0]):
+                def nosync_concat() -> Iterator[DeviceBatch]:
+                    batches = [b for p in child_parts for b in p()]
+                    if not batches:
+                        yield DeviceBatch.empty(schema)
+                        return
+                    yield _concat_device(batches, schema, growth)
+                return [nosync_concat]
+
             def single() -> Iterator[DeviceBatch]:
                 import jax as _jax
                 batches = [b for p in child_parts for b in p()]

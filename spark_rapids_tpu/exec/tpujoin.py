@@ -147,42 +147,56 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                 build = _concat_device(list(bp()), build_schema, growth)
                 matched_acc = None
                 emitted = False
-                for stream in sp():
-                    counts, bstart, bperm = self._probe(build, stream)
-                    if jt in ("leftsemi", "leftanti"):
-                        out = self._semi(stream, counts)
+                if jt in ("leftsemi", "leftanti"):
+                    for stream in sp():
                         emitted = True
-                        yield out
-                        continue
-                    # one batched fetch: per-element int() syncs each
-                    # pay a full device->host round trip
-                    sizes = [int(x) for x in jax.device_get(
-                        self._totals(build, stream, counts, bstart,
-                                     bperm))]
-                    total = sizes[0]
-                    if jt == "full":
-                        flags = self._match_flags(build, counts, bstart,
-                                                  bperm)
-                        matched_acc = (flags if matched_acc is None
-                                       else matched_acc | flags)
-                    if total == 0:
-                        continue
-                    n_s = sum(1 for d in stream.schema.dtypes if d.is_string)
-                    s_caps = tuple(_char_bucket(c)
-                                   for c in sizes[1:1 + n_s])
-                    b_caps = tuple(_char_bucket(c)
-                                   for c in sizes[1 + n_s:])
-                    out_cap = bucket_capacity(total, growth)
-                    emitted = True
-                    expanded = self._expand(build, stream, counts, bstart,
-                                            bperm, out_cap, s_caps, b_caps)
-                    from spark_rapids_tpu.memory.device import (
-                        TpuDeviceManager,
-                    )
-                    dm = TpuDeviceManager.current()
-                    if dm is not None:
-                        dm.meter_batch(expanded)
-                    yield expanded
+                        yield self._semi(stream,
+                                         self._probe(build, stream)[0])
+                else:
+                    # probe EVERY stream batch first (dispatch is async and
+                    # nearly free), then fetch all expansion totals in ONE
+                    # device->host round trip — a per-batch fetch would pay
+                    # ~150-250ms each on a tunneled attachment
+                    streams = list(sp())
+                    probes = [self._probe(build, s) for s in streams]
+                    sizes_all = jax.device_get(
+                        [self._totals(build, s, *pr)
+                         for s, pr in zip(streams, probes)])
+                    for bi_, (stream, (counts, bstart, bperm),
+                              sizes_d) in enumerate(
+                            zip(streams, probes, sizes_all)):
+                        # free consumed inputs as the loop advances: with
+                        # many large stream batches, holding every batch +
+                        # probe triple for the whole emission loop would
+                        # grow peak HBM from O(batch) to O(partition)
+                        streams[bi_] = probes[bi_] = None
+                        sizes = [int(x) for x in sizes_d]
+                        total = sizes[0]
+                        if jt == "full":
+                            flags = self._match_flags(build, counts, bstart,
+                                                      bperm)
+                            matched_acc = (flags if matched_acc is None
+                                           else matched_acc | flags)
+                        if total == 0:
+                            continue
+                        n_s = sum(1 for d in stream.schema.dtypes
+                                  if d.is_string)
+                        s_caps = tuple(_char_bucket(c)
+                                       for c in sizes[1:1 + n_s])
+                        b_caps = tuple(_char_bucket(c)
+                                       for c in sizes[1 + n_s:])
+                        out_cap = bucket_capacity(total, growth)
+                        emitted = True
+                        expanded = self._expand(build, stream, counts,
+                                                bstart, bperm, out_cap,
+                                                s_caps, b_caps)
+                        from spark_rapids_tpu.memory.device import (
+                            TpuDeviceManager,
+                        )
+                        dm = TpuDeviceManager.current()
+                        if dm is not None:
+                            dm.meter_batch(expanded)
+                        yield expanded
                 if jt == "full":
                     if matched_acc is None:
                         matched_acc = jnp.zeros((build.capacity,), jnp.bool_)

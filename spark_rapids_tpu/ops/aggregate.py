@@ -245,18 +245,25 @@ def _single_group_reduce(batch: DeviceBatch,
                          reductions: List[Tuple[str, int, DType]],
                          out_schema: Schema, live=None) -> DeviceBatch:
     """Global aggregate: plain masked vector reductions, no sort, no
-    segments, no gathers (SQL: global agg of empty input = one row)."""
+    segments, no gathers (SQL: global agg of empty input = one row).
+
+    The output batch has MIN_CAPACITY (not the input capacity): a global
+    aggregate is exactly one row, and carrying the input's padding forward
+    forced every downstream exchange/merge to run at pre-aggregation scale
+    (a 4-batch global sum would concat to 4M-capacity for 4 rows)."""
+    from spark_rapids_tpu.columnar.batch import MIN_CAPACITY
     capacity = batch.capacity
+    out_cap = MIN_CAPACITY
     if live is None:
         live = batch.row_mask()
     pos = jnp.arange(capacity, dtype=jnp.int32)
     out_cols: List[DeviceColumn] = []
-    slot0 = pos == 0
+    slot0 = jnp.arange(out_cap, dtype=jnp.int32) == 0
 
     def place(scalar, valid_scalar, out_dt):
-        data = jnp.zeros((capacity,), out_dt.np_dtype).at[0].set(
+        data = jnp.zeros((out_cap,), out_dt.np_dtype).at[0].set(
             scalar.astype(out_dt.np_dtype))
-        validity = jnp.zeros((capacity,), jnp.bool_).at[0].set(valid_scalar)
+        validity = jnp.zeros((out_cap,), jnp.bool_).at[0].set(valid_scalar)
         return DeviceColumn(out_dt, data, validity)
 
     for kind, col_idx, out_dt in reductions:
@@ -271,7 +278,8 @@ def _single_group_reduce(batch: DeviceBatch,
             from spark_rapids_tpu.ops.rowops import gather_column
             info = _trivial_group_info(batch, live)
             rows, has = gb.segment_select_string(kind, col, info)
-            out_cols.append(gather_column(col, rows, has & slot0))
+            out_cols.append(gather_column(col, rows[:out_cap],
+                                          has[:out_cap] & slot0))
             continue
         valid = col.validity & live
         vs = col.data
@@ -612,9 +620,19 @@ def _rowspace_reduce(batch: DeviceBatch, key_idx: List[int],
 
     # sort-free hash-table attempt first (the cuDF hash-agg analogue):
     # exact via per-key image agreement, falls back to the sort path for
-    # collisions, long string keys, or > SLOT_TABLE groups
-    _slot_state = _slot_hash_attempt(batch, key_idx, live)
-    leaves = jax.lax.cond(_slot_state[0], slot_branch, sort_branch)
+    # collisions, long string keys, or > SLOT_TABLE groups. The attempt
+    # itself costs ~17 segment passes (~0.8s at 1M rows), so only try it
+    # when every key column is dictionary-encoded (bounded cardinality —
+    # typically these took the direct dict path already, landing here only
+    # when the joint slot table overflowed DICT_SLOT_MAX); high-cardinality
+    # keys would fail the attempt anyway and go straight to the sort path.
+    attempt_worthwhile = all(
+        batch.columns[ki].dict_values is not None for ki in key_idx)
+    if attempt_worthwhile:
+        _slot_state = _slot_hash_attempt(batch, key_idx, live)
+        leaves = jax.lax.cond(_slot_state[0], slot_branch, sort_branch)
+    else:
+        leaves = sort_branch()
     num_groups = leaves[-1]
     leaves = leaves[:-1]
     # rebuild columns from the flattened leaves (cond needs flat outputs)

@@ -96,8 +96,9 @@ def gather_keys(batch: DeviceBatch, key_indices: Sequence[int],
                 info: GroupInfo) -> List[DeviceColumn]:
     """Key columns with one row per group (group's first occurrence)."""
     live = jnp.arange(batch.capacity, dtype=jnp.int32) < info.num_groups
-    return [gather_column(batch.columns[ki], info.rep_rows, live)
-            for ki in key_indices]
+    from spark_rapids_tpu.ops.rowops import gather_columns
+    return gather_columns([batch.columns[ki] for ki in key_indices],
+                          info.rep_rows, live)
 
 
 def minmax_operands(vs, kind: str):

@@ -26,6 +26,14 @@ import pandas as pd  # noqa: E402
 import pytest  # noqa: E402
 
 
+def _drop_compiled_programs():
+    import gc
+    jax.clear_caches()
+    from spark_rapids_tpu.utils import kernelcache
+    kernelcache.clear()
+    gc.collect()
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """The XLA CPU compiler segfaults deep in compilation after a few
@@ -35,11 +43,22 @@ def _clear_jax_caches_between_modules():
     modules keeps the compiler healthy; within-module caching is
     untouched, so the cost is one recompile set per file."""
     yield
-    import gc
-    jax.clear_caches()
-    from spark_rapids_tpu.utils import kernelcache
-    kernelcache.clear()
-    gc.collect()
+    _drop_compiled_programs()
+
+
+_TESTS_SINCE_CLEAR = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _clear_jax_caches_periodically():
+    """Same segfault, finer grain: heavyweight modules (the 22-query
+    differential file) can accumulate enough executables WITHIN one module
+    to trip the compiler. Drop programs every 20 tests as well."""
+    yield
+    _TESTS_SINCE_CLEAR["n"] += 1
+    if _TESTS_SINCE_CLEAR["n"] >= 20:
+        _TESTS_SINCE_CLEAR["n"] = 0
+        _drop_compiled_programs()
 
 
 @pytest.fixture

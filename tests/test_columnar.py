@@ -121,3 +121,23 @@ def test_prefix8_upload_and_propagation(rng):
 
     merged = jax.jit(lambda a, c: rowops.concat_batches([a, c], 512))(b, b)
     assert merged.columns[0].prefix8 is not None
+
+
+def test_bool_column_survives_packed_gather():
+    """Regression: the packed row gather rides bools as int8 lanes and must
+    cast back to the physical dtype (a filter used to emit 0/1 ints)."""
+    import numpy as np
+    import pandas as pd
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.ops.rowops import filter_batch
+
+    df = pd.DataFrame({"b": [True, False, True, False, True],
+                       "x": np.arange(5.0)})
+    batch = DeviceBatch.from_pandas(df)
+    kept = filter_batch(batch, batch.column("x").data > 1.0)
+    col = kept.column("b")
+    assert col.data.dtype == jnp.bool_
+    vals, _ = col.to_numpy(int(kept.num_rows))
+    assert vals.dtype == np.bool_
+    assert vals.tolist() == [True, False, True]
