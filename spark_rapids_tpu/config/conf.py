@@ -295,7 +295,21 @@ STAGE_FUSION = register(
     "single XLA executable so the compiler fuses them. TPU-first feature with "
     "no reference equivalent: cuDF dispatches one kernel per op.")
 
+JOIN_EXACT_LONG_STRINGS = register(
+    "spark.rapids.sql.join.exactLongStrings", _to_bool, True,
+    "String join keys longer than the 64-byte sort prefix are verified "
+    "with extended-prefix re-sorting and full-length compares of "
+    "candidate ties (exact, default). false keeps the dual 64-bit hash "
+    "tiebreak: faster on long-string keys but probabilistic equality "
+    "beyond 64 bytes (incompat).")
+
 # --- shuffle transport (ref RapidsConf.scala:520-601) ----------------------
+SHUFFLE_FETCH_RETRIES = register(
+    "spark.rapids.shuffle.maxFetchRetries", int, 3,
+    "Bounded task-level retries when a shuffle block fetch fails over the "
+    "transport before the error propagates (the in-process analogue of "
+    "the reference mapping transport errors into Spark's stage retry).")
+
 SHUFFLE_TRANSPORT_ENABLED = register(
     "spark.rapids.shuffle.transport.enabled", _to_bool, False,
     "Enable the accelerated shuffle manager: shuffle blocks stay in device "
@@ -355,6 +369,9 @@ class TpuConf:
     def get_bool(self, key: str, default: bool) -> bool:
         v = self.get(key, default)
         return _to_bool(v) if isinstance(v, str) else bool(v)
+
+    def get_int(self, key: str, default: int) -> int:
+        return int(self.get(key, default))
 
     def copy(self) -> "TpuConf":
         c = TpuConf()

@@ -920,9 +920,34 @@ class TpuShuffleExchangeExec(TpuExec):
 
             def make_manager(pid: int) -> Partition:
                 def run() -> Iterator[DeviceBatch]:
+                    from spark_rapids_tpu.shuffle.client import (
+                        ShuffleFetchFailedError,
+                    )
                     shuffle_id, statuses = materialize_manager()
-                    reader = CachingShuffleReader(ctx.session.shuffle_env)
-                    batches = list(reader.read(shuffle_id, pid, statuses))
+                    # bounded task retry on fetch failure — the in-process
+                    # analogue of mapping transport errors into Spark's
+                    # stage-retry path (RapidsShuffleClient.scala:409-418
+                    # -> RapidsShuffleFetchFailedException). The blocks
+                    # live in the spillable shuffle catalog, so a rerun
+                    # re-fetches the same registered data.
+                    max_retries = ctx.conf.get_int(
+                        "spark.rapids.shuffle.maxFetchRetries", 3)
+                    attempt = 0
+                    while True:
+                        try:
+                            reader = CachingShuffleReader(
+                                ctx.session.shuffle_env)
+                            batches = list(reader.read(shuffle_id, pid,
+                                                       statuses))
+                            break
+                        except ShuffleFetchFailedError as e:
+                            attempt += 1
+                            if attempt > max_retries:
+                                raise
+                            import logging
+                            logging.getLogger(__name__).warning(
+                                "shuffle fetch failed (%s); retrying "
+                                "%d/%d", e, attempt, max_retries)
                     if not batches:
                         yield DeviceBatch.empty(schema)
                         return

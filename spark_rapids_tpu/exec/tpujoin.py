@@ -60,12 +60,17 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
     columnar_output = True
 
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
-                 join_type: str, left_keys: List[int], right_keys: List[int]):
+                 join_type: str, left_keys: List[int], right_keys: List[int],
+                 exact_long_strings: bool = True):
         super().__init__([left, right])
         assert join_type in SUPPORTED_JOIN_TYPES, join_type
         self.join_type = join_type
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
+        # >64-byte string key equality: exact full-length verification
+        # (default) vs dual-hash tiebreak (incompat,
+        # spark.rapids.sql.join.exactLongStrings=false)
+        self.exact_long_strings = exact_long_strings
 
         # right outer streams the right side against a left-side build so
         # every preserved row is a stream row (the reference flips build
@@ -77,9 +82,11 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                      else self.right_keys)
         bkey = tuple(self.right_keys if self._stream_is_left
                      else self.left_keys)
-        sig = f"join|{jt}|{skey}|{bkey}"
+        sig = f"join|{jt}|{skey}|{bkey}|x{int(exact_long_strings)}"
         self._probe = cached_jit(sig + "|probe", lambda: jax.jit(
-            lambda b, s: join_ops.join_probe(b, s, bkey, skey, cross=cross)))
+            lambda b, s: join_ops.join_probe(
+                b, s, bkey, skey, cross=cross,
+                exact_long_strings=exact_long_strings)))
         outer = jt in ("left", "right", "full")
         swap = not self._stream_is_left
 

@@ -66,9 +66,26 @@ def _key_images(batch: DeviceBatch,
     return imgs
 
 
+def _union_string_extents(bcol: DeviceColumn, scol: DeviceColumn):
+    """(chars, starts, lens) of the build-then-stream row union (row order
+    matching the probe's image concatenation) for exact full-length key
+    verification. Explicit extents rather than an offsets array: the
+    stream chars land after the build side's PHYSICAL (padded) buffer, so
+    the union has a gap no offsets layout could express."""
+    b_chars = jnp.int32(bcol.data.shape[0])
+    chars = jnp.concatenate([bcol.data, scol.data])
+    starts = jnp.concatenate([
+        bcol.offsets[:-1].astype(jnp.int32),
+        scol.offsets[:-1].astype(jnp.int32) + b_chars])
+    lens = jnp.concatenate([
+        (bcol.offsets[1:] - bcol.offsets[:-1]).astype(jnp.int32),
+        (scol.offsets[1:] - scol.offsets[:-1]).astype(jnp.int32)])
+    return chars, starts, lens
+
+
 def join_probe(build: DeviceBatch, stream: DeviceBatch,
                build_keys: Sequence[int], stream_keys: Sequence[int],
-               cross: bool = False):
+               cross: bool = False, exact_long_strings: bool = True):
     """Phase 1. Returns device arrays
     (counts[ns], bstart[ns], bperm[nb], total_inner) where counts[i] is the
     number of build matches of stream row i and bperm maps sorted build
@@ -109,6 +126,75 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
     for img_s in imgs_s:
         differs = differs | jnp.concatenate(
             [jnp.zeros((1,), jnp.bool_), img_s[1:] != img_s[:-1]])
+
+    # EXACT equality for >64-byte string keys (default): strings agreeing
+    # on prefix+length+both hashes are image-ties. Adjacent-pair compares
+    # alone are NOT exact (an interleaved tie like A,B,A would split equal
+    # keys into different groups and DROP true matches), so the cond-gated
+    # repair re-sorts with extended 320-byte prefix images — content-
+    # sorting ties so equal keys become adjacent — then splits residual
+    # adjacent ties by full-length compare. This matches cuDF's full-key
+    # comparison (GpuHashJoin.scala:217-233) except the documented
+    # residual: keys sharing a 320-byte prefix AND length AND both 64-bit
+    # poly hashes AND interleaving in the tie run. With
+    # exact_long_strings=False the dual-hash tiebreak stands (incompat,
+    # spark.rapids.sql.join.exactLongStrings).
+    str_pairs = [(build.columns[bk], stream.columns[sk])
+                 for bk, sk in zip(build_keys, stream_keys)
+                 if build.columns[bk].dtype.is_string]
+    if exact_long_strings and str_pairs:
+        prev_valid = jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), valid_s[:-1]])
+        tie = (~differs) & valid_s & prev_valid
+        long_present = jnp.asarray(False)
+        for bcol, scol in str_pairs:
+            for col, kv in ((bcol, bkv), (scol, skv)):
+                lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+                long_present = long_present | jnp.any(
+                    jnp.where(kv, lens, 0) > 64)
+        need = long_present & jnp.any(tie)
+
+        def repair(_):
+            from spark_rapids_tpu.ops.strings import compare_extents
+            ext_imgs = []
+            unions = []
+            for bcol, scol in str_pairs:
+                chars, starts, lens = _union_string_extents(bcol, scol)
+                unions.append((chars, starts, lens))
+                nc = chars.shape[0]
+                for c in range(8, 40):  # bytes 64..320 as u64 chunks
+                    img = jnp.zeros(starts.shape, jnp.uint64)
+                    for b in range(8):
+                        p = c * 8 + b
+                        idxc = jnp.clip(starts + p, 0, nc - 1)
+                        byte = jnp.where(p < lens, chars[idxc],
+                                         jnp.asarray(0, jnp.uint8))
+                        img = (img << jnp.uint64(8)) | byte.astype(jnp.uint64)
+                    ext_imgs.append(img)
+            keys2 = (invalid,) + tuple(imgs) + tuple(ext_imgs) + (pos,)
+            out2 = jax.lax.sort(keys2, num_keys=len(keys2) - 1,
+                                is_stable=True)
+            inv2, all_s, perm2 = out2[0], out2[1:-1], out2[-1]
+            valid2 = inv2 == 0
+            d2 = jnp.zeros(inv2.shape, jnp.bool_).at[0].set(True)
+            for img_s2 in all_s:
+                d2 = d2 | jnp.concatenate(
+                    [jnp.zeros((1,), jnp.bool_), img_s2[1:] != img_s2[:-1]])
+            # residual ties (identical to 320 bytes): adjacent full-length
+            # compare — now content-sorted, equal keys are adjacent
+            prev2 = jnp.concatenate([perm2[:1], perm2[:-1]])
+            extra = jnp.zeros(d2.shape, jnp.bool_)
+            for chars, starts, lens in unions:
+                cmp = compare_extents(chars, starts[prev2], lens[prev2],
+                                      chars, starts[perm2], lens[perm2])
+                extra = extra | (cmp != 0)
+            prev_v2 = jnp.concatenate(
+                [jnp.zeros((1,), jnp.bool_), valid2[:-1]])
+            tie2 = (~d2) & valid2 & prev_v2
+            return d2 | (tie2 & extra), perm2, valid2
+
+        differs, perm, valid_s = jax.lax.cond(
+            need, repair, lambda _: (differs, perm, valid_s), None)
     boundary = differs & valid_s
     pid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     pid = jnp.where(valid_s, pid, -1)

@@ -66,17 +66,25 @@ class ShuffleClient:
 
     def fetch_blocks(self, blocks: List[Tuple[int, int, int]]) -> List[int]:
         """Fetch all batches of the given (shuffle, map, partition) blocks
-        from the peer. Returns received-catalog buffer ids."""
+        from the peer. Returns received-catalog buffer ids. Transactional:
+        a mid-fetch failure unregisters the blocks already received, so a
+        task-level retry (exec/tpu.py maxFetchRetries) cannot pile up
+        duplicate registered copies in the spillable received catalog."""
         metas = self._fetch_metadata(blocks)
-        out = []
-        for bid, length, tag in metas:
-            self._acquire_inflight(length)
-            try:
-                blob = self._receive_buffer(length, tag)
-            finally:
-                self._release_inflight(length)
-            batch = wire.deserialize_batch(blob)
-            out.append(self.received.add_batch(batch))
+        out: List[int] = []
+        try:
+            for bid, length, tag in metas:
+                self._acquire_inflight(length)
+                try:
+                    blob = self._receive_buffer(length, tag)
+                finally:
+                    self._release_inflight(length)
+                batch = wire.deserialize_batch(blob)
+                out.append(self.received.add_batch(batch))
+        except BaseException:
+            for rbid in out:
+                self.received.remove_batch(rbid)
+            raise
         return out
 
     def _fetch_metadata(self, blocks) -> List[Tuple[int, int, int]]:

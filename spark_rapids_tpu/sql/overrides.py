@@ -57,6 +57,54 @@ class ExecRule:
         return f"spark.rapids.sql.exec.{name}"
 
 
+class ExprMeta:
+    """Per-expression meta tree built during tagging — the explain output
+    names the exact offending expression NODE, not just the operator
+    (reference: BaseExprMeta and the expression meta tree,
+    RapidsMeta.scala:566-726)."""
+
+    def __init__(self, expr: Expression, schema):
+        from spark_rapids_tpu.sql.exprs.core import (
+            Expression as ExprBase,
+        )
+        self.expr = expr
+        reason = expr.device_supported(schema)
+        if reason is None and type(expr).eval_device is ExprBase.eval_device:
+            reason = "has no TPU implementation"
+        self.reason = reason
+        self.children = [ExprMeta(c, schema) for c in expr.children]
+
+    @property
+    def subtree_ok(self) -> bool:
+        return self.reason is None and all(c.subtree_ok
+                                           for c in self.children)
+
+    def first_reason(self):
+        """Pre-order first failing node's message, formatted exactly like
+        first_unsupported (the single support traversal serves both the
+        operator reason and the explain tree)."""
+        if self.reason is not None:
+            if self.reason == "has no TPU implementation":
+                return f"{self.expr.pretty_name} has no TPU implementation"
+            return f"{self.expr.pretty_name}: {self.reason}"
+        for c in self.children:
+            r = c.first_reason()
+            if r:
+                return r
+        return None
+
+    def explain_lines(self, depth: int = 0) -> List[str]:
+        marker = "*" if self.reason is None else "!"
+        line = "  " * depth + f"{marker} <{self.expr.pretty_name}> " \
+            f"{self.expr!r}"
+        if self.reason:
+            line += f"  <-- {self.reason}"
+        out = [line]
+        for c in self.children:
+            out.extend(c.explain_lines(depth + 1))
+        return out
+
+
 class ExecMeta:
     """Wraps one CPU physical operator during tagging
     (reference: SparkPlanMeta, RapidsMeta.scala:402-545)."""
@@ -69,6 +117,8 @@ class ExecMeta:
         self.parent = parent
         self.children: List[ExecMeta] = []
         self.reasons: List[str] = []
+        # (label, ExprMeta) per checked expression (RapidsMeta.scala:566+)
+        self.expr_metas: List[tuple] = []
 
     def will_not_work(self, reason: str) -> None:
         if reason not in self.reasons:
@@ -103,8 +153,10 @@ class ExecMeta:
         schema = (self.plan.children[0].output_schema()
                   if self.plan.children else self.plan.output_schema())
         for e in exprs:
-            reason = first_unsupported(e, schema)
+            em = ExprMeta(e, schema)
+            reason = em.first_reason()
             if reason:
+                self.expr_metas.append((what or "expr", em))
                 prefix = f"{what}: " if what else ""
                 self.will_not_work(prefix + reason)
 
@@ -122,12 +174,17 @@ class ExecMeta:
         return new
 
     def explain_lines(self, depth: int = 0) -> List[str]:
-        """RapidsMeta.explain tree printer (RapidsMeta.scala:245-283)."""
+        """RapidsMeta.explain tree printer (RapidsMeta.scala:245-283);
+        expression meta subtrees print under their operator so the
+        offending expression NODE is named (RapidsMeta.scala:566-726)."""
         marker = "*" if self.can_run_on_tpu else "!"
         line = "  " * depth + f"{marker} {self.plan.describe()}"
         if self.reasons:
             line += "  <-- " + "; ".join(self.reasons)
         out = [line]
+        for what, em in self.expr_metas:
+            out.append("  " * (depth + 1) + f"@{what}:")
+            out.extend(em.explain_lines(depth + 2))
         for c in self.children:
             out.extend(c.explain_lines(depth + 1))
         return out
@@ -165,6 +222,8 @@ def _tag_agg(meta: ExecMeta) -> None:
         reason = first_unsupported(e, schema)
         if reason:
             meta.will_not_work(f"group key {name}: {reason}")
+            meta.expr_metas.append((f"group key {name}",
+                                    ExprMeta(e, schema)))
     for fn in plan.agg_fns:
         reason = fn.device_supported(schema)
         if reason:
@@ -173,6 +232,9 @@ def _tag_agg(meta: ExecMeta) -> None:
             r = first_unsupported(c, schema)
             if r:
                 meta.will_not_work(f"aggregate input: {r}")
+                meta.expr_metas.append(
+                    (f"aggregate input of {fn.pretty_name}",
+                     ExprMeta(c, schema)))
     if mode == "final":
         for name, e in plan.finalize_exprs():
             r = first_unsupported(e, plan.partial_schema)
@@ -249,9 +311,11 @@ def _tag_join(meta: ExecMeta) -> None:
 
 def _convert_join(meta: ExecMeta, children) -> PhysicalPlan:
     from spark_rapids_tpu.exec.tpujoin import TpuShuffledHashJoinExec
-    return TpuShuffledHashJoinExec(children[0], children[1],
-                                   meta.plan.join_type, meta.plan.left_keys,
-                                   meta.plan.right_keys)
+    return TpuShuffledHashJoinExec(
+        children[0], children[1], meta.plan.join_type, meta.plan.left_keys,
+        meta.plan.right_keys,
+        exact_long_strings=meta.conf.get_bool(
+            "spark.rapids.sql.join.exactLongStrings", True))
 
 
 def _tag_nothing(meta: ExecMeta) -> None:
@@ -450,6 +514,72 @@ _register(ExecRule(cpu.CpuRangeExec, "device range source", _tag_nothing,
                        m.plan.num_partitions, m.plan.col_name)))
 
 
+def _run_after_tag_rules(root: ExecMeta) -> None:
+    """Cross-tree tag fixups after per-node tagging (the reference's
+    runAfterTagRules, RapidsMeta.scala:430-485): decisions that depend on
+    NEIGHBORING nodes' tags, not just the node itself."""
+    _fixup_join_hash_consistency(root)
+    _fixup_exchange_overhead(root)
+
+
+def _fixup_join_hash_consistency(meta: ExecMeta) -> None:
+    """A shuffled hash join and the exchanges feeding it must agree on the
+    partitioning hash function. If the join stays on CPU, its child TPU
+    exchanges fall back too (CPU join would read TPU-hash-partitioned
+    rows); if a feeding exchange stays on CPU, the join falls back
+    (reference makeShuffleConsistent, RapidsMeta.scala:430-445)."""
+    from spark_rapids_tpu.exec.cpu import (
+        CpuBroadcastHashJoinExec, CpuCartesianProductExec, CpuJoinExec,
+        CpuShuffleExchangeExec,
+    )
+    for c in meta.children:
+        _fixup_join_hash_consistency(c)
+    # only SHUFFLED equi-joins depend on partitioning-hash agreement;
+    # broadcast/cartesian joins consume stream partitions independently
+    if (not isinstance(meta.plan, CpuJoinExec)
+            or isinstance(meta.plan, (CpuBroadcastHashJoinExec,
+                                      CpuCartesianProductExec))):
+        return
+    exch_children = [c for c in meta.children
+                     if isinstance(c.plan, CpuShuffleExchangeExec)]
+    if not exch_children:
+        return
+    if not meta.can_run_on_tpu:
+        for c in exch_children:
+            if c.can_run_on_tpu:
+                c.will_not_work(
+                    "the shuffled join it feeds stays on CPU, so the "
+                    "partitioning hash must stay on CPU for consistency")
+    elif any(not c.can_run_on_tpu for c in exch_children):
+        meta.will_not_work(
+            "an input exchange stays on CPU, so the join must use the "
+            "CPU partitioning hash for consistency")
+        for c in exch_children:
+            if c.can_run_on_tpu:
+                c.will_not_work(
+                    "the shuffled join it feeds stays on CPU, so the "
+                    "partitioning hash must stay on CPU for consistency")
+
+
+def _fixup_exchange_overhead(meta: ExecMeta) -> None:
+    """An exchange with no columnar neighbors only adds two transitions
+    around a shuffle — keep it on CPU (reference's exchange-overhead
+    fixup, RapidsMeta.scala:447-454)."""
+    from spark_rapids_tpu.exec.cpu import CpuShuffleExchangeExec
+    for c in meta.children:
+        _fixup_exchange_overhead(c)
+    if not isinstance(meta.plan, CpuShuffleExchangeExec):
+        return
+    if not meta.can_run_on_tpu:
+        return
+    parent_columnar = meta.parent is not None and meta.parent.can_run_on_tpu
+    child_columnar = any(c.can_run_on_tpu for c in meta.children)
+    if not parent_columnar and not child_columnar:
+        meta.will_not_work(
+            "columnar exchange between CPU operators only adds "
+            "host<->device transition overhead")
+
+
 class TpuOverrides:
     """The preColumnarTransitions rule (GpuOverrides.apply,
     GpuOverrides.scala:1704-1761)."""
@@ -468,6 +598,7 @@ class TpuOverrides:
     def apply(self, plan: PhysicalPlan) -> PhysicalPlan:
         self.root_meta = self.wrap(plan)
         self.root_meta.tag()
+        _run_after_tag_rules(self.root_meta)
         explain = self.conf.explain
         if explain in ("ALL", "NOT_ON_TPU"):
             print(self.explain_text(explain))

@@ -1,0 +1,106 @@
+"""Join-key exactness at and beyond the 64-byte prefix boundary
+(VERDICT r2 item 8; reference exactness: cuDF full-key compares,
+GpuHashJoin.scala:217-233)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+
+
+def _mk(prefix_len: int):
+    """Key sets sharing a long common prefix, differing only PAST the
+    64-byte sort prefix (same length, so only a full compare or the hash
+    tiebreak can split them)."""
+    base = "k" * prefix_len
+    keys = [base + suf for suf in ("AA", "AB", "BA", "BB")]
+    left = pd.DataFrame({"k": keys * 3, "v": np.arange(12.0)})
+    right = pd.DataFrame({"k": keys, "w": np.arange(4.0) * 10})
+    return left, right
+
+
+@pytest.mark.parametrize("prefix_len", [62, 63, 64, 65, 100])
+def test_long_key_join_exact(session, prefix_len):
+    left, right = _mk(prefix_len)
+    q = (session.create_dataframe(left, 2)
+         .join(session.create_dataframe(right, 1), on="k", how="inner"))
+    session.set_conf("spark.rapids.sql.enabled", True)
+    session.set_conf("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    tpu = q.collect().sort_values(["k", "v"]).reset_index(drop=True)
+    session.set_conf("spark.rapids.sql.enabled", False)
+    cpu = q.collect().sort_values(["k", "v"]).reset_index(drop=True)
+    session.set_conf("spark.rapids.sql.enabled", True)
+    assert len(tpu) == len(cpu) == 12
+    assert tpu.k.tolist() == cpu.k.tolist()
+    assert tpu.w.tolist() == cpu.w.tolist()
+
+
+def test_long_key_tie_requires_full_compare(session):
+    """Adversarial: keys agree on the full 64-byte prefix AND length; only
+    the exact full-length compare distinguishes them from a same-group
+    merge. (The dual-hash tiebreak also happens to split them, but the
+    default path must not rely on it.)"""
+    base = "p" * 70
+    left = pd.DataFrame({"k": [base + "X", base + "Y"] * 4,
+                         "v": np.arange(8.0)})
+    right = pd.DataFrame({"k": [base + "X"], "w": [1.0]})
+    q = (session.create_dataframe(left, 1)
+         .join(session.create_dataframe(right, 1), on="k", how="inner"))
+    session.set_conf("spark.rapids.sql.enabled", True)
+    session.set_conf("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    out = q.collect()
+    assert len(out) == 4 and all(k == base + "X" for k in out.k)
+
+
+def test_interleaved_hash_collision_repair(session, monkeypatch):
+    """The repair path itself: with the dual poly hashes forced to
+    collide, distinct keys sharing the 64-byte prefix AND length become
+    image-ties. The extended-prefix re-sort must (a) split the distinct
+    keys and (b) keep EQUAL keys in one group even when interleaved
+    (adjacent-only compares would drop the A,B,A match)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import hashing
+    from spark_rapids_tpu.utils import kernelcache
+
+    real = hashing.string_poly_hashes
+
+    def colliding(offsets, data, validity):
+        h1, h2 = real(offsets, data, validity)
+        return jnp.zeros_like(h1), jnp.zeros_like(h2)
+
+    kernelcache.clear()  # the poisoned trace must not leak to other tests
+    monkeypatch.setattr(hashing, "string_poly_hashes", colliding)
+    try:
+        base = "q" * 66
+        left = pd.DataFrame({"k": [base + "A", base + "B", base + "A"],
+                             "v": [1.0, 2.0, 3.0]})
+        right = pd.DataFrame({"k": [base + "A"], "w": [7.0]})
+        q = (session.create_dataframe(left, 1)
+             .join(session.create_dataframe(right, 1), on="k", how="inner"))
+        session.set_conf("spark.rapids.sql.enabled", True)
+        session.set_conf("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+        out = q.collect().sort_values("v").reset_index(drop=True)
+        assert out.v.tolist() == [1.0, 3.0], out  # both A rows, no B row
+    finally:
+        kernelcache.clear()
+
+
+def test_long_key_join_incompat_conf_state(session):
+    """exactLongStrings=false keeps the dual-hash tiebreak — results still
+    match on non-adversarial data, and the conf round-trips."""
+    left, right = _mk(80)
+    q = (session.create_dataframe(left, 2)
+         .join(session.create_dataframe(right, 1), on="k", how="inner"))
+    session.set_conf("spark.rapids.sql.enabled", True)
+    session.set_conf("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    try:
+        session.set_conf("spark.rapids.sql.join.exactLongStrings", False)
+        tpu = q.collect().sort_values(["k", "v"]).reset_index(drop=True)
+        session.set_conf("spark.rapids.sql.enabled", False)
+        cpu = q.collect().sort_values(["k", "v"]).reset_index(drop=True)
+        session.set_conf("spark.rapids.sql.enabled", True)
+        assert tpu.k.tolist() == cpu.k.tolist()
+        assert tpu.w.tolist() == cpu.w.tolist()
+    finally:
+        session.set_conf("spark.rapids.sql.join.exactLongStrings", True)

@@ -1,0 +1,67 @@
+"""Cross-tree tag fixups + per-expression explain meta
+(reference: RapidsMeta.scala:430-485 runAfterTagRules and :566-726
+expression metas)."""
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.sql import functions as F
+
+
+def test_join_hash_consistency_pulls_exchanges_back(session):
+    left = pd.DataFrame({"k": np.arange(100, dtype=np.int64),
+                         "v": np.arange(100.0)})
+    right = pd.DataFrame({"k": np.arange(100, dtype=np.int64),
+                          "w": np.arange(100.0)})
+    q = (session.create_dataframe(left, 2)
+         .join(session.create_dataframe(right, 2), on="k", how="inner")
+         .group_by("k").agg(F.sum("v").alias("s")))
+    session.set_conf("spark.rapids.sql.enabled", True)
+    # large tables won't broadcast: force the shuffled join shape
+    session.set_conf("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    try:
+        session.set_conf("spark.rapids.sql.exec.JoinExec", False)
+        text = q.explain()
+        lines = text.splitlines()
+        join_lines = [ln for ln in lines if "JoinExec" in ln]
+        assert join_lines and all(ln.lstrip().startswith("!")
+                                  for ln in join_lines), text
+        # the exchanges FEEDING the join must fall back for hash
+        # consistency; the aggregate's own exchange may stay columnar
+        consistency = [ln for ln in lines
+                       if "partitioning hash must stay on CPU" in ln]
+        assert len(consistency) >= 2, text
+        out = q.collect()
+        assert len(out) == 100
+    finally:
+        session.set_conf("spark.rapids.sql.exec.JoinExec", True)
+        session.set_conf("spark.rapids.sql.autoBroadcastJoinThreshold",
+                         10 * 1024 * 1024)
+
+
+def test_exchange_overhead_fixup(session):
+    # an unsupported aggregation puts both aggregate halves on CPU; the
+    # exchange between them must NOT run columnar alone
+    df = pd.DataFrame({"k": ["a", "b"] * 20,
+                       "s": [f"x{i}" for i in range(40)]})
+    q = (session.create_dataframe(df, 2).group_by("k")
+         .agg(F.max(F.regexp_replace(F.col("s"), r"\d+", "Y"))
+              .alias("r")))
+    session.set_conf("spark.rapids.sql.enabled", True)
+    text = q.explain()
+    exch = [ln for ln in text.splitlines() if "ShuffleExchange" in ln]
+    assert exch and all(ln.lstrip().startswith("!") for ln in exch), text
+    assert any("transition overhead" in ln for ln in exch), text
+
+
+def test_explain_names_offending_expression(session):
+    df = pd.DataFrame({"s": [f"x{i}" for i in range(10)]})
+    q = session.create_dataframe(df, 1).select(
+        F.regexp_replace(F.col("s"), r"\d+", "Y").alias("d"))
+    session.set_conf("spark.rapids.sql.enabled", True)
+    text = q.explain()
+    # the expression meta tree names the exact unsupported NODE
+    assert "@" in text, text
+    flagged = [ln for ln in text.splitlines()
+               if ln.lstrip().startswith("!") and "RegexpReplace" in ln]
+    assert flagged, text

@@ -1,0 +1,31 @@
+"""Warm the persistent XLA compile cache at the bench's exact shapes, one
+query at a time with progress output (the driver's bench run then hits
+warm compiles only)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_rapids_tpu.session import TpuSparkSession
+from spark_rapids_tpu.models.tpch import QUERIES, TpchTables
+
+sf = float(os.environ.get("BENCH_SF", "0.5"))
+session = TpuSparkSession.builder().config(
+    "spark.rapids.sql.enabled", True).config(
+    "spark.rapids.sql.cacheDeviceScans", True).get_or_create()
+tables = TpchTables.generate(session, sf, num_partitions=4)
+names = (sys.argv[1].split(",") if len(sys.argv) > 1 else
+         ["q1", "q2", "q3", "q4", "q5", "q6", "q10", "q12",
+          "q14", "q16", "q18", "q19"])
+for q in names:
+    t0 = time.perf_counter()
+    try:
+        QUERIES[q](session, tables).collect()
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        QUERIES[q](session, tables).collect()
+        warm = time.perf_counter() - t0
+        print(f"{q}: cold {cold:.1f}s warm {warm:.2f}s", flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"{q}: FAILED {type(e).__name__}: {str(e)[:160]}", flush=True)
