@@ -140,7 +140,8 @@ class DeviceBatch:
     def from_pandas(df: pd.DataFrame, capacity: Optional[int] = None,
                     schema: Optional[Schema] = None,
                     dict_encode: bool = True,
-                    dict_state: Optional[dict] = None) -> "DeviceBatch":
+                    dict_state: Optional[dict] = None,
+                    device=None) -> "DeviceBatch":
         """Host -> device transition (reference: GpuRowToColumnarExec /
         HostColumnarToGpu, GpuRowToColumnarExec.scala:45-502).
 
@@ -174,7 +175,10 @@ class DeviceBatch:
             else:
                 dict_metas.append(None)
             host_bufs.append(bufs)
-        dev = jax.device_put((host_bufs, np.asarray(n, np.int32)))
+        # ``device``: explicit placement for sharded scans (mesh execution
+        # uploads partition i to mesh device i so data is born distributed)
+        dev = jax.device_put((host_bufs, np.asarray(n, np.int32)),
+                             device=device)
         dev_bufs, num_rows = dev
         cols = []
         for dt, bufs, dvals in zip(schema.dtypes, dev_bufs, dict_metas):

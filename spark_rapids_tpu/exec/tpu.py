@@ -45,6 +45,13 @@ def _concat_device(batches: List[DeviceBatch], schema: Schema,
         return batches[0]
     if not batches:
         return DeviceBatch.empty(schema)
+    # mesh execution commits batches to their shard device; a concat that
+    # spans shards (single-partition exchange, broadcast materialization)
+    # must colocate first or the jit below rejects the device mix
+    devs = {b.columns[0].data.device for b in batches if b.columns}
+    if len(devs) > 1:
+        target = batches[0].columns[0].data.device
+        batches = [jax.device_put(b, target) for b in batches]
     total_cap = sum(b.capacity for b in batches)
     out_cap = bucket_capacity(total_cap, growth)
     # one generic jitted concat kernel; jax re-specializes per pytree shape.
@@ -543,6 +550,13 @@ class TpuScanExec(TpuExec):
         # concurrent partition at worst costs one extra retrace)
         dict_state: dict = {}
 
+        # mesh execution: partition i uploads to mesh device i so scan data
+        # is born distributed (reference map tasks produce data already
+        # spread over executors) — the downstream exchange's device_put is
+        # then a no-op placement
+        mesh = getattr(ctx.session, "mesh", None) if ctx.session else None
+        mesh_devs = list(mesh.devices.flat) if mesh is not None else None
+
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
                 from spark_rapids_tpu.exec import taskctx
@@ -567,7 +581,9 @@ class TpuScanExec(TpuExec):
                             chunk = df.iloc[lo:lo + max_rows]
                             batch = DeviceBatch.from_pandas(
                                 chunk.reset_index(drop=True), schema=schema,
-                                dict_state=dict_state)
+                                dict_state=dict_state,
+                                device=(mesh_devs[i % len(mesh_devs)]
+                                        if mesh_devs else None))
                             if out is not None:
                                 # cached batches live in the spillable
                                 # catalog (budget-metered, evictable)
@@ -708,31 +724,54 @@ class TpuShuffleExchangeExec(TpuExec):
                     and ctx.conf.get_bool(
                         "spark.rapids.sql.shuffle.localCollapse", True))
 
-        if mesh is not None and kind == "hash":
+        mesh_kinds = ("hash", "range")
+        if (mesh is not None and kind == "roundrobin"
+                and self.partitioning[-1] == mesh.devices.size):
+            # user-visible repartition(n) keeps its partition count; it can
+            # only ride the mesh when n matches the device count
+            mesh_kinds = ("hash", "range", "roundrobin")
+        if mesh is not None and kind in mesh_kinds:
             # distributed exchange: one fused shard_map program whose core
             # is an ICI all_to_all (parallel/distributed.py), replacing the
             # reference's UCX transfers (RapidsShuffleInternalManager.scala)
-            key_idx = list(self.partitioning[1])
+            # for EVERY exchange kind (GpuShuffleExchangeExec.scala:60-215):
+            # hash (joins/aggregates), range (distributed global sort:
+            # per-shard sample -> host bounds -> all_to_all), roundrobin.
+            # Each upstream partition stays resident on its own mesh device
+            # end-to-end — no single-device funnel.
             n_dev = mesh.devices.size
             state = {"shards": None}
 
             def shards():
                 if state["shards"] is None:
-                    from spark_rapids_tpu.parallel.distributed import (
-                        mesh_exchange_hash,
-                    )
-                    batches = [b for p in child_parts for b in p()]
-                    merged = _concat_device(batches, schema, growth) \
-                        if batches else DeviceBatch.empty(schema)
-                    # mesh resharding reshapes capacity into n row blocks,
-                    # so pad tiny batches up to a multiple of n
-                    if merged.capacity % n_dev:
-                        target = -(-merged.capacity // n_dev) * n_dev
-                        merged = rowops.slice_batch_to(
-                            merged, jnp.asarray(0, jnp.int32),
-                            merged.num_rows, target)
-                    state["shards"] = mesh_exchange_hash(
-                        mesh, schema, key_idx, merged)
+                    from spark_rapids_tpu.parallel import distributed as dist
+                    per_shard: List[List[DeviceBatch]] = \
+                        [[] for _ in range(n_dev)]
+                    for j, p in enumerate(child_parts):
+                        per_shard[j % n_dev].extend(p())
+                    shard_batches = dist.mesh_collect_shards(
+                        mesh, schema, per_shard, growth)
+                    if kind == "hash":
+                        key_idx = list(self.partitioning[1])
+
+                        def pid_fn(b):
+                            return dist._hash_pid(b, key_idx, n_dev)
+                    elif kind == "range":
+                        key_idx = list(self.partitioning[1])
+                        asc = list(self.partitioning[2])
+                        nf = list(self.partitioning[3])
+                        bounds = dist.mesh_range_bounds(
+                            shard_batches, key_idx, asc, nf, n_dev)
+
+                        def pid_fn(b):
+                            return sortops.range_partition_ids(
+                                b, key_idx, asc, nf, bounds)
+                    else:
+                        def pid_fn(b):
+                            return (jnp.arange(b.capacity, dtype=jnp.int32)
+                                    % jnp.int32(n_dev))
+                    state["shards"] = dist.mesh_exchange_parts(
+                        mesh, schema, shard_batches, pid_fn)
                 return state["shards"]
 
             def make_mesh_part(i: int) -> Partition:
@@ -817,32 +856,26 @@ class TpuShuffleExchangeExec(TpuExec):
             fetched = jax.device_get([(b.num_rows,
                                        self._sample_kernel(b))
                                       for b in batches])
+            from spark_rapids_tpu.parallel.distributed import (
+                pick_bounds_from_samples,
+            )
             samples = []
+            k = None
             for batch, (rows, ops) in zip(batches, fetched):
                 rows = int(rows)
                 batch._host_rows = rows
+                ops = np.asarray(ops)  # (k, capacity)
+                k = ops.shape[0]
                 if rows == 0:
                     continue
-                ops = np.asarray(ops)  # (k, capacity)
                 take = min(rows, 128)
                 sel = np.linspace(0, rows - 1, take).astype(np.int64)
                 samples.append(ops[:, sel])
-            k = None
-            if samples:
-                all_s = np.concatenate(samples, axis=1)  # (k, total)
-                k = all_s.shape[0]
-                order = np.lexsort(all_s[::-1])
-                all_s = all_s[:, order]
-                total = all_s.shape[1]
-                picks = [int((i + 1) * total / n) - 1 for i in range(n - 1)]
-                bounds = [all_s[j, picks].astype(np.uint64)
-                          for j in range(k)]
-            else:
-                # no rows anywhere: operand count from an empty batch
-                probe = np.asarray(self._sample_kernel(
-                    DeviceBatch.empty(schema)))
-                k = probe.shape[0]
-                bounds = [np.zeros((n - 1,), np.uint64) for _ in range(k)]
+            if k is None:
+                # no batches at all: operand count from an empty probe
+                k = np.asarray(self._sample_kernel(
+                    DeviceBatch.empty(schema))).shape[0]
+            bounds = pick_bounds_from_samples(samples, k, n)
             return tuple(jnp.asarray(b) for b in bounds)
 
         def split_to_slices(batches, bounds):

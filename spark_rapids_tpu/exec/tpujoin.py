@@ -137,15 +137,41 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
         si, bi = self._sides()
         stream_parts = self.children[si].executed_partitions(ctx)
         build_parts = self.children[bi].executed_partitions(ctx)
+        growth = ctx.conf.capacity_growth
+        build_schema = self.children[bi].output_schema()
         if len(stream_parts) != len(build_parts):
             # broadcast build side: one build partition shared by every
             # stream partition (full outer never broadcasts — the unmatched-
             # build scan must see all stream rows, planner guarantees this)
             assert len(build_parts) == 1 and self.join_type != "full", \
                 "join children must be co-partitioned or build broadcast"
-            build_parts = build_parts * len(stream_parts)
-        growth = ctx.conf.capacity_growth
-        build_schema = self.children[bi].output_schema()
+            mesh = getattr(ctx.session, "mesh", None) if ctx.session else None
+            if mesh is not None:
+                # replicate the build table over the mesh with ONE
+                # collective device_put (parallel/distributed.mesh_broadcast
+                # — GpuBroadcastExchangeExec.scala:230-436's executor-side
+                # rebuild); stream partition i probes the copy resident on
+                # ITS device, so the probe kernel never crosses devices
+                orig_bp = build_parts[0]
+                n_dev = mesh.devices.size
+                bstate: dict = {}
+
+                def views():
+                    if "v" not in bstate:
+                        from spark_rapids_tpu.exec.tpu import _concat_device
+                        from spark_rapids_tpu.parallel.distributed import (
+                            mesh_broadcast,
+                        )
+                        build0 = _concat_device(list(orig_bp()),
+                                                build_schema, growth)
+                        bstate["v"] = mesh_broadcast(mesh, build0)
+                    return bstate["v"]
+
+                def mk_view(i: int) -> Partition:
+                    return lambda: iter([views()[i % n_dev]])
+                build_parts = [mk_view(i) for i in range(len(stream_parts))]
+            else:
+                build_parts = build_parts * len(stream_parts)
         jt = self.join_type
 
         def make(sp: Partition, bp: Partition) -> Partition:
