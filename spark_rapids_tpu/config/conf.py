@@ -105,6 +105,17 @@ AGG_FUSE_FILTER = register(
     "skipping the filter's per-column compaction gathers (indexed ops run "
     "at ~5M rows/s on TPU; the fused dense predicate is ~free).")
 
+AGG_SKIP_RATIO = register(
+    "spark.rapids.sql.agg.skipAggPassReductionRatio", float, 0.85,
+    "Adaptive partial-aggregation skip: after the first batch of a "
+    "partial hash aggregate, if output_groups/input_rows exceeds this "
+    "ratio (the pass barely reduces), remaining batches bypass the "
+    "grouping kernel and are projected straight into the partial layout "
+    "(count=1, sum=value) for the final aggregate to reduce once. On a "
+    "single chip the exchange is a local concat, so a low-reduction "
+    "partial pass is pure overhead. 1.0 disables skipping.",
+    validator=_fraction(0.0, 1.0))
+
 CACHE_DEVICE_SCANS = register(
     "spark.rapids.sql.cacheDeviceScans", _to_bool, False,
     "Keep uploaded scan batches resident in device memory across query "

@@ -197,6 +197,13 @@ class TpuHashAggregateExec(TpuExec):
                 lambda: jax.jit(lambda b: agg_ops.aggregate_update(
                     b, key_exprs, p.update_inputs, reductions,
                     p.partial_schema, mask_expr=pre_mask)))
+            # adaptive low-reduction skip: rows projected straight into the
+            # partial layout (spark.rapids.sql.agg.skipAggPassReductionRatio)
+            self._passthrough_kernel = cached_jit(
+                "aggpass|" + p.signature + mask_sig,
+                lambda: jax.jit(lambda b: agg_ops.aggregate_passthrough(
+                    b, key_exprs, p.update_inputs, reductions,
+                    p.partial_schema, mask_expr=pre_mask)))
             # merging partials within the partition uses merge kinds
             self._merge_kernel = self._make_merge_kernel()
         else:
@@ -233,16 +240,43 @@ class TpuHashAggregateExec(TpuExec):
         child_parts = self.children[0].executed_partitions(ctx)
         growth = ctx.conf.capacity_growth
 
+        from spark_rapids_tpu.config.conf import AGG_SKIP_RATIO
+        skip_ratio = float(ctx.conf.get(AGG_SKIP_RATIO.key))
+
         def make(part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
                 if self.mode == "partial":
-                    partials = [self._kernel(b) for b in part()]
-                    if not partials:
-                        partials = [self._kernel(
-                            DeviceBatch.empty(self.children[0].output_schema()))]
-                    if len(partials) == 1:
-                        yield partials[0]
+                    it = iter(part())
+                    first = next(it, None)
+                    if first is None:
+                        yield self._kernel(DeviceBatch.empty(
+                            self.children[0].output_schema()))
                         return
+                    p0 = self._kernel(first)
+                    second = next(it, None)
+                    if second is None:
+                        yield p0
+                        return
+                    # adaptive skip (one row-count sync, amortized over the
+                    # partition): if the first batch's pass barely reduced,
+                    # project the remaining batches straight into the
+                    # partial layout and let the final aggregate reduce
+                    # once — on a single chip the exchange is a local
+                    # concat, so a low-reduction partial pass is pure cost.
+                    # Only pay the sync when the partial kept its input
+                    # capacity (the bounded-cardinality paths shrink it,
+                    # proving heavy reduction without a round trip).
+                    if (skip_ratio < 1.0 and self.plan.num_keys > 0
+                            and p0.capacity >= first.capacity
+                            and p0.num_rows_host() > skip_ratio
+                            * max(first.num_rows_hint(), 1)):
+                        yield p0
+                        while second is not None:
+                            yield self._passthrough_kernel(second)
+                            second = next(it, None)
+                        return
+                    partials = [p0, self._kernel(second)]
+                    partials.extend(self._kernel(b) for b in it)
                     merged = _concat_device(partials, self.plan.partial_schema,
                                             growth)
                     yield self._merge_kernel(merged)

@@ -65,6 +65,56 @@ def aggregate_update(batch: DeviceBatch,
                            live=live)
 
 
+def aggregate_passthrough(batch: DeviceBatch,
+                          key_exprs: Sequence[Expression],
+                          input_exprs: Sequence[Expression],
+                          reductions: Sequence[Tuple[str, int, DType]],
+                          out_schema: Schema,
+                          mask_expr: Expression = None) -> DeviceBatch:
+    """Skipped partial aggregation: project rows straight into the partial
+    layout WITHOUT grouping — every row becomes a singleton group
+    (count = valid?1:0, sum = value, min/max/first/last = value). Used by
+    the adaptive low-reduction skip
+    (spark.rapids.sql.agg.skipAggPassReductionRatio): when the partial
+    pass barely reduces, the grouping sort is pure overhead on a single
+    chip (the exchange is a local concat) — the final aggregate reduces
+    once over the projected rows. A fused filter mask degrades to one
+    row compaction here (rowops.filter_batch)."""
+    from spark_rapids_tpu.ops.rowops import filter_batch
+    from spark_rapids_tpu.sql.exprs.core import BoundRef
+    ctx = make_context(batch)
+    if mask_expr is not None:
+        pred = to_device_column(ctx, mask_expr.eval_device(ctx))
+        batch = filter_batch(batch, pred.data & pred.validity)
+        ctx = make_context(batch)
+    key_cols = [batch.columns[e.index] if isinstance(e, BoundRef)
+                else to_device_column(ctx, e.eval_device(ctx))
+                for e in key_exprs]
+    input_cols = [to_device_column(ctx, e.eval_device(ctx))
+                  for e in input_exprs]
+    out_cols: List[DeviceColumn] = list(key_cols)
+    ones = None
+    for kind, idx, out_dt in reductions:
+        col = input_cols[idx]
+        if kind == "count_valid":
+            if ones is None:
+                ones = jnp.ones((batch.capacity,), jnp.bool_)
+            out_cols.append(DeviceColumn(
+                out_dt, col.validity.astype(out_dt.np_dtype), ones))
+        elif col.dtype.is_string:
+            out_cols.append(col)
+        elif kind == "any":
+            out_cols.append(DeviceColumn(
+                out_dt, (col.data & col.validity).astype(out_dt.np_dtype),
+                col.validity))
+        else:  # sum/min/max/first/last(_valid): the value IS the partial
+            data = col.data
+            if data.dtype != out_dt.np_dtype:
+                data = data.astype(out_dt.np_dtype)
+            out_cols.append(DeviceColumn(out_dt, data, col.validity))
+    return DeviceBatch(out_schema, out_cols, batch.num_rows)
+
+
 def aggregate_merge(batch: DeviceBatch, num_keys: int,
                     reductions: Sequence[Tuple[str, int, DType]],
                     out_schema: Schema,) -> DeviceBatch:
@@ -131,7 +181,141 @@ def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
     if dict_info is not None:
         return _dict_matmul_reduce(batch, key_idx, reductions, out_schema,
                                    dict_info, live)
+    # dictionary-encoded keys (bounded cardinality): the sort-free slot
+    # attempt usually wins; otherwise (high/unknown cardinality) the
+    # payload-sort path — its segment ops see SORTED ids, which XLA lowers
+    # ~10x cheaper than the row-space scatters of the old sort branch
+    if len(key_idx) <= 32 and not all(
+            batch.columns[ki].dict_values is not None for ki in key_idx):
+        return _sorted_payload_reduce(batch, key_idx, reductions,
+                                      out_schema, live)
     return _rowspace_reduce(batch, key_idx, reductions, out_schema, live)
+
+
+def _sorted_payload_reduce(batch: DeviceBatch, key_idx: List[int],
+                           reductions: List[Tuple[str, int, DType]],
+                           out_schema: Schema, live=None) -> DeviceBatch:
+    """High-cardinality keyed aggregation: ONE multi-operand ``lax.sort``
+    carries every reduction input column alongside the EXACT key images,
+    group boundaries come from adjacent-image comparison, and every
+    reduction runs as a segment op over SORTED segment ids.
+
+    Why this path exists (measured on TPU at 4M rows / 1.25M groups): a
+    capacity-width segment op keyed by ROW-SPACE (randomly ordered) ids
+    costs ~5.7s — the scatter cannot coalesce — while the same op keyed by
+    sorted ids costs ~50ms. Sorting the values WITH the keys (extra sort
+    payloads are nearly free, ~0.4ms/operand dispatch) buys every
+    downstream reduction the sorted-id fast case, the whole step landing
+    at ~0.6s vs ~5.7s for the row-space design. The reference leans on
+    cuDF's hash aggregation (aggregate.scala:338-396) which has no TPU
+    analogue; this is the sort-based recipe re-tuned for XLA's scatter
+    lowering.
+
+    Grouping equality is EXACT for fixed-width keys (the image is the
+    value; floats normalize -0.0/NaN first) and for strings up to 8 bytes
+    (prefix+length images), with the dual 64-bit poly hashes as tiebreak
+    beyond — strictly stronger than the dual-hash-only grouping of the
+    sort branch it replaces. Null keys group separately via a per-key
+    validity signature word."""
+    from spark_rapids_tpu.ops import hashing
+    from spark_rapids_tpu.ops.pallas_kernels import compact_permutation
+    from spark_rapids_tpu.ops.rowops import gather_columns
+    from spark_rapids_tpu.ops.sortops import u64_key_image
+
+    capacity = batch.capacity
+    if live is None:
+        live = batch.row_mask()
+    dead = (~live).astype(jnp.uint8)
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+
+    imgs: List[jnp.ndarray] = []
+    nullsig = jnp.zeros((capacity,), jnp.uint32)
+    for j, ki in enumerate(key_idx):
+        col = batch.columns[ki]
+        if col.dtype.is_string:
+            from spark_rapids_tpu.ops.sortops import string_prefix8
+            lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+            h1, h2 = hashing.string_poly_hashes(col.offsets, col.data,
+                                                col.validity)
+            per = [string_prefix8(col), lens.astype(jnp.uint64), h1, h2]
+        else:
+            per = u64_key_image(col)
+        # canonical image for null rows; real values sharing it are told
+        # apart by the validity signature below
+        per = [jnp.where(col.validity, im, jnp.uint64(0)) for im in per]
+        imgs.extend(per)
+        nullsig = nullsig | (col.validity.astype(jnp.uint32)
+                             << jnp.uint32(j))
+
+    # distinct reduction input columns ride the sort as payloads
+    payload_cols = []
+    payload_pos = {}
+    for _kind, ci, _dt in reductions:
+        if ci not in payload_pos:
+            payload_pos[ci] = len(payload_cols)
+            payload_cols.append(ci)
+    payloads = []
+    for ci in payload_cols:
+        col = batch.columns[ci]
+        if col.dtype.is_string:
+            # only count_valid consumes string inputs here (string min/max
+            # take the sorted-space path); the char slab can't ride a row
+            # sort, so the validity stands in for the data payload
+            d = col.validity.astype(jnp.int8)
+        else:
+            d = col.data
+            if d.dtype == jnp.bool_:
+                d = d.astype(jnp.int8)
+        payloads.extend([d, col.validity.astype(jnp.int8)])
+
+    keys = (dead, nullsig) + tuple(imgs) + (pos,)
+    out = jax.lax.sort(keys + tuple(payloads), num_keys=len(keys),
+                       is_stable=False)  # pos makes the order total
+    dead_s = out[0]
+    nullsig_s = out[1]
+    imgs_s = out[2:2 + len(imgs)]
+    pos_s = out[2 + len(imgs)]
+    payloads_s = out[3 + len(imgs):]
+    live_s = dead_s == 0
+
+    same = jnp.concatenate([jnp.zeros((1,), jnp.bool_),
+                            nullsig_s[1:] == nullsig_s[:-1]])
+    for img_s in imgs_s:
+        same = same & jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), img_s[1:] == img_s[:-1]])
+    boundary = live_s & ~same
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    sid = jnp.where(live_s, jnp.clip(gid, 0, capacity - 1), capacity)
+    num_groups = boundary.sum().astype(jnp.int32)
+    group_live = pos < num_groups
+
+    def seg(op, x):
+        return op(x, sid, num_segments=capacity + 1,
+                  indices_are_sorted=True)[:capacity]
+
+    # key output columns: one packed gather at the groups' first rows
+    slot_perm, _n = compact_permutation(boundary)
+    rep_row = pos_s[slot_perm]
+    out_cols = gather_columns([batch.columns[ki] for ki in key_idx],
+                              rep_row, group_live)
+
+    for kind, ci, out_dt in reductions:
+        pi = payload_pos[ci] * 2
+        data_s, valid_s = payloads_s[pi], payloads_s[pi + 1] != 0
+        if batch.columns[ci].data.dtype == jnp.bool_:
+            data_s = data_s != 0
+        if batch.columns[ci].dtype.is_string:
+            # only count_valid reaches here (string min/max take the
+            # sorted-space path); the payload pair carries validity twice
+            data, validity = _seg_reduce_kind(
+                "count_valid", valid_s, valid_s & live_s, live_s, seg, pos,
+                lambda x: x, capacity, capacity, out_dt)
+        else:
+            data, validity = _seg_reduce_kind(
+                kind, data_s, valid_s & live_s, live_s, seg, pos,
+                lambda x: x, capacity, capacity, out_dt)
+        out_cols.append(DeviceColumn(out_dt, data, validity & group_live))
+    return DeviceBatch(out_schema, out_cols, num_groups)
 
 
 def _dict_matmul_reduce(batch: DeviceBatch, key_idx: List[int],
@@ -442,20 +626,11 @@ def _slot_hash_attempt(batch: DeviceBatch, key_idx: List[int], live=None):
     for ki in key_idx:
         col = batch.columns[ki]
         if col.dtype.is_string:
+            from spark_rapids_tpu.ops.sortops import string_prefix8
             lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
-            if getattr(col, "prefix8", None) is not None:
-                # host-computed at upload, gather-propagated: zero char
-                # reads here
-                img = col.prefix8
-            else:
-                starts = col.offsets[:-1].astype(jnp.int32)
-                nc = col.data.shape[0]
-                img = jnp.zeros((capacity,), jnp.uint64)
-                for bpos in range(8):
-                    idxb = jnp.clip(starts + bpos, 0, max(nc - 1, 0))
-                    byte = jnp.where(bpos < lens, col.data[idxb],
-                                     jnp.asarray(0, jnp.uint8))
-                    img = (img << jnp.uint64(8)) | byte.astype(jnp.uint64)
+            # host-computed at upload (gather-propagated, zero char reads)
+            # or one device reconstruction pass
+            img = string_prefix8(col)
             # the raw prefix is injective over the bytes, but 0-padding
             # aliases 'a' with 'a\x00' — the length joins the agreement
             # check as its OWN image (XOR-folding it into one 64-bit word

@@ -80,6 +80,27 @@ def _string_prefix_chunks(col: DeviceColumn) -> List[jnp.ndarray]:
     return chunks
 
 
+def string_prefix8(col: DeviceColumn) -> jnp.ndarray:
+    """The column's 8-byte big-endian prefix image: the host-computed
+    ``prefix8`` when upload attached one, else one device reconstruction
+    pass — the single spelling shared by the slot-hash and payload-sort
+    aggregation paths (0-padded past-end bytes; pair with the length as a
+    separate image, 'a' vs 'a\\x00' alias otherwise)."""
+    if getattr(col, "prefix8", None) is not None:
+        return col.prefix8
+    capacity = col.offsets.shape[0] - 1
+    nchars = col.data.shape[0]
+    lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+    starts = col.offsets[:-1].astype(jnp.int32)
+    img = jnp.zeros((capacity,), jnp.uint64)
+    for bpos in range(8):
+        idx = jnp.clip(starts + bpos, 0, max(nchars - 1, 0))
+        byte = jnp.where(bpos < lens, col.data[idx],
+                         jnp.asarray(0, jnp.uint8))
+        img = (img << jnp.uint64(8)) | byte.astype(jnp.uint64)
+    return img
+
+
 def sort_permutation(batch: DeviceBatch,
                      key_indices: Sequence[int],
                      ascending: Sequence[bool],
