@@ -98,7 +98,8 @@ SUITES = {"tpch": _suite_tpch, "tpcxbb": _suite_tpcxbb,
 
 
 def main():
-    suite_names = os.environ.get("BENCH_SUITE", "tpch")
+    suite_env = os.environ.get("BENCH_SUITE")
+    suite_names = suite_env or "tpch"
     sf = float(os.environ.get("BENCH_SF", "0.5"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     qenv = os.environ.get("BENCH_QUERIES")
@@ -119,6 +120,12 @@ def main():
         built = SUITES[sn](session, sf, qnames)
         for q, fn in built.items():
             queries[f"{sn}.{q}" if len(names) > 1 else q] = fn
+    if suite_env is None and qnames is None:
+        # default sweep carries a TPCxBB sample alongside the 12 TPC-H
+        # queries (the reference benches both suites,
+        # integration_tests/.../tpcxbb/TpcxbbLikeSpark.scala)
+        for q, fn in SUITES["tpcxbb"](session, sf, ["q5", "q12", "q26"]).items():
+            queries[f"tpcxbb.{q}"] = fn
 
     def run_query(fn, enabled: bool):
         session.set_conf("spark.rapids.sql.enabled", enabled)

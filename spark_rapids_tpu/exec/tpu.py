@@ -943,15 +943,28 @@ class TpuShuffleExchangeExec(TpuExec):
                             jnp.asarray(host_counts[pid], jnp.int32),
                             int(host_counts[pid]))
 
+        # map-side output registers in the spillable BufferCatalog at the
+        # shuffle-output band (spills FIRST under pressure,
+        # SpillPriorities.scala:26-50 / RapidsShuffleInternalManager.scala:
+        # 92-141 route all shuffle data through the catalog); the reduce
+        # side acquires (faulting spilled pieces back) and frees on
+        # consumption
+        use_catalog = ctx.session is not None
+
         def materialize():
             if state["buckets"] is not None:
                 return state["buckets"]
-            buckets: List[List[DeviceBatch]] = [[] for _ in range(n)]
+            from spark_rapids_tpu.memory.spill import SpillPriorities
+            buckets: List[List] = [[] for _ in range(n)]
             all_batches = [b for p in child_parts for b in p()]
             bounds = (compute_range_bounds(all_batches)
                       if kind == "range" else None)
             for _bi, pid, piece in split_to_slices(all_batches, bounds):
-                buckets[pid].append(piece)
+                if use_catalog:
+                    buckets[pid].append(ctx.session.add_transient_batch(
+                        piece, SpillPriorities.OUTPUT_FOR_READ))
+                else:
+                    buckets[pid].append(piece)
             state["buckets"] = buckets
             return buckets
 
@@ -1025,9 +1038,20 @@ class TpuShuffleExchangeExec(TpuExec):
         def make(pid: int) -> Partition:
             def run() -> Iterator[DeviceBatch]:
                 buckets = materialize()
+                assert buckets[pid] is not None, \
+                    f"shuffle partition {pid} already consumed (freed on use)"
                 if not buckets[pid]:
                     yield DeviceBatch.empty(schema)
                     return
-                yield _concat_device(buckets[pid], schema, growth)
+                if use_catalog:
+                    catalog = ctx.session.buffer_catalog
+                    pieces = []
+                    for bid in buckets[pid]:
+                        pieces.append(catalog.acquire_batch(bid))
+                        ctx.session.consume_transient(bid)  # free on use
+                    buckets[pid] = None
+                else:
+                    pieces = buckets[pid]
+                yield _concat_device(pieces, schema, growth)
             return run
         return [make(i) for i in range(n)]

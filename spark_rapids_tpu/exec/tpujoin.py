@@ -45,15 +45,31 @@ class TpuBroadcastExchangeExec(PhysicalPlan):
         child = self.children[0]
         growth = ctx.conf.capacity_growth
 
-        def run():
-            if "batch" not in self._cache:
-                from spark_rapids_tpu.exec.tpu import _concat_device
-                parts = child.executed_partitions(ctx)
-                batches = [b for p in parts for b in p()]
-                self._cache["batch"] = _concat_device(
-                    batches, child.output_schema(), growth)
-            yield self._cache["batch"]
-        return [run]
+        def materialize():
+            from spark_rapids_tpu.exec.tpu import _concat_device
+            parts = child.executed_partitions(ctx)
+            batches = [b for p in parts for b in p()]
+            return _concat_device(batches, child.output_schema(), growth)
+
+        if ctx.session is None:
+            def run():
+                if "batch" not in self._cache:
+                    self._cache["batch"] = materialize()
+                yield self._cache["batch"]
+            return [run]
+
+        # the broadcast table lives in the spillable BufferCatalog (the
+        # reference materializes broadcasts as spillable device buffers,
+        # GpuBroadcastExchangeExec.scala:230-436): consumers acquire per
+        # use, faulting a spilled table back; OUTPUT_FOR_WRITE band so
+        # shuffle output (OUTPUT_FOR_READ) evicts first
+        def run_catalog():
+            from spark_rapids_tpu.memory.spill import SpillPriorities
+            if "bid" not in self._cache:
+                self._cache["bid"] = ctx.session.add_transient_batch(
+                    materialize(), SpillPriorities.OUTPUT_FOR_WRITE)
+            yield ctx.session.buffer_catalog.acquire_batch(self._cache["bid"])
+        return [run_catalog]
 
 
 class TpuShuffledHashJoinExec(PhysicalPlan):

@@ -63,6 +63,10 @@ class TpuSparkSession:
         self._shuffle_env = None
         self._shuffle_id_counter = 0
         self._active_shuffles: List[int] = []
+        # catalog ids of per-query transient spillables (exchange buckets,
+        # broadcast tables): consumed entries remove themselves; leftovers
+        # (short-circuited limits, errors) release at query end
+        self._transient_bids: set = set()
 
     def clear_device_cache(self) -> None:
         for _source, parts in self.device_scan_cache.values():
@@ -99,6 +103,28 @@ class TpuSparkSession:
         for sid in self._active_shuffles:
             self._shuffle_env.shuffle_catalog.remove_shuffle(sid)
         self._active_shuffles.clear()
+
+    def register_transient(self, bid: int) -> int:
+        self._transient_bids.add(bid)
+        return bid
+
+    def add_transient_batch(self, batch, priority: int) -> int:
+        """Register a per-query spillable in the catalog AND the transient
+        set in one step — the pairing is load-bearing (an add_batch alone
+        would pin the buffer in the catalog past query end)."""
+        return self.register_transient(
+            self.buffer_catalog.add_batch(batch, priority))
+
+    def consume_transient(self, bid: int) -> None:
+        self._transient_bids.discard(bid)
+        self.buffer_catalog.remove(bid)
+
+    def release_transient_buffers(self) -> None:
+        """Free per-query spillables a short-circuited (or failed) query
+        never consumed."""
+        for bid in self._transient_bids:
+            self.buffer_catalog.remove(bid)
+        self._transient_bids.clear()
 
     def set_mesh(self, n_devices: Optional[int]) -> None:
         """Configure an n-device data-parallel mesh for distributed
@@ -224,6 +250,7 @@ class TpuSparkSession:
             outs = self._drain(plan, ctx, conf)
         finally:
             self.release_active_shuffles()
+            self.release_transient_buffers()
         # per-operator SQL metrics of the last executed query (the
         # reference surfaces these in the Spark UI, GpuExec.scala:61-67),
         # plus the memory runtime's counters (allocated/spill activity —
