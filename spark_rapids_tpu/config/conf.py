@@ -105,6 +105,13 @@ AGG_FUSE_FILTER = register(
     "skipping the filter's per-column compaction gathers (indexed ops run "
     "at ~5M rows/s on TPU; the fused dense predicate is ~free).")
 
+EXCHANGE_FUSE_FILTER = register(
+    "spark.rapids.sql.exchange.fuseFilter", _to_bool, True,
+    "Fuse a deterministic Filter directly below a collapsed exchange (or "
+    "a broadcast materialization) into the concat's single compaction "
+    "gather, eliminating the standalone filter's per-batch per-column "
+    "gathers (~5M rows/s on TPU).")
+
 AGG_SKIP_RATIO = register(
     "spark.rapids.sql.agg.skipAggPassReductionRatio", float, 0.85,
     "Adaptive partial-aggregation skip: after the first batch of a "

@@ -38,10 +38,11 @@ class TpuExec(PhysicalPlan):
 
 
 def _concat_device(batches: List[DeviceBatch], schema: Schema,
-                   growth: float) -> DeviceBatch:
+                   growth: float, keep_masks=None) -> DeviceBatch:
     """Concatenate device batches (GpuCoalesceBatches / ConcatAndConsumeAll,
-    GpuCoalesceBatches.scala:38-165)."""
-    if len(batches) == 1:
+    GpuCoalesceBatches.scala:38-165). ``keep_masks``: per-batch keep
+    vectors of a fused Filter (see _fused_filter_source)."""
+    if len(batches) == 1 and keep_masks is None:
         return batches[0]
     if not batches:
         return DeviceBatch.empty(schema)
@@ -52,18 +53,49 @@ def _concat_device(batches: List[DeviceBatch], schema: Schema,
     if len(devs) > 1:
         target = batches[0].columns[0].data.device
         batches = [jax.device_put(b, target) for b in batches]
+        if keep_masks is not None:
+            keep_masks = [jax.device_put(k, target) for k in keep_masks]
     total_cap = sum(b.capacity for b in batches)
     out_cap = bucket_capacity(total_cap, growth)
     # one generic jitted concat kernel; jax re-specializes per pytree shape.
     # char capacity 0 = per-column sum computed inside concat_batches
-    kernel = cached_jit("concat", lambda: jax.jit(
-        rowops.concat_batches, static_argnums=(1, 2)))
-    out = kernel(batches, out_cap, 0)
+    if keep_masks is None:
+        kernel = cached_jit("concat", lambda: jax.jit(
+            rowops.concat_batches, static_argnums=(1, 2)))
+        out = kernel(batches, out_cap, 0)
+    else:
+        kernel = cached_jit("concatmask", lambda: jax.jit(
+            lambda bs, ks, oc, cc: rowops.concat_batches(
+                bs, oc, cc, keep_masks=ks), static_argnums=(2, 3)))
+        out = kernel(batches, list(keep_masks), out_cap, 0)
     from spark_rapids_tpu.memory.device import TpuDeviceManager
     dm = TpuDeviceManager.current()
     if dm is not None:
         dm.meter_batch(out)
     return out
+
+
+def _fused_filter_source(node: PhysicalPlan, ctx: ExecContext):
+    """(source node, mask kernel) for the exchange/broadcast collapse
+    concat: a deterministic TpuFilterExec directly below folds its
+    predicate into the concat's single compaction gather instead of
+    paying per-batch per-column compaction gathers (~5M rows/s on TPU) —
+    the exchange-side sibling of fuse_filter_into_aggregate
+    (exec/fusion.py). Returns (node, None) when nothing fuses."""
+    if (isinstance(node, TpuFilterExec) and not node._impure
+            and ctx.conf.get_bool(
+                "spark.rapids.sql.exchange.fuseFilter", True)):
+        cond = node.condition
+        sig = "filtermask|" + expr_signature(cond)
+
+        def build():
+            def mask(batch: DeviceBatch):
+                ectx = make_context(batch)
+                pred = to_device_column(ectx, cond.eval_device(ectx))
+                return pred.data & pred.validity & batch.row_mask()
+            return jax.jit(mask)
+        return node.children[0], cached_jit(sig, build)
+    return node, None
 
 
 def _split_by_pid(batch: DeviceBatch, pid: jnp.ndarray, n: int):
@@ -252,24 +284,55 @@ class TpuHashAggregateExec(TpuExec):
                         yield self._kernel(DeviceBatch.empty(
                             self.children[0].output_schema()))
                         return
+                    # adaptive statistics (Spark-AQE-style): the session
+                    # remembers each aggregate signature's observed
+                    # reduction ratio; a known-poor reducer skips its
+                    # partial pass from batch 0 — including single-batch
+                    # partitions, where the ratio is otherwise only
+                    # learnable AFTER paying the full pass. Entries expire
+                    # after a bounded number of skips (the signature is
+                    # structural, so a different data source under the
+                    # same shape must get a chance to re-learn), and a
+                    # signature already in the cache never re-pays the
+                    # row-count sync.
+                    cache = getattr(ctx.session, "agg_ratio_cache", None) \
+                        if ctx.session else None
+                    sig = self.plan.signature
+                    adaptive = (skip_ratio < 1.0 and cache is not None
+                                and self.plan.num_keys > 0)
+                    if adaptive and sig in cache:
+                        ratio_known, uses = cache[sig]
+                        if ratio_known > skip_ratio:
+                            if uses >= 8:
+                                del cache[sig]  # expire: re-learn below
+                            else:
+                                cache[sig][1] = uses + 1
+                                yield self._passthrough_kernel(first)
+                                for b in it:
+                                    yield self._passthrough_kernel(b)
+                                return
                     p0 = self._kernel(first)
                     second = next(it, None)
+                    # learn the ratio (one row-count sync, first execution
+                    # of a signature only) whenever the partial kept its
+                    # input capacity — the bounded-cardinality paths
+                    # shrink it, proving heavy reduction without a round
+                    # trip
+                    ratio = None
+                    if (adaptive and sig not in cache
+                            and p0.capacity >= first.capacity):
+                        ratio = (p0.num_rows_host()
+                                 / max(first.num_rows_hint(), 1))
+                        cache[sig] = [ratio, 0]
                     if second is None:
                         yield p0
                         return
-                    # adaptive skip (one row-count sync, amortized over the
-                    # partition): if the first batch's pass barely reduced,
-                    # project the remaining batches straight into the
+                    # adaptive skip: the first batch's pass barely reduced
+                    # -> project the remaining batches straight into the
                     # partial layout and let the final aggregate reduce
-                    # once — on a single chip the exchange is a local
-                    # concat, so a low-reduction partial pass is pure cost.
-                    # Only pay the sync when the partial kept its input
-                    # capacity (the bounded-cardinality paths shrink it,
-                    # proving heavy reduction without a round trip).
-                    if (skip_ratio < 1.0 and self.plan.num_keys > 0
-                            and p0.capacity >= first.capacity
-                            and p0.num_rows_host() > skip_ratio
-                            * max(first.num_rows_hint(), 1)):
+                    # once; on a single chip the exchange is a local
+                    # concat, so a low-reduction partial pass is pure cost
+                    if ratio is not None and ratio > skip_ratio:
                         yield p0
                         while second is not None:
                             yield self._passthrough_kernel(second)
@@ -825,12 +888,21 @@ class TpuShuffleExchangeExec(TpuExec):
             # outputs carry pre-agg padding worth removing before the
             # merge/sort).
             if not self._padded_producer(self.children[0]):
+                # a deterministic Filter directly below folds into the
+                # concat's compaction gather (_fused_filter_source)
+                src_node, mask_kernel = _fused_filter_source(
+                    self.children[0], ctx)
+                fused_parts = (src_node.executed_partitions(ctx)
+                               if mask_kernel is not None else child_parts)
+
                 def nosync_concat() -> Iterator[DeviceBatch]:
-                    batches = [b for p in child_parts for b in p()]
+                    batches = [b for p in fused_parts for b in p()]
                     if not batches:
                         yield DeviceBatch.empty(schema)
                         return
-                    yield _concat_device(batches, schema, growth)
+                    masks = ([mask_kernel(b) for b in batches]
+                             if mask_kernel is not None else None)
+                    yield _concat_device(batches, schema, growth, masks)
                 return [nosync_concat]
 
             def single() -> Iterator[DeviceBatch]:
@@ -1038,8 +1110,10 @@ class TpuShuffleExchangeExec(TpuExec):
         def make(pid: int) -> Partition:
             def run() -> Iterator[DeviceBatch]:
                 buckets = materialize()
-                assert buckets[pid] is not None, \
-                    f"shuffle partition {pid} already consumed (freed on use)"
+                if buckets[pid] is None:
+                    raise RuntimeError(
+                        f"shuffle partition {pid} already consumed "
+                        "(freed on use)")
                 if not buckets[pid]:
                     yield DeviceBatch.empty(schema)
                     return

@@ -46,10 +46,19 @@ class TpuBroadcastExchangeExec(PhysicalPlan):
         growth = ctx.conf.capacity_growth
 
         def materialize():
-            from spark_rapids_tpu.exec.tpu import _concat_device
-            parts = child.executed_partitions(ctx)
+            from spark_rapids_tpu.exec.tpu import (
+                _concat_device, _fused_filter_source,
+            )
+            src_node, mask_kernel = _fused_filter_source(child, ctx)
+            parts = src_node.executed_partitions(ctx)
             batches = [b for p in parts for b in p()]
-            return _concat_device(batches, child.output_schema(), growth)
+            if not batches:
+                return _concat_device(batches, child.output_schema(),
+                                      growth)
+            masks = ([mask_kernel(b) for b in batches]
+                     if mask_kernel is not None else None)
+            return _concat_device(batches, child.output_schema(), growth,
+                                  masks)
 
         if ctx.session is None:
             def run():

@@ -67,6 +67,10 @@ class TpuSparkSession:
         # broadcast tables): consumed entries remove themselves; leftovers
         # (short-circuited limits, errors) release at query end
         self._transient_bids: set = set()
+        # adaptive statistics: aggregate signature -> last observed
+        # partial-pass reduction ratio (groups/rows); known-poor reducers
+        # skip their partial pass from batch 0 on later executions
+        self.agg_ratio_cache: dict = {}
 
     def clear_device_cache(self) -> None:
         for _source, parts in self.device_scan_cache.values():
