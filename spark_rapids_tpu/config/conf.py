@@ -324,9 +324,10 @@ JOIN_EXACT_LONG_STRINGS = register(
 # --- shuffle transport (ref RapidsConf.scala:520-601) ----------------------
 SHUFFLE_FETCH_RETRIES = register(
     "spark.rapids.shuffle.maxFetchRetries", int, 3,
-    "Bounded task-level retries when a shuffle block fetch fails over the "
-    "transport before the error propagates (the in-process analogue of "
-    "the reference mapping transport errors into Spark's stage retry).")
+    "Bounded retries PER PEER GROUP when a shuffle fetch fails over the "
+    "transport before the error propagates: a failure re-fetches only "
+    "that peer's blocks (the in-process analogue of the reference "
+    "mapping transport errors into Spark's stage retry).")
 
 SHUFFLE_TRANSPORT_ENABLED = register(
     "spark.rapids.shuffle.transport.enabled", _to_bool, False,

@@ -1076,30 +1076,39 @@ class TpuShuffleExchangeExec(TpuExec):
                         ShuffleFetchFailedError,
                     )
                     shuffle_id, statuses = materialize_manager()
-                    # bounded task retry on fetch failure — the in-process
+                    # reduce with bounded PER-PEER retry — the in-process
                     # analogue of mapping transport errors into Spark's
                     # stage-retry path (RapidsShuffleClient.scala:409-418
-                    # -> RapidsShuffleFetchFailedException). The blocks
-                    # live in the spillable shuffle catalog, so a rerun
-                    # re-fetches the same registered data.
+                    # -> RapidsShuffleFetchFailedException). Each peer
+                    # group moves in ONE metadata/transfer round trip
+                    # (RapidsCachingReader groups per BlockManagerId) and
+                    # a failure re-fetches only that peer's blocks (they
+                    # live in the spillable map-side catalog), never data
+                    # already fetched. The pieces still concatenate into
+                    # ONE wide batch before yielding — deliberate:
+                    # downstream joins/aggregates run one wide kernel
+                    # instead of per-fragment dispatches (same trade as
+                    # the collapse path).
                     max_retries = ctx.conf.get_int(
                         "spark.rapids.shuffle.maxFetchRetries", 3)
-                    attempt = 0
-                    while True:
-                        try:
-                            reader = CachingShuffleReader(
-                                ctx.session.shuffle_env)
-                            batches = list(reader.read(shuffle_id, pid,
-                                                       statuses))
-                            break
-                        except ShuffleFetchFailedError as e:
-                            attempt += 1
-                            if attempt > max_retries:
-                                raise
-                            import logging
-                            logging.getLogger(__name__).warning(
-                                "shuffle fetch failed (%s); retrying "
-                                "%d/%d", e, attempt, max_retries)
+                    reader = CachingShuffleReader(ctx.session.shuffle_env)
+                    batches = []
+                    for peer, group in reader.peer_groups(statuses):
+                        attempt = 0
+                        while True:
+                            try:
+                                got = reader.read_group(
+                                    shuffle_id, pid, peer, group)
+                                break
+                            except ShuffleFetchFailedError as e:
+                                attempt += 1
+                                if attempt > max_retries:
+                                    raise
+                                import logging
+                                logging.getLogger(__name__).warning(
+                                    "shuffle fetch failed (%s); retrying "
+                                    "%d/%d", e, attempt, max_retries)
+                        batches.extend(got)
                     if not batches:
                         yield DeviceBatch.empty(schema)
                         return

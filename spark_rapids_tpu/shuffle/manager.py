@@ -113,20 +113,48 @@ class CachingShuffleReader:
     def __init__(self, env: ShuffleEnv):
         self.env = env
 
-    def read(self, shuffle_id: int, partition_id: int,
-             map_statuses: List[MapStatus]) -> Iterator[DeviceBatch]:
-        # group remote blocks per peer (RapidsCachingReader groups per
-        # BlockManagerId the same way)
-        remote: Dict[str, List[Tuple[int, int, int]]] = {}
+    def peer_groups(self, map_statuses: List[MapStatus]):
+        """[(peer_or_None, [MapStatus, ...])]: local blocks first (peer
+        None), then one group per remote peer — the fetch AND retry
+        granule (the reference groups per BlockManagerId the same way,
+        RapidsCachingReader.scala:170, and registers per-peer fetch
+        handlers, RapidsShuffleIterator.scala:46-341)."""
+        local: List[MapStatus] = []
+        remote: Dict[str, List[MapStatus]] = {}
         for ms in map_statuses:
             if ms.executor_id == self.env.executor_id:
-                for batch in self.env.shuffle_catalog.acquire_batches(
-                        shuffle_id, ms.map_id, partition_id):
-                    yield batch
+                local.append(ms)
             else:
-                remote.setdefault(ms.executor_id, []).append(
-                    (shuffle_id, ms.map_id, partition_id))
-        for peer, blocks in remote.items():
-            client = self.env.client_for(peer)
-            for bid in client.fetch_blocks(blocks):
-                yield self.env.received_catalog.acquire_batch(bid)
+                remote.setdefault(ms.executor_id, []).append(ms)
+        groups: List[Tuple[Optional[str], List[MapStatus]]] = []
+        if local:
+            groups.append((None, local))
+        groups.extend(remote.items())
+        return groups
+
+    def read_group(self, shuffle_id: int, partition_id: int,
+                   peer: Optional[str],
+                   group: List[MapStatus]) -> List[DeviceBatch]:
+        """One peer group's blocks (all its maps in ONE metadata/transfer
+        round trip). Remote batches are freed from the received catalog
+        on acquisition — consumption is final; a retried task re-fetches
+        from the map side, which keeps its registered blocks."""
+        if peer is None:
+            out: List[DeviceBatch] = []
+            for ms in group:
+                out.extend(self.env.shuffle_catalog.acquire_batches(
+                    shuffle_id, ms.map_id, partition_id))
+            return out
+        client = self.env.client_for(peer)
+        blocks = [(shuffle_id, ms.map_id, partition_id) for ms in group]
+        batches = []
+        for bid in client.fetch_blocks(blocks):
+            batches.append(self.env.received_catalog.acquire_batch(bid))
+            self.env.received_catalog.remove_batch(bid)
+        return batches
+
+    def read(self, shuffle_id: int, partition_id: int,
+             map_statuses: List[MapStatus]) -> Iterator[DeviceBatch]:
+        for peer, group in self.peer_groups(map_statuses):
+            yield from self.read_group(shuffle_id, partition_id, peer,
+                                       group)

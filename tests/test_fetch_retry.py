@@ -35,20 +35,20 @@ def _df():
 def test_fetch_failure_retries_then_succeeds(manager_session, monkeypatch):
     df = _df()
     q = _manager_query(manager_session, df)
-    real_read = shuffle_manager.CachingShuffleReader.read
+    real_read = shuffle_manager.CachingShuffleReader.read_group
     fails = {"n": 2}
     calls = {"n": 0}
 
-    def flaky_read(self, shuffle_id, partition_id, statuses):
+    def flaky_read(self, shuffle_id, partition_id, peer, group):
         calls["n"] += 1
         if fails["n"] > 0:
             fails["n"] -= 1
             raise ShuffleFetchFailedError(
                 f"injected fetch failure #{calls['n']}")
-        yield from real_read(self, shuffle_id, partition_id, statuses)
+        return real_read(self, shuffle_id, partition_id, peer, group)
 
-    monkeypatch.setattr(shuffle_manager.CachingShuffleReader, "read",
-                        flaky_read)
+    monkeypatch.setattr(shuffle_manager.CachingShuffleReader,
+                        "read_group", flaky_read)
     out = q.collect().sort_values("k").reset_index(drop=True)
     assert calls["n"] >= 3  # two failures + the successful attempt
     exp = (df.groupby("k").agg(s=("v", "sum"), c=("v", "count"))
@@ -60,10 +60,11 @@ def test_fetch_failure_retries_then_succeeds(manager_session, monkeypatch):
 
 def test_fetch_failure_exhausts_retries(manager_session, monkeypatch):
     q = _manager_query(manager_session, _df())
+    def always_fail(self, *a):
+        raise ShuffleFetchFailedError("always failing")
     monkeypatch.setattr(
-        shuffle_manager.CachingShuffleReader, "read",
-        lambda self, *a: (_ for _ in ()).throw(
-            ShuffleFetchFailedError("always failing")))
+        shuffle_manager.CachingShuffleReader, "read_group",
+        always_fail)
     manager_session.set_conf("spark.rapids.shuffle.maxFetchRetries", 1)
     try:
         with pytest.raises(ShuffleFetchFailedError):
