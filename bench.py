@@ -18,6 +18,7 @@ Env knobs:
 import json
 import math
 import os
+import sys
 import time
 
 
@@ -163,7 +164,6 @@ def main():
                 # second full run and being silently absorbed.
                 if not _is_transient(first):
                     raise
-                import sys
                 print(f"bench: {q} transient failure "
                       f"({type(first).__name__}: {first}); retrying",
                       file=sys.stderr)
@@ -185,6 +185,8 @@ def main():
                      "speedup": round(sp, 3)}
         if retried:
             detail[q]["retried"] = True
+        print(f"bench: {q} tpu={tpu_s:.2f}s cpu={cpu_s:.2f}s "
+              f"speedup={sp:.2f}x", file=sys.stderr, flush=True)
 
     if not speedups:
         print(json.dumps({

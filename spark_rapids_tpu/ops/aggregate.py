@@ -195,45 +195,53 @@ def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
 def _sorted_payload_reduce(batch: DeviceBatch, key_idx: List[int],
                            reductions: List[Tuple[str, int, DType]],
                            out_schema: Schema, live=None) -> DeviceBatch:
-    """High-cardinality keyed aggregation: ONE multi-operand ``lax.sort``
-    carries every reduction input column alongside the EXACT key images,
-    group boundaries come from adjacent-image comparison, and every
-    reduction runs as a segment op over SORTED segment ids.
+    """High-cardinality keyed aggregation in sorted space.
 
-    Why this path exists (measured on TPU at 4M rows / 1.25M groups): a
-    capacity-width segment op keyed by ROW-SPACE (randomly ordered) ids
-    costs ~5.7s — the scatter cannot coalesce — while the same op keyed by
-    sorted ids costs ~50ms. Sorting the values WITH the keys (extra sort
-    payloads are nearly free, ~0.4ms/operand dispatch) buys every
-    downstream reduction the sorted-id fast case, the whole step landing
-    at ~0.6s vs ~5.7s for the row-space design. The reference leans on
-    cuDF's hash aggregation (aggregate.scala:338-396) which has no TPU
-    analogue; this is the sort-based recipe re-tuned for XLA's scatter
-    lowering.
+    Shape (each step chosen for how XLA:TPU compiles, all measured):
+      1. group_rows' 4-operand hash sort assigns the sorted order — the
+         SAME compiled sort every other grouping path uses (a lax.sort
+         gains ~25-150s of COMPILE time per extra operand at >=512k rows
+         on this backend, so the wide carry-everything-through-the-sort
+         spelling is unusable: 2 keys + 12 payloads measured 301s to
+         compile);
+      2. every reduction input and the exact key images move to sorted
+         space with dtype-grouped PACKED gathers (compile-cheap, ~100ms
+         run at 4M);
+      3. group boundaries = the hash boundaries REFINED by adjacent-image
+         comparison, so two keys are merged only when every exact image
+         agrees — at least as strong as the dual-hash grouping this
+         replaces (fixed-width keys: image = value, exact; strings:
+         prefix8+length+both poly hashes). The refinement can only ever
+         SPLIT a hash collision, never merge distinct keys; an
+         interleaved collision (probability ~2^-128) splits a group into
+         runs rather than corrupting it;
+      4. every reduction runs as a segment op over SORTED ids — ~100x
+         cheaper than the row-space scatters of the old design (measured
+         5.7s -> 0.05s per op at 4M rows / 1.25M groups).
 
-    Grouping equality is EXACT for fixed-width keys (the image is the
-    value; floats normalize -0.0/NaN first) and for strings up to 8 bytes
-    (prefix+length images), with the dual 64-bit poly hashes as tiebreak
-    beyond — strictly stronger than the dual-hash-only grouping of the
-    sort branch it replaces. Null keys group separately via a per-key
-    validity signature word."""
+    The reference leans on cuDF's hash aggregation
+    (aggregate.scala:338-396) which has no TPU analogue; this is the
+    sort-based recipe re-tuned for XLA's scatter and sort lowering."""
     from spark_rapids_tpu.ops import hashing
     from spark_rapids_tpu.ops.pallas_kernels import compact_permutation
     from spark_rapids_tpu.ops.rowops import gather_columns
-    from spark_rapids_tpu.ops.sortops import u64_key_image
+    from spark_rapids_tpu.ops.sortops import string_prefix8, u64_key_image
 
     capacity = batch.capacity
     if live is None:
         live = batch.row_mask()
-    dead = (~live).astype(jnp.uint8)
     pos = jnp.arange(capacity, dtype=jnp.int32)
 
+    info = gb.group_rows(batch, key_idx, compute_rep=False, live=live)
+    perm = info.perm
+
+    # exact key images + per-key validity signature, gathered to sorted
+    # space alongside the reduction inputs in dtype-grouped packed gathers
     imgs: List[jnp.ndarray] = []
     nullsig = jnp.zeros((capacity,), jnp.uint32)
     for j, ki in enumerate(key_idx):
         col = batch.columns[ki]
         if col.dtype.is_string:
-            from spark_rapids_tpu.ops.sortops import string_prefix8
             lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
             h1, h2 = hashing.string_poly_hashes(col.offsets, col.data,
                                                 col.validity)
@@ -241,51 +249,46 @@ def _sorted_payload_reduce(batch: DeviceBatch, key_idx: List[int],
         else:
             per = u64_key_image(col)
         # canonical image for null rows; real values sharing it are told
-        # apart by the validity signature below
-        per = [jnp.where(col.validity, im, jnp.uint64(0)) for im in per]
-        imgs.extend(per)
+        # apart by the validity signature
+        imgs.extend(jnp.where(col.validity, im, jnp.uint64(0))
+                    for im in per)
         nullsig = nullsig | (col.validity.astype(jnp.uint32)
                              << jnp.uint32(j))
 
-    # distinct reduction input columns ride the sort as payloads
-    payload_cols = []
-    payload_pos = {}
+    payload_cols: List[int] = []
+    payload_pos: dict = {}
     for _kind, ci, _dt in reductions:
         if ci not in payload_pos:
             payload_pos[ci] = len(payload_cols)
             payload_cols.append(ci)
-    payloads = []
+    vectors: List[jnp.ndarray] = list(imgs) + [nullsig]
     for ci in payload_cols:
         col = batch.columns[ci]
         if col.dtype.is_string:
-            # only count_valid consumes string inputs here (string min/max
-            # take the sorted-space path); the char slab can't ride a row
-            # sort, so the validity stands in for the data payload
-            d = col.validity.astype(jnp.int8)
+            # only count_valid consumes string inputs here (string
+            # min/max take the sorted-space path); validity stands in
+            d = col.validity
         else:
             d = col.data
-            if d.dtype == jnp.bool_:
-                d = d.astype(jnp.int8)
-        payloads.extend([d, col.validity.astype(jnp.int8)])
+        vectors.extend([d, col.validity])
+    from spark_rapids_tpu.ops.rowops import packed_gather_vectors
+    gathered = packed_gather_vectors(vectors, perm)
+    imgs_s = gathered[:len(imgs)]
+    nullsig_s = gathered[len(imgs)]
+    payloads_s = gathered[len(imgs) + 1:]
 
-    keys = (dead, nullsig) + tuple(imgs) + (pos,)
-    out = jax.lax.sort(keys + tuple(payloads), num_keys=len(keys),
-                       is_stable=False)  # pos makes the order total
-    dead_s = out[0]
-    nullsig_s = out[1]
-    imgs_s = out[2:2 + len(imgs)]
-    pos_s = out[2 + len(imgs)]
-    payloads_s = out[3 + len(imgs):]
-    live_s = dead_s == 0
-
-    same = jnp.concatenate([jnp.zeros((1,), jnp.bool_),
-                            nullsig_s[1:] == nullsig_s[:-1]])
+    # refined boundaries: hash boundary OR any exact image disagreement
+    # (group_rows' boundary is already masked to live rows; the
+    # refinement must be too — dead rows sort last)
+    dead_slot = _sorted_dead_mask(info, live)
+    differs = jnp.concatenate([jnp.zeros((1,), jnp.bool_),
+                               nullsig_s[1:] != nullsig_s[:-1]])
     for img_s in imgs_s:
-        same = same & jnp.concatenate(
-            [jnp.zeros((1,), jnp.bool_), img_s[1:] == img_s[:-1]])
-    boundary = live_s & ~same
+        differs = differs | jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), img_s[1:] != img_s[:-1]])
+    boundary = (info.boundary | differs) & ~dead_slot
     gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    sid = jnp.where(live_s, jnp.clip(gid, 0, capacity - 1), capacity)
+    sid = jnp.where(dead_slot, capacity, jnp.clip(gid, 0, capacity - 1))
     num_groups = boundary.sum().astype(jnp.int32)
     group_live = pos < num_groups
 
@@ -295,27 +298,38 @@ def _sorted_payload_reduce(batch: DeviceBatch, key_idx: List[int],
 
     # key output columns: one packed gather at the groups' first rows
     slot_perm, _n = compact_permutation(boundary)
-    rep_row = pos_s[slot_perm]
+    rep_row = perm[slot_perm]
     out_cols = gather_columns([batch.columns[ki] for ki in key_idx],
                               rep_row, group_live)
 
+    live_slot = ~dead_slot
     for kind, ci, out_dt in reductions:
         pi = payload_pos[ci] * 2
         data_s, valid_s = payloads_s[pi], payloads_s[pi + 1] != 0
-        if batch.columns[ci].data.dtype == jnp.bool_:
+        src_dtype = batch.columns[ci].data.dtype
+        if src_dtype == jnp.bool_ and data_s.dtype != jnp.bool_:
             data_s = data_s != 0
         if batch.columns[ci].dtype.is_string:
-            # only count_valid reaches here (string min/max take the
-            # sorted-space path); the payload pair carries validity twice
+            # only count_valid reaches here; the payload pair carries
+            # validity twice
             data, validity = _seg_reduce_kind(
-                "count_valid", valid_s, valid_s & live_s, live_s, seg, pos,
-                lambda x: x, capacity, capacity, out_dt)
+                "count_valid", valid_s, valid_s & live_slot, live_slot,
+                seg, pos, lambda x: x, capacity, capacity, out_dt)
         else:
             data, validity = _seg_reduce_kind(
-                kind, data_s, valid_s & live_s, live_s, seg, pos,
+                kind, data_s, valid_s & live_slot, live_slot, seg, pos,
                 lambda x: x, capacity, capacity, out_dt)
         out_cols.append(DeviceColumn(out_dt, data, validity & group_live))
     return DeviceBatch(out_schema, out_cols, num_groups)
+
+
+def _sorted_dead_mask(info: "gb.GroupInfo", live) -> jnp.ndarray:
+    """bool per SORTED slot: the slot holds a dead (padding or
+    filtered-out) row. group_rows sorts dead rows last, so the mask is
+    one gather-free comparison against the live count."""
+    capacity = info.perm.shape[0]
+    n_live = jnp.sum(live.astype(jnp.int32))
+    return jnp.arange(capacity, dtype=jnp.int32) >= n_live
 
 
 def _dict_matmul_reduce(batch: DeviceBatch, key_idx: List[int],

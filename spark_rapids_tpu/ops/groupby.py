@@ -199,12 +199,20 @@ def segment_select_string(kind: str, col, info: GroupInfo
         # invalid rows must sort strictly last within the group: the image
         # sentinel alone cannot guarantee it for max, where a valid empty
         # string's inverted image is also all-ones and an earlier null row
-        # would stably win the boundary slot
+        # would stably win the boundary slot. Wide string keys (9 image
+        # operands) take the LSD path inside lexsort_permutation — a
+        # direct multi-operand sort compiles pathologically at large
+        # capacities on TPU.
+        from spark_rapids_tpu.ops.rowops import packed_gather_vectors
+        from spark_rapids_tpu.ops.sortops import lexsort_permutation
         invalid_key = (~val_s).astype(jnp.uint8)
-        keys = (gid, invalid_key) + tuple(imgs)
-        out = jax.lax.sort(keys + (info.perm, val_s), num_keys=len(keys),
-                           is_stable=True)
-        imgs_s, orig_new, val_new = out[2:-2], out[-2], out[-1]
+        keys = [gid, invalid_key] + list(imgs)
+        p2 = lexsort_permutation(keys)
+        gathered = packed_gather_vectors(
+            list(imgs) + [info.perm, val_s], p2)
+        imgs_s = gathered[:len(imgs)]
+        orig_new = gathered[len(imgs)]
+        val_new = gathered[len(imgs) + 1] != 0
         # gid sequence is unchanged by the re-sort, so the original group
         # boundaries still mark each group's first (= winning) slot
         rows = seg(jax.ops.segment_sum,

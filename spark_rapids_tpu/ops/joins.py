@@ -112,13 +112,16 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
     # them — runs ~3x SLOWER than this union sort on TPU, because u64
     # comparisons are emulated and searchsorted lowers to a per-element
     # binary search. The union sort exists precisely so the searchsorted
-    # below runs on dense int32 ids.
+    # below runs on dense int32 ids. Wide keys (multi-column / string)
+    # take LSD passes inside lexsort_permutation — a direct multi-operand
+    # sort gains ~25-150s of COMPILE time per operand at >=512k rows.
+    from spark_rapids_tpu.ops.rowops import packed_gather_vectors
+    from spark_rapids_tpu.ops.sortops import lexsort_permutation
     imgs = [jnp.concatenate([bi, si]) for bi, si in zip(b_imgs, s_imgs)]
     invalid = (~jnp.concatenate([bkv, skv])).astype(jnp.uint8)
-    pos = jnp.arange(nb + ns, dtype=jnp.int32)
-    out = jax.lax.sort((invalid,) + tuple(imgs) + (pos,),
-                       num_keys=1 + len(imgs), is_stable=True)
-    inv_s, imgs_s, perm = out[0], out[1:-1], out[-1]
+    perm = lexsort_permutation([invalid] + imgs)
+    sorted_vecs = packed_gather_vectors([invalid] + imgs, perm)
+    inv_s, imgs_s = sorted_vecs[0], sorted_vecs[1:]
     valid_s = inv_s == 0
     # position 0 is always a group start; later positions start a group
     # when any image differs from the previous row's
@@ -171,10 +174,10 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
                                          jnp.asarray(0, jnp.uint8))
                         img = (img << jnp.uint64(8)) | byte.astype(jnp.uint64)
                     ext_imgs.append(img)
-            keys2 = (invalid,) + tuple(imgs) + tuple(ext_imgs) + (pos,)
-            out2 = jax.lax.sort(keys2, num_keys=len(keys2) - 1,
-                                is_stable=True)
-            inv2, all_s, perm2 = out2[0], out2[1:-1], out2[-1]
+            ops2 = [invalid] + list(imgs) + list(ext_imgs)
+            perm2 = lexsort_permutation(ops2)
+            sorted2 = packed_gather_vectors(ops2, perm2)
+            inv2, all_s = sorted2[0], sorted2[1:]
             valid2 = inv2 == 0
             d2 = jnp.zeros(inv2.shape, jnp.bool_).at[0].set(True)
             for img_s2 in all_s:

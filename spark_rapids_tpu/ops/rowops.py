@@ -29,6 +29,30 @@ def rank_of_iota(sorted_vals: jnp.ndarray, out_len: int) -> jnp.ndarray:
     return jnp.cumsum(hist[:out_len]).astype(jnp.int32)
 
 
+def packed_gather_vectors(vectors: Sequence[jnp.ndarray],
+                          perm: jnp.ndarray) -> List[jnp.ndarray]:
+    """Gather many same-length raw vectors by one index vector with
+    dtype-grouped STACKED gathers (the gather_columns trick without the
+    column wrapper): a (n, k) row gather moves k lane-contiguous elements
+    per index — 4-6x cheaper than k separate 1-D gathers on TPU. Bool
+    inputs ride as int8 (callers convert back)."""
+    groups: dict = {}
+    for i, v in enumerate(vectors):
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.int8)
+        groups.setdefault(str(v.dtype), []).append((i, v))
+    out: List[jnp.ndarray] = [None] * len(vectors)
+    for _dt, items in groups.items():
+        if len(items) == 1:
+            i, v = items[0]
+            out[i] = v[perm]
+        else:
+            m = jnp.stack([v for _i, v in items], axis=1)[perm, :]
+            for j, (i, _v) in enumerate(items):
+                out[i] = m[:, j]
+    return out
+
+
 def gather_columns(cols: Sequence[DeviceColumn], perm: jnp.ndarray,
                    live: jnp.ndarray,
                    char_caps: Sequence[int] = ()) -> List[DeviceColumn]:

@@ -101,22 +101,53 @@ def string_prefix8(col: DeviceColumn) -> jnp.ndarray:
     return img
 
 
+# operand-count ceiling for the direct one-shot lax.sort: XLA:TPU sort
+# COMPILE time grows ~25-150s per extra operand at >=512k rows (measured
+# 54s at 4, 176s at 8, 301s at 14 operands — q16's 3-string ORDER BY
+# would build a 30+-operand sort and "hang" for tens of minutes). Wider
+# keys take the LSD path below: chained 2-operand stable sorts, which
+# XLA dedupes into ONE compiled sort (8 passes measured the same ~19s
+# compile as a single pass, 0.14s warm at 512k).
+MAX_DIRECT_SORT_OPERANDS = 5
+
+
+def lexsort_permutation(operands: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Stable lexicographic argsort over operand vectors (priority
+    order). Direct multi-operand sort for narrow keys; LSD passes
+    (least-significant key first, each a stable 2-operand sort) for wide
+    ones — identical ordering, bounded compile time (see
+    MAX_DIRECT_SORT_OPERANDS)."""
+    ops = list(operands)
+    capacity = ops[0].shape[0]
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    if len(ops) + 1 <= MAX_DIRECT_SORT_OPERANDS:
+        results = jax.lax.sort(tuple(ops) + (idx,),
+                               num_keys=len(ops), is_stable=True)
+        return results[-1]
+    perm = idx
+    for key in reversed(ops):
+        _, perm = jax.lax.sort((key[perm], perm), num_keys=1,
+                               is_stable=True)
+    return perm
+
+
+def lexsort_live_last(operands: Sequence[jnp.ndarray],
+                      dead: jnp.ndarray) -> jnp.ndarray:
+    """lexsort_permutation with dead rows sorted last."""
+    return lexsort_permutation([dead] + list(operands))
+
+
 def sort_permutation(batch: DeviceBatch,
                      key_indices: Sequence[int],
                      ascending: Sequence[bool],
                      nulls_first: Sequence[bool]) -> jnp.ndarray:
     """Row permutation sorting live rows; padding rows sort to the end."""
-    capacity = batch.capacity
     live = batch.row_mask()
     # dead rows last, always; then the shared key operands (also used for
     # range partitioning so bounds compare exactly like this sort)
-    operands: List[jnp.ndarray] = [(~live).astype(jnp.uint8)]
-    operands.extend(sort_key_operands(batch, key_indices, ascending,
-                                      nulls_first))
-    idx = jnp.arange(capacity, dtype=jnp.int32)
-    results = jax.lax.sort(tuple(operands) + (idx,),
-                           num_keys=len(operands), is_stable=True)
-    return results[-1]
+    return lexsort_live_last(
+        sort_key_operands(batch, key_indices, ascending, nulls_first),
+        (~live).astype(jnp.uint8))
 
 
 def sort_batch(batch: DeviceBatch, key_indices: Sequence[int],
