@@ -50,6 +50,34 @@ def _substitute(e: Expression, bindings: List[Expression],
     return out
 
 
+def fuse_selection_into_filter(plan: PhysicalPlan, conf) -> PhysicalPlan:
+    """Rewrite TpuProjectExec(pure column refs)(TpuFilterExec(child)) into
+    one TpuFilterExec with an output selection: the filter's row
+    compaction then gathers ONLY the selected columns, so predicate-only
+    columns (string char slabs especially) are never moved. The
+    narrowing projects come from the logical column-pruning pass
+    (sql/pushdown.py prune_filter_columns)."""
+    from spark_rapids_tpu.exec import tpu as tpuexec
+    from spark_rapids_tpu.sql.exprs.core import BoundRef
+
+    def walk(node: PhysicalPlan) -> PhysicalPlan:
+        node = node.map_children(walk)
+        if not isinstance(node, tpuexec.TpuProjectExec):
+            return node
+        child = node.children[0]
+        if not (isinstance(child, tpuexec.TpuFilterExec)
+                and not child._impure and child.out_sel is None):
+            return node
+        if not all(isinstance(e, BoundRef) for _n, e in node.exprs):
+            return node
+        names = [n for n, _ in node.exprs]
+        idx = [e.index for _n, e in node.exprs]
+        return tpuexec.TpuFilterExec(child.children[0], child.condition,
+                                     out_sel=(tuple(names), tuple(idx)))
+
+    return walk(plan)
+
+
 def fuse_filter_into_aggregate(plan: PhysicalPlan, conf) -> PhysicalPlan:
     """Rewrite partial TpuHashAggregateExec(TpuProjectExec* (TpuFilterExec
     (child))) into a fused aggregate with the projects substituted and the
@@ -75,10 +103,19 @@ def fuse_filter_into_aggregate(plan: PhysicalPlan, conf) -> PhysicalPlan:
             grouping = [(n, e) for n, e in node.plan.grouping]
             results = [(n, e) for n, e in node.plan.results]
             # fold each intervening projection into the aggregate's
-            # expressions (innermost project last)
-            for proj in projects:
-                bindings = [e for _, e in proj.exprs]
-                names = [n for n, _ in proj.exprs]
+            # expressions (innermost project last); a selection fused
+            # into the filter (out_sel) acts as one more projection
+            # mapping the narrowed ordinals back to the full child schema
+            sub_projects = [(list(p.exprs)) for p in projects]
+            if c.out_sel is not None:
+                names_sel, idx_sel = c.out_sel
+                full = new_child.output_schema()
+                sub_projects.append(
+                    [(n, BoundRef(i, full.dtypes[i], n))
+                     for n, i in zip(names_sel, idx_sel)])
+            for exprs in sub_projects:
+                bindings = [e for _, e in exprs]
+                names = [n for n, _ in exprs]
                 memo: dict = {}
                 grouping = [(n, _substitute(e, bindings, names, memo))
                             for n, e in grouping]

@@ -76,12 +76,15 @@ def _concat_device(batches: List[DeviceBatch], schema: Schema,
 
 
 def _fused_filter_source(node: PhysicalPlan, ctx: ExecContext):
-    """(source node, mask kernel) for the exchange/broadcast collapse
-    concat: a deterministic TpuFilterExec directly below folds its
-    predicate into the concat's single compaction gather instead of
+    """(source node, mask kernel, out_sel) for the exchange/broadcast
+    collapse concat: a deterministic TpuFilterExec directly below folds
+    its predicate into the concat's single compaction gather instead of
     paying per-batch per-column compaction gathers (~5M rows/s on TPU) —
     the exchange-side sibling of fuse_filter_into_aggregate
-    (exec/fusion.py). Returns (node, None) when nothing fuses."""
+    (exec/fusion.py). ``out_sel`` is the filter's fused output selection
+    (fuse_selection_into_filter); the caller applies it as a zero-copy
+    column view before the concat. Returns (node, None, None) when
+    nothing fuses."""
     if (isinstance(node, TpuFilterExec) and not node._impure
             and ctx.conf.get_bool(
                 "spark.rapids.sql.exchange.fuseFilter", True)):
@@ -94,8 +97,18 @@ def _fused_filter_source(node: PhysicalPlan, ctx: ExecContext):
                 pred = to_device_column(ectx, cond.eval_device(ectx))
                 return pred.data & pred.validity & batch.row_mask()
             return jax.jit(mask)
-        return node.children[0], cached_jit(sig, build)
-    return node, None
+        return node.children[0], cached_jit(sig, build), node.out_sel
+    return node, None, None
+
+
+def _select_view(batch: DeviceBatch, out_sel) -> DeviceBatch:
+    """Zero-copy column selection (no device op)."""
+    if out_sel is None:
+        return batch
+    names, idx = out_sel
+    return DeviceBatch(
+        Schema(list(names), [batch.schema.dtypes[i] for i in idx]),
+        [batch.columns[i] for i in idx], batch.num_rows)
 
 
 def _split_by_pid(batch: DeviceBatch, pid: jnp.ndarray, n: int):
@@ -159,31 +172,51 @@ class TpuProjectExec(TpuExec):
 
 
 class TpuFilterExec(TpuExec):
-    """reference: GpuFilterExec (basicPhysicalOperators.scala:126)."""
+    """reference: GpuFilterExec (basicPhysicalOperators.scala:126).
 
-    def __init__(self, child: PhysicalPlan, condition: Expression):
+    ``out_sel``: optional (names, indices) output selection fused from a
+    pure-column Project above (exec/fusion.py fuse_selection_into_filter):
+    the predicate evaluates over the FULL input, but the row compaction
+    gathers ONLY the selected columns — predicate-only columns (string
+    slabs especially) are never moved."""
+
+    def __init__(self, child: PhysicalPlan, condition: Expression,
+                 out_sel=None):
         super().__init__([child])
         self.condition = condition
+        self.out_sel = out_sel
 
         def kernel(batch: DeviceBatch) -> DeviceBatch:
             ctx = make_context(batch)
             pred = to_device_column(ctx, condition.eval_device(ctx))
             keep = pred.data & pred.validity
-            return rowops.filter_batch(batch, keep)
+            return rowops.filter_batch(_select_view(batch, out_sel), keep)
         from spark_rapids_tpu.sql.exprs.nondet import has_nondeterministic
         self._impure = has_nondeterministic(condition)
         if self._impure:
             # see TpuProjectExec: task-local state must be read at call time
             self._kernel = kernel
         else:
-            sig = "filter|" + expr_signature(condition)
+            # names participate in the cache key: the closure bakes the
+            # output Schema, so an aliased selection must not hit a
+            # same-ordinal kernel compiled under different names
+            sel_sig = ("" if out_sel is None
+                       else f"|sel={tuple(out_sel[1])}"
+                            f":{','.join(out_sel[0])}")
+            sig = "filter|" + expr_signature(condition) + sel_sig
             self._kernel = cached_jit(sig, lambda: jax.jit(kernel))
 
     def output_schema(self) -> Schema:
-        return self.children[0].output_schema()
+        cs = self.children[0].output_schema()
+        if self.out_sel is None:
+            return cs
+        names, idx = self.out_sel
+        return Schema(list(names), [cs.dtypes[i] for i in idx])
 
     def describe(self) -> str:
-        return f"TpuFilterExec({self.condition!r})"
+        sel = ("" if self.out_sel is None
+               else f", sel={list(self.out_sel[0])}")
+        return f"TpuFilterExec({self.condition!r}{sel})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         from spark_rapids_tpu.exec import taskctx
@@ -890,7 +923,7 @@ class TpuShuffleExchangeExec(TpuExec):
             if not self._padded_producer(self.children[0]):
                 # a deterministic Filter directly below folds into the
                 # concat's compaction gather (_fused_filter_source)
-                src_node, mask_kernel = _fused_filter_source(
+                src_node, mask_kernel, out_sel = _fused_filter_source(
                     self.children[0], ctx)
                 fused_parts = (src_node.executed_partitions(ctx)
                                if mask_kernel is not None else child_parts)
@@ -902,6 +935,9 @@ class TpuShuffleExchangeExec(TpuExec):
                         return
                     masks = ([mask_kernel(b) for b in batches]
                              if mask_kernel is not None else None)
+                    if masks is not None and out_sel is not None:
+                        batches = [_select_view(b, out_sel)
+                                   for b in batches]
                     yield _concat_device(batches, schema, growth, masks)
                 return [nosync_concat]
 

@@ -47,16 +47,19 @@ class TpuBroadcastExchangeExec(PhysicalPlan):
 
         def materialize():
             from spark_rapids_tpu.exec.tpu import (
-                _concat_device, _fused_filter_source,
+                _concat_device, _fused_filter_source, _select_view,
             )
-            src_node, mask_kernel = _fused_filter_source(child, ctx)
+            src_node, mask_kernel, out_sel = _fused_filter_source(child, ctx)
             parts = src_node.executed_partitions(ctx)
             batches = [b for p in parts for b in p()]
             if not batches:
                 return _concat_device(batches, child.output_schema(),
                                       growth)
-            masks = ([mask_kernel(b) for b in batches]
-                     if mask_kernel is not None else None)
+            masks = None
+            if mask_kernel is not None:
+                masks = [mask_kernel(b) for b in batches]
+                if out_sel is not None:
+                    batches = [_select_view(b, out_sel) for b in batches]
             return _concat_device(batches, child.output_schema(), growth,
                                   masks)
 

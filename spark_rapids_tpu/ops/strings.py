@@ -40,12 +40,28 @@ def _validity(ctx: EvalContext, v: DevValue) -> jnp.ndarray:
 
 def string_equal_literal(ctx: EvalContext, col: DevCol,
                          lit: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """col == literal. Returns (eq bool vec, validity)."""
+    """col == literal. Returns (eq bool vec, validity).
+
+    Fast paths from upload metadata (no char reads): a dictionary-encoded
+    column compares int32 codes against the literal's host-resolved code;
+    a column carrying prefix8 with a <=8-byte literal compares one u64
+    image + the length. The char-gather spelling ( _match_at: a
+    (capacity, m) indexed gather) only remains for derived columns."""
     pat = lit.encode("utf-8")
     m = len(pat)
+    if getattr(col, "dict_values", None) is not None:
+        try:
+            code = col.dict_values.index(lit)
+        except ValueError:
+            return jnp.zeros(col.validity.shape, jnp.bool_), col.validity
+        return col.dict_codes == jnp.int32(code), col.validity
     lens = lengths_of(col)
     if m == 0:
         return lens == 0, col.validity
+    if getattr(col, "prefix8", None) is not None and m <= 8:
+        img = int.from_bytes(pat.ljust(8, b"\0"), "big")
+        return ((col.prefix8 == jnp.uint64(img)) & (lens == m),
+                col.validity)
     eq = _match_at(col, jnp.asarray(col.offsets[:-1]), pat) & (lens == m)
     return eq, col.validity
 
@@ -68,6 +84,13 @@ def starts_with(ctx: EvalContext, col: DevCol, lit: str):
     lens = lengths_of(col)
     if m == 0:
         return jnp.ones((ctx.capacity,), dtype=jnp.bool_), col.validity
+    if getattr(col, "prefix8", None) is not None and m <= 8:
+        # dense u64 image compare on the upload-computed prefix — no char
+        # reads (see string_equal_literal)
+        want = int.from_bytes(pat, "big")
+        shift = jnp.uint64(8 * (8 - m))
+        return (((col.prefix8 >> shift) == jnp.uint64(want)) & (lens >= m),
+                col.validity)
     eq = _match_at(col, jnp.asarray(col.offsets[:-1]), pat) & (lens >= m)
     return eq, col.validity
 

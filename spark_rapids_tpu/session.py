@@ -229,9 +229,13 @@ class TpuSparkSession:
 
         conf = self.conf
         ctx = ExecContext(conf, self)
-        # projection pushdown: mark file scans with the query's referenced
-        # column subset before planning (sql/pushdown.py)
-        from spark_rapids_tpu.sql.pushdown import annotate_scan_pruning
+        # column pruning (narrowing projects above filters / semi-anti
+        # build sides), then projection pushdown: mark file scans with the
+        # query's referenced column subset before planning (sql/pushdown.py)
+        from spark_rapids_tpu.sql.pushdown import (
+            annotate_scan_pruning, prune_filter_columns,
+        )
+        logical = prune_filter_columns(logical)
         annotate_scan_pruning(logical)
         planner = Planner(conf)
         if isinstance(logical, lp.LogicalLimit):

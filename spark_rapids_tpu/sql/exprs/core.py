@@ -37,15 +37,25 @@ from spark_rapids_tpu.columnar.dtype import DType
 
 
 class DevCol:
-    """Device column value during expression evaluation (traced)."""
+    """Device column value during expression evaluation (traced).
 
-    __slots__ = ("dtype", "data", "validity", "offsets")
+    ``dict_codes``/``dict_values``/``prefix8``: upload-computed metadata
+    carried through from scanned DeviceColumns (columnar/column.py) —
+    string predicates compile to dense code/image compares instead of
+    per-row char gathers when present. Derived values carry None."""
 
-    def __init__(self, dtype: DType, data, validity, offsets=None):
+    __slots__ = ("dtype", "data", "validity", "offsets", "dict_codes",
+                 "dict_values", "prefix8")
+
+    def __init__(self, dtype: DType, data, validity, offsets=None,
+                 dict_codes=None, dict_values=None, prefix8=None):
         self.dtype = dtype
         self.data = data          # (capacity,) or chars for strings
         self.validity = validity  # (capacity,) bool
         self.offsets = offsets    # strings: (capacity+1,) int32
+        self.dict_codes = dict_codes
+        self.dict_values = dict_values
+        self.prefix8 = prefix8
 
     def with_(self, data=None, validity=None, dtype=None) -> "DevCol":
         return DevCol(dtype or self.dtype,

@@ -14,7 +14,9 @@ from spark_rapids_tpu.sql.exprs.core import (
 
 
 def make_context(batch: DeviceBatch) -> EvalContext:
-    cols = [DevCol(c.dtype, c.data, c.validity, c.offsets)
+    cols = [DevCol(c.dtype, c.data, c.validity, c.offsets,
+                   dict_codes=c.dict_codes, dict_values=c.dict_values,
+                   prefix8=c.prefix8)
             for c in batch.columns]
     mask = jnp.arange(batch.capacity, dtype=jnp.int32) < batch.num_rows
     return EvalContext(cols, mask, batch.num_rows, batch.capacity)

@@ -622,11 +622,15 @@ class TransitionOverrides:
 
     def apply(self, plan: PhysicalPlan) -> PhysicalPlan:
         from spark_rapids_tpu.exec.coalesce import insert_coalesce
-        from spark_rapids_tpu.exec.fusion import fuse_filter_into_aggregate
+        from spark_rapids_tpu.exec.fusion import (
+            fuse_filter_into_aggregate, fuse_selection_into_filter,
+        )
         # fuse BEFORE coalesce insertion: a fused-away Filter is no longer
         # a fragmenting producer, so no coalesce node appears above it
         return insert_coalesce(
-            fuse_filter_into_aggregate(self._apply(plan), self.conf),
+            fuse_filter_into_aggregate(
+                fuse_selection_into_filter(self._apply(plan), self.conf),
+                self.conf),
             self.conf)
 
     def _apply(self, plan: PhysicalPlan) -> PhysicalPlan:

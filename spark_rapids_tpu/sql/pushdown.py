@@ -157,6 +157,134 @@ def partition_value_matches(pval, op: str, value) -> bool:
         return True
 
 
+def prune_filter_columns(root):
+    """Classic top-down column pruning, rewritten where it pays most on
+    this engine: a narrowing LogicalProject above every Filter (and
+    semi/anti join build side) whose output carries columns no ancestor
+    references. On TPU the filter's row compaction is a per-column
+    gather (string columns gather their whole char slab), so dead
+    columns — e.g. predicate-only strings like q19's l_shipmode — cost
+    real seconds at scale. The physical layer then folds the pure
+    selection INTO the filter kernel (exec/fusion.py
+    fuse_selection_into_filter) so the dead columns are never gathered
+    at all. Returns the (possibly rewritten) root."""
+    from spark_rapids_tpu.sql import plan as lp
+    from spark_rapids_tpu.sql.window import WindowExpression
+
+    def expr_cols(e, into: set) -> None:
+        if isinstance(e, Col):
+            into.add(e.name)
+        if isinstance(e, WindowExpression):
+            for c in e.spec.partition_cols:
+                expr_cols(c, into)
+            for o in e.spec.orders:
+                expr_cols(o.expr, into)
+            expr_cols(e.fn, into)
+            return
+        for c in getattr(e, "children", ()):
+            expr_cols(c, into)
+
+    def cols_of(*exprs) -> set:
+        out: set = set()
+        for e in exprs:
+            expr_cols(e, out)
+        return out
+
+    def narrow(node, required):
+        """Wrap ``node`` in a name-selection project when its output has
+        columns outside ``required``."""
+        names = node.schema().names
+        keep = [n for n in names if n in required]
+        if keep and len(keep) < len(names):
+            return lp.LogicalProject(node, [(n, Col(n)) for n in keep])
+        return node
+
+    def rewrite(node, required):
+        # ``required``: names the parent needs from this node's output;
+        # None = all (unknown consumer)
+        if isinstance(node, lp.LogicalFilter):
+            out_names = set(node.schema().names)
+            cond_req = cols_of(node.condition)
+            child_req = (None if required is None
+                         else (required | cond_req) & out_names)
+            f = lp.LogicalFilter(rewrite(node.children[0], child_req),
+                                 node.condition)
+            return f if required is None else narrow(f, required)
+        if isinstance(node, lp.LogicalProject):
+            req = cols_of(*(e for _n, e in node.exprs))
+            return lp.LogicalProject(rewrite(node.children[0], req),
+                                     node.exprs)
+        if isinstance(node, lp.LogicalAggregate):
+            req = cols_of(*(e for _n, e in node.grouping),
+                          *(e for _n, e in node.results))
+            return lp.LogicalAggregate(rewrite(node.children[0], req),
+                                       node.grouping, node.results)
+        if isinstance(node, lp.LogicalJoin):
+            lnames = set(node.children[0].schema().names)
+            rnames = set(node.children[1].schema().names)
+            keyreq_l = cols_of(*node.left_keys)
+            keyreq_r = cols_of(*node.right_keys)
+            cond_req = (cols_of(node.condition)
+                        if node.condition is not None else set())
+            if required is None:
+                lreq = None
+                rreq = None
+            else:
+                lreq = ({n for n in required if n in lnames}
+                        | keyreq_l | cond_req) & lnames
+                rreq = ({n for n in required if n in rnames}
+                        | keyreq_r | cond_req) & rnames
+            if node.join_type in ("leftsemi", "leftanti"):
+                # the build side contributes no output columns: always
+                # prunable down to its keys (+ condition inputs)
+                rreq = (keyreq_r | cond_req) & rnames
+            return lp.LogicalJoin(
+                rewrite(node.children[0], lreq),
+                rewrite(node.children[1], rreq),
+                node.join_type, node.left_keys, node.right_keys,
+                node.condition)
+        if isinstance(node, lp.LogicalSort):
+            req = (None if required is None else
+                   (required | cols_of(*(o.expr for o in node.orders)))
+                   & set(node.schema().names))
+            return lp.LogicalSort(rewrite(node.children[0], req),
+                                  node.orders, node.is_global)
+        import copy
+
+        def with_children(n, kids):
+            # never mutate in place: logical nodes are shared by live
+            # DataFrames and may be re-planned with different consumers
+            new = copy.copy(n)
+            new.children = kids
+            return new
+
+        if isinstance(node, (lp.LogicalLimit, lp.LogicalRepartition,
+                             lp.LogicalCoalesce)):
+            return with_children(
+                node, [rewrite(c, required) for c in node.children])
+        if isinstance(node, lp.LogicalUnion):
+            if required is None:
+                return with_children(
+                    node, [rewrite(c, None) for c in node.children])
+            # every branch must end at the SAME narrowed schema (union
+            # concatenates positionally)
+            return with_children(
+                node, [narrow(rewrite(c, required), required)
+                       for c in node.children])
+        if isinstance(node, lp.LogicalWindow):
+            req = (None if required is None else
+                   ({n for n in required
+                     if n in node.children[0].schema().names}
+                    | cols_of(*(w for _n, w in node.window_exprs))))
+            return with_children(node, [rewrite(node.children[0], req)])
+        # unknown/opaque shapes (Expand/Generate/Write/Scan/Range/...):
+        # children keep their full output
+        return with_children(node,
+                             [rewrite(c, None) for c in node.children])
+
+    return rewrite(root, None)
+
+
 def annotate_scan_pruning(root) -> None:
     """Per-query scan annotation: mark each file scan with the column
     subset the query actually references (cleared when the query shape

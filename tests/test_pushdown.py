@@ -138,3 +138,30 @@ def test_no_pruning_on_bare_collect(session, parquet_dir):
     out = session.read.parquet(path).collect()
     assert list(out.columns) == ["id", "v", "s"]
     assert len(out) == len(df)
+
+
+def test_filter_column_pruning_union_and_reuse(session, rng):
+    """prune_filter_columns: union branches narrow to one consistent
+    schema, and the rewrite never mutates logical nodes shared by live
+    DataFrames (a reused DataFrame re-plans cleanly with different
+    consumers)."""
+    import numpy as np
+    import pandas as pd
+    from spark_rapids_tpu.sql import functions as F
+
+    t1 = pd.DataFrame({"a": rng.integers(0, 10, 500),
+                       "b": rng.random(500),
+                       "c": np.array(["x%d" % i
+                                      for i in rng.integers(0, 5, 500)])})
+    u = (session.create_dataframe(t1, 2).filter(F.col("b") > 0.5)
+         .union(session.create_dataframe(t1.copy(), 2)))
+    session.set_conf("spark.rapids.sql.enabled", True)
+    r1 = u.select("a").collect()
+    r2 = u.select("c").collect()       # same DataFrame, new projection
+    r3 = u.collect()                   # and the full schema again
+    session.set_conf("spark.rapids.sql.enabled", False)
+    c1 = u.select("a").collect()
+    c3 = u.collect()
+    assert sorted(r1["a"]) == sorted(c1["a"])
+    assert len(r2) == len(r1)
+    assert list(r3.columns) == list(c3.columns) and len(r3) == len(c3)
