@@ -241,7 +241,11 @@ def _sorted_payload_reduce(batch: DeviceBatch, key_idx: List[int],
     nullsig = jnp.zeros((capacity,), jnp.uint32)
     for j, ki in enumerate(key_idx):
         col = batch.columns[ki]
-        if col.dtype.is_string:
+        if col.dtype.is_string and col.dict_values is not None:
+            # dictionary codes are exact per batch by construction: ONE
+            # image, zero char reads (vs prefix+length+two poly hashes)
+            per = [col.dict_codes.astype(jnp.uint64)]
+        elif col.dtype.is_string:
             lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
             h1, h2 = hashing.string_poly_hashes(col.offsets, col.data,
                                                 col.validity)

@@ -33,17 +33,28 @@ from spark_rapids_tpu.ops import hashing
 from spark_rapids_tpu.ops.rowops import gather_batch, gather_column
 
 
-def row_hashes(batch: DeviceBatch,
-               key_indices: Sequence[int]) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Two independent 64-bit row hashes over the key columns."""
+def row_hashes(batch: DeviceBatch, key_indices: Sequence[int],
+               batch_local: bool = False
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Two independent 64-bit row hashes over the key columns.
+
+    ``batch_local``: the caller only needs consistency WITHIN this batch
+    (grouping), not across batches or tables (exchange partitioning) —
+    dictionary-encoded string columns then hash their int32 codes (exact
+    per batch by construction, zero char reads) instead of running the
+    char-scanning poly hashes. NEVER set for exchange/join partitioning:
+    two tables' dictionaries assign different codes to equal values."""
     h1s, h2s = [], []
     for ki in key_indices:
         col = batch.columns[ki]
-        if col.dtype.is_string:
+        if col.dtype.is_string and not (
+                batch_local and col.dict_values is not None):
             h1, h2 = hashing.string_poly_hashes(col.offsets, col.data,
                                                 col.validity)
         else:
-            h = hashing.hash_fixed_width(col.data, col.validity)
+            data = (col.dict_codes
+                    if col.dtype.is_string else col.data)
+            h = hashing.hash_fixed_width(data, col.validity)
             h1 = h
             h2 = hashing.splitmix64(h ^ jnp.uint64(hashing.SALT2))
         h1s.append(h1)
@@ -68,7 +79,9 @@ def group_rows(batch: DeviceBatch, key_indices: Sequence[int],
     capacity = batch.capacity
     if live is None:
         live = batch.row_mask()
-    h1, h2 = row_hashes(batch, key_indices)
+    # grouping is batch-local: dictionary codes may stand in for string
+    # poly hashes (see row_hashes)
+    h1, h2 = row_hashes(batch, key_indices, batch_local=True)
     # dead rows sort last
     dead = (~live).astype(jnp.uint8)
     idx = jnp.arange(capacity, dtype=jnp.int32)

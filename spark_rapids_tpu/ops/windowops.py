@@ -138,7 +138,9 @@ def window_compute(batch: DeviceBatch, num_child_cols: int,
     def boundaries(idx_cols):
         if not idx_cols:
             return jnp.zeros((cap,), jnp.bool_).at[0].set(True) & live
-        h1, h2 = row_hashes(sorted_b, idx_cols)
+        # adjacent-row comparison within one sorted batch: batch-local,
+        # so dict-encoded keys hash their codes (no char scans)
+        h1, h2 = row_hashes(sorted_b, idx_cols, batch_local=True)
         p1 = jnp.concatenate([h1[:1] ^ jnp.uint64(1), h1[:-1]])
         p2 = jnp.concatenate([h2[:1], h2[:-1]])
         b = ((h1 != p1) | (h2 != p2))
