@@ -168,6 +168,18 @@ class DeviceBatch:
             enc = host_dict_encode_stateful(values, validity, dt, cap,
                                             dict_state, i) \
                 if dict_encode else None
+            if enc is not None and dt.is_string:
+                # only pay the slab scan when a dictionary was actually
+                # built (high-cardinality columns already bailed at the
+                # probe): NUL-bearing data must not be dictionary-encoded
+                # (see string_host_buffers_have_nul)
+                from spark_rapids_tpu.columnar.column import (
+                    string_host_buffers_have_nul,
+                )
+                if string_host_buffers_have_nul(bufs, n):
+                    enc = None
+                    if dict_state is not None:
+                        dict_state[i] = False  # close for the whole scan
             if enc is not None:
                 codes, vals = enc
                 bufs = bufs + (codes,)

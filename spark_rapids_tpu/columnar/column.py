@@ -258,6 +258,19 @@ DICT_MAX_CARD = 256
 _DICT_PROBE = 4096
 
 
+def string_host_buffers_have_nul(bufs, n: int) -> bool:
+    """True when the string host-buffer tuple built by build_host_buffers
+    — (chars, validity, offsets, prefix8), see its string branch above —
+    holds a NUL byte among the first ``n`` rows' chars. Lives beside the
+    layout definition so the positional access cannot silently drift.
+    Used to gate dictionary encoding: pandas 3.x factorize hashes object
+    strings through a NUL-terminated path and MERGES 'a' with 'a\\x00',
+    which would corrupt dictionary-based grouping and comparison."""
+    chars, _validity, offsets = bufs[0], bufs[1], bufs[2]
+    used = int(offsets[n])
+    return bool(used and (chars[:used] == 0).any())
+
+
 def host_dict_encode(values: np.ndarray, validity: Optional[np.ndarray],
                      dtype: DType, capacity: int):
     """Host-side dictionary probe+encode of a column being uploaded.

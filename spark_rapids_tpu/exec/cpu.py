@@ -372,9 +372,19 @@ def sort_key_arrays(df: pd.DataFrame, orders: Sequence[SortOrder]):
     for so in orders:
         vals, validity, _ = host_unary_values(so.expr.eval_host(df))
         if vals.dtype == object:
+            # NUL-exact: numpy '<U' comparison pads with NULs and merges
+            # 'a' with 'a\x00'; dictionary-encode via arrow, rank the
+            # (small) dictionary with python compares
+            import pyarrow as pa
             filled = np.where(validity, vals, "")
-            uniq, inv = np.unique(filled.astype(str), return_inverse=True)
-            img = inv.astype(np.int64)
+            d = (pa.array(filled, type=pa.string(), from_pandas=True)
+                 .dictionary_encode())
+            codes = d.indices.to_numpy(zero_copy_only=False).astype(np.int64)
+            uniq = np.asarray(d.dictionary.to_pylist(), dtype=object)
+            order = np.argsort(uniq)
+            rank = np.empty(len(uniq), dtype=np.int64)
+            rank[order] = np.arange(len(uniq), dtype=np.int64)
+            img = rank[codes]
         elif vals.dtype.kind == "f":
             # exact host image (the CPU oracle models Spark, which orders
             # denormals properly; only the DEVICE image flushes them, an

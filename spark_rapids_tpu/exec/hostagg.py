@@ -25,13 +25,22 @@ def group_codes(keys: Sequence[Tuple[np.ndarray, np.ndarray]]) -> Tuple[np.ndarr
     combined = np.zeros(n, dtype=np.int64)
     for values, validity in keys:
         if values.dtype == object:
+            # NUL-exact string factorization via arrow: pandas 3.x
+            # factorize hashes object strings through a NUL-terminated
+            # path and merges 'a' with 'a\x00'
+            import pyarrow as pa
             vals = np.where(validity, values, "")
+            codes = (pa.array(vals, type=pa.string(), from_pandas=True)
+                     .dictionary_encode().indices
+                     .to_numpy(zero_copy_only=False).astype(np.int64))
         elif values.dtype.kind == "f":
             vals = np.where(validity, np.where(values == 0.0, 0.0, values), 0.0)
+            codes, _ = pd.factorize(vals)
+            codes = codes.astype(np.int64)
         else:
             vals = np.where(validity, values, np.zeros(1, dtype=values.dtype))
-        codes, _ = pd.factorize(vals)
-        codes = codes.astype(np.int64)
+            codes, _ = pd.factorize(vals)
+            codes = codes.astype(np.int64)
         nan_code = codes.max(initial=-1) + 1
         codes = np.where(codes == -1, nan_code, codes)  # NaN group
         codes = np.where(validity, codes + 1, 0)        # NULL group = 0

@@ -245,3 +245,57 @@ class TestDatetime:
 
     def test_unix_timestamp(self, rng):
         check_expr(self._dt_df(rng), F.unix_timestamp(F.col("t")))
+
+
+def test_string_literal_fastpath_edges(session, rng):
+    """Dense string-predicate fast paths (dict codes / prefix8): literals
+    absent from the dictionary, prefix-sharing literals longer than 8
+    bytes, and aliasing 'a' vs 'a\\x00'-style boundaries must all agree
+    with the host oracle."""
+    import numpy as np
+    import pandas as pd
+    from spark_rapids_tpu.sql import functions as F
+
+    vals = np.array(["alpha", "alphabet", "alpha\x00", "b", "", "Brand#12",
+                     "Brand#123", "12345678", "123456789"], dtype=object)
+    pdf = pd.DataFrame({"s": vals[rng.integers(0, len(vals), 4000)]})
+
+    def q(s):
+        df = s.create_dataframe(pdf, 2)
+        return df.select(
+            (F.col("s") == "alpha").alias("eq8"),           # 5B literal
+            (F.col("s") == "123456789").alias("eq9"),       # >8B literal
+            (F.col("s") == "NOT_IN_DICT").alias("eq_miss"),
+            F.col("s").isin("b", "Brand#12", "zzz").alias("isin3"),
+            F.col("s").startswith("alpha").alias("sw5"),
+            F.col("s").startswith("12345678").alias("sw8"),
+            F.col("s").startswith("123456789").alias("sw9"))
+
+    session.set_conf("spark.rapids.sql.enabled", True)
+    tpu = q(session).collect()
+    session.set_conf("spark.rapids.sql.enabled", False)
+    cpu = q(session).collect()
+    for c in tpu.columns:
+        assert (tpu[c].to_numpy() == cpu[c].to_numpy()).all(), c
+
+    # NUL-free low-cardinality data: the dict-code branch itself (the
+    # NUL above disables dictionaries for the whole first column)
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    clean = np.array(["alpha", "alphabet", "b", "", "Brand#12"],
+                     dtype=object)
+    pdf2 = pd.DataFrame({"s": clean[rng.integers(0, len(clean), 4000)]})
+    assert DeviceBatch.from_pandas(pdf2).columns[0].dict_values is not None
+
+    def q2(s):
+        df = s.create_dataframe(pdf2, 2)
+        return df.select(
+            (F.col("s") == "alpha").alias("eq"),
+            (F.col("s") == "NOT_IN_DICT").alias("eq_miss"),
+            F.col("s").isin("b", "Brand#12", "zzz").alias("isin3"))
+
+    session.set_conf("spark.rapids.sql.enabled", True)
+    tpu2 = q2(session).collect()
+    session.set_conf("spark.rapids.sql.enabled", False)
+    cpu2 = q2(session).collect()
+    for c in tpu2.columns:
+        assert (tpu2[c].to_numpy() == cpu2[c].to_numpy()).all(), c
