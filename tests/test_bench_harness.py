@@ -1,0 +1,44 @@
+"""Bench harness isolation: a timed-out query must not poison the ones
+after it (VERDICT r3 weak #6 — the old daemon-thread deadline left a hung
+worker hogging the chip).
+
+Runs the real bench.py as a subprocess against its `_selftest` suite:
+`fast` then `hang` (sleeps past the per-query deadline) then `fast2`.
+The parent must SIGKILL the wedged worker, respawn, and measure fast2
+normally."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+@pytest.mark.smoke
+def test_timeout_kills_worker_and_next_query_unaffected():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BENCH_SUITE="_selftest",
+        BENCH_QUERIES="_selftest.fast,_selftest.hang,_selftest.fast2",
+        BENCH_ITERS="1",
+        BENCH_QUERY_TIMEOUT_S="20",
+        BENCH_SELFTEST_HANG_S="3600",
+    )
+    out = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True, text=True,
+        timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    q = payload["detail"]["queries"]
+    assert "tpu_s" in q["_selftest.fast"], q
+    assert "timed out" in q["_selftest.hang"].get("skipped", ""), q
+    # the query AFTER the timeout ran normally on a fresh worker
+    assert "tpu_s" in q["_selftest.fast2"], q
+    assert q["_selftest.fast2"]["timed_compiles"] == 0
+    # loadavg guard fields present
+    assert "loadavg_before" in payload["detail"]

@@ -1,0 +1,58 @@
+"""Diagnose q2's warm-path: count XLA backend compiles, jit traces, and
+kernel-cache misses during the *timed* iterations (post-warmup), where a
+healthy query should show zero of each."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+COUNTS = {"backend_compile": 0}
+DURS = []
+
+
+def _dur_listener(name, dur, **kw):
+    if "backend_compile" in name:
+        COUNTS["backend_compile"] += 1
+        DURS.append((name, round(dur, 3)))
+
+
+from jax import monitoring
+monitoring.register_event_duration_secs_listener(_dur_listener)
+
+from spark_rapids_tpu.session import TpuSparkSession
+from spark_rapids_tpu.utils import kernelcache
+from spark_rapids_tpu.models.tpch import QUERIES, TpchTables
+
+qname = sys.argv[1] if len(sys.argv) > 1 else "q2"
+
+session = TpuSparkSession.builder().config(
+    "spark.rapids.sql.enabled", True).config(
+    "spark.rapids.sql.cacheDeviceScans", True).get_or_create()
+tables = TpchTables.generate(session, 0.5, num_partitions=4)
+
+print(f"backend={jax.default_backend()}", flush=True)
+
+# warm
+t0 = time.perf_counter()
+QUERIES[qname](session, tables).collect()
+print(f"warm1: {time.perf_counter()-t0:.2f}s compiles={COUNTS['backend_compile']}",
+      flush=True)
+t0 = time.perf_counter()
+QUERIES[qname](session, tables).collect()
+print(f"warm2: {time.perf_counter()-t0:.2f}s compiles={COUNTS['backend_compile']}",
+      flush=True)
+
+for i in range(3):
+    c0 = COUNTS["backend_compile"]
+    k0 = kernelcache.cache_stats()["misses"]
+    d0 = len(DURS)
+    t0 = time.perf_counter()
+    QUERIES[qname](session, tables).collect()
+    dt = time.perf_counter() - t0
+    print(f"iter{i}: {dt:.2f}s new_compiles={COUNTS['backend_compile']-c0} "
+          f"new_kc_misses={kernelcache.cache_stats()['misses']-k0}", flush=True)
+    for name, dur in DURS[d0:]:
+        print(f"   compile {dur}s {name}", flush=True)
