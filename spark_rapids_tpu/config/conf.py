@@ -335,6 +335,22 @@ SHUFFLE_TRANSPORT_ENABLED = register(
     "memory (spilling through the store framework) and move between workers "
     "over the mesh interconnect instead of the host serializer path.")
 
+SHUFFLE_TRANSPORT_CLASS = register(
+    "spark.rapids.shuffle.transport.class", str, "inprocess",
+    "Transport implementation for the accelerated shuffle manager: "
+    "'inprocess' (direct-call, single process) or 'socket' (real TCP "
+    "loopback framing — the wire path the reference runs over UCX, "
+    "UCXShuffleTransport.scala). The SPI accepts other implementations "
+    "by class path.")
+
+SHUFFLE_EXECUTORS = register(
+    "spark.rapids.shuffle.executors", int, 1,
+    "Number of simulated executors for the accelerated shuffle manager: "
+    "map tasks stripe across this many ShuffleEnvs (each with its own "
+    "transport endpoint and server), so reduce-side fetches of other "
+    "executors' blocks traverse the full serializer->server->client wire "
+    "path instead of the local catalog.", validator=_positive)
+
 SHUFFLE_MAX_INFLIGHT = register(
     "spark.rapids.shuffle.maxMetadataFetchesInFlight", int, 128,
     "Bound on simultaneous in-flight shuffle fetches per task.",

@@ -707,6 +707,10 @@ class TpuScanExec(TpuExec):
                 dm = ctx.session.device_manager if ctx.session else None
                 try:
                     for df in part():
+                        from spark_rapids_tpu.exec.transitions import (
+                            note_scan_stats,
+                        )
+                        note_scan_stats(ctx.session, df)
                         for lo in range(0, max(len(df), 1), max_rows):
                             chunk = df.iloc[lo:lo + max_rows]
                             batch = DeviceBatch.from_pandas(
@@ -1090,7 +1094,11 @@ class TpuShuffleExchangeExec(TpuExec):
             def materialize_manager():
                 if mstate["statuses"] is not None:
                     return mstate["statuses"]
-                env = ctx.session.shuffle_env
+                # map tasks stripe across the executor pool
+                # (spark.rapids.shuffle.executors); with >1, reduce-side
+                # fetches of other executors' blocks traverse the real
+                # transport wire (socket: serializer -> server -> client)
+                envs = ctx.session.shuffle_envs
                 shuffle_id = ctx.session.next_shuffle_id()
                 per_map_batches = [list(p()) for p in child_parts]
                 bounds = (compute_range_bounds(
@@ -1101,7 +1109,8 @@ class TpuShuffleExchangeExec(TpuExec):
                     per_pid: List[List[DeviceBatch]] = [[] for _ in range(n)]
                     for _bi, pid, piece in split_to_slices(batches, bounds):
                         per_pid[pid].append(piece)
-                    writer = CachingShuffleWriter(env, shuffle_id, mi)
+                    writer = CachingShuffleWriter(envs[mi % len(envs)],
+                                                  shuffle_id, mi)
                     statuses.append(writer.write(per_pid))
                 mstate["statuses"] = (shuffle_id, statuses)
                 return mstate["statuses"]

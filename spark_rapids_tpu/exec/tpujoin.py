@@ -111,6 +111,8 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
         bkey = tuple(self.right_keys if self._stream_is_left
                      else self.left_keys)
         sig = f"join|{jt}|{skey}|{bkey}|x{int(exact_long_strings)}"
+        self._sig = sig
+        self._skey, self._bkey = skey, bkey
         self._probe = cached_jit(sig + "|probe", lambda: jax.jit(
             lambda b, s: join_ops.join_probe(
                 b, s, bkey, skey, cross=cross,
@@ -161,6 +163,61 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
         """(stream_child_idx, build_child_idx)."""
         return (0, 1) if self._stream_is_left else (1, 0)
 
+    # dense-key fast path: direct-index probe over a bounded key range
+    # (ops/joins.join_probe_dense). Applicable to single-int-key equi
+    # joins whose build key has scan-derived advisory bounds small enough
+    # to table. The reference's equivalent is cuDF's hash build+probe;
+    # here the "hash table" is the identity map over the key range.
+    _DENSE_MAX_RANGE = 1 << 24
+
+    def _dense_plan(self, ctx, build_schema):
+        """(lo, table_size) when the dense path applies, else None."""
+        if self.join_type == "cross" or len(self._bkey) != 1:
+            return None
+        if ctx.session is None:
+            return None
+        bk = self._bkey[0]
+        dt = build_schema.dtypes[bk]
+        if dt.is_string or not jnp.issubdtype(
+                jnp.dtype(dt.np_dtype), jnp.integer):
+            return None
+        # resolve the build key's name through the rename-alias map to
+        # scan stats; union bounds over every candidate source (multiple
+        # sources only loosen — the device verification catches any
+        # residual mismatch)
+        reg = ctx.session.column_stats
+        amap = ctx.session.column_aliases
+        names = {build_schema.names[bk]}
+        frontier = set(names)
+        for _ in range(8):  # alias chains are shallow; bound the walk
+            nxt = set()
+            for n in frontier:
+                nxt |= amap.get(n, set()) - names
+            if not nxt:
+                break
+            names |= nxt
+            frontier = nxt
+        bounds = [reg[n] for n in names if n in reg]
+        if not bounds:
+            return None
+        lo = min(b[0] for b in bounds)
+        hi = max(b[1] for b in bounds)
+        rng = hi - lo + 1
+        if rng <= 0 or rng > self._DENSE_MAX_RANGE:
+            return None
+        table_size = 1024
+        while table_size < rng:
+            table_size <<= 1
+        return lo, table_size
+
+    def _dense_kernel(self, table_size: int):
+        bk, sk = self._bkey[0], self._skey[0]
+        return cached_jit(
+            f"{self._sig}|dense{table_size}",
+            lambda: jax.jit(
+                lambda b, s, lo: join_ops.join_probe_dense(
+                    b, s, bk, sk, lo, table_size)))
+
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         si, bi = self._sides()
         stream_parts = self.children[si].executed_partitions(ctx)
@@ -202,27 +259,67 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                 build_parts = build_parts * len(stream_parts)
         jt = self.join_type
 
+        dense = None
+
         def make(sp: Partition, bp: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
                 from spark_rapids_tpu.exec.tpu import _concat_device
                 build = _concat_device(list(bp()), build_schema, growth)
                 matched_acc = None
                 emitted = False
+                nonlocal dense
+                if dense is None:
+                    dense = self._dense_plan(ctx, build_schema) or False
+                if dense:
+                    lo_arr = jnp.asarray(dense[0], jnp.int64)
+                    dkern = self._dense_kernel(dense[1])
                 if jt in ("leftsemi", "leftanti"):
-                    for stream in sp():
-                        emitted = True
-                        yield self._semi(stream,
-                                         self._probe(build, stream)[0])
+                    if dense:
+                        # probe every batch first, ONE ok-flag fetch for
+                        # all of them (a per-batch device_get would pay a
+                        # full RTT each on the tunneled attachment)
+                        streams = list(sp())
+                        raw = [dkern(build, s, lo_arr) for s in streams]
+                        oks = jax.device_get([r[3] for r in raw])
+                        for stream, r, ok in zip(streams, raw, oks):
+                            emitted = True
+                            counts = (r[0] if bool(ok)
+                                      else self._probe(build, stream)[0])
+                            yield self._semi(stream, counts)
+                    else:
+                        for stream in sp():
+                            emitted = True
+                            yield self._semi(stream,
+                                             self._probe(build, stream)[0])
                 else:
                     # probe EVERY stream batch first (dispatch is async and
                     # nearly free), then fetch all expansion totals in ONE
                     # device->host round trip — a per-batch fetch would pay
                     # ~150-250ms each on a tunneled attachment
                     streams = list(sp())
-                    probes = [self._probe(build, s) for s in streams]
-                    sizes_all = jax.device_get(
-                        [self._totals(build, s, *pr)
-                         for s, pr in zip(streams, probes)])
+                    if dense:
+                        raw = [dkern(build, s, lo_arr) for s in streams]
+                        probes = [r[:3] for r in raw]
+                        fetch = jax.device_get(
+                            [(self._totals(build, s, *pr), r[3])
+                             for s, pr, r in zip(streams, probes, raw)])
+                        del raw  # or probes[i]=None below frees nothing
+                        sizes_all = []
+                        for bi_, (sizes_d, ok) in enumerate(fetch):
+                            if bool(ok):
+                                sizes_all.append(sizes_d)
+                                continue
+                            # advisory bounds were wrong for this build:
+                            # exact sort probe, one extra fetch (rare)
+                            pr = self._probe(build, streams[bi_])
+                            probes[bi_] = pr
+                            sizes_all.append(jax.device_get(
+                                self._totals(build, streams[bi_], *pr)))
+                    else:
+                        probes = [self._probe(build, s) for s in streams]
+                        sizes_all = jax.device_get(
+                            [self._totals(build, s, *pr)
+                             for s, pr in zip(streams, probes)])
                     for bi_, (stream, (counts, bstart, bperm),
                               sizes_d) in enumerate(
                             zip(streams, probes, sizes_all)):

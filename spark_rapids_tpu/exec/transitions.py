@@ -41,6 +41,31 @@ def scan_cache_for(ctx: ExecContext, source, schema: Schema,
     return store[key][1]
 
 
+def note_scan_stats(session, df: pd.DataFrame) -> None:
+    """Union each scanned int column's (min, max) into the session's
+    advisory stats registry (session.column_stats). Called ONLY from scan
+    uploads (TpuScanExec / HostToDeviceExec-over-scan), so derived columns
+    can never seed it; the dense-key join verifies the bounds on device
+    before relying on them (exec/tpujoin.py)."""
+    if session is None:
+        return
+    reg = session.column_stats
+    for name in df.columns:
+        s = df[name]
+        if not (pd.api.types.is_integer_dtype(s.dtype)
+                and not pd.api.types.is_bool_dtype(s.dtype)):
+            continue
+        # min/max skip NA natively; count() avoids the dropna() copy this
+        # scan-upload hot path would otherwise pay per column
+        if not int(s.count()):
+            continue
+        lo, hi = int(s.min()), int(s.max())
+        prev = reg.get(str(name))
+        if prev is not None:
+            lo, hi = min(lo, prev[0]), max(hi, prev[1])
+        reg[str(name)] = (lo, hi)
+
+
 class HostToDeviceExec(PhysicalPlan):
     """pandas partition chunks -> DeviceBatch, chunked to the conf'd batch
     size and padded to capacity buckets."""
@@ -64,9 +89,11 @@ class HostToDeviceExec(PhysicalPlan):
         # DataFrame, symmetric with the CPU path holding pandas in RAM
         cache = None
         from spark_rapids_tpu.exec.cpu import CpuScanExec
-        if isinstance(child, CpuScanExec):
+        is_scan = isinstance(child, CpuScanExec)
+        if is_scan:
             cache = scan_cache_for(ctx, child.source, schema, max_rows,
                                    getattr(child, "pushed_filters", None))
+
         # shared dictionary registry across every batch of this transition
         # (see TpuScanExec: bounds program-shape churn to one dict/scan)
         dict_state: dict = {}
@@ -88,6 +115,8 @@ class HostToDeviceExec(PhysicalPlan):
                 dm = ctx.session.device_manager if ctx.session else None
                 try:
                     for df in part():
+                        if is_scan:
+                            note_scan_stats(ctx.session, df)
                         for lo in range(0, max(len(df), 1), max_rows):
                             chunk = df.iloc[lo:lo + max_rows]
                             batch = DeviceBatch.from_pandas(

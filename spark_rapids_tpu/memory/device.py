@@ -37,6 +37,13 @@ class TpuDeviceManager:
         self._allocated = 0
         self._alloc_lock = threading.Lock()
         self._oom_handlers = []  # callbacks: (needed_bytes) -> freed_bytes
+        # per-device residency accounting for mesh execution: committed
+        # batches meter against THEIR device, so tests can assert the
+        # funnel-free property (no single device's peak ever approaches
+        # the whole dataset) through the metering hooks rather than by
+        # inspecting internals
+        self._per_device: dict = {}
+        self._per_device_peak: dict = {}
 
     @classmethod
     def get(cls, conf) -> "TpuDeviceManager":
@@ -64,8 +71,30 @@ class TpuDeviceManager:
         import weakref
         size = batch.device_memory_size()
         if size:
-            self.track_alloc(size)
-            weakref.finalize(batch, self.track_free, size)
+            dev = self._committed_device(batch)
+            self.track_alloc(size, device=dev)
+            weakref.finalize(batch, self.track_free, size, dev)
+
+    @staticmethod
+    def _committed_device(batch):
+        """The single device EVERY column of a batch is committed to, or
+        None (uncommitted / sharded / split batches meter only globally —
+        attributing a split batch to one column's device would undercount
+        the others')."""
+        dev = None
+        try:
+            for col in batch.columns:
+                devs = col.data.devices()
+                if len(devs) != 1:
+                    return None
+                d = next(iter(devs))
+                if dev is None:
+                    dev = d
+                elif d != dev:
+                    return None
+        except Exception:  # pragma: no cover - non-jax columns
+            return None
+        return dev
 
     def _probe_hbm_bytes(self) -> int:
         try:
@@ -87,12 +116,17 @@ class TpuDeviceManager:
         if handler in self._oom_handlers:
             self._oom_handlers.remove(handler)
 
-    def track_alloc(self, nbytes: int) -> None:
+    def track_alloc(self, nbytes: int, device=None) -> None:
         """Meter a framework allocation against the HBM budget; drive spill
         handlers synchronously when over budget (the reference spills on
         RMM alloc-failure callbacks, RapidsBufferStore.scala:148-188)."""
         with self._alloc_lock:
             self._allocated += nbytes
+            if device is not None:
+                cur = self._per_device.get(device, 0) + nbytes
+                self._per_device[device] = cur
+                if cur > self._per_device_peak.get(device, 0):
+                    self._per_device_peak[device] = cur
             over = self._allocated - self.hbm_budget
         if over > 0:
             for h in self._oom_handlers:
@@ -101,9 +135,21 @@ class TpuDeviceManager:
                 if over <= 0:
                     break
 
-    def track_free(self, nbytes: int) -> None:
+    def track_free(self, nbytes: int, device=None) -> None:
         with self._alloc_lock:
             self._allocated -= nbytes
+            if device is not None and device in self._per_device:
+                self._per_device[device] -= nbytes
+
+    def per_device_peaks(self) -> dict:
+        """Snapshot of peak metered bytes per device (mesh tests)."""
+        with self._alloc_lock:
+            return dict(self._per_device_peak)
+
+    def reset_per_device_peaks(self) -> None:
+        with self._alloc_lock:
+            self._per_device_peak = {d: v for d, v in
+                                     self._per_device.items() if v > 0}
 
     @property
     def allocated(self) -> int:

@@ -225,6 +225,59 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
     return counts, bstart, bperm
 
 
+def join_probe_dense(build: DeviceBatch, stream: DeviceBatch,
+                     build_key: int, stream_key: int, lo_arr: jnp.ndarray,
+                     table_size: int):
+    """Dense-key direct-index probe: the sort-free fast path for the
+    PK-FK joins that dominate analytic schemas (every TPC-H/TPCxBB equi
+    join is on dense contiguous int keys).
+
+    Instead of the union lexsort over nb+ns key images (join_probe), the
+    build side scatters a (table_size, 2) [start, count] table indexed by
+    ``key - lo`` and every stream row probes with ONE gather. The build
+    side still sorts — but only ITSELF, by table offset (one int32
+    operand), to give the same (counts, bstart, bperm) contract
+    join_expand consumes; the stream side (usually the big fact table) is
+    never sorted at all. This replaces cuDF's device hash build+probe
+    (GpuHashJoin.scala:113-244) with the shape-static TPU equivalent:
+    the "hash table" is the identity map on a bounded key range.
+
+    ``lo_arr``: int64 device scalar, the assumed minimum key.
+    ``table_size``: static bucketed range. Returns (counts, bstart,
+    bperm, ok) — ``ok`` is False when some VALID build key fell outside
+    [lo, lo+table_size): the bounds came from name-keyed scan statistics
+    (session.column_stats) which are advisory, so the caller must fall
+    back to the exact sort probe when verification fails. Out-of-range
+    STREAM keys need no verification: when ok holds, every build key is
+    in-table, so an out-of-range stream key matching nothing is correct
+    SQL semantics, not data loss."""
+    nb, ns = build.capacity, stream.capacity
+    bkv = _key_valid(build, [build_key])
+    skv = _key_valid(stream, [stream_key])
+    lo = lo_arr.astype(jnp.int64)
+    boff = build.columns[build_key].data.astype(jnp.int64) - lo
+    in_tbl = (boff >= 0) & (boff < table_size)
+    ok = jnp.all(in_tbl | ~bkv)
+    off_key = jnp.where(in_tbl & bkv, boff,
+                        table_size).astype(jnp.int32)
+    off_sorted, bperm = jax.lax.sort(
+        (off_key, jnp.arange(nb, dtype=jnp.int32)), num_keys=1,
+        is_stable=True)
+    # scatter-add over SORTED offsets (random-index scatters serialize on
+    # TPU; the build-side sort just above makes this one cheap)
+    cnt = jnp.zeros((table_size + 1,), jnp.int32).at[off_sorted].add(1)[
+        :table_size]
+    starts = jnp.cumsum(cnt) - cnt
+    tbl = jnp.stack([starts, cnt], axis=1)
+    soff = stream.columns[stream_key].data.astype(jnp.int64) - lo
+    s_in = skv & (soff >= 0) & (soff < table_size)
+    sidx = jnp.clip(soff, 0, table_size - 1).astype(jnp.int32)
+    picked = tbl[sidx, :]
+    bstart = picked[:, 0].astype(jnp.int32)
+    counts = jnp.where(s_in, picked[:, 1], 0).astype(jnp.int32)
+    return counts, bstart, bperm, ok
+
+
 def outer_adjusted_counts(stream: DeviceBatch,
                           counts: jnp.ndarray) -> jnp.ndarray:
     """Left-outer: every live stream row emits at least one output row."""

@@ -293,8 +293,7 @@ def mesh_exchange_parts(mesh: Mesh, schema: Schema,
     n_out = 1 + sum(3 if dt.is_string else 2 for dt in schema.dtypes)
     out_specs = tuple([P("dp")] + [P("dp", None)] * (n_out - 1))
     fn = jax.jit(shard_map(_make_local(schema, n, pid_fn), mesh=mesh,
-                           in_specs=tuple(in_specs), out_specs=out_specs,
-                           check_vma=False))
+                           in_specs=tuple(in_specs), out_specs=out_specs))
     outs = fn(*args)
 
     # unstack: each mesh device's addressable block -> one committed
@@ -429,7 +428,7 @@ def distributed_hash_aggregate_step(mesh: Mesh, schema: Schema,
     out_specs = tuple([P("dp")]
                       + [P("dp", None)] * _arrays_per_col(partial_schema))
     fn = shard_map(local_step, mesh=mesh, in_specs=in_specs,
-                   out_specs=out_specs, check_vma=False)
+                   out_specs=out_specs)
     return jax.jit(fn)
 
 

@@ -71,6 +71,17 @@ class TpuSparkSession:
         # partial-pass reduction ratio (groups/rows); known-poor reducers
         # skip their partial pass from batch 0 on later executions
         self.agg_ratio_cache: dict = {}
+        # scan-derived integer column bounds: column name -> (min, max),
+        # unioned across every scanned batch carrying that name. ADVISORY
+        # (the role of the reference's cuDF column min/max the join build
+        # reads): the dense-key join fast path sizes its direct-index
+        # table from these and VERIFIES them on device, falling back to
+        # the exact sort probe on mismatch — correctness never depends on
+        # this registry (exec/tpujoin.py).
+        self.column_stats: dict = {}
+        # rename provenance: alias -> {source column names} recorded by
+        # rename-only projections, so stats resolve through `.alias(...)`
+        self.column_aliases: dict = {}
 
     def clear_device_cache(self) -> None:
         for _source, parts in self.device_scan_cache.values():
@@ -79,20 +90,49 @@ class TpuSparkSession:
                     self.buffer_catalog.remove(bid)
         self.device_scan_cache.clear()
 
+    def _make_transport(self, executor_id: str):
+        kind = self.conf.get("spark.rapids.shuffle.transport.class",
+                             "inprocess")
+        if kind == "socket":
+            from spark_rapids_tpu.shuffle.socket_transport import (
+                SocketTransport,
+            )
+            return SocketTransport(executor_id)
+        if kind == "inprocess":
+            from spark_rapids_tpu.shuffle.transport import InProcessTransport
+            return InProcessTransport(executor_id)
+        # SPI: dotted path "module:Class" taking (executor_id)
+        import importlib
+        mod, _, cls = kind.partition(":")
+        return getattr(importlib.import_module(mod), cls)(executor_id)
+
     @property
-    def shuffle_env(self):
+    def shuffle_envs(self):
+        """The executor pool for the accelerated shuffle manager. With
+        spark.rapids.shuffle.executors > 1, map tasks stripe across the
+        pool and cross-executor fetches ride the configured transport
+        (socket = real TCP loopback) through serializer -> server ->
+        client -> received catalog — the reference's multi-executor UCX
+        flow (RapidsShuffleInternalManager.scala:74-362) in one process."""
         if self._shuffle_env is None:
             from spark_rapids_tpu.shuffle.manager import ShuffleEnv
-            from spark_rapids_tpu.shuffle.transport import InProcessTransport
             bsize = int(self.conf.get(
                 "spark.rapids.shuffle.bounceBuffers.size", 4 << 20))
             bcount = int(self.conf.get(
                 "spark.rapids.shuffle.bounceBuffers.count", 16))
-            self._shuffle_env = ShuffleEnv(
-                "local-exec", InProcessTransport("local-exec"),
-                bounce_buffer_size=bsize, bounce_buffer_count=bcount,
-                buffer_catalog=self.buffer_catalog)
+            nexec = int(self.conf.get("spark.rapids.shuffle.executors", 1))
+            self._shuffle_env = [
+                ShuffleEnv(f"local-exec-{i}",
+                           self._make_transport(f"local-exec-{i}"),
+                           bounce_buffer_size=bsize,
+                           bounce_buffer_count=bcount,
+                           buffer_catalog=self.buffer_catalog)
+                for i in range(max(1, nexec))]
         return self._shuffle_env
+
+    @property
+    def shuffle_env(self):
+        return self.shuffle_envs[0]
 
     def next_shuffle_id(self) -> int:
         self._shuffle_id_counter += 1
@@ -104,8 +144,9 @@ class TpuSparkSession:
         reference's unregisterShuffle path)."""
         if self._shuffle_env is None:
             return
-        for sid in self._active_shuffles:
-            self._shuffle_env.shuffle_catalog.remove_shuffle(sid)
+        for env in self._shuffle_env:
+            for sid in self._active_shuffles:
+                env.shuffle_catalog.remove_shuffle(sid)
         self._active_shuffles.clear()
 
     def register_transient(self, bid: int) -> int:
@@ -184,7 +225,8 @@ class TpuSparkSession:
         self.clear_device_cache()
         self.release_active_shuffles()
         if self._shuffle_env is not None:
-            self._shuffle_env.close()
+            for env in self._shuffle_env:
+                env.close()
             self._shuffle_env = None
         self.device_manager.unregister_oom_handler(self.memory_event_handler)
         self.buffer_catalog.close()
@@ -229,6 +271,12 @@ class TpuSparkSession:
 
         conf = self.conf
         ctx = ExecContext(conf, self)
+        # record rename provenance (alias -> source names) from the
+        # LOGICAL plan — physical projections can fuse away, but the
+        # logical tree always carries `.alias(...)` / USING-join renames.
+        # Advisory input to the dense-key join's stats resolution; bounds
+        # are device-verified there, so staleness only loosens them.
+        self._note_rename_aliases(logical)
         # column pruning (narrowing projects above filters / semi-anti
         # build sides), then projection pushdown: mark file scans with the
         # query's referenced column subset before planning (sql/pushdown.py)
@@ -274,6 +322,20 @@ class TpuSparkSession:
             }
         self.last_query_metrics = ctx.metrics
         return plan, outs
+
+    def _note_rename_aliases(self, logical) -> None:
+        from spark_rapids_tpu.sql.exprs.core import Alias, Col
+        amap = self.column_aliases
+        stack = [logical]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children)
+            if isinstance(node, lp.LogicalProject):
+                for out_name, e in node.exprs:
+                    while isinstance(e, Alias):
+                        e = e.children[0]
+                    if isinstance(e, Col) and e.name != out_name:
+                        amap.setdefault(out_name, set()).add(e.name)
 
     def _drain(self, plan, ctx, conf) -> List[pd.DataFrame]:
         outs: List[pd.DataFrame] = []

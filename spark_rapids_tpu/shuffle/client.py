@@ -113,14 +113,15 @@ class ShuffleClient:
         nchunks = (length + size - 1) // size or 1
         chunks: List[bytearray] = []
         events: List[threading.Event] = []
+        txns = []
         for c in range(nchunks):
             clen = min(size, length - c * size) if length else 0
             target = bytearray(clen)
             ev = threading.Event()
             chunks.append(target)
             events.append(ev)
-            self.connection.receive(tag + 1 + c, target,
-                                    lambda txn, ev=ev: ev.set())
+            txns.append(self.connection.receive(
+                tag + 1 + c, target, lambda txn, ev=ev: ev.set()))
         peer = self.executor_id.encode("utf-8")
         payload = (struct.pack("<H", len(peer)) + peer
                    + TRANSFER_REQ.pack(0, tag))
@@ -136,7 +137,12 @@ class ShuffleClient:
         if tres["txn"].status != TransactionStatus.SUCCESS:
             raise ShuffleFetchFailedError(
                 f"transfer failed: {tres['txn'].error_message}")
-        for ev in events:
+        for ev, txn in zip(events, txns):
             if not ev.wait(30):
                 raise ShuffleFetchFailedError("chunk receive timed out")
+            # a completed-but-failed receive (dropped connection) must not
+            # pass off partially-filled chunks as data
+            if txn.status != TransactionStatus.SUCCESS:
+                raise ShuffleFetchFailedError(
+                    f"chunk receive failed: {txn.error_message}")
         return b"".join(bytes(c) for c in chunks)

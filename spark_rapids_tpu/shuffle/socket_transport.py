@@ -1,0 +1,409 @@
+"""Real-wire shuffle transport: TCP loopback sockets implementing the
+transport SPI (reference: the UCX production transport,
+shuffle-plugin/src/main/scala/com/nvidia/spark/rapids/shuffle/ucx/
+UCX.scala:330-450 + UCXShuffleTransport.scala).
+
+Where the reference registers UCX endpoints keyed by a tag composed from
+the peer's BlockManagerId, this transport runs one listening socket per
+executor and one bidirectional TCP connection per (client, server) pair:
+requests flow client->server as framed messages with correlation ids, and
+tagged buffer chunks flow server->client over the SAME socket (the
+socket's two directions play the role of the paired UCX endpoints).
+
+Frame format (little-endian):
+    [u8 kind][u64 id_or_tag][u32 len][len bytes]
+kinds: 1=METADATA request, 2=TRANSFER request, 3=success response,
+4=error response, 5=tagged chunk send.
+
+Fault injection (tests): ``fault_drop_tagged_after(n)`` hard-closes the
+server side of a connection after n tagged frames — the mid-transfer
+drop case. The client fails all posted receives immediately (no 30s
+timeout), the fetch surfaces ShuffleFetchFailedError, and the engine's
+per-peer retry (exec/tpu.py maxFetchRetries) re-fetches from the
+still-registered map-side blocks over a fresh connection.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from spark_rapids_tpu.shuffle.transport import (
+    ClientConnection, RequestType, ServerConnection, ShuffleTransport,
+    Transaction, TransactionStatus,
+)
+
+_HDR = struct.Struct("<BQI")
+_K_META = 1
+_K_TRANSFER = 2
+_K_RESP = 3
+_K_ERR = 4
+_K_TAGGED = 5
+
+_REQ_KIND = {RequestType.METADATA: _K_META, RequestType.TRANSFER: _K_TRANSFER}
+
+
+def _send_frame(sock: socket.socket, kind: int, ident: int,
+                payload: bytes) -> None:
+    sock.sendall(_HDR.pack(kind, ident, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[int, int, bytes]:
+    kind, ident, ln = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return kind, ident, _recv_exact(sock, ln) if ln else b""
+
+
+class SocketTransport(ShuffleTransport):
+    """One executor's endpoint: a loopback listener + dialed-out client
+    connections. Executor ids resolve to ports through a process-local
+    registry (the role BlockManagerId's topology field plays for the
+    reference, RapidsShuffleInternalManager.scala:157-172); multi-host
+    deployments would swap the registry for the cluster's block-manager
+    directory without touching the framing."""
+
+    _registry: Dict[str, int] = {}
+    _registry_lock = threading.Lock()
+
+    def __init__(self, executor_id: str):
+        self.executor_id = executor_id
+        self._server = _SocketServer(self)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(16)
+        self.port = self._listener.getsockname()[1]
+        self._closed = False
+        with SocketTransport._registry_lock:
+            SocketTransport._registry[executor_id] = self.port
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"shuffle-accept-{executor_id}")
+        self._accept_thread.start()
+        # fault injection: drop server->client sockets after N tagged sends
+        self._fault_drop_after: Optional[int] = None
+        self._tagged_sent = 0
+        self._fault_lock = threading.Lock()
+        # wire counters (tests assert data really crossed the socket)
+        self.stats = {"tagged_frames": 0, "tagged_bytes": 0,
+                      "requests": 0, "faults_fired": 0}
+
+    # -- fault injection ---------------------------------------------------
+    def fault_drop_tagged_after(self, n: Optional[int]) -> None:
+        """Arm (or disarm with None) a one-shot mid-transfer drop: the
+        n+1-th tagged frame hard-closes its connection instead of
+        sending."""
+        with self._fault_lock:
+            self._fault_drop_after = n
+            self._tagged_sent = 0
+
+    def _fault_should_drop(self) -> bool:
+        with self._fault_lock:
+            if self._fault_drop_after is None:
+                return False
+            self._tagged_sent += 1
+            if self._tagged_sent > self._fault_drop_after:
+                self._fault_drop_after = None  # one-shot
+                return True
+            return False
+
+    # -- SPI ---------------------------------------------------------------
+    @classmethod
+    def lookup_port(cls, executor_id: str) -> int:
+        with cls._registry_lock:
+            return cls._registry[executor_id]
+
+    @classmethod
+    def clear_registry(cls) -> None:
+        with cls._registry_lock:
+            cls._registry.clear()
+
+    def make_client(self, peer_executor_id: str) -> "_SocketClient":
+        return _SocketClient(self, peer_executor_id)
+
+    def get_server(self) -> "_SocketServer":
+        return self._server
+
+    def shutdown(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._server.close_all()
+        with SocketTransport._registry_lock:
+            SocketTransport._registry.pop(self.executor_id, None)
+
+    # -- server plumbing ---------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name=f"shuffle-serve-{self.executor_id}").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        """Server side of one accepted connection. First frame is the
+        peer's identity (kind=RESP, payload=executor id); afterwards
+        requests are handled inline and responses/tagged sends share the
+        socket under a write lock."""
+        peer_id = None
+        try:
+            kind, _i, payload = _recv_frame(conn)
+            if kind != _K_RESP:
+                conn.close()
+                return
+            peer_id = payload.decode("utf-8")
+            self._server.register_peer(peer_id, conn)
+            while True:
+                kind, ident, payload = _recv_frame(conn)
+                if kind not in (_K_META, _K_TRANSFER):
+                    continue
+                rt = (RequestType.METADATA if kind == _K_META
+                      else RequestType.TRANSFER)
+                self.stats["requests"] += 1
+                try:
+                    resp = self._server.handle_request(rt, payload)
+                    self._server.write_frame(conn, _K_RESP, ident, resp)
+                except Exception as e:  # noqa: BLE001 — sent to peer
+                    self._server.write_frame(
+                        conn, _K_ERR, ident, str(e).encode("utf-8")[:1000])
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            if peer_id is not None:
+                self._server.unregister_peer(peer_id, conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class _SocketServer(ServerConnection):
+    def __init__(self, transport: SocketTransport):
+        self.transport = transport
+        self._handlers: Dict[RequestType, Callable[[bytes], bytes]] = {}
+        self._peers: Dict[str, socket.socket] = {}
+        self._write_locks: Dict[socket.socket, threading.Lock] = {}
+        self._lock = threading.Lock()
+
+    def register_request_handler(self, req_type: RequestType,
+                                 handler: Callable[[bytes], bytes]) -> None:
+        self._handlers[req_type] = handler
+
+    def handle_request(self, req_type: RequestType, payload: bytes) -> bytes:
+        handler = self._handlers.get(req_type)
+        if handler is None:
+            raise RuntimeError(f"no handler for {req_type}")
+        return handler(payload)
+
+    def register_peer(self, peer_id: str, conn: socket.socket) -> None:
+        with self._lock:
+            self._peers[peer_id] = conn
+            self._write_locks[conn] = threading.Lock()
+
+    def unregister_peer(self, peer_id: str, conn: socket.socket) -> None:
+        with self._lock:
+            if self._peers.get(peer_id) is conn:
+                del self._peers[peer_id]
+            self._write_locks.pop(conn, None)
+
+    def write_frame(self, conn: socket.socket, kind: int, ident: int,
+                    payload: bytes) -> None:
+        with self._lock:
+            wlock = self._write_locks.get(conn)
+        if wlock is None:
+            raise ConnectionError("peer connection gone")
+        with wlock:
+            _send_frame(conn, kind, ident, payload)
+
+    def send(self, peer_id: str, tag: int, data: bytes,
+             cb: Callable[[Transaction], None]) -> Transaction:
+        """Tagged chunk send to a connected peer (server->client leg)."""
+        txn = Transaction()
+        with self._lock:
+            conn = self._peers.get(peer_id)
+        if conn is None:
+            txn.complete(TransactionStatus.ERROR, 0,
+                         f"peer {peer_id} not connected")
+            cb(txn)
+            return txn
+        if self.transport._fault_should_drop():
+            self.transport.stats["faults_fired"] += 1
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+                conn.close()
+            except OSError:
+                pass
+            txn.complete(TransactionStatus.ERROR, 0,
+                         "fault injection: connection dropped mid-transfer")
+            cb(txn)
+            return txn
+        try:
+            self.write_frame(conn, _K_TAGGED, tag, data)
+            self.transport.stats["tagged_frames"] += 1
+            self.transport.stats["tagged_bytes"] += len(data)
+            txn.complete(TransactionStatus.SUCCESS, len(data))
+        except (ConnectionError, OSError) as e:
+            txn.complete(TransactionStatus.ERROR, 0, str(e))
+        cb(txn)
+        return txn
+
+    def close_all(self) -> None:
+        with self._lock:
+            conns = list(self._peers.values())
+            self._peers.clear()
+            self._write_locks.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class _SocketClient(ClientConnection):
+    """Client leg: dials the peer's listener lazily and redials after a
+    drop (each request re-checks liveness), so a stage retry lands on a
+    fresh connection — the reference reconnects through
+    UCX.getConnection the same way."""
+
+    def __init__(self, transport: SocketTransport, peer_id: str):
+        self.transport = transport
+        self.peer_id = peer_id
+        self._sock: Optional[socket.socket] = None
+        self._sock_lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self._reqs: Dict[int, Callable[[Transaction, bytes], None]] = {}
+        self._recvs: Dict[int, Tuple[bytearray, Transaction,
+                                     Callable[[Transaction], None]]] = {}
+        self._pending_tagged: Dict[int, bytes] = {}
+        self._state_lock = threading.Lock()
+        self._req_seq = 0
+
+    def _ensure_connected(self) -> socket.socket:
+        with self._sock_lock:
+            if self._sock is not None:
+                return self._sock
+            port = SocketTransport.lookup_port(self.peer_id)
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send_frame(s, _K_RESP, 0,
+                        self.transport.executor_id.encode("utf-8"))
+            self._sock = s
+            threading.Thread(target=self._read_loop, args=(s,), daemon=True,
+                             name=f"shuffle-client-{self.peer_id}").start()
+            return s
+
+    def _read_loop(self, s: socket.socket) -> None:
+        try:
+            while True:
+                kind, ident, payload = _recv_frame(s)
+                if kind == _K_RESP or kind == _K_ERR:
+                    with self._state_lock:
+                        cb = self._reqs.pop(ident, None)
+                    if cb is None:
+                        continue
+                    txn = Transaction()
+                    if kind == _K_RESP:
+                        txn.complete(TransactionStatus.SUCCESS, len(payload))
+                        cb(txn, payload)
+                    else:
+                        txn.complete(TransactionStatus.ERROR, 0,
+                                     payload.decode("utf-8", "replace"))
+                        cb(txn, b"")
+                elif kind == _K_TAGGED:
+                    self._deliver_tagged(ident, payload)
+        except (ConnectionError, OSError) as e:
+            self._fail_all(f"connection lost: {e}")
+
+    def _deliver_tagged(self, tag: int, payload: bytes) -> None:
+        with self._state_lock:
+            posted = self._recvs.pop(tag, None)
+            if posted is None:
+                # chunk arrived before the receive was posted: park it
+                self._pending_tagged[tag] = payload
+                return
+        target, txn, cb = posted
+        n = min(len(payload), len(target))
+        target[:n] = payload[:n]
+        txn.complete(TransactionStatus.SUCCESS, n)
+        cb(txn)
+
+    def _fail_all(self, msg: str) -> None:
+        """A dead socket fails every outstanding op NOW — a dropped
+        transfer must surface as ShuffleFetchFailedError immediately, not
+        after per-chunk timeouts."""
+        with self._sock_lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+        with self._state_lock:
+            reqs = list(self._reqs.values())
+            self._reqs.clear()
+            recvs = list(self._recvs.values())
+            self._recvs.clear()
+            self._pending_tagged.clear()
+        for cb in reqs:
+            txn = Transaction()
+            txn.complete(TransactionStatus.ERROR, 0, msg)
+            cb(txn, b"")
+        for _target, txn, cb in recvs:
+            txn.complete(TransactionStatus.ERROR, 0, msg)
+            cb(txn)
+
+    def request(self, req_type: RequestType, payload: bytes,
+                cb: Callable[[Transaction, bytes], None]) -> Transaction:
+        txn = Transaction()
+        try:
+            s = self._ensure_connected()
+            with self._state_lock:
+                self._req_seq += 1
+                ident = self._req_seq
+                self._reqs[ident] = (
+                    lambda t, resp: (txn.complete(t.status, t.length,
+                                                  t.error_message),
+                                     cb(txn, resp)))
+            with self._write_lock:
+                _send_frame(s, _REQ_KIND[req_type], ident, payload)
+        except (KeyError, ConnectionError, OSError) as e:
+            txn.complete(TransactionStatus.ERROR, 0, str(e))
+            cb(txn, b"")
+        return txn
+
+    def receive(self, tag: int, target: bytearray,
+                cb: Callable[[Transaction], None]) -> Transaction:
+        txn = Transaction()
+        try:
+            self._ensure_connected()
+        except (KeyError, ConnectionError, OSError) as e:
+            txn.complete(TransactionStatus.ERROR, 0, str(e))
+            cb(txn)
+            return txn
+        with self._state_lock:
+            early = self._pending_tagged.pop(tag, None)
+            if early is None:
+                self._recvs[tag] = (target, txn, cb)
+                return txn
+        n = min(len(early), len(target))
+        target[:n] = early[:n]
+        txn.complete(TransactionStatus.SUCCESS, n)
+        cb(txn)
+        return txn

@@ -206,3 +206,52 @@ def test_mesh_tpch_q1_differential(mesh_session):
     cpu = with_cpu_session(q)
     tpu = _collect_with_mesh(mesh_session, q)
     assert_frames_equal(tpu, cpu, ignore_order=True, approx=True)
+
+
+def test_mesh_memory_meaningful_no_device_holds_dataset(mesh_session):
+    """VERDICT r3 item 6: a mesh differential at a shape where the whole
+    dataset does NOT fit one shard's budget, with the funnel-free property
+    asserted through the METERING hooks: per-device peak residency during
+    the query stays under a per-shard budget that the full dataset
+    exceeds several times over (reference contract: data is born and
+    stays distributed, GpuShuffleExchangeExec.scala:123-215)."""
+    from spark_rapids_tpu.models import tpch_data
+    from spark_rapids_tpu.parallel import distributed as dist
+
+    sf = 0.05  # lineitem 300k rows — ~40 MB of real columns
+    pdf = tpch_data.gen_lineitem(sf)[
+        ["l_orderkey", "l_quantity", "l_extendedprice", "l_discount",
+         "l_shipdate"]]
+    dm = mesh_session.device_manager
+
+    def q(s):
+        # raw-row range exchange: the global sort moves EVERY row across
+        # the mesh (post-agg exchanges only carry tiny partials)
+        return (s.create_dataframe(pdf, 8)
+                .order_by("l_extendedprice", "l_orderkey"))
+
+    cpu = with_cpu_session(q)
+    dist.exchange_stats_log.clear()
+    dm.reset_per_device_peaks()
+    tpu = _collect_with_mesh(mesh_session, q)
+    assert_frames_equal(tpu, cpu, ignore_order=False, approx=True)
+
+    assert dist.exchange_stats_log, "mesh exchange never ran"
+    # committed per-device batches: every device's peak metered residency
+    # stays under a per-shard budget the full dataset exceeds 3x+
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    total = DeviceBatch.from_pandas(pdf).device_memory_size()
+    per_shard_budget = total // 3
+    peaks = dm.per_device_peaks()
+    mesh_devices = set(mesh_session.mesh.devices.flat)
+    mesh_peaks = {d: p for d, p in peaks.items() if d in mesh_devices}
+    assert len(mesh_peaks) >= 4, (
+        "expected residency across the mesh", peaks)
+    for dev, peak in mesh_peaks.items():
+        assert peak < per_shard_budget, (
+            f"device {dev} peaked at {peak} bytes — more than a shard's "
+            f"budget ({per_shard_budget}) of the {total}-byte dataset")
+    # and the exchange operands themselves stayed per-shard slices
+    total_rows = len(pdf)
+    for st in dist.exchange_stats_log:
+        assert st["common_cap"] < total_rows / 3, st
