@@ -1,10 +1,13 @@
 """CoalesceBatches framework tests (reference: GpuCoalesceBatchesSuite)."""
 
+import pytest
 import numpy as np
 import pandas as pd
 
 from spark_rapids_tpu.sql import functions as F
 from tests.querytest import assert_tpu_and_cpu_equal
+
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
 
 
 def test_coalesce_inserted_above_scan_and_filter(session, tmp_path):

@@ -8,6 +8,8 @@ import pytest
 from spark_rapids_tpu.columnar import DeviceBatch, DeviceColumn, Schema, dtypes
 from spark_rapids_tpu.columnar.batch import bucket_capacity
 
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
+
 
 def test_bucket_capacity():
     assert bucket_capacity(0) == 8

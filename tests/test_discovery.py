@@ -7,6 +7,8 @@ import pytest
 
 from spark_rapids_tpu.memory import discovery
 
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
+
 
 @pytest.fixture(autouse=True)
 def lock_dir(tmp_path, monkeypatch):

@@ -7,6 +7,8 @@ import pytest
 
 from spark_rapids_tpu.memory.leak import TRACKER, LeakTracker, assert_no_leaks
 
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
+
 
 def test_register_unregister_and_report():
     t = LeakTracker()

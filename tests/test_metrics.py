@@ -1,9 +1,12 @@
 """Per-operator SQL metrics (reference: GpuMetricNames, GpuExec.scala:24-41)."""
 
+import pytest
 import numpy as np
 import pandas as pd
 
 from spark_rapids_tpu.sql import functions as F
+
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
 
 
 def test_metrics_collected(session):

@@ -9,6 +9,8 @@ import pytest
 from spark_rapids_tpu.sql import functions as F
 from tests.querytest import assert_tpu_and_cpu_equal, with_tpu_session
 
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
+
 
 def _sales_df(rng, n=500):
     return pd.DataFrame({

@@ -1,10 +1,13 @@
 """Device-resident scan cache tests (spark.rapids.sql.cacheDeviceScans —
 the HBM analogue of a cached DataFrame)."""
 
+import pytest
 import numpy as np
 import pandas as pd
 
 from spark_rapids_tpu.sql import functions as F
+
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
 
 
 def _enable(session):

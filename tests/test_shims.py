@@ -10,6 +10,8 @@ from spark_rapids_tpu.shims.loader import (
     TpuShims,
 )
 
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
+
 
 def test_parse_version():
     assert ShimLoader.parse_version("0.4.26") == (0, 4, 26)
