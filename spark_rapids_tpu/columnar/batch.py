@@ -28,7 +28,9 @@ MIN_CAPACITY = 8
 
 
 def bucket_capacity(n: int, growth: float = 2.0, minimum: int = MIN_CAPACITY) -> int:
-    """Smallest capacity bucket >= n. growth=2.0 -> power-of-two buckets."""
+    """Smallest capacity bucket >= n. growth=2.0 -> power-of-two buckets.
+    growth <= 1 cannot make progress (it would loop forever)."""
+    assert growth > 1.0, f"bucket growth must exceed 1.0, got {growth}"
     cap = minimum
     while cap < n:
         cap = int(np.ceil(cap * growth))
@@ -256,9 +258,19 @@ class DeviceBatch:
         """One device_get of (num_rows + full-capacity buffers) for every
         batch, trimmed to the fetched row counts host-side."""
         import jax
-        payload = [(b.num_rows,
-                    [(c.data, c.validity, c.offsets) if c.dtype.is_string
-                     else (c.data, c.validity) for c in b.columns])
+
+        def views(c):
+            # lazy (codes-only) string columns ship codes+validity and
+            # decode through their static dictionary on the host —
+            # touching .data here would materialize the worst-case char
+            # slab on device and ship it over the tunnel
+            if c.dtype.is_string and c.is_lazy:
+                return (c.validity, c.dict_codes)
+            if c.dtype.is_string:
+                return (c.data, c.validity, c.offsets)
+            return (c.data, c.validity)
+
+        payload = [(b.num_rows, [views(c) for c in b.columns])
                    for b in batches]
         host = jax.device_get(payload)
         out: List[pd.DataFrame] = []
@@ -267,7 +279,10 @@ class DeviceBatch:
             b._host_rows = n
             series: List[pd.Series] = []
             for dt, col, parts in zip(b.schema.dtypes, b.columns, host_cols):
-                if dt.is_string:
+                if dt.is_string and col.is_lazy:
+                    validity, codes = (np.asarray(p) for p in parts)
+                    trimmed = (validity[:n], codes[:n])
+                elif dt.is_string:
                     chars, validity, offsets = (np.asarray(p) for p in parts)
                     trimmed = (validity[:n], offsets[:n + 1], chars)
                 else:

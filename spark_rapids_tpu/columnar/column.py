@@ -36,23 +36,34 @@ class DeviceColumn:
     ``validity`` is bool (capacity,), True = valid. Padding rows are invalid.
     """
 
-    def __init__(self, dtype: DType, data: jnp.ndarray,
+    def __init__(self, dtype: DType, data: Optional[jnp.ndarray],
                  validity: jnp.ndarray,
                  offsets: Optional[jnp.ndarray] = None,
                  prefix8: Optional[jnp.ndarray] = None,
                  dict_codes: Optional[jnp.ndarray] = None,
                  dict_values: Optional[tuple] = None):
         self.dtype = dtype
-        self.data = data
+        # codes-only (lazy) string column: ``data=None`` with a dictionary
+        # present. Chars/offsets materialize from the static dictionary on
+        # first access (the .data/.offsets properties) — pipeline stages
+        # that never read chars (concat, joins on other keys, dict-coded
+        # grouping/sorting/predicates) move ONLY the int32 codes, which
+        # measured ~2x cheaper than even the dict-rebuild char gather at
+        # fact-table scale. The TPU answer to cuDF keeping dictionary
+        # columns encoded end-to-end.
+        assert data is not None or (dtype.is_string
+                                    and dict_values is not None), dtype
+        self._data = data
         self.validity = validity
-        self.offsets = offsets
+        self._offsets = offsets
         # optional per-row big-endian image of the first 8 bytes (uint64,
         # (capacity,)): computed host-side at upload for scanned string
         # columns and propagated through gathers, it lets grouping/sorting
         # read key bytes without per-row char gathers (which lower to
         # seconds-per-million-rows scalar loops on TPU). Derived string
-        # columns may carry None.
-        self.prefix8 = prefix8
+        # columns may carry None. Lazy (codes-only) columns derive it
+        # from the dictionary on access.
+        self._prefix8 = prefix8
         # optional host-computed dictionary encoding (low-cardinality
         # columns): ``dict_codes`` int32 (capacity,) with values in
         # [0, card], where card = len(dict_values) encodes NULL (and row
@@ -65,26 +76,121 @@ class DeviceColumn:
         self.dict_codes = dict_codes
         self.dict_values = dict_values
 
+    # --- lazy chars (codes-only string columns) ---------------------------
+    @property
+    def data(self):
+        if self._data is None:
+            self._materialize_chars()
+        return self._data
+
+    @property
+    def offsets(self):
+        if self._offsets is None and self._data is None \
+                and self.dtype.is_string:
+            self._materialize_chars()
+        return self._offsets
+
+    @property
+    def prefix8(self):
+        if (self._prefix8 is None and self.dtype.is_string
+                and self.dict_values is not None
+                and self.dict_codes is not None):
+            # row-space derivation from the static dictionary — cheap (one
+            # tiny-table gather), no char materialization needed
+            import numpy as np
+            card = len(self.dict_values)
+            imgs = np.asarray(
+                [int.from_bytes(v.encode("utf-8")[:8].ljust(8, b"\0"),
+                                "big") for v in self.dict_values] + [0],
+                np.uint64)
+            self._prefix8 = jnp.where(
+                self.validity,
+                jnp.asarray(imgs)[jnp.clip(self.dict_codes, 0, card)],
+                jnp.uint64(0))
+        return self._prefix8
+
+    @prefix8.setter
+    def prefix8(self, v) -> None:
+        self._prefix8 = v
+
+    @property
+    def is_lazy(self) -> bool:
+        """True while chars/offsets are unmaterialized (codes-only)."""
+        return self._data is None
+
+    def dict_tables(self):
+        """Host constants of the static dictionary: (chars u8, starts
+        int32 (card+1,), lens int32 (card+1,)) — trailing entry is the
+        NULL sentinel (empty)."""
+        import numpy as np
+        vals_b = [v.encode("utf-8") for v in self.dict_values]
+        dchars = np.frombuffer(b"".join(vals_b) or b"\0", np.uint8)
+        dlens = np.asarray([len(v) for v in vals_b] + [0], np.int32)
+        dstarts = np.concatenate([[0], np.cumsum(dlens[:-1])]).astype(
+            np.int32)
+        return dchars, dstarts, dlens
+
+    def _materialize_chars(self) -> None:
+        """Rebuild chars+offsets from dictionary codes (jnp ops: works
+        eagerly or inside a consumer's trace). Char capacity is the
+        static worst case capacity*maxlen, bucketed."""
+        assert self.dict_values is not None and self.dict_codes is not None
+        dchars, dstarts, dlens = self.dict_tables()
+        card = len(self.dict_values)
+        cap = int(self.validity.shape[0])
+        code_c = jnp.clip(self.dict_codes, 0, card)
+        lens = jnp.where(self.validity, jnp.asarray(dlens)[code_c], 0)
+        offsets = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(lens).astype(jnp.int32)])
+        max_len = max((len(v.encode("utf-8")) for v in self.dict_values),
+                      default=1)
+        char_cap = _char_bucket(cap * max_len)
+        from spark_rapids_tpu.ops.rowops import rank_of_iota
+        k = jnp.arange(char_cap, dtype=jnp.int32)
+        out_row = jnp.clip(rank_of_iota(offsets, char_cap) - 1, 0, cap - 1)
+        src = (jnp.asarray(dstarts)[code_c[out_row]]
+               + (k - offsets[out_row]))
+        chars = jnp.asarray(dchars)[jnp.clip(src, 0, dchars.shape[0] - 1)]
+        total = offsets[cap]
+        self._data = jnp.where(k < total, chars, 0).astype(jnp.uint8)
+        self._offsets = offsets
+
     # --- pytree protocol ---------------------------------------------------
     def tree_flatten(self):
-        leaves = [self.data, self.validity]
+        lazy = self._data is None
+        if lazy:
+            # codes-only: validity + codes are the whole payload; chars
+            # materialize on the other side on demand
+            return ((self.validity, self.dict_codes),
+                    (self.dtype, False, self.dict_values, True))
+        leaves = [self._data, self.validity]
         if self.dtype.is_string:
-            leaves.append(self.offsets)
-        has_prefix = self.dtype.is_string and self.prefix8 is not None
+            leaves.append(self._offsets)
+        has_prefix = self.dtype.is_string and self._prefix8 is not None
         if has_prefix:
-            leaves.append(self.prefix8)
+            leaves.append(self._prefix8)
         if self.dict_values is not None:
             leaves.append(self.dict_codes)
-        return tuple(leaves), (self.dtype, has_prefix, self.dict_values)
+        return tuple(leaves), (self.dtype, has_prefix, self.dict_values,
+                               False)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         if isinstance(aux, tuple):
-            dtype, has_prefix, dict_values = (aux if len(aux) == 3
-                                              else (*aux, None))
+            if len(aux) == 4:
+                dtype, has_prefix, dict_values, lazy = aux
+            elif len(aux) == 3:
+                (dtype, has_prefix, dict_values), lazy = aux, False
+            else:
+                (dtype, has_prefix), dict_values, lazy = aux, None, False
         else:
-            dtype, has_prefix, dict_values = aux, False, None
+            dtype, has_prefix, dict_values, lazy = aux, False, None, False
         it = list(children)
+        if lazy:
+            validity, dict_codes = it
+            return cls(dtype, None, validity, dict_codes=dict_codes,
+                       dict_values=dict_values)
         data, validity = it[0], it[1]
         pos = 2
         offsets = prefix8 = dict_codes = None
@@ -108,9 +214,9 @@ class DeviceColumn:
     # --- properties --------------------------------------------------------
     @property
     def capacity(self) -> int:
-        if self.dtype.is_string:
-            return int(self.offsets.shape[0]) - 1
-        return int(self.data.shape[0])
+        # validity is (capacity,) for every kind — and reading it never
+        # triggers lazy char materialization
+        return int(self.validity.shape[0])
 
     @property
     def char_capacity(self) -> int:
@@ -188,7 +294,10 @@ class DeviceColumn:
         """The device arrays a host copy needs (leading-rows slices).
         Kept lazy so a whole batch's views can ride ONE jax.device_get —
         per-buffer fetches each pay a full round trip on remote
-        attachments."""
+        attachments. Codes-only columns ship just codes+validity and
+        decode through the static dictionary on the host."""
+        if self._data is None and self.dtype.is_string:
+            return (self.validity[:num_rows], self.dict_codes[:num_rows])
         if self.dtype.is_string:
             return (self.validity[:num_rows], self.offsets[:num_rows + 1],
                     self.data)
@@ -204,6 +313,14 @@ class DeviceColumn:
     def numpy_from_host(self, host_parts,
                         num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
         """Finish a host copy from already-fetched device_views buffers."""
+        if self._data is None and self.dtype.is_string:
+            validity, codes = (np.asarray(p) for p in host_parts)
+            card = len(self.dict_values)
+            table = np.asarray(list(self.dict_values) + [None],
+                               dtype=object)
+            out = table[np.clip(codes, 0, card)]
+            out[~validity] = None
+            return out, validity
         if self.dtype.is_string:
             import pyarrow as pa
             validity, offsets, chars = (np.asarray(p) for p in host_parts)

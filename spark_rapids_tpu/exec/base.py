@@ -63,6 +63,19 @@ class PhysicalPlan:
             return parts
         import time
         op = self.describe()
+        # profile mode: force a device sync after every operator's batch
+        # so totalTime is ATTRIBUTABLE per kernel — without it dispatch is
+        # async and all queued compute lands on whichever operator first
+        # syncs (the first device_get carries ~85% of wall time). NB on
+        # the tunneled attachment block_until_ready does not reliably
+        # block; fetching the num_rows device scalar does.
+        sync_each = ctx.profile_sync
+
+        def _force_sync(batch):
+            nr = getattr(batch, "num_rows", None)
+            if nr is not None:
+                import jax
+                jax.device_get(nr)
         try:
             from jax.profiler import TraceAnnotation
         except ImportError:  # pragma: no cover
@@ -79,6 +92,14 @@ class PhysicalPlan:
                             batch = next(it)
                         except StopIteration:
                             return
+                    if sync_each:
+                        _force_sync(batch)
+                        # per-node-identity inclusive time: the profiler
+                        # subtracts children to get exclusive per-kernel
+                        # attribution (describe() keys merge same-shaped
+                        # operators, which hides where time goes)
+                        ctx.node_times[id(self)] = ctx.node_times.get(
+                            id(self), 0.0) + (time.perf_counter() - t0)
                     ctx.metric_add(op, "totalTime",
                                    time.perf_counter() - t0)
                     ctx.metric_add(op, "numOutputBatches", 1)
@@ -120,6 +141,10 @@ class ExecContext:
         self.metrics: dict = {}
         self.metrics_enabled = conf.get_bool(
             "spark.rapids.sql.metrics.enabled", True)
+        # per-operator sync for kernel attribution (tools/profile_query.py)
+        self.profile_sync = conf.get_bool(
+            "spark.rapids.sql.profile.syncEachOp", False)
+        self.node_times: dict = {}
 
     def metric_add(self, op: str, name: str, value):
         self.metrics.setdefault(op, {}).setdefault(name, 0)

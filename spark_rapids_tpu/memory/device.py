@@ -84,7 +84,9 @@ class TpuDeviceManager:
         dev = None
         try:
             for col in batch.columns:
-                devs = col.data.devices()
+                # validity, not data: lazy (codes-only) string columns
+                # must not materialize chars just to be metered
+                devs = col.validity.devices()
                 if len(devs) != 1:
                     return None
                 d = next(iter(devs))

@@ -50,15 +50,23 @@ def _key_valid(batch: DeviceBatch, key_idx: Sequence[int]) -> jnp.ndarray:
     return v
 
 
-def _key_images(batch: DeviceBatch,
-                key_idx: Sequence[int]) -> List[jnp.ndarray]:
+def _key_images(batch: DeviceBatch, key_idx: Sequence[int],
+                dict_ok: Sequence[bool] = ()) -> List[jnp.ndarray]:
     """Exact per-row equality-image vectors for the join keys (one or more
-    u64 arrays per key column; see module docstring)."""
+    u64 arrays per key column; see module docstring). ``dict_ok[i]``:
+    both sides of key i share the identical dictionary, so the code alone
+    is an exact equality image (no prefix chunks, no poly hashes, no char
+    reads) — codes from DIFFERENT dictionaries are never comparable, so
+    the caller asserts the tuples match (join_probe)."""
     from spark_rapids_tpu.ops.hashing import string_poly_hashes
     from spark_rapids_tpu.ops.sortops import u64_key_image
     imgs: List[jnp.ndarray] = []
-    for ki in key_idx:
+    for j, ki in enumerate(key_idx):
         col = batch.columns[ki]
+        if (col.dtype.is_string and j < len(dict_ok) and dict_ok[j]
+                and col.dict_values is not None):
+            imgs.append(col.dict_codes.astype(jnp.uint64))
+            continue
         imgs.extend(u64_key_image(col))
         if col.dtype.is_string:
             h1, h2 = string_poly_hashes(col.offsets, col.data, col.validity)
@@ -101,8 +109,15 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
             is_stable=True)
         return counts, bstart, bperm
 
-    b_imgs = _key_images(build, build_keys)
-    s_imgs = _key_images(stream, stream_keys)
+    # per-key: both sides share one identical dictionary -> the code is
+    # the exact equality image (and the >64-byte repair is unnecessary)
+    dict_ok = tuple(
+        build.columns[bk].dtype.is_string
+        and build.columns[bk].dict_values is not None
+        and build.columns[bk].dict_values == stream.columns[sk].dict_values
+        for bk, sk in zip(build_keys, stream_keys))
+    b_imgs = _key_images(build, build_keys, dict_ok)
+    s_imgs = _key_images(stream, stream_keys, dict_ok)
     assert len(b_imgs) == len(s_imgs), (len(b_imgs), len(s_imgs))
     bkv = _key_valid(build, build_keys)
     skv = _key_valid(stream, stream_keys)
@@ -143,8 +158,8 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
     # exact_long_strings=False the dual-hash tiebreak stands (incompat,
     # spark.rapids.sql.join.exactLongStrings).
     str_pairs = [(build.columns[bk], stream.columns[sk])
-                 for bk, sk in zip(build_keys, stream_keys)
-                 if build.columns[bk].dtype.is_string]
+                 for j, (bk, sk) in enumerate(zip(build_keys, stream_keys))
+                 if build.columns[bk].dtype.is_string and not dict_ok[j]]
     if exact_long_strings and str_pairs:
         prev_valid = jnp.concatenate(
             [jnp.zeros((1,), jnp.bool_), valid_s[:-1]])
@@ -292,16 +307,24 @@ def expand_totals(build: DeviceBatch, stream: DeviceBatch,
     col...]. String char totals are exact (each emitted pair copies the
     source strings once); build-side totals ride a prefix sum over the
     sorted build rows."""
+    def str_lens(c):
+        """Per-row byte lengths WITHOUT materializing lazy (codes-only)
+        columns: dictionary lengths ride a tiny-table row-space gather."""
+        if c.is_lazy:
+            _dchars, _dstarts, dlens = c.dict_tables()
+            card = len(c.dict_values)
+            lens = jnp.asarray(dlens)[jnp.clip(c.dict_codes, 0, card)]
+            return jnp.where(c.validity, lens, 0).astype(jnp.int64)
+        return (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+
     parts = [counts_adj.sum().astype(jnp.int64)]
     for c in stream.columns:
         if c.dtype.is_string:
-            lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
-            parts.append((counts_adj.astype(jnp.int64) * lens).sum())
+            parts.append((counts_adj.astype(jnp.int64) * str_lens(c)).sum())
     nb = build.capacity
     for c in build.columns:
         if c.dtype.is_string:
-            lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
-            lens_sorted = lens[bperm]
+            lens_sorted = str_lens(c)[bperm]
             cl = jnp.concatenate([jnp.zeros((1,), jnp.int64),
                                   jnp.cumsum(lens_sorted)])
             hi = jnp.clip(bstart + counts, 0, nb)

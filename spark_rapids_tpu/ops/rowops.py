@@ -80,6 +80,13 @@ def gather_columns(cols: Sequence[DeviceColumn], perm: jnp.ndarray,
     for i, c in enumerate(cols):
         add(c.validity, i, "validity")
         if c.dtype.is_string:
+            if c.dict_values is not None:
+                # dictionary strings move ONLY their codes; the output is
+                # a codes-only (lazy) column — chars rebuild from the
+                # static dictionary if a consumer ever reads them. Char
+                # space (tens of MB at fact scale) is never touched here.
+                add(c.dict_codes, i, "codes")
+                continue
             # _ExtentColumn (concat's flat view) carries explicit extents;
             # plain columns derive them from the offsets vector
             lens = getattr(c, "ext_lens", None)
@@ -127,11 +134,19 @@ def gather_columns(cols: Sequence[DeviceColumn], perm: jnp.ndarray,
             continue
         occ = char_caps[si] if si < len(char_caps) else 0
         si += 1
+        if codes is not None:
+            # codes-only output: chars never move (see the add() loop) —
+            # the column materializes from its static dictionary only if
+            # some consumer actually reads chars
+            out.append(DeviceColumn(c.dtype, None, validity,
+                                    dict_codes=codes,
+                                    dict_values=c.dict_values))
+            continue
+        nchars = c.data.shape[0]
         new_len = jnp.where(live, p["lens"], 0)
         new_offsets = jnp.concatenate([
             jnp.zeros((1,), jnp.int32),
             jnp.cumsum(new_len).astype(jnp.int32)])
-        nchars = c.data.shape[0]
         out_chars_n = occ if occ > 0 else nchars
         total_new = new_offsets[out_cap]
         k = jnp.arange(out_chars_n, dtype=jnp.int32)
@@ -256,6 +271,14 @@ def concat_batches(batches: Sequence[DeviceBatch],
         shared = _shared_dict(parts)
         codes = (jnp.concatenate([p.dict_codes for p in parts])
                  if shared is not None else None)
+        if dt.is_string and shared is not None:
+            # dictionary strings concat as codes only — no char extents,
+            # no char slab reads (and lazy inputs stay unmaterialized)
+            flat_cols.append(DeviceColumn(
+                dt, None, jnp.concatenate([p.validity for p in parts]),
+                dict_codes=codes, dict_values=shared))
+            char_caps.append(0)
+            continue
         if dt.is_string:
             char_base = 0
             starts_parts = []

@@ -35,11 +35,24 @@ from spark_rapids_tpu.ops.rowops import gather_batch
 STRING_PREFIX_CHUNKS = 8  # 64 prefix bytes
 
 
-def u64_key_image(col: DeviceColumn) -> List[jnp.ndarray]:
-    """Order-preserving uint64 image(s) of a column (ascending order)."""
-    d = col.data
+def u64_key_image(col: DeviceColumn,
+                  allow_dict: bool = False) -> List[jnp.ndarray]:
+    """Order-preserving uint64 image(s) of a column (ascending order).
+
+    ``allow_dict``: dictionary codes are assigned in canonical sorted
+    value order (host_dict_encode), and UTF-8 byte order == code point
+    order, so the code IS an exact order-preserving and equality-exact
+    image — one int32 operand instead of eight 64-byte prefix chunks +
+    length, and no char reads at all. ONLY valid within one batch (or
+    between batches proven to share the identical dictionary): codes from
+    different dictionaries are not comparable, so cross-batch operand
+    consumers (range-partition bounds) must keep it off."""
     if col.dtype.is_string:
+        if (allow_dict and col.dict_values is not None
+                and col.dict_codes is not None):
+            return [col.dict_codes.astype(jnp.uint64)]
         return _string_prefix_chunks(col)
+    d = col.data
     if d.dtype == jnp.bool_:
         return [d.astype(jnp.uint64)]
     if jnp.issubdtype(d.dtype, jnp.floating):
@@ -143,10 +156,11 @@ def sort_permutation(batch: DeviceBatch,
                      nulls_first: Sequence[bool]) -> jnp.ndarray:
     """Row permutation sorting live rows; padding rows sort to the end."""
     live = batch.row_mask()
-    # dead rows last, always; then the shared key operands (also used for
-    # range partitioning so bounds compare exactly like this sort)
+    # dead rows last, always. Within-batch sort: dictionary strings sort
+    # by code (order-preserving by construction) — one operand, no chars
     return lexsort_live_last(
-        sort_key_operands(batch, key_indices, ascending, nulls_first),
+        sort_key_operands(batch, key_indices, ascending, nulls_first,
+                          allow_dict=True),
         (~live).astype(jnp.uint8))
 
 
@@ -159,18 +173,22 @@ def sort_batch(batch: DeviceBatch, key_indices: Sequence[int],
 
 def sort_key_operands(batch: DeviceBatch, key_indices: Sequence[int],
                       ascending: Sequence[bool],
-                      nulls_first: Sequence[bool]) -> List[jnp.ndarray]:
+                      nulls_first: Sequence[bool],
+                      allow_dict: bool = False) -> List[jnp.ndarray]:
     """The per-row comparison operand vectors (null flags + order-preserving
     key images, direction applied) that sort_permutation sorts by — reused
     for range partitioning so partition bounds compare exactly like the
-    downstream sort."""
+    downstream sort. ``allow_dict`` (within-batch consumers only) lets
+    dictionary strings ride their code as the image; cross-batch operand
+    consumers (range bounds vs rows of other batches) must keep it off —
+    see u64_key_image."""
     operands: List[jnp.ndarray] = []
     for ki, asc, nf in zip(key_indices, ascending, nulls_first):
         col = batch.columns[ki]
         null_flag = (~col.validity).astype(jnp.uint8)
         flag = null_flag if not nf else (1 - null_flag)
         operands.append(flag.astype(jnp.uint64))
-        for img in u64_key_image(col):
+        for img in u64_key_image(col, allow_dict=allow_dict):
             operands.append(img if asc else ~img)
     return operands
 

@@ -321,6 +321,7 @@ class TpuSparkSession:
                 "diskStoreBytes": cat.disk_store.total_size,
             }
         self.last_query_metrics = ctx.metrics
+        self.last_node_times = ctx.node_times  # profiler (syncEachOp)
         return plan, outs
 
     def _note_rename_aliases(self, logical) -> None:

@@ -1,0 +1,76 @@
+"""Micro-profile of the exchange-collapse concat at a q12-like shape:
+12 batches x 250k rows of lineitem-ish columns (1 dict string + dates +
+floats), with and without keep_masks, plus per-piece variants isolating
+the string char gather."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, bucket_capacity
+from spark_rapids_tpu.ops import rowops
+
+rng = np.random.default_rng(0)
+NB, ROWS = 4, 750_000
+modes = np.array(["AIR", "AIR REG", "MAIL", "SHIP", "RAIL", "TRUCK", "FOB"],
+                 dtype=object)
+
+
+def mkbatch():
+    df = pd.DataFrame({
+        "l_shipmode": modes[rng.integers(0, len(modes), ROWS)],
+        "l_commitdate": rng.integers(8000, 10000, ROWS),
+        "l_receiptdate": rng.integers(8000, 10000, ROWS),
+        "l_shipdate": rng.integers(8000, 10000, ROWS),
+        "l_extendedprice": rng.uniform(900, 105000, ROWS),
+    })
+    return DeviceBatch.from_pandas(df)
+
+
+import sys
+print("building...", flush=True)
+batches = []
+for i in range(NB):
+    batches.append(mkbatch())
+    print(f"batch {i} built", flush=True)
+masks = [jnp.asarray(np.concatenate([rng.random(ROWS) < 0.2, np.zeros(b.capacity - ROWS, bool)])) for b in batches]
+out_cap = bucket_capacity(NB * ROWS)
+
+
+def t(label, fn, *args):
+    fn(*args)  # warm/compile
+    jax.device_get(jnp.zeros(1))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = fn(*args)
+        leaf = jax.tree_util.tree_leaves(r)[0]
+        jax.device_get(leaf.ravel()[:1])
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label:42s} {best*1000:8.1f} ms", flush=True)
+
+
+concat = jax.jit(rowops.concat_batches, static_argnums=(1, 2))
+t("concat 12x250k (5 cols, 1 dict-str)", concat, batches, out_cap, 0)
+
+concat_m = jax.jit(lambda bs, ks, oc: rowops.concat_batches(
+    bs, oc, 0, keep_masks=ks), static_argnums=(2,))
+t("concat+mask 12x250k", concat_m, batches, masks, out_cap)
+
+# fixed-width only
+fw = [DeviceBatch(b.schema.__class__(b.schema.names[1:], b.schema.dtypes[1:]),
+                  b.columns[1:], b.num_rows) for b in batches]
+t("concat fixed-only (4 cols)", concat, fw, out_cap, 0)
+t("concat+mask fixed-only", concat_m, fw, masks, out_cap)
+
+# string only
+so = [DeviceBatch(b.schema.__class__(b.schema.names[:1], b.schema.dtypes[:1]),
+                  b.columns[:1], b.num_rows) for b in batches]
+t("concat string-only (1 dict-str)", concat, so, out_cap, 0)
+t("concat+mask string-only", concat_m, so, masks, out_cap)

@@ -1,0 +1,62 @@
+"""Per-operator kernel attribution for one workload query.
+
+Runs the query warm, then timed with spark.rapids.sql.profile.syncEachOp
+so every operator's batch is synced before the clock stops — totalTime
+becomes real queued compute per operator instead of piling on the first
+sync. Usage:
+
+    python tools/profile_query.py q12           # TPC-H
+    python tools/profile_query.py tpcxbb.q9     # TPCxBB
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_rapids_tpu.session import TpuSparkSession
+
+qname = sys.argv[1] if len(sys.argv) > 1 else "q12"
+sf = float(os.environ.get("BENCH_SF", "0.5"))
+
+session = TpuSparkSession.builder().config(
+    "spark.rapids.sql.enabled", True).config(
+    "spark.rapids.sql.cacheDeviceScans", True).get_or_create()
+
+if qname.startswith("tpcxbb."):
+    from spark_rapids_tpu.models.tpcxbb import QUERIES, TpcxbbTables
+    tables = TpcxbbTables.generate(session, sf * 20, num_partitions=4)
+    fn = QUERIES[qname.split(".", 1)[1]]
+else:
+    from spark_rapids_tpu.models.tpch import QUERIES, TpchTables
+    tables = TpchTables.generate(session, sf, num_partitions=4)
+    fn = QUERIES[qname]
+
+# warm (compile + scan cache)
+t0 = time.perf_counter()
+fn(session, tables).collect()
+print(f"warm: {time.perf_counter()-t0:.2f}s", flush=True)
+t0 = time.perf_counter()
+fn(session, tables).collect()
+print(f"steady (no sync): {time.perf_counter()-t0:.2f}s", flush=True)
+
+session.set_conf("spark.rapids.sql.profile.syncEachOp", True)
+session.capture_plans = True
+t0 = time.perf_counter()
+fn(session, tables).collect()
+total = time.perf_counter() - t0
+print(f"steady (sync each op): {total:.2f}s\n", flush=True)
+
+plan = session.captured_plans[-1]
+times = session.last_node_times
+rows = []
+for node in plan.walk():
+    incl = times.get(id(node))
+    if incl is None:
+        continue
+    excl = incl - sum(times.get(id(c), 0.0) for c in node.children)
+    rows.append((excl, incl, node.describe()))
+rows.sort(reverse=True)
+print(f"{'excl_s':>8} {'incl_s':>8}  operator")
+for ex, inc, op in rows[:25]:
+    print(f"{ex:8.3f} {inc:8.3f}  {op[:110]}")
