@@ -211,9 +211,25 @@ def prune_filter_columns(root):
                                  node.condition)
             return f if required is None else narrow(f, required)
         if isinstance(node, lp.LogicalProject):
-            req = cols_of(*(e for _n, e in node.exprs))
-            return lp.LogicalProject(rewrite(node.children[0], req),
-                                     node.exprs)
+            # project-output pruning: drop outputs no ancestor references
+            # (with_column() re-emits EVERY input column, which would
+            # otherwise stop pruning dead at each derived column — q7's
+            # l_year project kept a 37-column intermediate alive through
+            # a five-join chain)
+            exprs = node.exprs
+            if required is not None:
+                kept = [(n, e) for n, e in node.exprs if n in required]
+                if not kept:
+                    # nothing referenced (e.g. count(*) above): keep ONE
+                    # output to preserve the row count — prefer a bare
+                    # column ref (zero-cost under the selection fast
+                    # path) over whatever derived expr happens first
+                    bare = [(n, e) for n, e in node.exprs
+                            if isinstance(e, Col)]
+                    kept = bare[:1] or node.exprs[:1]
+                exprs = kept
+            req = cols_of(*(e for _n, e in exprs))
+            return lp.LogicalProject(rewrite(node.children[0], req), exprs)
         if isinstance(node, lp.LogicalAggregate):
             req = cols_of(*(e for _n, e in node.grouping),
                           *(e for _n, e in node.results))
@@ -238,9 +254,17 @@ def prune_filter_columns(root):
                 # the build side contributes no output columns: always
                 # prunable down to its keys (+ condition inputs)
                 rreq = (keyreq_r | cond_req) & rnames
+            # narrow each side AT the join input: every dead column a
+            # join carries is gathered again by every expand above it
+            # (join chains ran 30+-column expands before this)
+            left = rewrite(node.children[0], lreq)
+            right = rewrite(node.children[1], rreq)
+            if lreq is not None:
+                left = narrow(left, lreq)
+            if rreq is not None:
+                right = narrow(right, rreq)
             return lp.LogicalJoin(
-                rewrite(node.children[0], lreq),
-                rewrite(node.children[1], rreq),
+                left, right,
                 node.join_type, node.left_keys, node.right_keys,
                 node.condition)
         if isinstance(node, lp.LogicalSort):
