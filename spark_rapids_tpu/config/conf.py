@@ -112,6 +112,20 @@ EXCHANGE_FUSE_FILTER = register(
     "gather, eliminating the standalone filter's per-batch per-column "
     "gathers (~5M rows/s on TPU).")
 
+ADAPTIVE_CAPACITY = register(
+    "spark.rapids.sql.adaptiveCapacity.enabled", _to_bool, True,
+    "Adaptive (AQE-style) output-capacity speculation: the session "
+    "remembers each join's expansion sizes per structural plan "
+    "fingerprint and later executions of the same query skip the "
+    "per-join device->host capacity sync, expanding straight into the "
+    "remembered buckets. The exact sizes are still computed on device; "
+    "ONE deferred fetch at query end verifies every speculated capacity "
+    "covered its actual size and the query transparently re-executes "
+    "without speculation on any miss — correctness never depends on the "
+    "cache. On a high-latency host-device link (tunneled attachment: "
+    "100-250ms per round trip) this removes the dominant steady-state "
+    "cost of join-heavy plans.")
+
 AGG_SKIP_RATIO = register(
     "spark.rapids.sql.agg.skipAggPassReductionRatio", float, 0.85,
     "Adaptive partial-aggregation skip: after the first batch of a "

@@ -126,16 +126,44 @@ class PhysicalPlan:
     def describe(self) -> str:
         return self.name
 
+    def fingerprint_extra(self) -> str:
+        """Extra identity beyond ``describe()`` for the structural plan
+        fingerprint (plan_fingerprint): scans add their data identity,
+        projects their expression signatures. Collisions are safe — every
+        consumer of the fingerprint (the adaptive capacity cache) device-
+        verifies what it speculates — they only cost cache churn."""
+        return ""
+
     def walk(self):
         yield self
         for c in self.children:
             yield from c.walk()
 
 
+def plan_fingerprint(node: "PhysicalPlan") -> str:
+    """Structural identity of a plan subtree, stable across executions of
+    the same query over the same data (plan objects are rebuilt per
+    execution; this string is not). Keys the session's adaptive capacity
+    cache (reference analogue: AQE's per-stage runtime statistics reuse,
+    which also keys on the canonicalized plan subtree)."""
+    import hashlib
+    parts: List[str] = []
+
+    def rec(n: "PhysicalPlan") -> None:
+        parts.append(n.describe())
+        parts.append(n.fingerprint_extra())
+        parts.append("(")
+        for c in n.children:
+            rec(c)
+        parts.append(")")
+    rec(node)
+    return hashlib.md5("|".join(parts).encode()).hexdigest()
+
+
 class ExecContext:
     """Per-query execution context: conf, session services, metrics."""
 
-    def __init__(self, conf, session=None):
+    def __init__(self, conf, session=None, speculate: bool = True):
         self.conf = conf
         self.session = session
         self.metrics: dict = {}
@@ -145,6 +173,18 @@ class ExecContext:
         self.profile_sync = conf.get_bool(
             "spark.rapids.sql.profile.syncEachOp", False)
         self.node_times: dict = {}
+        # adaptive capacity speculation (spark.rapids.sql.adaptiveCapacity.
+        # enabled): operators that speculated a device->host size fetch
+        # from the session cache append (key, totals_device, caps_used,
+        # ok_flags_device) here; the session verifies the whole list in
+        # ONE fetch at query end and re-executes without speculation on
+        # any miss (session._execute). ``speculate=False`` is that exact
+        # re-execution.
+        self.speculate = (
+            speculate and session is not None
+            and conf.get_bool("spark.rapids.sql.adaptiveCapacity.enabled",
+                              True))
+        self.spec_pending: list = []
 
     def metric_add(self, op: str, name: str, value):
         self.metrics.setdefault(op, {}).setdefault(name, 0)

@@ -62,6 +62,9 @@ class CpuScanExec(PhysicalPlan):
     def describe(self) -> str:
         return f"CpuScanExec({self.source.describe()})"
 
+    def fingerprint_extra(self) -> str:
+        return self.source.data_uid()
+
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         if self.pushed_filters and hasattr(self.source, "prune_splits"):
             return self.source.cpu_partitions(ctx, self.pushed_filters)

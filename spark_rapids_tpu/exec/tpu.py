@@ -211,6 +211,10 @@ class TpuProjectExec(TpuExec):
     def describe(self) -> str:
         return f"TpuProjectExec([{', '.join(n for n, _ in self.exprs)}])"
 
+    def fingerprint_extra(self) -> str:
+        from spark_rapids_tpu.utils.kernelcache import expr_signature
+        return ";".join(expr_signature(e) for _, e in self.exprs)
+
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         from spark_rapids_tpu.exec import taskctx
         child_parts = self.children[0].executed_partitions(ctx)
@@ -357,6 +361,9 @@ class TpuHashAggregateExec(TpuExec):
         fused = (f", fused_filter={self.pre_mask!r}"
                  if self.pre_mask is not None else "")
         return f"TpuHashAggregateExec(mode={self.mode}, keys=[{keys}]{fused})"
+
+    def fingerprint_extra(self) -> str:
+        return self.plan.signature
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child_parts = self.children[0].executed_partitions(ctx)
@@ -716,6 +723,13 @@ class TpuScanExec(TpuExec):
 
     def describe(self) -> str:
         return f"TpuScanExec({self.source.describe()})"
+
+    def fingerprint_extra(self) -> str:
+        # pushed filters are (name, op, value) tuples (sql/pushdown.py
+        # extract_pushable_filters), with repr-stable literal values
+        pushed = ",".join(repr(f) for f in (self.pushed_filters or ()))
+        return (f"{self.source.data_uid()}|{pushed}"
+                f"|{','.join(self._schema.names)}")
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         if self.pushed_filters and hasattr(self.source, "prune_splits"):

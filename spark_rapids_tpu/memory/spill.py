@@ -374,6 +374,14 @@ class BufferCatalog:
         assert buf is not None, f"unknown buffer id {buffer_id}"
         return buf.get_batch()
 
+    def contains(self, buffer_id: int) -> bool:
+        """Is the id still registered? Consumers that cache buffer ids
+        across query executions (broadcast exchange) must re-materialize
+        after a release (query-end transient sweep or a speculation
+        re-execution, session._execute)."""
+        with self._lock:
+            return buffer_id in self._buffers
+
     def promoted(self, buf: SpillableBuffer, old_tier: StorageTier) -> None:
         """A spilled buffer faulted back to the device tier: move its store
         registration and re-meter the allocation."""

@@ -50,7 +50,11 @@ class ColumnarRdd:
                 "query does not end on the TPU; the export would require a "
                 "device->host round trip (plan root: "
                 f"{plan.describe()})")
-        ctx = ExecContext(conf, session)
+        # speculate=False: the partitions are handed to an external
+        # consumer and nothing would run the session's deferred
+        # speculation verification on this context — capacity syncs must
+        # stay exact here (session._verify_speculation contract)
+        ctx = ExecContext(conf, session, speculate=False)
         return plan.executed_partitions(ctx)
 
 

@@ -71,6 +71,15 @@ class TpuSparkSession:
         # partial-pass reduction ratio (groups/rows); known-poor reducers
         # skip their partial pass from batch 0 on later executions
         self.agg_ratio_cache: dict = {}
+        # adaptive capacity speculation (spark.rapids.sql.adaptiveCapacity
+        # .enabled): structural-plan-fingerprint -> last observed join
+        # expansion sizes; later executions skip the per-join capacity
+        # sync and verify in one deferred fetch (exec/tpujoin.py,
+        # _verify_speculation). capacity_spec_reruns counts verification
+        # misses (each one transparently re-executed without speculation).
+        self.capacity_cache: dict = {}
+        self.capacity_spec_reruns = 0
+        self.capacity_spec_hits = 0
         # scan-derived integer column bounds: column name -> (min, max),
         # unioned across every scanned batch carrying that name. ADVISORY
         # (the role of the reference's cuDF column min/max the join build
@@ -302,8 +311,27 @@ class TpuSparkSession:
             self.captured_plans.append(plan)
         # final output to host
         outs: List[pd.DataFrame] = []
+        if ctx.speculate and any(
+                type(n).__name__ in ("TpuWriteExec", "CpuWriteExec")
+                for n in plan.walk()):
+            # writes commit files DURING the drain; a speculation miss
+            # detected after it would have committed truncated output and
+            # the re-execution would collide with the committed path.
+            # Capacity syncs stay exact under write commands.
+            ctx.speculate = False
         try:
             outs = self._drain(plan, ctx, conf)
+            if ctx.spec_pending and not self._verify_speculation(ctx):
+                # a speculated capacity did not cover its actual size:
+                # the speculative output may be truncated. Re-execute the
+                # same physical plan without speculation (the cache
+                # entries that missed were dropped above, so the next
+                # execution re-learns them with the exact sync).
+                self.capacity_spec_reruns += 1
+                self.release_active_shuffles()
+                self.release_transient_buffers()
+                ctx = ExecContext(conf, self, speculate=False)
+                outs = self._drain(plan, ctx, conf)
         finally:
             self.release_active_shuffles()
             self.release_transient_buffers()
@@ -323,6 +351,56 @@ class TpuSparkSession:
         self.last_query_metrics = ctx.metrics
         self.last_node_times = ctx.node_times  # profiler (syncEachOp)
         return plan, outs
+
+    def _verify_speculation(self, ctx) -> bool:
+        """ONE deferred fetch validating every capacity the query
+        speculated (exec/tpujoin.py). A covered speculation is EXACT —
+        capacities only pad — so success means the speculative output
+        stands; any shortfall (or a dense-probe ok-flag gone false) drops
+        the offending cache entry and returns False, and _execute
+        re-runs the plan without speculation. Surviving entries are
+        refreshed with the actual sizes so the cache follows data drift
+        while it stays inside the buckets."""
+        import jax
+        flat = []
+        for _key, totals_d, _caps, oks_d in ctx.spec_pending:
+            flat.extend(totals_d)
+            flat.extend(oks_d)
+        fetched = jax.device_get(flat) if flat else []
+        pos = 0
+        all_good = True
+        for key, totals_d, caps, oks_d in ctx.spec_pending:
+            sizes = fetched[pos:pos + len(totals_d)]
+            pos += len(totals_d)
+            oks = fetched[pos:pos + len(oks_d)]
+            pos += len(oks_d)
+            good = all(bool(o) for o in oks)
+            if good:
+                # verify the CONSUMED prefix (a short-circuiting parent —
+                # CollectLimit — may abandon the emission loop early;
+                # batches never expanded cannot have truncated anything)
+                for cap, sz in zip(caps, sizes):
+                    sz = [int(x) for x in sz]
+                    if cap is None:  # speculated-empty batch
+                        if sz[0] != 0:
+                            good = False
+                            break
+                        continue
+                    out_cap, s_caps, b_caps = cap
+                    cchars = list(s_caps) + list(b_caps)
+                    if (sz[0] > out_cap or len(sz) - 1 != len(cchars)
+                            or any(c > cc
+                                   for c, cc in zip(sz[1:], cchars))):
+                        good = False
+                        break
+            if good:
+                ent = self.capacity_cache.get(key)
+                if ent is not None and len(sizes) == ent.get("n"):
+                    ent["sizes"] = [[int(x) for x in s] for s in sizes]
+            else:
+                self.capacity_cache.pop(key, None)
+                all_good = False
+        return all_good
 
     def _note_rename_aliases(self, logical) -> None:
         from spark_rapids_tpu.sql.exprs.core import Alias, Col

@@ -290,6 +290,18 @@ def prune_filter_columns(root):
             if required is None:
                 return with_children(
                     node, [rewrite(c, None) for c in node.children])
+            if not required:
+                # count(*)-style: nothing referenced by name. Branches
+                # pruned independently with an empty requirement would
+                # each keep an ARBITRARY surviving column — positionally
+                # misaligning the union. Coordinate on each branch's
+                # position-0 column (dtypes agree positionally by union
+                # precondition), keeping the row counts and alignment.
+                kids = []
+                for c in node.children:
+                    first = {c.schema().names[0]}
+                    kids.append(narrow(rewrite(c, first), first))
+                return with_children(node, kids)
             # every branch must end at the SAME narrowed schema (union
             # concatenates positionally)
             return with_children(

@@ -7,6 +7,7 @@ pyarrow performs the host decode, the HostToDevice transition uploads.
 
 from __future__ import annotations
 
+import itertools
 import math
 from typing import List, Optional, Tuple
 
@@ -17,8 +18,23 @@ from spark_rapids_tpu.columnar.batch import Schema
 from spark_rapids_tpu.exec.base import ExecContext, Partition
 
 
+_DATA_UID_COUNTER = itertools.count(1)
+
+
 class DataSource:
     schema: Schema
+
+    def data_uid(self) -> str:
+        """Stable identity of the *data* behind this source for the
+        session's adaptive caches: two scans of the same source object
+        (or projection views of it, ``with_columns``) share a uid; a new
+        upload gets a fresh one (a process-unique counter, never an
+        ``id()`` that the allocator could reuse)."""
+        base = getattr(self, "_base", self)
+        uid = getattr(base, "_data_uid", None)
+        if uid is None:
+            uid = base._data_uid = next(_DATA_UID_COUNTER)
+        return f"{type(base).__name__}#{uid}"
 
     def describe(self) -> str:
         return type(self).__name__

@@ -1,0 +1,154 @@
+"""Adaptive capacity speculation (spark.rapids.sql.adaptiveCapacity.enabled).
+
+The session remembers join expansion sizes per structural plan fingerprint
+and later executions of the same query skip the per-join capacity sync,
+verifying every speculated capacity in one deferred fetch at query end
+(exec/tpujoin.py, session._verify_speculation). These tests pin the three
+contract points: repeated runs stay oracle-exact, a corrupted (undersized)
+cache entry is detected and transparently re-executed, and the conf gate
+really disables the machinery. Reference analogue: AQE runtime-statistics
+reuse — also advisory, also never allowed to change results.
+
+The tables are uploaded ONCE per test and the query rebuilt from the same
+DataFrame handles — the fingerprint carries the upload's data uid, so a
+fresh upload is (correctly) a fresh cache key; reuse is what real
+workloads (the bench's generated-once tables) do.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.querytest import (
+    assert_frames_equal, with_cpu_session, with_tpu_session,
+)
+
+
+def _tables(session, rng, n_orders=4000, n_cust=300):
+    orders = pd.DataFrame({
+        "o_id": np.arange(n_orders, dtype=np.int64),
+        "cust": pd.Series(rng.integers(0, n_cust, n_orders)).astype("Int64")
+                  .mask(pd.Series(rng.random(n_orders) < 0.05)),
+        "amount": rng.uniform(1.0, 900.0, n_orders),
+    })
+    cust = pd.DataFrame({
+        "cust": pd.Series(np.arange(n_cust, dtype=np.int64)).astype("Int64"),
+        "name": pd.Series([f"cust_{i}" for i in range(n_cust)]),
+        "tier": rng.integers(0, 3, n_cust),
+    })
+    return (session.create_dataframe(orders, 2),
+            session.create_dataframe(cust, 2))
+
+
+def _join_query(o, c, how="inner"):
+    from spark_rapids_tpu.sql import functions as F
+    j = o.join(c, on="cust", how=how).filter(F.col("amount") > 100.0)
+    # semi/anti joins keep only the left side's columns
+    key = "tier" if how in ("inner", "left", "right", "full") else "cust"
+    return j.group_by(key).agg(F.sum("amount").alias("rev"))
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("how", ["inner", "left", "leftsemi"])
+def test_spec_repeated_runs_match_oracle(session, rng, how):
+    """Run the same join query three times: the first learns capacities,
+    later runs speculate; every run must match the CPU oracle and no
+    verification miss may fire (identical data => covered buckets)."""
+    o, c = _tables(session, rng)
+    session.capacity_cache.clear()
+    reruns0 = session.capacity_spec_reruns
+    hits0 = session.capacity_spec_hits
+    cpu = with_cpu_session(lambda s: _join_query(o, c, how))
+    outs = [with_tpu_session(lambda s: _join_query(o, c, how))
+            for _ in range(3)]
+    for t in outs:
+        assert_frames_equal(t, cpu, ignore_order=True, approx=True)
+    assert session.capacity_cache, "join never registered a capacity entry"
+    assert session.capacity_spec_hits >= hits0 + 2, \
+        "2nd and 3rd runs must speculate (fingerprint failed to match?)"
+    assert session.capacity_spec_reruns == reruns0, \
+        "identical reruns must not trip verification"
+
+
+def test_spec_undersized_entry_detected_and_rerun(session, rng):
+    """Corrupt every cached sizes entry to 1 row / 1 char: the speculative
+    expand would truncate, the deferred verification must catch it, and
+    the transparent re-execution must still produce oracle-exact output."""
+    o, c = _tables(session, rng)
+    session.capacity_cache.clear()
+    cpu = with_cpu_session(lambda s: _join_query(o, c))
+    first = with_tpu_session(lambda s: _join_query(o, c))
+    assert_frames_equal(first, cpu, ignore_order=True, approx=True)
+    assert session.capacity_cache
+    corrupted = []
+    for key, ent in session.capacity_cache.items():
+        if ent.get("sizes"):
+            ent["sizes"] = [[1 for _ in sz] for sz in ent["sizes"]]
+            corrupted.append(key)
+    assert corrupted, "expected at least one sizes-carrying entry"
+    reruns0 = session.capacity_spec_reruns
+    second = with_tpu_session(lambda s: _join_query(o, c))
+    assert_frames_equal(second, cpu, ignore_order=True, approx=True)
+    assert session.capacity_spec_reruns == reruns0 + 1, \
+        "undersized speculation must trigger exactly one re-execution"
+    for key in corrupted:
+        assert key not in session.capacity_cache, \
+            "missed entry must be dropped for re-learn"
+    # and the run after the miss re-learns + speculates cleanly again
+    third = with_tpu_session(lambda s: _join_query(o, c))
+    assert_frames_equal(third, cpu, ignore_order=True, approx=True)
+    assert session.capacity_spec_reruns == reruns0 + 1
+
+
+def test_spec_conf_disables(session, rng):
+    o, c = _tables(session, rng, n_orders=500, n_cust=40)
+    session.capacity_cache.clear()
+    conf = {"spark.rapids.sql.adaptiveCapacity.enabled": "false"}
+    cpu = with_cpu_session(lambda s: _join_query(o, c))
+    for _ in range(2):
+        t = with_tpu_session(lambda s: _join_query(o, c), conf=conf)
+        assert_frames_equal(t, cpu, ignore_order=True, approx=True)
+    assert not session.capacity_cache
+
+
+def test_spec_join_over_filtered_file_scan(session, rng, tmp_path):
+    """Pushed file-scan filters are (name, op, value) tuples; the plan
+    fingerprint must format them without assuming Expression objects
+    (regression: speculating joins above a filtered parquet scan)."""
+    from spark_rapids_tpu.sql import functions as F
+    n = 1000
+    pd.DataFrame({
+        "k": np.arange(n, dtype=np.int64),
+        "v": rng.uniform(0, 1, n),
+    }).to_parquet(str(tmp_path / "t.parquet"))
+    dims = session.create_dataframe(pd.DataFrame({
+        "k": np.arange(0, n, 7, dtype=np.int64),
+        "w": np.arange(0, n, 7, dtype=np.int64) * 2,
+    }), 1)
+    session.capacity_cache.clear()
+    reruns0 = session.capacity_spec_reruns
+
+    def q(s):
+        return (s.read.parquet(str(tmp_path / "t.parquet"))
+                 .filter(F.col("k") > 100).join(dims, on="k"))
+    cpu = with_cpu_session(q)
+    for _ in range(2):
+        t = with_tpu_session(q)
+        assert_frames_equal(t, cpu, ignore_order=True, approx=True)
+    assert session.capacity_spec_reruns == reruns0
+
+
+def test_spec_distinguishes_different_data(session, rng):
+    """Two structurally identical queries over DIFFERENT uploads must not
+    share capacity entries (the fingerprint carries the source data uid):
+    both must stay oracle-exact with zero verification misses."""
+    from spark_rapids_tpu.sql import functions as F
+    o1, c = _tables(session, rng)
+    o2 = o1.filter(F.col("o_id") < 700)
+    session.capacity_cache.clear()
+    reruns0 = session.capacity_spec_reruns
+    for o in (o1, o2, o1, o2):
+        cpu = with_cpu_session(lambda s: _join_query(o, c))
+        t = with_tpu_session(lambda s: _join_query(o, c))
+        assert_frames_equal(t, cpu, ignore_order=True, approx=True)
+    assert session.capacity_spec_reruns == reruns0
