@@ -126,6 +126,13 @@ ADAPTIVE_CAPACITY = register(
     "100-250ms per round trip) this removes the dominant steady-state "
     "cost of join-heavy plans.")
 
+REUSE_SUBTREES = register(
+    "spark.rapids.sql.reuseSubtrees.enabled", _to_bool, True,
+    "Within-query reuse of identical deterministic subtrees (the "
+    "ReuseExchange analogue, exec/reuse.py): branches referencing the "
+    "same joined/aggregated intermediate (scalar-subquery thresholds, "
+    "self-join views) materialize it once and replay the batches.")
+
 AGG_SKIP_RATIO = register(
     "spark.rapids.sql.agg.skipAggPassReductionRatio", float, 0.85,
     "Adaptive partial-aggregation skip: after the first batch of a "

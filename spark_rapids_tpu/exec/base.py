@@ -185,6 +185,10 @@ class ExecContext:
             and conf.get_bool("spark.rapids.sql.adaptiveCapacity.enabled",
                               True))
         self.spec_pending: list = []
+        # per-query materialization state of deduped shared subtrees
+        # (exec/reuse.TpuReuseSubtreeExec) — context-scoped so a fresh
+        # context (speculation re-execution) re-runs the subtree
+        self.reuse_state: dict = {}
 
     def metric_add(self, op: str, name: str, value):
         self.metrics.setdefault(op, {}).setdefault(name, 0)

@@ -1,0 +1,177 @@
+"""Within-query reuse of identical deterministic subtrees.
+
+Reference analogue: Spark's ReuseExchange / ReuseSubquery rules, which the
+reference plugin keeps working by canonicalizing its exchanges
+(GpuBroadcastExchangeExec doCanonicalize); TPC-H/TPCxBB lean on it —
+q2's min-cost subquery, q11's threshold, q15's revenue view and q17's
+per-part average all reference one joined/aggregated intermediate from
+two branches. This engine plans those branches as separate physical
+subtrees; without reuse each branch re-executes the shared work.
+
+The pass runs on the FINAL physical plan (after overrides+transitions):
+identical subtrees are found by the structural plan fingerprint
+(exec/base.plan_fingerprint — data-uid-stamped scans, expression-level
+signatures), with coordinated column pruning upstream
+(sql/pushdown.prune_filter_columns) making shared logical subtrees prune
+identically so their physical forms actually match. Matching is gated to
+an allowlist of node types whose fingerprints carry their full identity,
+and to subtrees whose expressions are deterministic — a rand() branch
+must keep re-executing (Spark reuses nondeterministic exchanges only
+within one canonicalized stage; staying conservative here costs only the
+reuse). A deduped subtree executes ONCE per query; every consumer
+replays the materialized batches.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.exec.base import (
+    ExecContext, Partition, PhysicalPlan, plan_fingerprint,
+)
+
+# node types whose describe()+fingerprint_extra() carry their complete
+# behavioral identity (anything outside this set disqualifies the subtree)
+_PRECISE = {
+    "TpuScanExec", "TpuProjectExec", "TpuFilterExec",
+    "TpuHashAggregateExec", "TpuShuffledHashJoinExec",
+    "TpuBroadcastHashJoinExec", "TpuBroadcastExchangeExec",
+    "TpuShuffleExchangeExec", "TpuSortExec", "TpuCoalesceBatchesExec",
+    "TpuCoalescePartitionsExec",
+}
+
+# a subtree is only worth materializing when it contains real compute
+_WORTH = {"TpuShuffledHashJoinExec", "TpuBroadcastHashJoinExec",
+          "TpuHashAggregateExec", "TpuSortExec"}
+
+
+def _node_deterministic(node: PhysicalPlan) -> bool:
+    from spark_rapids_tpu.sql.exprs.nondet import has_nondeterministic
+    exprs = []
+    if hasattr(node, "exprs"):          # project
+        exprs.extend(e for _n, e in node.exprs)
+    if getattr(node, "condition", None) is not None:   # filter
+        exprs.append(node.condition)
+    if getattr(node, "pre_mask", None) is not None:    # fused agg filter
+        exprs.append(node.pre_mask)
+    plan = getattr(node, "plan", None)
+    if plan is not None and hasattr(plan, "grouping"):  # aggregate
+        exprs.extend(e for _n, e in plan.grouping)
+        exprs.extend(e for _n, e in plan.results)
+    for o in getattr(node, "orders", ()):               # sort
+        exprs.append(o.expr)
+    return not any(has_nondeterministic(e) for e in exprs)
+
+
+class TpuReuseSubtreeExec(PhysicalPlan):
+    """Executes its child once per query and replays the materialized
+    batches to every consumer. The same INSTANCE appears at every
+    occurrence of the deduped subtree; per-query state lives on the
+    ExecContext so a speculation re-execution (fresh context,
+    session._execute) re-runs the child rather than replaying
+    possibly-truncated batches."""
+
+    columnar_output = True
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__([child])
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return "TpuReuseSubtreeExec"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        state = ctx.reuse_state.setdefault(
+            id(self), {"parts": None, "data": {}})
+        if state["parts"] is None:
+            state["parts"] = self.children[0].executed_partitions(ctx)
+        parts = state["parts"]
+        data = state["data"]
+        session = ctx.session
+
+        def mk(i: int) -> Partition:
+            def run() -> Iterator:
+                if i not in data:
+                    if session is not None:
+                        # register in the spillable catalog (same band as
+                        # broadcast tables) so a big shared intermediate
+                        # can evict under pressure instead of pinning HBM
+                        from spark_rapids_tpu.memory.spill import (
+                            SpillPriorities,
+                        )
+                        data[i] = [session.add_transient_batch(
+                            b, SpillPriorities.OUTPUT_FOR_WRITE)
+                            for b in parts[i]()]
+                    else:
+                        data[i] = list(parts[i]())
+                if session is not None:
+                    return iter([session.buffer_catalog.acquire_batch(bid)
+                                 for bid in data[i]])
+                return iter(data[i])
+            return run
+        return [mk(i) for i in range(len(parts))]
+
+
+def subtree_deterministic(node: PhysicalPlan) -> bool:
+    """Every expression in the subtree deterministic — the gate shared by
+    subtree reuse and capacity speculation (a rand() below a join would
+    change sizes every run, making speculation alternate learn/miss and
+    double latency; reuse would be outright wrong)."""
+    return all(_node_deterministic(n) for n in node.walk())
+
+
+def _eligible(node: PhysicalPlan, memo: dict) -> bool:
+    got = memo.get(id(node))
+    if got is None:
+        got = (type(node).__name__ in _PRECISE
+               and _node_deterministic(node)
+               and all(_eligible(c, memo) for c in node.children))
+        memo[id(node)] = got
+    return got
+
+
+def _worth(node: PhysicalPlan) -> bool:
+    return any(type(n).__name__ in _WORTH for n in node.walk())
+
+
+def reuse_common_subtrees(plan: PhysicalPlan) -> PhysicalPlan:
+    """Replace every group of fingerprint-identical eligible subtrees
+    with one shared TpuReuseSubtreeExec instance (outermost match wins;
+    nested duplicates collapse automatically because the shared subtree
+    executes once)."""
+    from collections import Counter
+
+    elig: dict = {}
+    fp_memo: dict = {}
+
+    def fp(node: PhysicalPlan) -> str:
+        got = fp_memo.get(id(node))
+        if got is None:
+            got = fp_memo[id(node)] = plan_fingerprint(node)
+        return got
+
+    counts: Counter = Counter()
+
+    def collect(node: PhysicalPlan) -> None:
+        for c in node.children:
+            collect(c)
+        if node.columnar_output and _eligible(node, elig):
+            counts[fp(node)] += 1
+    collect(plan)
+
+    shared: dict = {}
+
+    def rewrite(node: PhysicalPlan) -> PhysicalPlan:
+        if (node.columnar_output and _eligible(node, elig)
+                and counts[fp(node)] >= 2 and _worth(node)):
+            w = shared.get(fp(node))
+            if w is None:
+                w = shared[fp(node)] = TpuReuseSubtreeExec(node)
+            return w
+        node.children = [rewrite(c) for c in node.children]
+        return node
+
+    return rewrite(plan)

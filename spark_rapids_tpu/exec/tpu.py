@@ -279,6 +279,13 @@ class TpuFilterExec(TpuExec):
                else f", sel={list(self.out_sel[0])}")
         return f"TpuFilterExec({self.condition!r}{sel})"
 
+    def fingerprint_extra(self) -> str:
+        # expr repr prints only class name + children for many nodes
+        # (startswith('a') vs startswith('b') collide); the signature
+        # serializes every instance attribute
+        from spark_rapids_tpu.utils.kernelcache import expr_signature
+        return expr_signature(self.condition)
+
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         from spark_rapids_tpu.exec import taskctx
         child_parts = self.children[0].executed_partitions(ctx)
@@ -363,7 +370,11 @@ class TpuHashAggregateExec(TpuExec):
         return f"TpuHashAggregateExec(mode={self.mode}, keys=[{keys}]{fused})"
 
     def fingerprint_extra(self) -> str:
-        return self.plan.signature
+        extra = ""
+        if self.pre_mask is not None:
+            from spark_rapids_tpu.utils.kernelcache import expr_signature
+            extra = "|mask:" + expr_signature(self.pre_mask)
+        return self.plan.signature + extra
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child_parts = self.children[0].executed_partitions(ctx)
@@ -493,6 +504,12 @@ class TpuSortExec(TpuExec):
 
     def describe(self) -> str:
         return f"TpuSortExec({self.orders})"
+
+    def fingerprint_extra(self) -> str:
+        from spark_rapids_tpu.utils.kernelcache import expr_signature
+        return ";".join(
+            f"{expr_signature(o.expr)}|a{int(o.ascending)}"
+            f"|n{int(o.nulls_first)}" for o in self.orders)
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child_parts = self.children[0].executed_partitions(ctx)
@@ -904,6 +921,9 @@ class TpuShuffleExchangeExec(TpuExec):
     def describe(self) -> str:
         return f"TpuShuffleExchangeExec({self.partitioning[0]})"
 
+    def fingerprint_extra(self) -> str:
+        return repr(self.partitioning)
+
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child_parts = self.children[0].executed_partitions(ctx)
         schema = self.output_schema()
@@ -1026,12 +1046,47 @@ class TpuShuffleExchangeExec(TpuExec):
                 # pre-aggregate input capacity as padding; ONE batched
                 # row-count fetch lets each piece drop to its true bucket
                 # so every downstream kernel compiles and runs at the
-                # real scale instead of the padded one
+                # real scale instead of the padded one. Speculation
+                # (spark.rapids.sql.adaptiveCapacity.enabled): later
+                # executions reuse the remembered counts as host
+                # metadata and defer an EXACT-equality check to query
+                # end (session._verify_speculation) — the slice kernel
+                # clamps liveness by the device-side row count, so a
+                # covered speculation emits identical data
                 need = [b for b in batches if b._host_rows is None]
                 if need:
-                    counts = _jax.device_get([b.num_rows for b in need])
-                    for b, c in zip(need, counts):
-                        b._host_rows = int(c)
+                    counts_d = [b.num_rows for b in need]
+                    cache = entry = None
+                    if getattr(ctx, "speculate", False):
+                        from spark_rapids_tpu.exec.base import (
+                            plan_fingerprint,
+                        )
+                        from spark_rapids_tpu.exec.reuse import (
+                            subtree_deterministic,
+                        )
+                        if subtree_deterministic(self):
+                            skey = plan_fingerprint(self) + "|shrink"
+                            cache = ctx.session.capacity_cache
+                            entry = cache.get(skey)
+                    if (entry is not None
+                            and entry.get("n") == len(need)):
+                        from spark_rapids_tpu.exec.tpujoin import (
+                            _start_host_copies,
+                        )
+                        _start_host_copies(counts_d)
+                        ctx.session.capacity_spec_hits += 1
+                        ctx.spec_pending.append(
+                            (skey, counts_d, [], [], entry["counts"]))
+                        for b, c in zip(need, entry["counts"]):
+                            b._host_rows = int(c)
+                    else:
+                        counts = _jax.device_get(counts_d)
+                        if cache is not None:
+                            cache[skey] = {
+                                "n": len(need),
+                                "counts": [int(c) for c in counts]}
+                        for b, c in zip(need, counts):
+                            b._host_rows = int(c)
                 shrunk = []
                 for b in batches:
                     target = bucket_capacity(max(b._host_rows, 1), growth)

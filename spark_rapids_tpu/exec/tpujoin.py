@@ -295,7 +295,14 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                 return None
             if spec_fp is None:
                 from spark_rapids_tpu.exec.base import plan_fingerprint
-                spec_fp = plan_fingerprint(self)
+                from spark_rapids_tpu.exec.reuse import subtree_deterministic
+                # a nondeterministic input (rand() filter) changes sizes
+                # every run: speculation would alternate learn/miss and
+                # re-execute every other query
+                spec_fp = (plan_fingerprint(self)
+                           if subtree_deterministic(self) else False)
+            if spec_fp is False:
+                return None
             return f"{spec_fp}|g{growth}|part{idx}"
 
         def make(sp: Partition, bp: Partition, pidx: int) -> Partition:
@@ -328,7 +335,7 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                             # defer the ok-flag check to query end
                             _start_host_copies(oks_d)
                             ctx.session.capacity_spec_hits += 1
-                            ctx.spec_pending.append((key, [], [], oks_d))
+                            ctx.spec_pending.append((key, [], [], oks_d, None))
                             for stream, r in zip(streams, raw):
                                 emitted = True
                                 yield self._semi(stream, r[0])
@@ -377,7 +384,7 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                         ctx.session.capacity_spec_hits += 1
                         caps_used: list = []
                         ctx.spec_pending.append(
-                            (key, totals_d, caps_used, oks_d))
+                            (key, totals_d, caps_used, oks_d, None))
                     elif dense:
                         fetch = jax.device_get(
                             list(zip(totals_d, oks_d)))

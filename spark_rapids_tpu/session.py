@@ -303,6 +303,12 @@ class TpuSparkSession:
         if conf.sql_enabled:
             plan = TpuOverrides(conf).apply(cpu_plan)
             plan = TransitionOverrides(conf).apply(plan)
+            if conf.get_bool("spark.rapids.sql.reuseSubtrees.enabled",
+                             True):
+                from spark_rapids_tpu.exec.reuse import (
+                    reuse_common_subtrees,
+                )
+                plan = reuse_common_subtrees(plan)
         else:
             plan = cpu_plan
         if conf.test_enabled:
@@ -363,20 +369,27 @@ class TpuSparkSession:
         while it stays inside the buckets."""
         import jax
         flat = []
-        for _key, totals_d, _caps, oks_d in ctx.spec_pending:
+        for _key, totals_d, _caps, oks_d, _exact in ctx.spec_pending:
             flat.extend(totals_d)
             flat.extend(oks_d)
         fetched = jax.device_get(flat) if flat else []
         pos = 0
         all_good = True
-        for key, totals_d, caps, oks_d in ctx.spec_pending:
+        for key, totals_d, caps, oks_d, exact in ctx.spec_pending:
             sizes = fetched[pos:pos + len(totals_d)]
             pos += len(totals_d)
             oks = fetched[pos:pos + len(oks_d)]
             pos += len(oks_d)
             good = all(bool(o) for o in oks)
-            if good:
-                # verify the CONSUMED prefix (a short-circuiting parent —
+            if good and exact is not None:
+                # exchange-shrink speculation: the cached row counts were
+                # used as EXACT host metadata (batch._host_rows), so any
+                # drift — not just overflow — invalidates
+                good = all(int(a) == int(e) for a, e in zip(sizes, exact))
+            elif good:
+                # join-expansion speculation: capacities only pad, so the
+                # entry stands while the actual sizes stay covered.
+                # Verify the CONSUMED prefix (a short-circuiting parent —
                 # CollectLimit — may abandon the emission loop early;
                 # batches never expanded cannot have truncated anything)
                 for cap, sz in zip(caps, sizes):
@@ -395,7 +408,8 @@ class TpuSparkSession:
                         break
             if good:
                 ent = self.capacity_cache.get(key)
-                if ent is not None and len(sizes) == ent.get("n"):
+                if (exact is None and ent is not None
+                        and len(sizes) == ent.get("n")):
                     ent["sizes"] = [[int(x) for x in s] for s in sizes]
             else:
                 self.capacity_cache.pop(key, None)

@@ -199,9 +199,65 @@ def prune_filter_columns(root):
             return lp.LogicalProject(node, [(n, Col(n)) for n in keep])
         return node
 
+    # --- shared-subtree coordination ------------------------------------
+    # DataFrame DAGs reference the same logical subtree from several
+    # branches (q2's min-cost subquery, q11's threshold, q17's avg
+    # limit). Pruning each occurrence with its own requirement set makes
+    # the branches STRUCTURALLY DIFFERENT (one keeps a column the other
+    # dropped), which defeats the physical common-subtree reuse pass
+    # (exec/reuse.py). Shared nodes therefore prune with the UNION of
+    # every requirement reaching them — requirement propagation is
+    # union-distributive node-by-node, so the union is exact — and every
+    # parent receives the SAME rewritten object, which the planner turns
+    # into structurally identical (fingerprint-equal) physical subtrees.
+    refs: dict = {}
+
+    def count_refs(n) -> None:
+        refs[id(n)] = refs.get(id(n), 0) + 1
+        if refs[id(n)] == 1:
+            for c in getattr(n, "children", ()):
+                count_refs(c)
+    count_refs(root)
+    shared_ids = {i for i, c in refs.items() if c > 1}
+    collecting = bool(shared_ids)
+    collected: dict = {}     # id(shared node) -> [required per occurrence]
+    shared_memo: dict = {}   # id(shared node) -> rewritten-once subtree
+
+    collect_memo: set = set()
+
     def rewrite(node, required):
         # ``required``: names the parent needs from this node's output;
         # None = all (unknown consumer)
+        if id(node) in shared_ids:
+            if collecting:
+                collected.setdefault(id(node), []).append(
+                    None if required is None else set(required))
+                # keep descending so nested shared nodes collect too
+                # (the pass-A result tree is discarded) — but each
+                # (node, required) pair only once: a repeat propagates
+                # identical requirement sets below, and without the memo
+                # nested shared nodes walk 2^depth times
+                mkey = (id(node), None if required is None
+                        else frozenset(required))
+                if mkey in collect_memo:
+                    return node
+                collect_memo.add(mkey)
+            else:
+                got = shared_memo.get(id(node))
+                if got is None:
+                    reqs = collected.get(id(node), [None])
+                    if any(r is None for r in reqs):
+                        union = None
+                    else:
+                        union = set().union(*reqs)
+                    sid = id(node)
+                    shared_ids.discard(sid)  # rewrite the body plainly
+                    got = rewrite(node, union)
+                    if union is not None:
+                        got = narrow(got, union)
+                    shared_ids.add(sid)
+                    shared_memo[sid] = got
+                return got
         if isinstance(node, lp.LogicalFilter):
             out_names = set(node.schema().names)
             cond_req = cols_of(node.condition)
@@ -318,6 +374,9 @@ def prune_filter_columns(root):
         return with_children(node,
                              [rewrite(c, None) for c in node.children])
 
+    if collecting:
+        rewrite(root, None)   # pass A: record requireds at shared nodes
+        collecting = False
     return rewrite(root, None)
 
 

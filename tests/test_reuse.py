@@ -1,0 +1,103 @@
+"""Within-query subtree reuse (spark.rapids.sql.reuseSubtrees.enabled,
+exec/reuse.py) — the ReuseExchange analogue. Pins: (1) a genuinely shared
+subtree executes once and stays oracle-exact, (2) subtrees differing only
+in expression ATTRIBUTES (startswith pattern — invisible in repr) never
+merge, (3) nondeterministic subtrees never merge."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.querytest import (
+    assert_frames_equal, with_cpu_session, with_tpu_session,
+)
+
+
+def _sales(session, rng, n=2000):
+    return session.create_dataframe(pd.DataFrame({
+        "name": pd.Series([f"{c}{i % 7}" for i, c in zip(
+            range(n), np.random.default_rng(3).choice(
+                list("abcd"), n))]),
+        "v": rng.uniform(0.0, 100.0, n),
+        "k": rng.integers(0, 50, n).astype(np.int64),
+    }), 2)
+
+
+@pytest.mark.smoke
+def test_reuse_shared_threshold_subquery(session, rng):
+    """q11's shape: one aggregated base referenced by a per-group branch
+    and a global-threshold branch; the physical plan must carry ONE
+    shared instance and match the oracle."""
+    from spark_rapids_tpu.sql import functions as F
+    df = _sales(session, rng)
+    dims = session.create_dataframe(pd.DataFrame({
+        "k": np.arange(50, dtype=np.int64),
+        "grp": np.arange(50, dtype=np.int64) % 5,
+    }), 1)
+    # the shared base contains a JOIN (the worth-gate requires real
+    # compute — a bare filtered scan is not worth materializing)
+    base = df.join(dims, on="k").filter(F.col("v") > 5.0)
+    per_k = base.group_by("grp").agg(F.sum("v").alias("sv"))
+    total = base.agg((F.sum("v") * 0.05).alias("thr"))
+
+    def q(s):
+        return (per_k.join(total, on=None)
+                .filter(F.col("sv") > F.col("thr"))
+                .select("grp", "sv"))
+    cpu = with_cpu_session(q)
+    session.capture_plans = True
+    tpu = with_tpu_session(
+        q, allow_non_tpu=["CpuCartesianProductExec"])
+    session.capture_plans = False
+    assert_frames_equal(tpu, cpu, ignore_order=True, approx=True)
+    plan = session.captured_plans[-1]
+    seen = set()
+    reused = [n for n in plan.walk()
+              if type(n).__name__ == "TpuReuseSubtreeExec"
+              and not (id(n) in seen or seen.add(id(n)))]
+    assert reused, "shared base was not deduped into a reuse node"
+
+
+def test_reuse_distinguishes_expr_attributes(session, rng):
+    """startswith('a') vs startswith('b') print identical reprs; the
+    fingerprint must still separate them (regression: the two branches
+    merged and the union returned one branch's rows twice)."""
+    from spark_rapids_tpu.sql import functions as F
+    df = _sales(session, rng)
+
+    def q(s):
+        a = (df.filter(F.col("name").startswith("a"))
+             .group_by("name").agg(F.sum("v").alias("sv")))
+        b = (df.filter(F.col("name").startswith("b"))
+             .group_by("name").agg(F.sum("v").alias("sv")))
+        return a.union(b)
+    cpu = with_cpu_session(q)
+    tpu = with_tpu_session(q)
+    assert_frames_equal(tpu, cpu, ignore_order=True, approx=True)
+    names = set(tpu["name"])
+    assert any(n.startswith("a") for n in names)
+    assert any(n.startswith("b") for n in names)
+
+
+def test_reuse_skips_nondeterministic(session, rng):
+    """Two structurally identical rand() branches must both execute (no
+    merge): with a shared seedless rand the branches are independent
+    samples, so the plan must not contain a reuse node."""
+    from spark_rapids_tpu.sql import functions as F
+    df = _sales(session, rng)
+
+    def q(s):
+        a = df.filter(F.rand() < 2.0).group_by("k").agg(
+            F.count("*").alias("n"))
+        return a.join(df.filter(F.rand() < 2.0).group_by("k").agg(
+            F.count("*").alias("m")), on="k")
+    session.capture_plans = True
+    tpu = with_tpu_session(q)
+    session.capture_plans = False
+    plan = session.captured_plans[-1]
+    assert not [n for n in plan.walk()
+                if type(n).__name__ == "TpuReuseSubtreeExec"], \
+        "nondeterministic subtree must not be reused"
+    # rand() < 2.0 keeps everything, so the result is still exact
+    cpu = with_cpu_session(q)
+    assert_frames_equal(tpu, cpu, ignore_order=True, approx=True)
