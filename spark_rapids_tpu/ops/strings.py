@@ -78,6 +78,21 @@ def _match_at(col: DevCol, starts: jnp.ndarray, pat: bytes) -> jnp.ndarray:
     return jnp.all((gathered == patv[None, :]) & in_bounds, axis=1)
 
 
+def _row_of_pos(offsets: jnp.ndarray, k: jnp.ndarray,
+                capacity: int) -> jnp.ndarray:
+    """Row id of every position in ``k`` (which must be arange(n)): the
+    last row r with offsets[r] <= k. O(n) sorted scatter + prefix sum —
+    the drop-in replacement for the per-position binary search
+    (``searchsorted`` lowers to log(capacity) dependent gather rounds per
+    element on TPU; this was the dominant cost of every char-space
+    kernel at scale)."""
+    n_pos = k.shape[0]
+    marks = jnp.zeros((n_pos + 1,), jnp.int32).at[
+        jnp.clip(offsets[:capacity].astype(jnp.int32), 0, n_pos)].add(1)
+    ids = jnp.cumsum(marks[:n_pos]) - 1
+    return jnp.clip(ids, 0, capacity - 1).astype(jnp.int32)
+
+
 def starts_with(ctx: EvalContext, col: DevCol, lit: str):
     pat = lit.encode("utf-8")
     m = len(pat)
@@ -122,16 +137,19 @@ def contains(ctx: EvalContext, col: DevCol, lit: str):
         # mask rolled-around tail
         ok = (jnp.arange(nchars) + j) < nchars
         pos_match = pos_match & (shifted == c) & ok
-    # a match at i counts for row r iff i >= off[r] and i + m <= off[r+1]
+    # a match at position p counts for row r iff p >= off[r] and
+    # p + m <= off[r+1]; per-row ANY is a prefix-sum range query (two
+    # tiny gathers per ROW) instead of per-char row ids + segment_max
     i = jnp.arange(nchars, dtype=jnp.int32)
-    row_ids = jnp.clip(
-        jnp.searchsorted(col.offsets, i, side="right").astype(jnp.int32) - 1,
-        0, capacity - 1)
-    fits = (i + m) <= col.offsets[row_ids + 1]
     total = col.offsets[capacity]
-    contrib = (pos_match & fits & (i < total)).astype(jnp.int32)
-    row_any = jax.ops.segment_max(contrib, row_ids, num_segments=capacity)
-    return (row_any > 0) & (lens >= m), col.validity
+    pm = pos_match & (i < total)
+    ps = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                          jnp.cumsum(pm.astype(jnp.int32))])
+    starts_r = col.offsets[:-1].astype(jnp.int32)
+    ends_r = col.offsets[1:].astype(jnp.int32)
+    hi = jnp.clip(ends_r - (m - 1), starts_r, nchars)
+    cnt = ps[hi] - ps[starts_r]
+    return (cnt > 0) & (lens >= m), col.validity
 
 
 def string_equal(ctx: EvalContext, lv: DevValue, rv: DevValue):
@@ -307,9 +325,7 @@ def _gather_substrings(ctx: EvalContext, col: DevCol, src_start: jnp.ndarray,
         jnp.cumsum(new_len).astype(jnp.int32)])
     total_new = new_offsets[capacity]
     k = jnp.arange(nchars, dtype=jnp.int32)
-    out_row = jnp.clip(
-        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
-        0, capacity - 1)
+    out_row = _row_of_pos(new_offsets, k, capacity)
     src_idx = src_start[out_row].astype(jnp.int32) + (k - new_offsets[out_row])
     gathered = col.data[jnp.clip(src_idx, 0, nchars - 1)]
     new_chars = jnp.where(k < total_new, gathered, 0).astype(jnp.uint8)
@@ -329,9 +345,7 @@ def concat_columns(ctx: EvalContext, cols) -> DevCol:
         jnp.cumsum(total_len).astype(jnp.int32)])
     out_cap = sum(int(c.data.shape[0]) for c in cols)
     k = jnp.arange(out_cap, dtype=jnp.int32)
-    out_row = jnp.clip(
-        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
-        0, capacity - 1)
+    out_row = _row_of_pos(new_offsets, k, capacity)
     # position within the concatenated row
     rel = k - new_offsets[out_row]
     # walk the parts: select source column and index per char
@@ -363,9 +377,7 @@ def select_strings(ctx: EvalContext, cond: jnp.ndarray, a: DevCol,
     total_new = new_offsets[capacity]
     out_cap = int(a.data.shape[0]) + int(b.data.shape[0])
     k = jnp.arange(out_cap, dtype=jnp.int32)
-    out_row = jnp.clip(
-        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
-        0, capacity - 1)
+    out_row = _row_of_pos(new_offsets, k, capacity)
     rel = k - new_offsets[out_row]
     src_a = a.offsets[:-1][out_row].astype(jnp.int32) + rel
     src_b = b.offsets[:-1][out_row].astype(jnp.int32) + rel
@@ -380,9 +392,7 @@ def _char_row_ids(col: DevCol, capacity: int) -> jnp.ndarray:
     """Row id owning each char slot (clipped into [0, capacity-1])."""
     nchars = col.data.shape[0]
     i = jnp.arange(nchars, dtype=jnp.int32)
-    return jnp.clip(
-        jnp.searchsorted(col.offsets, i, side="right").astype(jnp.int32) - 1,
-        0, capacity - 1)
+    return _row_of_pos(col.offsets, i, capacity)
 
 
 def trim(ctx: EvalContext, col: DevCol, chars: str = " \t\r\n",
@@ -605,9 +615,7 @@ def integral_to_string(ctx: EvalContext, data: jnp.ndarray,
                                jnp.cumsum(lens).astype(jnp.int32)])
     out_chars = cap * 21
     k = jnp.arange(out_chars, dtype=jnp.int32)
-    row = jnp.clip(
-        jnp.searchsorted(offsets, k, side="right").astype(jnp.int32) - 1,
-        0, cap - 1)
+    row = _row_of_pos(offsets, k, cap)
     pos = k - offsets[row]
     negr = neg[row]
     sign_char = (pos == 0) & negr
@@ -640,9 +648,7 @@ def strings_from_choices(ctx: EvalContext, idx: jnp.ndarray,
                                jnp.cumsum(lens).astype(jnp.int32)])
     out_chars = cap * max(1, int(lit_lens.max()) if len(enc) else 1)
     k = jnp.arange(out_chars, dtype=jnp.int32)
-    row = jnp.clip(
-        jnp.searchsorted(offsets, k, side="right").astype(jnp.int32) - 1,
-        0, cap - 1)
+    row = _row_of_pos(offsets, k, cap)
     pos = k - offsets[row]
     src = jnp.clip(ls[sel[row]] + pos, 0, pk.shape[0] - 1)
     total = offsets[cap]
@@ -694,9 +700,7 @@ def date_to_string(ctx: EvalContext, days: jnp.ndarray,
                                jnp.cumsum(lens)])
     out_chars = cap * 10
     k = jnp.arange(out_chars, dtype=jnp.int32)
-    row = jnp.clip(
-        jnp.searchsorted(offsets, k, side="right").astype(jnp.int32) - 1,
-        0, cap - 1)
+    row = _row_of_pos(offsets, k, cap)
     pos = k - offsets[row]
     ch = table[jnp.clip(row * 10 + pos, 0, cap * 10 - 1)]
     total = offsets[cap]
@@ -890,9 +894,7 @@ def repeat_string(ctx: EvalContext, col: DevCol, n: int) -> DevCol:
         jnp.cumsum(new_len).astype(jnp.int32)])
     out_cap = max(int(col.data.shape[0]) * n, 16)
     k = jnp.arange(out_cap, dtype=jnp.int32)
-    out_row = jnp.clip(
-        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
-        0, capacity - 1)
+    out_row = _row_of_pos(new_offsets, k, capacity)
     rel = k - new_offsets[out_row]
     safe_len = jnp.maximum(lens[out_row], 1)
     src = (col.offsets[:-1][out_row].astype(jnp.int32) + rel % safe_len)
@@ -930,9 +932,7 @@ def chr_from_int(ctx: EvalContext, data: jnp.ndarray,
         jnp.cumsum(lens).astype(jnp.int32)])
     out_cap = _char_capacity_for(2 * capacity)
     k = jnp.arange(out_cap, dtype=jnp.int32)
-    out_row = jnp.clip(
-        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
-        0, capacity - 1)
+    out_row = _row_of_pos(new_offsets, k, capacity)
     rel = k - new_offsets[out_row]
     c = code[out_row]
     first = jnp.where(two_byte[out_row], 0xC0 | (c >> 6), c)
@@ -981,9 +981,7 @@ def concat_ws_columns(ctx: EvalContext, sep: str, cols) -> DevCol:
                + sep_len * max(len(cols) - 1, 0) * capacity)
     out_cap = _char_capacity_for(max(out_cap, 16), 16)
     k = jnp.arange(out_cap, dtype=jnp.int32)
-    out_row = jnp.clip(
-        jnp.searchsorted(new_offsets, k, side="right").astype(jnp.int32) - 1,
-        0, capacity - 1)
+    out_row = _row_of_pos(new_offsets, k, capacity)
     rel = k - new_offsets[out_row]
     out = jnp.zeros((out_cap,), dtype=jnp.uint8)
     part_start = jnp.zeros((capacity,), dtype=jnp.int32)
