@@ -126,6 +126,16 @@ ADAPTIVE_CAPACITY = register(
     "100-250ms per round trip) this removes the dominant steady-state "
     "cost of join-heavy plans.")
 
+AGG_FUSE_COUNT_DISTINCT = register(
+    "spark.rapids.sql.agg.fuseCountDistinct", _to_bool, True,
+    "Fuse the two-level aggregation that count(DISTINCT) (and the "
+    "distinct().group_by().count() spelling) expands into — distinct "
+    "over G1 keys, then count grouped by G2 — into ONE sorted pass over "
+    "the G1 tuple (exec/aggfuse.py): distinct-tuple boundaries and "
+    "group boundaries come from the same sorted images, halving the "
+    "dominant cost of distinct-heavy queries. Single-chip only; on a "
+    "mesh the chain's exchanges carry real distribution.")
+
 REUSE_SUBTREES = register(
     "spark.rapids.sql.reuseSubtrees.enabled", _to_bool, True,
     "Within-query reuse of identical deterministic subtrees (the "

@@ -303,6 +303,12 @@ class TpuSparkSession:
         if conf.sql_enabled:
             plan = TpuOverrides(conf).apply(cpu_plan)
             plan = TransitionOverrides(conf).apply(plan)
+            if (getattr(self, "mesh", None) is None and conf.get_bool(
+                    "spark.rapids.sql.agg.fuseCountDistinct", True)):
+                from spark_rapids_tpu.exec.aggfuse import (
+                    fuse_count_distinct,
+                )
+                plan = fuse_count_distinct(plan)
             if conf.get_bool("spark.rapids.sql.reuseSubtrees.enabled",
                              True):
                 from spark_rapids_tpu.exec.reuse import (
