@@ -126,6 +126,15 @@ ADAPTIVE_CAPACITY = register(
     "100-250ms per round trip) this removes the dominant steady-state "
     "cost of join-heavy plans.")
 
+AGG_DENSE_KEYS = register(
+    "spark.rapids.sql.agg.denseKeys", _to_bool, True,
+    "Bounded-int composite grouping keys: when every group key is a "
+    "fixed-width integer with advisory scan-stat bounds fitting 62 bits "
+    "of combined slot space, the grouping sort runs on ONE exact "
+    "composite key (2 sort operands instead of 4, no hashing, no image "
+    "refinement). Device-verified; stale stats fall back to the generic "
+    "hash path inside the same compiled program (lax.cond).")
+
 AGG_FUSE_COUNT_DISTINCT = register(
     "spark.rapids.sql.agg.fuseCountDistinct", _to_bool, True,
     "Fuse the two-level aggregation that count(DISTINCT) (and the "

@@ -330,6 +330,15 @@ class TpuHashAggregateExec(TpuExec):
                 lambda: jax.jit(lambda b: agg_ops.aggregate_update(
                     b, key_exprs, p.update_inputs, reductions,
                     p.partial_schema, mask_expr=pre_mask)))
+            # bounded-int composite grouping key variant (advisory scan
+            # stats resolved at partitions() time; device-verified with
+            # lax.cond fallback — ops/aggregate.dense_composite)
+            self._dense_update = lambda sizes: cached_jit(
+                f"aggupd|{p.signature}{mask_sig}|dense{sizes}",
+                lambda: jax.jit(lambda b, los: agg_ops.aggregate_update(
+                    b, key_exprs, p.update_inputs, reductions,
+                    p.partial_schema, mask_expr=pre_mask,
+                    dense=(los, sizes))))
             # adaptive low-reduction skip: rows projected straight into the
             # partial layout (spark.rapids.sql.agg.skipAggPassReductionRatio)
             self._passthrough_kernel = cached_jit(
@@ -354,10 +363,45 @@ class TpuHashAggregateExec(TpuExec):
         for merged in p.merge_plan:
             for kind, col, idt in merged:
                 reductions.append((kind, col, idt))
+        self._dense_merge = lambda sizes: cached_jit(
+            f"aggmrg|{p.signature}|dense{sizes}",
+            lambda: jax.jit(lambda b, los: agg_ops.aggregate_merge(
+                b, p.num_keys, reductions, p.partial_schema,
+                dense=(los, sizes))))
         return cached_jit(
             "aggmrg|" + p.signature,
             lambda: jax.jit(lambda b: agg_ops.aggregate_merge(
                 b, p.num_keys, reductions, p.partial_schema)))
+
+    def _dense_group_plan(self, ctx: ExecContext):
+        """(los list, sizes tuple) for the bounded-int composite grouping
+        key, or None (non-int keys, unresolvable stats, or >62 bits).
+        Advisory only: the kernel verifies on device and falls back."""
+        if ctx.session is None or not ctx.conf.get_bool(
+                "spark.rapids.sql.agg.denseKeys", True):
+            return None
+        p = self.plan
+        if p.num_keys == 0:
+            return None
+        from spark_rapids_tpu.exec.statsutil import dense_group_plan
+        from spark_rapids_tpu.sql.exprs.core import BoundRef
+        key_names, key_dts = [], []
+        if self.mode == "partial":
+            cs = p.child_schema
+            for name, e in p.grouping:
+                if not isinstance(e, BoundRef):
+                    return None
+                names = {name}
+                if 0 <= e.index < len(cs.names):
+                    names.add(cs.names[e.index])
+                key_names.append(names)
+                key_dts.append(cs.dtypes[e.index])
+        else:
+            ps = p.partial_schema
+            for j in range(p.num_keys):
+                key_names.append({ps.names[j]})
+                key_dts.append(ps.dtypes[j])
+        return dense_group_plan(ctx.session, key_names, key_dts)
 
     def output_schema(self) -> Schema:
         return (self.plan.partial_schema if self.mode == "partial"
@@ -382,6 +426,21 @@ class TpuHashAggregateExec(TpuExec):
 
         from spark_rapids_tpu.config.conf import AGG_SKIP_RATIO
         skip_ratio = float(ctx.conf.get(AGG_SKIP_RATIO.key))
+
+        dense = self._dense_group_plan(ctx)
+        if dense is not None:
+            los_arr = jnp.asarray(dense[0], jnp.int64)
+            sizes = dense[1]
+            dmerge = self._dense_merge(sizes)
+            merge_kernel = lambda b: dmerge(b, los_arr)  # noqa: E731
+            if self.mode == "partial":
+                dupd = self._dense_update(sizes)
+                update_kernel = lambda b: dupd(b, los_arr)  # noqa: E731
+            else:
+                update_kernel = None
+        else:
+            merge_kernel = self._merge_kernel
+            update_kernel = self._kernel if self.mode == "partial" else None
 
         def make(part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
@@ -419,7 +478,7 @@ class TpuHashAggregateExec(TpuExec):
                                 for b in it:
                                     yield self._passthrough_kernel(b)
                                 return
-                    p0 = self._kernel(first)
+                    p0 = update_kernel(first)
                     second = next(it, None)
                     # learn the ratio (one row-count sync, first execution
                     # of a signature only) whenever the partial kept its
@@ -446,16 +505,16 @@ class TpuHashAggregateExec(TpuExec):
                             yield self._passthrough_kernel(second)
                             second = next(it, None)
                         return
-                    partials = [p0, self._kernel(second)]
-                    partials.extend(self._kernel(b) for b in it)
+                    partials = [p0, update_kernel(second)]
+                    partials.extend(update_kernel(b) for b in it)
                     merged = _concat_device(partials, self.plan.partial_schema,
                                             growth)
-                    yield self._merge_kernel(merged)
+                    yield merge_kernel(merged)
                     return
                 batches = list(part())
                 merged_in = _concat_device(batches, self.plan.partial_schema,
                                            growth)
-                merged = self._merge_kernel(merged_in)
+                merged = merge_kernel(merged_in)
                 yield self._final_kernel(merged)
             return run
         return [make(p) for p in child_parts]

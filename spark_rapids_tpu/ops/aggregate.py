@@ -28,9 +28,12 @@ def aggregate_update(batch: DeviceBatch,
                      input_exprs: Sequence[Expression],
                      reductions: Sequence[Tuple[str, int, DType]],
                      out_schema: Schema,
-                     mask_expr: Expression = None) -> DeviceBatch:
+                     mask_expr: Expression = None,
+                     dense=None) -> DeviceBatch:
     """Partial aggregation of one batch: group by evaluated keys, reduce
     evaluated inputs. reductions: (kind, input_index, out_dtype).
+    ``dense``: optional (los device vector, static sizes tuple) enabling
+    the exact bounded-int composite grouping key (dense_composite).
 
     ``mask_expr``: optional fused pre-filter predicate evaluated over the
     INPUT batch; failing rows are excluded from every group without the
@@ -62,7 +65,7 @@ def aggregate_update(batch: DeviceBatch,
                             for kind, idx, dt in reductions],
                            out_schema,
                            force_single_group=len(key_cols) == 0,
-                           live=live)
+                           live=live, dense=dense)
 
 
 def aggregate_passthrough(batch: DeviceBatch,
@@ -117,11 +120,12 @@ def aggregate_passthrough(batch: DeviceBatch,
 
 def aggregate_merge(batch: DeviceBatch, num_keys: int,
                     reductions: Sequence[Tuple[str, int, DType]],
-                    out_schema: Schema,) -> DeviceBatch:
+                    out_schema: Schema, dense=None) -> DeviceBatch:
     """Merge partial outputs: group by leading key columns, reduce
     intermediate columns with merge kinds. reductions: (kind, col_idx, dt)."""
     return _grouped_reduce(batch, list(range(num_keys)), list(reductions),
-                           out_schema, force_single_group=num_keys == 0)
+                           out_schema, force_single_group=num_keys == 0,
+                           dense=dense)
 
 
 # group-slot width of the fast aggregation branch: segment reductions at
@@ -168,7 +172,7 @@ def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
                     reductions: List[Tuple[str, int, DType]],
                     out_schema: Schema,
                     force_single_group: bool,
-                    live=None) -> DeviceBatch:
+                    live=None, dense=None) -> DeviceBatch:
     if not key_idx:
         return _single_group_reduce(batch, reductions, out_schema, live)
     has_string_reduction = any(
@@ -181,6 +185,20 @@ def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
     if dict_info is not None:
         return _dict_matmul_reduce(batch, key_idx, reductions, out_schema,
                                    dict_info, live)
+    if dense is not None:
+        # bounded-int keys (advisory scan stats, exec/tpu.py): exact
+        # composite grouping key — device-verified, lax.cond falls back
+        # to the generic path when the stats were stale
+        los, sizes = dense
+        lv = batch.row_mask() if live is None else live
+        comp, ok = dense_composite(batch, key_idx, los, sizes, lv)
+        return jax.lax.cond(
+            ok,
+            lambda _: _dense_payload_reduce(batch, key_idx, reductions,
+                                            out_schema, lv, comp),
+            lambda _: _sorted_payload_reduce(batch, key_idx, reductions,
+                                             out_schema, lv),
+            None)
     # dictionary-encoded keys (bounded cardinality): the sort-free slot
     # attempt usually wins; otherwise (high/unknown cardinality) the
     # payload-sort path — its segment ops see SORTED ids, which XLA lowers
@@ -926,3 +944,103 @@ def count_distinct_reduce(batch: DeviceBatch, g2_idx: List[int],
     cperm, n_groups = compact_permutation(g2_b)
     rep_rows = perm[cperm]
     return rep_rows, counts.astype(jnp.int64), n_groups
+
+
+def dense_composite(batch: DeviceBatch, key_idx: List[int],
+                    los: jnp.ndarray, sizes: Tuple[int, ...], live):
+    """Single u64 composite grouping key for bounded-int key tuples:
+    slot_i = key_i - lo_i (value) or size_i (NULL), composite = mixed-radix
+    over (size_i + 1). Bijective with the key tuple INCLUDING null-ness,
+    so adjacent-equality on the composite is an EXACT group boundary — no
+    hashes, no image refinement, and the grouping sort drops from 4
+    operands (dead, h1, h2, idx) to 2 (composite, idx), the measured
+    dominant cost of high-cardinality aggregation (q18/q21 shape).
+
+    ``los``: int64 device vector (k,), advisory scan-stat lower bounds.
+    ``sizes``: static per-key slot counts (bucketed pow2 of the stat
+    range). Returns (comp u64, ok bool): ok=False when any live valid key
+    falls outside its advisory range — the caller must take the generic
+    path (lax.cond), so correctness never depends on the stats."""
+    capacity = batch.capacity
+    comp = jnp.zeros((capacity,), jnp.uint64)
+    ok = jnp.asarray(True)
+    for j, ki in enumerate(key_idx):
+        col = batch.columns[ki]
+        off = col.data.astype(jnp.int64) - los[j]
+        size = sizes[j]
+        in_rng = (off >= 0) & (off < size)
+        ok = ok & jnp.all(in_rng | ~col.validity | ~live)
+        slot = jnp.where(col.validity, jnp.clip(off, 0, size - 1),
+                         size).astype(jnp.uint64)
+        comp = comp * jnp.uint64(size + 1) + slot
+    return comp, ok
+
+
+def _dense_payload_reduce(batch: DeviceBatch, key_idx: List[int],
+                          reductions: List[Tuple[str, int, DType]],
+                          out_schema: Schema, live,
+                          comp: jnp.ndarray) -> DeviceBatch:
+    """_sorted_payload_reduce specialized to an exact composite key: the
+    2-operand (composite, idx) sort replaces the hash sort AND the whole
+    image build/gather/refine stage (boundaries are exact by
+    construction). Reduction semantics stay single-sourced through
+    _seg_reduce_kind."""
+    from spark_rapids_tpu.ops.pallas_kernels import compact_permutation
+    from spark_rapids_tpu.ops.rowops import (
+        gather_columns, packed_gather_vectors,
+    )
+    capacity = batch.capacity
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    # dead rows sort last: composite < product(size_i+1) <= 2^62 < MAX
+    comp2 = jnp.where(live, comp, ~jnp.uint64(0))
+    comp_s, perm = jax.lax.sort(
+        (comp2, pos), num_keys=1, is_stable=True)
+    n_live = jnp.sum(live.astype(jnp.int32))
+    dead_slot = pos >= n_live
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), comp_s[1:] != comp_s[:-1]]) & ~dead_slot
+
+    payload_cols: List[int] = []
+    payload_pos: dict = {}
+    for _kind, ci, _dt in reductions:
+        if ci not in payload_pos:
+            payload_pos[ci] = len(payload_cols)
+            payload_cols.append(ci)
+    vectors: List[jnp.ndarray] = []
+    for ci in payload_cols:
+        col = batch.columns[ci]
+        d = col.validity if col.dtype.is_string else col.data
+        vectors.extend([d, col.validity])
+    payloads_s = packed_gather_vectors(vectors, perm) if vectors else []
+
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    sid = jnp.where(dead_slot, capacity, jnp.clip(gid, 0, capacity - 1))
+    num_groups = boundary.sum().astype(jnp.int32)
+    group_live = pos < num_groups
+
+    def seg(op, x):
+        return op(x, sid, num_segments=capacity + 1,
+                  indices_are_sorted=True)[:capacity]
+
+    slot_perm, _n = compact_permutation(boundary)
+    rep_row = perm[slot_perm]
+    out_cols = gather_columns([batch.columns[ki] for ki in key_idx],
+                              rep_row, group_live)
+
+    live_slot = ~dead_slot
+    for kind, ci, out_dt in reductions:
+        pi = payload_pos[ci] * 2
+        data_s, valid_s = payloads_s[pi], payloads_s[pi + 1] != 0
+        src_dtype = batch.columns[ci].data.dtype
+        if src_dtype == jnp.bool_ and data_s.dtype != jnp.bool_:
+            data_s = data_s != 0
+        if batch.columns[ci].dtype.is_string:
+            data, validity = _seg_reduce_kind(
+                "count_valid", valid_s, valid_s & live_slot, live_slot,
+                seg, pos, lambda x: x, capacity, capacity, out_dt)
+        else:
+            data, validity = _seg_reduce_kind(
+                kind, data_s, valid_s & live_slot, live_slot, seg, pos,
+                lambda x: x, capacity, capacity, out_dt)
+        out_cols.append(DeviceColumn(out_dt, data, validity & group_live))
+    return DeviceBatch(out_schema, out_cols, num_groups)

@@ -1,0 +1,78 @@
+"""Bounded-int composite grouping keys (spark.rapids.sql.agg.denseKeys,
+ops/aggregate.dense_composite): advisory scan stats give each int key a
+slot range; the kernel verifies on device and lax.cond-falls back to the
+generic hash path when the stats are stale. Pins: correctness with stats
+present, correctness with DELIBERATELY WRONG (too-narrow) stats, null
+keys, and multi-key composites."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.querytest import (
+    assert_frames_equal, with_cpu_session, with_tpu_session,
+)
+
+
+def _orders(session, rng, n=6000):
+    return session.create_dataframe(pd.DataFrame({
+        "okey": pd.Series(rng.integers(1000, 9000, n)).astype("Int64")
+                  .mask(pd.Series(rng.random(n) < 0.03)),
+        "skey": pd.Series(rng.integers(0, 40, n)).astype("Int64"),
+        "qty": rng.uniform(1.0, 50.0, n),
+    }), 2)
+
+
+def _q(o):
+    from spark_rapids_tpu.sql import functions as F
+    return (o.group_by("okey").agg(
+        F.sum("qty").alias("sq"), F.count("*").alias("n"),
+        F.max("qty").alias("mx")))
+
+
+@pytest.mark.smoke
+def test_dense_single_key_matches_oracle(session, rng):
+    o = _orders(session, rng)
+    cpu = with_cpu_session(lambda s: _q(o))
+    tpu = with_tpu_session(lambda s: _q(o))
+    assert_frames_equal(tpu, cpu, ignore_order=True, approx=True)
+
+
+def test_dense_multi_key_with_nulls(session, rng):
+    from spark_rapids_tpu.sql import functions as F
+    o = _orders(session, rng)
+
+    def q(s):
+        return (o.group_by("okey", "skey")
+                .agg(F.sum("qty").alias("sq"), F.count("*").alias("n")))
+    cpu = with_cpu_session(q)
+    tpu = with_tpu_session(q)
+    assert_frames_equal(tpu, cpu, ignore_order=True, approx=True)
+
+
+def test_dense_stale_stats_fall_back_exactly(session, rng):
+    """Corrupt the advisory bounds to a range that excludes most keys:
+    the device verification must reject the dense path and the generic
+    path must still produce oracle-exact output."""
+    o = _orders(session, rng)
+    cpu = with_cpu_session(lambda s: _q(o))
+    first = with_tpu_session(lambda s: _q(o))
+    assert_frames_equal(first, cpu, ignore_order=True, approx=True)
+    # the registry now has real bounds; narrow them so live keys fall
+    # outside the advertised range
+    touched = []
+    for name, (lo, hi) in list(session.column_stats.items()):
+        if name == "okey":
+            session.column_stats[name] = (lo, lo + 1)
+            touched.append(name)
+    assert touched, "scan stats never recorded the group key"
+    second = with_tpu_session(lambda s: _q(o))
+    assert_frames_equal(second, cpu, ignore_order=True, approx=True)
+
+
+def test_dense_conf_gate(session, rng):
+    o = _orders(session, rng)
+    conf = {"spark.rapids.sql.agg.denseKeys": "false"}
+    cpu = with_cpu_session(lambda s: _q(o))
+    tpu = with_tpu_session(lambda s: _q(o), conf=conf)
+    assert_frames_equal(tpu, cpu, ignore_order=True, approx=True)
