@@ -124,7 +124,8 @@ ADAPTIVE_CAPACITY = register(
     "without speculation on any miss — correctness never depends on the "
     "cache. On a high-latency host-device link (tunneled attachment: "
     "100-250ms per round trip) this removes the dominant steady-state "
-    "cost of join-heavy plans.")
+    "cost of join-heavy plans. Also the verification substrate of "
+    "spark.rapids.sql.agg.denseKeys, which this conf gates.")
 
 AGG_DENSE_KEYS = register(
     "spark.rapids.sql.agg.denseKeys", _to_bool, True,
@@ -132,8 +133,12 @@ AGG_DENSE_KEYS = register(
     "fixed-width integer with advisory scan-stat bounds fitting 62 bits "
     "of combined slot space, the grouping sort runs on ONE exact "
     "composite key (2 sort operands instead of 4, no hashing, no image "
-    "refinement). Device-verified; stale stats fall back to the generic "
-    "hash path inside the same compiled program (lax.cond).")
+    "refinement) and it is the ONLY grouping path compiled. The "
+    "device-computed bounds check joins the deferred speculation "
+    "verification: a stale-stats miss transparently re-executes the "
+    "query without dense grouping and blocklists the plan. Requires "
+    "spark.rapids.sql.adaptiveCapacity.enabled (the verification "
+    "machinery); disabling that disables dense grouping too.")
 
 AGG_FUSE_COUNT_DISTINCT = register(
     "spark.rapids.sql.agg.fuseCountDistinct", _to_bool, True,

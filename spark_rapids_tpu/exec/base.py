@@ -185,6 +185,11 @@ class ExecContext:
             and conf.get_bool("spark.rapids.sql.adaptiveCapacity.enabled",
                               True))
         self.spec_pending: list = []
+        # adaptive-ratio cache entries written during this execution:
+        # a speculative run that later fails verification learned its
+        # ratios from possibly-garbage group counts — the session clears
+        # exactly these before re-executing (session._execute)
+        self.ratio_writes: list = []
         # per-query materialization state of deduped shared subtrees
         # (exec/reuse.TpuReuseSubtreeExec) — context-scoped so a fresh
         # context (speculation re-execution) re-runs the subtree

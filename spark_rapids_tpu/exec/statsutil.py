@@ -72,4 +72,9 @@ def dense_group_plan(session, key_names, key_dtypes,
             return None
         los.append(lo)
         sizes.append(size)
+    # low-cardinality tuples take the dictionary matmul path anyway
+    # (ops/aggregate._dict_path_info, DICT_SLOT_MAX): a dense variant
+    # would compile a duplicate program and speculate for nothing
+    if total <= 4096:
+        return None
     return los, tuple(sizes)

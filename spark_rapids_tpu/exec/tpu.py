@@ -331,8 +331,9 @@ class TpuHashAggregateExec(TpuExec):
                     b, key_exprs, p.update_inputs, reductions,
                     p.partial_schema, mask_expr=pre_mask)))
             # bounded-int composite grouping key variant (advisory scan
-            # stats resolved at partitions() time; device-verified with
-            # lax.cond fallback — ops/aggregate.dense_composite)
+            # stats resolved at partitions() time; the ONLY compiled
+            # grouping path — a miss re-executes via the deferred
+            # speculation verification, ops/aggregate.dense_composite)
             self._dense_update = lambda sizes: cached_jit(
                 f"aggupd|{p.signature}{mask_sig}|dense{sizes}",
                 lambda: jax.jit(lambda b, los: agg_ops.aggregate_update(
@@ -374,11 +375,17 @@ class TpuHashAggregateExec(TpuExec):
                 b, p.num_keys, reductions, p.partial_schema)))
 
     def _dense_group_plan(self, ctx: ExecContext):
-        """(los list, sizes tuple) for the bounded-int composite grouping
-        key, or None (non-int keys, unresolvable stats, or >62 bits).
-        Advisory only: the kernel verifies on device and falls back."""
-        if ctx.session is None or not ctx.conf.get_bool(
-                "spark.rapids.sql.agg.denseKeys", True):
+        """(los list, sizes tuple, spec_key) for the bounded-int composite
+        grouping key, or None (non-int keys, unresolvable stats, >62
+        bits, speculation off, or blocklisted after a verification miss).
+        The dense program is the ONLY compiled grouping path; the
+        device-computed ok flag joins the deferred speculation
+        verification and a miss re-executes without dense (and
+        blocklists this plan so chronically-stale stats do not re-run
+        every execution)."""
+        if (ctx.session is None or not getattr(ctx, "speculate", False)
+                or not ctx.conf.get_bool(
+                    "spark.rapids.sql.agg.denseKeys", True)):
             return None
         p = self.plan
         if p.num_keys == 0:
@@ -401,7 +408,23 @@ class TpuHashAggregateExec(TpuExec):
             for j in range(p.num_keys):
                 key_names.append({ps.names[j]})
                 key_dts.append(ps.dtypes[j])
-        return dense_group_plan(ctx.session, key_names, key_dts)
+        from spark_rapids_tpu.exec.base import plan_fingerprint
+        fp = plan_fingerprint(self)
+        # dense only engages for a plan the session has EXECUTED before:
+        # on a first execution the scan stats may not cover this upload
+        # yet (they record as batches stream, after planning), and a
+        # guaranteed-stale speculation would re-execute the query
+        seen = ctx.session.dense_plans_seen
+        if fp not in seen:
+            seen.add(fp)
+            return None
+        got = dense_group_plan(ctx.session, key_names, key_dts)
+        if got is None:
+            return None
+        skey = f"nocache|densegroup|{fp}|{got[1]}"
+        if skey in ctx.session.capacity_spec_blocklist:
+            return None
+        return got[0], got[1], skey
 
     def output_schema(self) -> Schema:
         return (self.plan.partial_schema if self.mode == "partial"
@@ -430,12 +453,26 @@ class TpuHashAggregateExec(TpuExec):
         dense = self._dense_group_plan(ctx)
         if dense is not None:
             los_arr = jnp.asarray(dense[0], jnp.int64)
-            sizes = dense[1]
+            sizes, skey = dense[1], dense[2]
+
+            def _register(ok) -> None:
+                from spark_rapids_tpu.exec.tpujoin import _start_host_copies
+                _start_host_copies([ok])
+                ctx.spec_pending.append((skey, [], [], [ok], None))
+
             dmerge = self._dense_merge(sizes)
-            merge_kernel = lambda b: dmerge(b, los_arr)  # noqa: E731
+
+            def merge_kernel(b):
+                out, ok = dmerge(b, los_arr)
+                _register(ok)
+                return out
             if self.mode == "partial":
                 dupd = self._dense_update(sizes)
-                update_kernel = lambda b: dupd(b, los_arr)  # noqa: E731
+
+                def update_kernel(b):
+                    out, ok = dupd(b, los_arr)
+                    _register(ok)
+                    return out
             else:
                 update_kernel = None
         else:
@@ -491,6 +528,7 @@ class TpuHashAggregateExec(TpuExec):
                         ratio = (p0.num_rows_host()
                                  / max(first.num_rows_hint(), 1))
                         cache[sig] = [ratio, 0]
+                        ctx.ratio_writes.append(sig)
                     if second is None:
                         yield p0
                         return

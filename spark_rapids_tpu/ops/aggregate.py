@@ -173,41 +173,46 @@ def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
                     out_schema: Schema,
                     force_single_group: bool,
                     live=None, dense=None) -> DeviceBatch:
+    def out(res):
+        # dense callers always receive (result, ok): paths the dense key
+        # does not apply to are trivially ok
+        return (res, jnp.asarray(True)) if dense is not None else res
     if not key_idx:
-        return _single_group_reduce(batch, reductions, out_schema, live)
+        return out(_single_group_reduce(batch, reductions, out_schema, live))
     has_string_reduction = any(
         batch.columns[ci].dtype.is_string and kind != "count_valid"
         for kind, ci, _dt in reductions)
     if has_string_reduction:
-        return _sorted_space_reduce(batch, key_idx, reductions, out_schema,
-                                    live)
+        return out(_sorted_space_reduce(batch, key_idx, reductions,
+                                        out_schema, live))
     dict_info = _dict_path_info(batch, key_idx)
     if dict_info is not None:
-        return _dict_matmul_reduce(batch, key_idx, reductions, out_schema,
-                                   dict_info, live)
+        return out(_dict_matmul_reduce(batch, key_idx, reductions,
+                                       out_schema, dict_info, live))
     if dense is not None:
         # bounded-int keys (advisory scan stats, exec/tpu.py): exact
-        # composite grouping key — device-verified, lax.cond falls back
-        # to the generic path when the stats were stale
+        # composite grouping key. ONLY the dense program is compiled —
+        # the ok flag rides the deferred speculation verification
+        # (session._verify_speculation) and a stale-stats miss
+        # re-executes the query without dense grouping. A lax.cond
+        # fallback would compile BOTH grouping paths into every
+        # aggregation (measured to push big multi-agg chains past the
+        # bench's per-query deadline).
         los, sizes = dense
         lv = batch.row_mask() if live is None else live
         comp, ok = dense_composite(batch, key_idx, los, sizes, lv)
-        return jax.lax.cond(
-            ok,
-            lambda _: _dense_payload_reduce(batch, key_idx, reductions,
-                                            out_schema, lv, comp),
-            lambda _: _sorted_payload_reduce(batch, key_idx, reductions,
-                                             out_schema, lv),
-            None)
+        return _dense_payload_reduce(batch, key_idx, reductions,
+                                     out_schema, lv, comp), ok
     # dictionary-encoded keys (bounded cardinality): the sort-free slot
     # attempt usually wins; otherwise (high/unknown cardinality) the
     # payload-sort path — its segment ops see SORTED ids, which XLA lowers
     # ~10x cheaper than the row-space scatters of the old sort branch
     if len(key_idx) <= 32 and not all(
             batch.columns[ki].dict_values is not None for ki in key_idx):
-        return _sorted_payload_reduce(batch, key_idx, reductions,
-                                      out_schema, live)
-    return _rowspace_reduce(batch, key_idx, reductions, out_schema, live)
+        return out(_sorted_payload_reduce(batch, key_idx, reductions,
+                                          out_schema, live))
+    return out(_rowspace_reduce(batch, key_idx, reductions, out_schema,
+                                live))
 
 
 def _sorted_payload_reduce(batch: DeviceBatch, key_idx: List[int],
@@ -959,8 +964,9 @@ def dense_composite(batch: DeviceBatch, key_idx: List[int],
     ``los``: int64 device vector (k,), advisory scan-stat lower bounds.
     ``sizes``: static per-key slot counts (bucketed pow2 of the stat
     range). Returns (comp u64, ok bool): ok=False when any live valid key
-    falls outside its advisory range — the caller must take the generic
-    path (lax.cond), so correctness never depends on the stats."""
+    falls outside its advisory range — the caller defers ok to the
+    speculation verification and the query re-executes without dense
+    grouping on a miss, so correctness never depends on the stats."""
     capacity = batch.capacity
     comp = jnp.zeros((capacity,), jnp.uint64)
     ok = jnp.asarray(True)

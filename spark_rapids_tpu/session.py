@@ -80,6 +80,14 @@ class TpuSparkSession:
         self.capacity_cache: dict = {}
         self.capacity_spec_reruns = 0
         self.capacity_spec_hits = 0
+        # speculation keys that failed verification and must not retry
+        # ("nocache|" prefix: dense grouping keys — chronically-stale
+        # stats would otherwise re-execute every run)
+        self.capacity_spec_blocklist: set = set()
+        # plan fingerprints that have executed once: dense grouping only
+        # engages from the second execution (first-run scan stats cannot
+        # cover the upload yet — they record as batches stream)
+        self.dense_plans_seen: set = set()
         # scan-derived integer column bounds: column name -> (min, max),
         # unioned across every scanned batch carrying that name. ADVISORY
         # (the role of the reference's cuDF column min/max the join build
@@ -340,6 +348,10 @@ class TpuSparkSession:
                 # entries that missed were dropped above, so the next
                 # execution re-learns them with the exact sync).
                 self.capacity_spec_reruns += 1
+                # ratios learned from a misspeculated run may be garbage
+                # (a dense-group miss collapses group counts)
+                for sig in ctx.ratio_writes:
+                    self.agg_ratio_cache.pop(sig, None)
                 self.release_active_shuffles()
                 self.release_transient_buffers()
                 ctx = ExecContext(conf, self, speculate=False)
@@ -419,6 +431,8 @@ class TpuSparkSession:
                     ent["sizes"] = [[int(x) for x in s] for s in sizes]
             else:
                 self.capacity_cache.pop(key, None)
+                if key.startswith("nocache|"):
+                    self.capacity_spec_blocklist.add(key)
                 all_good = False
         return all_good
 

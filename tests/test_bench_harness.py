@@ -31,7 +31,7 @@ def test_timeout_kills_worker_and_next_query_unaffected():
     )
     out = subprocess.run(
         [sys.executable, BENCH], env=env, capture_output=True, text=True,
-        timeout=300)
+        timeout=600)
     assert out.returncode == 0, out.stderr[-2000:]
     payload = json.loads(out.stdout.strip().splitlines()[-1])
     q = payload["detail"]["queries"]

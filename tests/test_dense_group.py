@@ -1,7 +1,8 @@
 """Bounded-int composite grouping keys (spark.rapids.sql.agg.denseKeys,
 ops/aggregate.dense_composite): advisory scan stats give each int key a
-slot range; the kernel verifies on device and lax.cond-falls back to the
-generic hash path when the stats are stale. Pins: correctness with stats
+slot range; the kernel verifies on device, and a stale-stats miss
+re-executes the query without dense grouping (deferred speculation
+verification) and blocklists the plan. Pins: correctness with stats
 present, correctness with DELIBERATELY WRONG (too-narrow) stats, null
 keys, and multi-key composites."""
 
@@ -32,10 +33,17 @@ def _q(o):
 
 @pytest.mark.smoke
 def test_dense_single_key_matches_oracle(session, rng):
+    # dense grouping engages from the SECOND execution of a plan (the
+    # first records the fingerprint while scan stats fill in): both the
+    # generic first run and the dense later runs must match the oracle
     o = _orders(session, rng)
     cpu = with_cpu_session(lambda s: _q(o))
-    tpu = with_tpu_session(lambda s: _q(o))
-    assert_frames_equal(tpu, cpu, ignore_order=True, approx=True)
+    reruns0 = session.capacity_spec_reruns
+    for _ in range(3):
+        tpu = with_tpu_session(lambda s: _q(o))
+        assert_frames_equal(tpu, cpu, ignore_order=True, approx=True)
+    assert session.capacity_spec_reruns == reruns0, \
+        "healthy stats must never trigger a re-execution"
 
 
 def test_dense_multi_key_with_nulls(session, rng):
@@ -46,14 +54,16 @@ def test_dense_multi_key_with_nulls(session, rng):
         return (o.group_by("okey", "skey")
                 .agg(F.sum("qty").alias("sq"), F.count("*").alias("n")))
     cpu = with_cpu_session(q)
-    tpu = with_tpu_session(q)
-    assert_frames_equal(tpu, cpu, ignore_order=True, approx=True)
+    for _ in range(3):
+        tpu = with_tpu_session(q)
+        assert_frames_equal(tpu, cpu, ignore_order=True, approx=True)
 
 
 def test_dense_stale_stats_fall_back_exactly(session, rng):
     """Corrupt the advisory bounds to a range that excludes most keys:
-    the device verification must reject the dense path and the generic
-    path must still produce oracle-exact output."""
+    the deferred verification must catch the dense miss, transparently
+    re-execute without dense grouping (still oracle-exact), and
+    blocklist the plan so the NEXT run does not re-pay the re-execution."""
     o = _orders(session, rng)
     cpu = with_cpu_session(lambda s: _q(o))
     first = with_tpu_session(lambda s: _q(o))
@@ -66,8 +76,16 @@ def test_dense_stale_stats_fall_back_exactly(session, rng):
             session.column_stats[name] = (lo, lo + 1)
             touched.append(name)
     assert touched, "scan stats never recorded the group key"
+    reruns0 = session.capacity_spec_reruns
+    bl0 = len(session.capacity_spec_blocklist)
     second = with_tpu_session(lambda s: _q(o))
     assert_frames_equal(second, cpu, ignore_order=True, approx=True)
+    assert session.capacity_spec_reruns == reruns0 + 1
+    assert len(session.capacity_spec_blocklist) > bl0
+    third = with_tpu_session(lambda s: _q(o))
+    assert_frames_equal(third, cpu, ignore_order=True, approx=True)
+    assert session.capacity_spec_reruns == reruns0 + 1, \
+        "blocklisted plan must not re-execute again"
 
 
 def test_dense_conf_gate(session, rng):

@@ -24,14 +24,38 @@ monitoring.register_event_duration_secs_listener(_dur_listener)
 
 from spark_rapids_tpu.session import TpuSparkSession
 from spark_rapids_tpu.utils import kernelcache
-from spark_rapids_tpu.models.tpch import QUERIES, TpchTables
 
 qname = sys.argv[1] if len(sys.argv) > 1 else "q2"
+sf = float(os.environ.get("BENCH_SF", "0.5"))
 
 session = TpuSparkSession.builder().config(
     "spark.rapids.sql.enabled", True).config(
     "spark.rapids.sql.cacheDeviceScans", True).get_or_create()
-tables = TpchTables.generate(session, 0.5, num_partitions=4)
+if qname.startswith("tpcxbb."):
+    from spark_rapids_tpu.models.tpcxbb import QUERIES, TpcxbbTables
+    tables = TpcxbbTables.generate(session, sf * 20, num_partitions=4)
+    qname = qname.split(".", 1)[1]
+elif qname.startswith("mortgage."):
+    from spark_rapids_tpu.models import mortgage, mortgage_data
+    # same conf bench.py sets: the ETL's broadcast cross join must run
+    # on-device or the timings describe a hybrid plan
+    session.set_conf("spark.rapids.sql.exec.CartesianProductExec", True)
+    perf = session.create_dataframe(
+        mortgage_data.gen_performance(sf * 20), 4)
+    acq = session.create_dataframe(
+        mortgage_data.gen_acquisition(sf * 20), 4)
+    QUERIES = {
+        "etl": lambda s, t: mortgage.run_etl(s, perf, acq),
+        "agg_join": lambda s, t: mortgage.aggregates_with_join(
+            s, perf, acq),
+        "percentiles": lambda s, t: mortgage.aggregates_with_percentiles(
+            s, perf),
+    }
+    tables = None
+    qname = qname.split(".", 1)[1]
+else:
+    from spark_rapids_tpu.models.tpch import QUERIES, TpchTables
+    tables = TpchTables.generate(session, sf, num_partitions=4)
 
 print(f"backend={jax.default_backend()}", flush=True)
 
