@@ -68,12 +68,14 @@ def test_dense_stale_stats_fall_back_exactly(session, rng):
     cpu = with_cpu_session(lambda s: _q(o))
     first = with_tpu_session(lambda s: _q(o))
     assert_frames_equal(first, cpu, ignore_order=True, approx=True)
-    # the registry now has real bounds; narrow them so live keys fall
-    # outside the advertised range
+    # the registry now has real bounds; shift them to a large-but-wrong
+    # window so every live key falls outside the advertised range (a
+    # tiny range would fall under the low-cardinality floor and
+    # legitimately skip dense instead of exercising the miss path)
     touched = []
     for name, (lo, hi) in list(session.column_stats.items()):
         if name == "okey":
-            session.column_stats[name] = (lo, lo + 1)
+            session.column_stats[name] = (hi + 10000, hi + 40000)
             touched.append(name)
     assert touched, "scan stats never recorded the group key"
     reruns0 = session.capacity_spec_reruns
