@@ -1,6 +1,12 @@
 """Benchmark: query-sweep wall clock, framework TPU path vs CPU path.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE compact JSON summary line as the FINAL stdout line:
+{"metric", "value", "unit", "vs_baseline", per-sweep counters}. Full
+per-query detail is written to BENCH_DETAIL.json (BENCH_DETAIL_FILE to
+override) so a tail capture of the run always contains the headline
+number. Before measuring, the harness waits for an idle box
+(BENCH_LOAD_GATE / BENCH_LOAD_WAIT_S) — on a 1-core box a co-tenant
+inflates the CPU-path times ~2x.
 
 The measured quantity is the geomean wall-clock speedup of the TPU
 (accelerated) path over the framework's CPU path across every runnable
@@ -309,6 +315,33 @@ def _parse_sweep():
     return suite_env, sweep
 
 
+def _wait_for_idle_box():
+    """Refuse to start measuring on a loaded box: spin-wait (up to
+    BENCH_LOAD_WAIT_S, default 600s) until 1-min loadavg drops below
+    BENCH_LOAD_GATE (default 0.5 * ncpu + 0.25). On a 1-core box a
+    co-tenant inflates the CPU-path (pandas) times ~2x, which once
+    produced a phantom sign flip — gating beats annotating."""
+    ncpu = os.cpu_count() or 1
+    gate = float(os.environ.get("BENCH_LOAD_GATE", 0.5 * ncpu + 0.25))
+    max_wait = float(os.environ.get("BENCH_LOAD_WAIT_S", "600"))
+    t0 = time.monotonic()
+    waited = False
+    while os.getloadavg()[0] > gate:
+        if time.monotonic() - t0 > max_wait:
+            print(f"bench: box still loaded after {max_wait:.0f}s "
+                  f"(loadavg {os.getloadavg()[0]:.2f} > gate {gate:.2f}); "
+                  f"proceeding with load_warning", file=sys.stderr,
+                  flush=True)
+            return False
+        if not waited:
+            print(f"bench: waiting for idle box (loadavg "
+                  f"{os.getloadavg()[0]:.2f} > gate {gate:.2f})",
+                  file=sys.stderr, flush=True)
+            waited = True
+        time.sleep(10)
+    return True
+
+
 def main():
     if "--worker" in sys.argv:
         _worker()
@@ -323,6 +356,7 @@ def main():
     # deadline so a slow build cannot eat the first query's budget, and a
     # killed worker re-pays only the build, not a cascading timeout
     build_timeout = int(os.environ.get("BENCH_BUILD_TIMEOUT_S", "900"))
+    box_idle = _wait_for_idle_box()
     load_before = os.getloadavg()
     detail = {}
     speedups = []
@@ -413,7 +447,8 @@ def main():
     # the bench itself contributes ~1 runnable process; anything beyond
     # that on top of the core count means a co-tenant is inflating the
     # CPU-path (pandas) times
-    if load_before[0] > 0.6 * ncpu or load_after[0] > 1.0 + 0.6 * ncpu:
+    if (not box_idle or load_before[0] > 0.6 * ncpu
+            or load_after[0] > 1.0 + 0.6 * ncpu):
         load_warning = (
             f"box loaded (loadavg before={load_before[0]:.1f} "
             f"after={load_after[0]:.1f}, {ncpu} cpus): CPU-path times "
@@ -427,25 +462,53 @@ def main():
     if load_warning:
         meta["load_warning"] = load_warning
 
-    if not speedups:
-        print(json.dumps({
-            "metric": f"{suite_names}_geomean_speedup_tpu_vs_cpu_path",
-            "value": 0.0, "unit": "x", "vs_baseline": 0.0,
-            "detail": dict(meta, error="every query timed out or failed"),
-        }))
-        return
-    geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
-    print(json.dumps({
+    # Full per-query detail goes to a sidecar file; stdout stays compact
+    # so a tail capture of the run ALWAYS contains the headline number
+    # (round 4's 40KB single-line detail truncated the geomean out of the
+    # graded record). The summary is printed as the FINAL stdout line.
+    detail_file = os.environ.get("BENCH_DETAIL_FILE", "BENCH_DETAIL.json")
+    try:
+        with open(detail_file, "w") as f:
+            json.dump(meta, f, indent=1)
+    except OSError as e:
+        # the per-query breakdown must survive somewhere: stderr keeps
+        # stdout compact while preserving the data
+        print(f"bench: could not write {detail_file}: {e}; detail "
+              f"follows on stderr:\n{json.dumps(meta)}",
+              file=sys.stderr, flush=True)
+        detail_file = None
+
+    scored = {k: v for k, v in detail.items() if "speedup" in v}
+    summary = {
         "metric": f"{suite_names}_geomean_speedup_tpu_vs_cpu_path",
-        "value": round(geomean, 4),
+        "value": 0.0,
         "unit": "x",
         # baseline: the CPU side is this framework's own pandas oracle
         # path, NOT CPU Apache Spark (which does not exist in this
         # environment); vs_baseline normalizes against the reference's
         # "4x typical" GPU-vs-CPU-Spark claim (docs/FAQ.md:62-66)
-        "vs_baseline": round(geomean / 4.0, 4),
-        "detail": meta,
-    }))
+        "vs_baseline": 0.0,
+        "n_queries": len(sweep),
+        "n_scored": len(scored),
+        "n_below_1x": sum(1 for v in scored.values() if v["speedup"] < 1.0),
+        "timed_compiles_total": sum(v.get("timed_compiles", 0)
+                                    for v in scored.values()),
+        "warm_compile_s_total": round(sum(v.get("warm_compile_s", 0.0)
+                                          for v in scored.values()), 1),
+        "loadavg_before": round(load_before[0], 2),
+        "loadavg_after": round(load_after[0], 2),
+        "detail_file": detail_file,
+    }
+    if load_warning:
+        summary["load_warning"] = load_warning
+    if not speedups:
+        summary["error"] = "every query timed out or failed"
+        print(json.dumps(summary))
+        return
+    geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
+    summary["value"] = round(geomean, 4)
+    summary["vs_baseline"] = round(geomean / 4.0, 4)
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

@@ -19,7 +19,8 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(
 
 
 @pytest.mark.smoke
-def test_timeout_kills_worker_and_next_query_unaffected():
+def test_timeout_kills_worker_and_next_query_unaffected(tmp_path):
+    detail_file = str(tmp_path / "detail.json")
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
@@ -28,17 +29,26 @@ def test_timeout_kills_worker_and_next_query_unaffected():
         BENCH_ITERS="1",
         BENCH_QUERY_TIMEOUT_S="20",
         BENCH_SELFTEST_HANG_S="3600",
+        BENCH_DETAIL_FILE=detail_file,
+        BENCH_LOAD_WAIT_S="0",
     )
     out = subprocess.run(
         [sys.executable, BENCH], env=env, capture_output=True, text=True,
         timeout=600)
     assert out.returncode == 0, out.stderr[-2000:]
-    payload = json.loads(out.stdout.strip().splitlines()[-1])
-    q = payload["detail"]["queries"]
+    # the summary must be the FINAL stdout line and must be compact: a
+    # tail capture of the run always contains the headline number
+    # (VERDICT r4 missing #2 — the 40KB detail line truncated the geomean)
+    last = out.stdout.strip().splitlines()[-1]
+    assert len(last) < 2000, f"summary line not compact: {len(last)}B"
+    payload = json.loads(last)
+    assert "value" in payload and "vs_baseline" in payload
+    assert payload["n_scored"] == 2 and payload["n_queries"] == 3
+    assert "loadavg_before" in payload
+    with open(detail_file) as f:
+        q = json.load(f)["queries"]
     assert "tpu_s" in q["_selftest.fast"], q
     assert "timed out" in q["_selftest.hang"].get("skipped", ""), q
     # the query AFTER the timeout ran normally on a fresh worker
     assert "tpu_s" in q["_selftest.fast2"], q
     assert q["_selftest.fast2"]["timed_compiles"] == 0
-    # loadavg guard fields present
-    assert "loadavg_before" in payload["detail"]
