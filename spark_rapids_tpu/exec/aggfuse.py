@@ -176,8 +176,34 @@ def _match_chain(node: PhysicalPlan):
     if len(plan_o.agg_fns) != 1 or not _is_count_star(plan_o.agg_fns[0]):
         return None
     g2_names = [n for n, _ in plan_o.grouping]
+    # an empty outer grouping (global count-distinct) must NOT fuse: the
+    # unfused final aggregate runs force_single_group and returns one
+    # row (count 0) on empty input, while the fused kernel would return
+    # zero rows — a silent result-shape divergence (ADVICE r4 #1)
+    if not g2_names:
+        return None
     if not set(g2_names) <= set(g1_names):
         return None
+    # the count_distinct_reduce nullsig packs one validity bit per G1 key
+    # into a uint32 (ops/aggregate.py count_distinct_reduce); wider
+    # tuples would overflow the shift (ADVICE r4 #3)
+    if len(g1_names) > 32:
+        return None
+    # outer grouping exprs must be bare references to the SAME-named
+    # inner G1 output — a computed expr aliased to an inner output name
+    # (e.g. (col('size')+1).alias('size')) would pass the name-subset
+    # check and silently group on the raw child column (ADVICE r4 #2)
+    for n, e in plan_o.grouping:
+        e = _strip_alias(e)
+        if isinstance(e, BoundRef):
+            if not (0 <= e.index < len(g1_names)
+                    and g1_names[e.index] == n):
+                return None
+        elif isinstance(e, Col):
+            if e.name != n or n not in g1_names:
+                return None
+        else:
+            return None
     # inner grouping exprs must be bare columns of the real child
     child_schema = child.output_schema()
     g1_child_idx = {}

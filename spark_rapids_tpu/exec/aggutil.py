@@ -76,9 +76,21 @@ class AggPlan:
                 col += 1
             self.merge_plan.append(merged)
 
-        # final output schema
+        # final output schema. Key results are Col(grouping_output_name)
+        # references resolved at finalize; their dtype comes from the
+        # grouping expr — evaluating the name against the child schema
+        # would pick up a shadowing raw column when a computed key is
+        # aliased to an existing column name.
+        gdt = {n: dt for (n, _), dt
+               in zip(self.grouping, dts[:self.num_keys])}
         out_names = [n for n, _ in self.results]
-        out_dts = [e.dtype(child_schema) for _, e in self.results]
+        out_dts = []
+        for _, e in self.results:
+            from spark_rapids_tpu.sql.exprs.core import Col
+            if isinstance(e, Col) and e.name in gdt:
+                out_dts.append(gdt[e.name])
+            else:
+                out_dts.append(e.dtype(child_schema))
         self.output_schema = Schema(out_names, out_dts)
 
     @property

@@ -1150,23 +1150,38 @@ class TpuShuffleExchangeExec(TpuExec):
                 # end (session._verify_speculation) — the slice kernel
                 # clamps liveness by the device-side row count, so a
                 # covered speculation emits identical data
-                need = [b for b in batches if b._host_rows is None]
+                cache = entry = None
+                if getattr(ctx, "speculate", False):
+                    from spark_rapids_tpu.exec.base import (
+                        plan_fingerprint,
+                    )
+                    from spark_rapids_tpu.exec.reuse import (
+                        subtree_deterministic,
+                    )
+                    if subtree_deterministic(self):
+                        skey = plan_fingerprint(self) + "|shrink"
+                        cache = ctx.session.capacity_cache
+                        entry = cache.get(skey)
+                # under speculation the cache entry must key on an
+                # execution-invariant batch set: which batches already
+                # carry _host_rows differs between run 1 (one-time
+                # agg-ratio learning syncs set some) and run 2, so
+                # filtering to the unknown ones made entry['n'] mismatch
+                # and wasted the first speculation window (ADVICE r4 #5).
+                # Counts only speculate once they have proven STABLE
+                # across two consecutive runs: adaptive strategy shifts
+                # (dense grouping / partial-skip engage from a plan's
+                # second execution) legitimately change the counts between
+                # run 1 and run 2 under an identical structural
+                # fingerprint, and speculating unstable counts forces a
+                # full re-execution at verify time.
+                need = (list(batches) if cache is not None
+                        else [b for b in batches if b._host_rows is None])
                 if need:
                     counts_d = [b.num_rows for b in need]
-                    cache = entry = None
-                    if getattr(ctx, "speculate", False):
-                        from spark_rapids_tpu.exec.base import (
-                            plan_fingerprint,
-                        )
-                        from spark_rapids_tpu.exec.reuse import (
-                            subtree_deterministic,
-                        )
-                        if subtree_deterministic(self):
-                            skey = plan_fingerprint(self) + "|shrink"
-                            cache = ctx.session.capacity_cache
-                            entry = cache.get(skey)
                     if (entry is not None
-                            and entry.get("n") == len(need)):
+                            and entry.get("n") == len(need)
+                            and entry.get("stable")):
                         from spark_rapids_tpu.exec.tpujoin import (
                             _start_host_copies,
                         )
@@ -1177,13 +1192,18 @@ class TpuShuffleExchangeExec(TpuExec):
                         for b, c in zip(need, entry["counts"]):
                             b._host_rows = int(c)
                     else:
-                        counts = _jax.device_get(counts_d)
+                        counts = [int(c)
+                                  for c in _jax.device_get(counts_d)]
                         if cache is not None:
-                            cache[skey] = {
-                                "n": len(need),
-                                "counts": [int(c) for c in counts]}
+                            if (entry is not None
+                                    and entry.get("n") == len(need)
+                                    and entry["counts"] == counts):
+                                entry["stable"] = True
+                            else:
+                                cache[skey] = {"n": len(need),
+                                               "counts": counts}
                         for b, c in zip(need, counts):
-                            b._host_rows = int(c)
+                            b._host_rows = c
                 shrunk = []
                 for b in batches:
                     target = bucket_capacity(max(b._host_rows, 1), growth)

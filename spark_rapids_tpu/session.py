@@ -23,6 +23,46 @@ from spark_rapids_tpu.sql.planner import Planner
 from spark_rapids_tpu.sql.sources import CsvSource, InMemorySource, ParquetSource
 
 
+class OrderedSet:
+    """Insertion-ordered set (dict-backed) so size sweeps can evict
+    oldest-first — an arbitrary ``set.pop()`` could drop a hot entry or,
+    worse, re-enable a blocklisted speculation key."""
+
+    def __init__(self):
+        self._d: dict = {}
+
+    def add(self, k) -> None:
+        self._d[k] = True
+
+    def __contains__(self, k) -> bool:
+        return k in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def pop_oldest(self) -> None:
+        del self._d[next(iter(self._d))]
+
+
+class LruDict(dict):
+    """dict whose reads move the key to the end, so the size sweep's
+    oldest-first eviction approximates LRU instead of FIFO (a stable hot
+    query set inserted early must outlive churned dead keys)."""
+
+    def get(self, k, default=None):
+        try:
+            return self[k]
+        except KeyError:
+            return default
+
+    def __getitem__(self, k):
+        v = super().__getitem__(k)
+        if next(reversed(self)) != k:
+            super().__delitem__(k)
+            super().__setitem__(k, v)
+        return v
+
+
 class TpuSparkSession:
     _active: Optional["TpuSparkSession"] = None
     _lock = threading.Lock()
@@ -70,24 +110,26 @@ class TpuSparkSession:
         # adaptive statistics: aggregate signature -> last observed
         # partial-pass reduction ratio (groups/rows); known-poor reducers
         # skip their partial pass from batch 0 on later executions
-        self.agg_ratio_cache: dict = {}
+        self.agg_ratio_cache: LruDict = LruDict()
         # adaptive capacity speculation (spark.rapids.sql.adaptiveCapacity
         # .enabled): structural-plan-fingerprint -> last observed join
         # expansion sizes; later executions skip the per-join capacity
         # sync and verify in one deferred fetch (exec/tpujoin.py,
         # _verify_speculation). capacity_spec_reruns counts verification
         # misses (each one transparently re-executed without speculation).
-        self.capacity_cache: dict = {}
+        self.capacity_cache: LruDict = LruDict()
         self.capacity_spec_reruns = 0
         self.capacity_spec_hits = 0
         # speculation keys that failed verification and must not retry
         # ("nocache|" prefix: dense grouping keys — chronically-stale
-        # stats would otherwise re-execute every run)
-        self.capacity_spec_blocklist: set = set()
+        # stats would otherwise re-execute every run). Insertion-ordered
+        # set (dict keys) so the size sweep evicts oldest-first — an
+        # arbitrary set.pop() could re-enable a known-bad speculation.
+        self.capacity_spec_blocklist: OrderedSet = OrderedSet()
         # plan fingerprints that have executed once: dense grouping only
         # engages from the second execution (first-run scan stats cannot
         # cover the upload yet — they record as batches stream)
-        self.dense_plans_seen: set = set()
+        self.dense_plans_seen: OrderedSet = OrderedSet()
         # scan-derived integer column bounds: column name -> (min, max),
         # unioned across every scanned batch carrying that name. ADVISORY
         # (the role of the reference's cuDF column min/max the join build
@@ -374,7 +416,29 @@ class TpuSparkSession:
             }
         self.last_query_metrics = ctx.metrics
         self.last_node_times = ctx.node_times  # profiler (syncEachOp)
+        self._sweep_adaptive_caches()
         return plan, outs
+
+    # adaptive-state size cap: fingerprints embed per-upload data uids,
+    # so a workload that keeps creating DataFrames mints fresh keys every
+    # query and the dicts would grow for the session's lifetime
+    # (ADVICE r4 #4). The LruDict caches touch keys on read, so
+    # oldest-first half-eviction approximates LRU; the ordered sets evict
+    # oldest-first (never arbitrary — a random blocklist eviction would
+    # re-enable a known-bad speculation).
+    ADAPTIVE_CACHE_CAP = 4096
+
+    def _sweep_adaptive_caches(self) -> None:
+        cap = self.ADAPTIVE_CACHE_CAP
+        for d in (self.capacity_cache, self.agg_ratio_cache,
+                  self.column_stats, self.column_aliases):
+            if len(d) > cap:
+                for k in list(d.keys())[:len(d) - cap // 2]:
+                    del d[k]
+        for s in (self.capacity_spec_blocklist, self.dense_plans_seen):
+            if len(s) > cap:
+                while len(s) > cap // 2:
+                    s.pop_oldest()
 
     def _verify_speculation(self, ctx) -> bool:
         """ONE deferred fetch validating every capacity the query
@@ -559,13 +623,18 @@ class GroupedData:
         child = self.df._plan
         grouping = []
         computed = []   # non-column keys get pre-projected (Spark's shape)
-        for g in self.grouping:
+        for i, g in enumerate(self.grouping):
             e = _c(g)
             name = e.sql_name(schema)
             base = e.children[0] if isinstance(e, Alias) else e
             if not isinstance(base, Col):
-                computed.append((name, e))
-                e = Col(name)
+                # a computed key aliased to an EXISTING column name would
+                # collide with its passthrough twin in the pre-projection
+                # and name-binding would silently group on the raw column;
+                # project under an internal name, output the user's alias
+                iname = f"__grp{i}" if name in schema.names else name
+                computed.append((iname, e))
+                e = Col(iname)
             grouping.append((name, e))
         if computed:
             passthrough = [(n, col_fn(n).expr) for n in schema.names]
@@ -579,7 +648,10 @@ class GroupedData:
                for _, e in result_exprs for fn in find_aggregates(e)):
             return self._agg_with_distinct(child, grouping, schema,
                                            result_exprs)
-        results = list(grouping) + result_exprs
+        # key results reference the aggregate's OUTPUT names (finalize
+        # resolves Col against grouping names), not the pre-projection's
+        # internal names
+        results = [(n, Col(n)) for n, _ in grouping] + result_exprs
         return DataFrame(self.df.session,
                          lp.LogicalAggregate(child, grouping, results))
 
@@ -633,7 +705,7 @@ class GroupedData:
         def merge_fn(kind, ref):
             return kind_ctor[kind](ref)
 
-        l1_results = list(l1_grouping)
+        l1_results = [(n, Col(n)) for n, _ in l1_grouping]
         fn_level2 = {}
         pi = 0
         for fn in fns:

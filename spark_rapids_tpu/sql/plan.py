@@ -93,8 +93,21 @@ class LogicalAggregate(LogicalPlan):
 
     def schema(self) -> Schema:
         cs = self.children[0].schema()
-        return Schema([n for n, _ in self.results],
-                      [e.dtype(cs) for _, e in self.results])
+        # key results are Col(output_name) references resolved at
+        # finalize; their dtype must come from the GROUPING expr, not
+        # from evaluating the name against the child schema — a computed
+        # key aliased to an existing column name would otherwise report
+        # the shadowing raw column's dtype
+        gdt = {n: e.dtype(cs) for n, e in self.grouping}
+        dts = []
+        for n, e in self.results:
+            base = e
+            from spark_rapids_tpu.sql.exprs.core import Col
+            if isinstance(base, Col) and base.name in gdt:
+                dts.append(gdt[base.name])
+            else:
+                dts.append(e.dtype(cs))
+        return Schema([n for n, _ in self.results], dts)
 
 
 class LogicalSort(LogicalPlan):

@@ -58,6 +58,75 @@ def test_count_distinct_function_spelling(session, rng):
     assert_frames_equal(tpu, cpu, ignore_order=True)
 
 
+def test_global_count_distinct_not_fused(session, rng):
+    """No outer grouping keys: the unfused final aggregate returns ONE
+    row (count 0) on empty/fully-dead input via force_single_group; the
+    fused kernel would return zero rows. Must not match (ADVICE r4 #1),
+    and the empty-input shape must hold."""
+    from spark_rapids_tpu.sql import functions as F
+    d = _df(session, rng)
+
+    def q(s):
+        return d.distinct().group_by().agg(F.count("*").alias("cnt"))
+    cpu = with_cpu_session(q)
+    session.capture_plans = True
+    tpu = with_tpu_session(q)
+    session.capture_plans = False
+    assert_frames_equal(tpu, cpu, ignore_order=True)
+    assert not any(type(n).__name__ == "TpuCountDistinctExec"
+                   for n in session.captured_plans[-1].walk()), \
+        "global count-distinct must not fuse"
+
+    # empty input: one row, count 0, on both paths
+    e = session.create_dataframe(pd.DataFrame({
+        "brand": pd.Series([], dtype=object),
+        "supp": pd.Series([], dtype="Int64")}), 2)
+
+    def qe(s):
+        return e.distinct().group_by().agg(F.count("*").alias("cnt"))
+    cpu_e = with_cpu_session(qe)
+    tpu_e = with_tpu_session(qe)
+    assert len(tpu_e) == 1 and int(tpu_e["cnt"].iloc[0]) == 0
+    assert_frames_equal(tpu_e, cpu_e, ignore_order=True)
+
+
+def test_computed_outer_grouping_not_fused(session, rng):
+    """A computed outer grouping expr aliased to an inner output name
+    must not fuse to grouping on the raw child column (ADVICE r4 #2)."""
+    from spark_rapids_tpu.sql import functions as F
+    d = _df(session, rng)
+
+    def q(s):
+        return (d.select("size", "supp").distinct()
+                .group_by((F.col("size") + 1).alias("size"))
+                .agg(F.count("*").alias("cnt")))
+    cpu = with_cpu_session(q)
+    session.capture_plans = True
+    tpu = with_tpu_session(q)
+    session.capture_plans = False
+    assert_frames_equal(tpu, cpu, ignore_order=True)
+    assert not any(type(n).__name__ == "TpuCountDistinctExec"
+                   for n in session.captured_plans[-1].walk()), \
+        "computed outer grouping must not fuse"
+
+
+def test_computed_key_alias_collision_groups_and_types(session, rng):
+    """group_by((expr).alias(existing_name)): must group on the computed
+    values (not the shadowed raw column) and the output schema must carry
+    the computed dtype (code-review r5: logical + AggPlan schemas read
+    the raw column's dtype through the passthrough shadow)."""
+    from spark_rapids_tpu.sql import functions as F
+    d = _df(session, rng, n=500)
+
+    def q(s):
+        return (d.group_by(F.length(F.col("brand")).alias("brand"))
+                .agg(F.count("*").alias("cnt")))
+    cpu = with_cpu_session(q)
+    tpu = with_tpu_session(q)
+    assert_frames_equal(tpu, cpu, ignore_order=True)
+    assert str(tpu["brand"].dtype).lower().startswith("int")
+
+
 def test_fuse_conf_gate(session, rng):
     from spark_rapids_tpu.sql import functions as F
     d = _df(session, rng)
