@@ -76,6 +76,53 @@ SUITE_QUERIES = {"tpch": TPCH_ALL, "tpcxbb": TPCXBB_ALL,
 # Worker side: owns the jax session; one process, queries fed over stdin.
 # --------------------------------------------------------------------------
 
+def _results_match(tpu_df, cpu_df) -> bool:
+    """Order-insensitive value comparison of two result DataFrames:
+    float columns compared with a relative tolerance (sum order differs
+    across backends), everything else exactly."""
+    import numpy as np
+    if len(tpu_df) != len(cpu_df) or list(tpu_df.columns) != \
+            list(cpu_df.columns):
+        return False
+    if len(tpu_df) == 0:
+        return True
+    # canonical order: lexsort by every column (floats rounded so the
+    # two backends' last-ulp differences cannot reorder rows; remaining
+    # ties differ below the comparison tolerance anyway)
+    def canon(df):
+        keys = []
+        for i in range(df.shape[1] - 1, -1, -1):
+            col = df.iloc[:, i]
+            try:
+                keys.append(np.round(col.to_numpy(dtype=float), 6))
+            except (TypeError, ValueError):
+                keys.append(col.astype(str).to_numpy())
+        order = np.lexsort(keys)
+        return df.iloc[order].reset_index(drop=True)
+    t, c = canon(tpu_df), canon(cpu_df)
+    for i in range(t.shape[1]):
+        tv, cv = t.iloc[:, i], c.iloc[:, i]
+        tnull = tv.isna().to_numpy()
+        if not (tnull == cv.isna().to_numpy()).all():
+            return False
+        both = ~tnull
+        # ONLY float columns compare approximately (sum order differs
+        # across backends); ints/bools/strings/dates compare exactly —
+        # an int count off by one is a wrong answer, not noise
+        if tv.dtype.kind == "f" or (hasattr(tv.dtype, "numpy_dtype")
+                                    and tv.dtype.numpy_dtype.kind == "f"):
+            tf = tv.to_numpy(dtype=float)
+            cf = cv.to_numpy(dtype=float)
+            if not np.allclose(tf[both], cf[both], rtol=1e-6, atol=1e-9,
+                               equal_nan=True):
+                return False
+        else:
+            if not (tv[both].astype(str).to_numpy()
+                    == cv[both].astype(str).to_numpy()).all():
+                return False
+    return True
+
+
 def _worker():
     sf = float(os.environ.get("BENCH_SF", "0.5"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
@@ -186,8 +233,13 @@ def _worker():
             cpu_iters.append(round(time.perf_counter() - t0, 4))
         rec["cpu_iters"] = cpu_iters
 
-        assert len(tpu_out) == len(cpu_out), \
-            ("row-count mismatch", len(tpu_out), len(cpu_out))
+        # RESULT VERIFICATION, not just row counts: a backend
+        # miscompilation once produced silently-wrong TPU sums that a
+        # len() check sailed past (densered.py _f64_limb_word). A wrong
+        # answer makes the timing meaningless.
+        rec["verified"] = _results_match(tpu_out, cpu_out)
+        assert rec["verified"], \
+            ("TPU/CPU result mismatch", len(tpu_out), len(cpu_out))
         # steady state = min over iterations: the tunnel's one-off stalls
         # (remote relay hiccups) otherwise masquerade as compute
         rec["tpu_s"] = min(tpu_iters)

@@ -27,6 +27,22 @@ from spark_rapids_tpu.columnar.dtype import DType
 MIN_CAPACITY = 8
 
 
+def _start_host_copies_tree(tree) -> None:
+    """Issue async device->host copies for every array leaf before a
+    blocking ``jax.device_get``: without copies in flight, a multi-array
+    fetch serializes one ~40-100ms tunnel round trip PER ARRAY; with
+    them the whole tree lands in about one round trip plus transfer
+    time. Best-effort — a backend without the method just skips."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        copy = getattr(leaf, "copy_to_host_async", None)
+        if copy is None:
+            continue
+        try:
+            copy()
+        except Exception:  # noqa: BLE001 — prefetch is advisory only
+            return
+
+
 def bucket_capacity(n: int, growth: float = 2.0, minimum: int = MIN_CAPACITY) -> int:
     """Smallest capacity bucket >= n. growth=2.0 -> power-of-two buckets.
     growth <= 1 cannot make progress (it would loop forever)."""
@@ -224,16 +240,29 @@ class DeviceBatch:
         programs need compiling); each round trip costs ~100-250 ms on a
         tunneled attachment, which dominates small-result collects."""
         import jax
+        if not batches:
+            return []
         need = [b for b in batches if b._host_rows is None]
         total_padded = sum(b.device_memory_size() for b in batches)
-        if need and total_padded <= fused_fetch_bytes:
-            return DeviceBatch._to_pandas_fused(batches)
+        if total_padded <= fused_fetch_bytes:
+            # mesh results live on several devices; one jitted pack
+            # cannot span them — the multi-array fused fetch handles that
+            devs = set()
+            for b in batches:
+                devs |= getattr(b.num_rows, "devices", set)() \
+                    if callable(getattr(b.num_rows, "devices", None)) \
+                    else set()
+            if len(devs) <= 1:
+                return DeviceBatch._to_pandas_packed(batches)
+            if need:
+                return DeviceBatch._to_pandas_fused(batches)
         if need:
             counts = jax.device_get([b.num_rows for b in need])
             for b, c in zip(need, counts):
                 b._host_rows = int(c)
         all_views = [[col.device_views(b._host_rows) for col in b.columns]
                      for b in batches]
+        _start_host_copies_tree(all_views)
         host = jax.device_get(all_views)
         out: List[pd.DataFrame] = []
         for b, host_cols in zip(batches, host):
@@ -248,6 +277,149 @@ class DeviceBatch:
                 continue
             # positional construction: join outputs may carry duplicate
             # column names (both sides keep their key column, like Spark)
+            df = pd.concat(series, axis=1)
+            df.columns = list(b.schema.names)
+            out.append(df)
+        return out
+
+    @staticmethod
+    def _to_pandas_packed(batches: Sequence["DeviceBatch"]) -> List[pd.DataFrame]:
+        """ONE device buffer for the whole result set: a jitted kernel
+        concatenates every batch's row count + column buffers into a
+        single uint8 slab, fetched with a single device_get. Even a
+        batched multi-array fetch pays per-ARRAY costs on the tunneled
+        attachment (~25-40ms each after async overlap); a small query's
+        ~10-50 output arrays made the fetch the whole query floor. The
+        slab layout is derived host-side from the same static structure
+        the kernel packs, then sliced into numpy views."""
+        import jax
+        from spark_rapids_tpu.utils.kernelcache import cached_jit
+
+        # (static) pack plan: mirrors the kernel's segment order. float64
+        # data cannot be packed (no f64 bitcast on this stack — see
+        # ops/floatbits.py; arithmetic bit extraction is not value-exact
+        # for -0.0/NaN/denormals) so it rides as SIDE arrays in the same
+        # fetch; everything else lands in one uint8 slab.
+        plan = []  # per batch: list of (field, np_dtype, count)
+        sig_parts = []
+        for b in batches:
+            fields = [("rows", np.dtype(np.int32), 1)]
+            for col in b.columns:
+                if col.dtype.is_string and col.is_lazy:
+                    cap = int(col.validity.shape[0])
+                    fields.append(("codes", np.dtype(np.int32), cap))
+                    fields.append(("validity", np.dtype(np.uint8), cap))
+                elif col.dtype.is_string:
+                    cap = int(col.validity.shape[0])
+                    fields.append(("chars", np.dtype(np.uint8),
+                                   int(col.data.shape[0])))
+                    fields.append(("offsets", np.dtype(np.int32), cap + 1))
+                    fields.append(("validity", np.dtype(np.uint8), cap))
+                else:
+                    cap = int(col.validity.shape[0])
+                    dt = np.dtype(col.data.dtype)
+                    if dt == np.dtype(np.bool_):
+                        dt = np.dtype(np.uint8)
+                    if dt == np.dtype(np.float64):
+                        fields.append(("side", dt, cap))
+                    else:
+                        fields.append(("data", dt, cap))
+                    fields.append(("validity", np.dtype(np.uint8), cap))
+            plan.append(fields)
+            sig_parts.append(";".join(f"{f}:{d}:{c}" for f, d, c in fields))
+        sig = "packfetch|" + "|".join(sig_parts)
+
+        def build():
+            def to_bytes(arr):
+                if arr.dtype == jnp.bool_:
+                    return arr.astype(jnp.uint8)
+                if arr.dtype == jnp.uint8:
+                    return arr
+                if arr.dtype.itemsize == 8:
+                    # 64-bit ints: split into u32 words (the x64-rewrite
+                    # pass rejects a direct 64->8 bitcast), then to bytes
+                    u = arr.astype(jnp.uint64)
+                    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+                    hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+                    arr = jnp.stack([lo, hi], axis=-1).reshape(-1)
+                return jax.lax.bitcast_convert_type(
+                    arr, jnp.uint8).reshape(-1)
+
+            def pack(bs):
+                segs = []
+                sides = []
+                for b in bs:
+                    segs.append(to_bytes(
+                        b.num_rows.astype(jnp.int32).reshape(1)))
+                    for col in b.columns:
+                        if col.dtype.is_string and col.is_lazy:
+                            segs.append(to_bytes(
+                                col.dict_codes.astype(jnp.int32)))
+                            segs.append(col.validity.astype(jnp.uint8))
+                        elif col.dtype.is_string:
+                            segs.append(col.data)
+                            segs.append(to_bytes(
+                                col.offsets.astype(jnp.int32)))
+                            segs.append(col.validity.astype(jnp.uint8))
+                        else:
+                            if col.data.dtype == jnp.float64:
+                                sides.append(col.data)
+                            else:
+                                segs.append(to_bytes(col.data))
+                            segs.append(col.validity.astype(jnp.uint8))
+                return jnp.concatenate(segs), sides
+            return jax.jit(pack)
+
+        slab_d, sides_d = cached_jit(sig, build)(list(batches))
+        _start_host_copies_tree((slab_d, sides_d))
+        slab, sides = jax.device_get((slab_d, sides_d))
+        slab = np.asarray(slab)
+        sides = [np.asarray(sd) for sd in sides]
+        side_i = 0
+
+        out: List[pd.DataFrame] = []
+        off = 0
+
+        def take(dt: np.dtype, count: int):
+            nonlocal off
+            nb = dt.itemsize * count
+            arr = slab[off:off + nb].view(dt)
+            off += nb
+            return arr
+
+        for b, fields in zip(batches, plan):
+            it = iter(fields)
+            _f, dt, c = next(it)
+            n = int(take(dt, c)[0])
+            b._host_rows = n
+            series: List[pd.Series] = []
+            for col, cdt in zip(b.columns, b.schema.dtypes):
+                if cdt.is_string and col.is_lazy:
+                    codes = take(*next(it)[1:])
+                    validity = take(*next(it)[1:]).astype(bool)
+                    trimmed = (validity[:n], codes[:n])
+                elif cdt.is_string:
+                    chars = take(*next(it)[1:])
+                    offsets = take(*next(it)[1:])
+                    validity = take(*next(it)[1:]).astype(bool)
+                    trimmed = (validity[:n], offsets[:n + 1], chars)
+                else:
+                    field, fdt, fcount = next(it)
+                    if field == "side":
+                        data = sides[side_i]
+                        side_i += 1
+                    else:
+                        data = take(fdt, fcount)
+                    validity = take(*next(it)[1:]).astype(bool)
+                    if cdt.np_dtype == np.bool_:
+                        data = data.astype(bool)
+                    trimmed = (data[:n], validity[:n])
+                values, validity = col.numpy_from_host(trimmed, n)
+                series.append(_numpy_to_pandas(values, validity, cdt)
+                              .reset_index(drop=True))
+            if not series:
+                out.append(pd.DataFrame(index=range(n)))
+                continue
             df = pd.concat(series, axis=1)
             df.columns = list(b.schema.names)
             out.append(df)
@@ -272,6 +444,7 @@ class DeviceBatch:
 
         payload = [(b.num_rows, [views(c) for c in b.columns])
                    for b in batches]
+        _start_host_copies_tree(payload)
         host = jax.device_get(payload)
         out: List[pd.DataFrame] = []
         for b, (count, host_cols) in zip(batches, host):

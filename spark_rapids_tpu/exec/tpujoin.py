@@ -29,12 +29,10 @@ SUPPORTED_JOIN_TYPES = ("inner", "left", "right", "full", "leftsemi",
 def _start_host_copies(arrays) -> None:
     """Begin async device->host transfers so the deferred speculation-
     verification fetch (session._verify_speculation) overlaps the rest of
-    the query instead of paying its own round trip at the end."""
-    for a in arrays:
-        try:
-            a.copy_to_host_async()
-        except AttributeError:  # backend without async host copies
-            return
+    the query instead of paying its own round trip at the end.
+    Delegates to the shared tree-walking prefetch (columnar/batch.py)."""
+    from spark_rapids_tpu.columnar.batch import _start_host_copies_tree
+    _start_host_copies_tree(list(arrays))
 
 
 class TpuBroadcastExchangeExec(PhysicalPlan):

@@ -116,31 +116,50 @@ def _int_limbs(x: jnp.ndarray, contribute: jnp.ndarray, width: int,
     return out
 
 
+def _f64_limb_word(tot: jnp.ndarray, lo: int, hi: int, b: int,
+                   base: int) -> jnp.ndarray:
+    """sum_{li in [lo, hi)} tot[:, li] * 2^(b*li - base) accumulated in
+    FLOAT64. Exact: each limb total is an integer <= 2^24
+    (limb_bits_for guarantees (2^b - 1) * capacity < 2^24), every scale
+    is a power of two, and partial sums stay far below 2^48 — within
+    even this hardware's emulated float64 (~49-bit) integer-exact range.
+
+    Why not int64: XLA:TPU's X64-rewriting pass MISCOMPILES the
+    previous formulation (f32 matmul totals -> int64 convert -> shifts
+    -> subtract, fused after the one-hot dot): the recombined sum
+    silently dropped the high limb's contribution in full-graph
+    compilations while every piece computed correctly in isolation
+    (verified on v5e; returning the totals as a program output or
+    constant-folding them "fixed" it). Keeping the recombination in
+    pure f64 arithmetic avoids the rewritten-int64 pattern entirely."""
+    out = jnp.zeros(tot.shape[:1], jnp.float64)
+    for li in range(lo, hi):
+        out = out + tot[:, li].astype(jnp.float64) * jnp.float64(
+            1 << (b * li - base))
+    return out
+
+
 def _recombine_int(tot: jnp.ndarray, count: jnp.ndarray, width: int,
                    b: int) -> jnp.ndarray:
     """Per-slot integer sum from limb totals, exact mod 2^64 (Spark's
     wraparound overflow semantics for free). tot: (T, nlimbs) f32 exact
-    integers; count: (T,) int64."""
+    integers; count: (T,) int64. Limb words are accumulated in f64
+    (see _f64_limb_word) and assembled into int64 at the end — each
+    word is < 2^44 so the f64->int64 converts are exact, and the final
+    shifts/adds wrap mod 2^64 exactly like the direct reconstruction."""
     nlimbs = tot.shape[1]
-    t64 = tot.astype(jnp.int64)
+    word_limbs = max(1, 24 // b)  # limbs per f64 word: <= 24 value bits
+    words = []
+    for lo in range(0, nlimbs, word_limbs):
+        hi = min(lo + word_limbs, nlimbs)
+        words.append((b * lo,
+                      _f64_limb_word(tot, lo, hi, b, b * lo)))
+    s = jnp.zeros(tot.shape[:1], jnp.int64)
+    for base, w in words:
+        s = s + (w.astype(jnp.int64) << jnp.int64(base))
     if width == 32:
-        s = jnp.zeros(tot.shape[:1], jnp.int64)
-        for li in range(nlimbs):
-            s = s + (t64[:, li] << jnp.int64(b * li))
         return s - (count << jnp.int64(31))
-    # 64-bit: split the reconstruction so every partial stays < 2^63 exact,
-    # then recombine with int64 wraparound
-    lo_limbs = -(-32 // b)
-    s_lo = jnp.zeros(tot.shape[:1], jnp.int64)
-    for li in range(min(lo_limbs, nlimbs)):
-        s_lo = s_lo + (t64[:, li] << jnp.int64(b * li))
-    s_hi = jnp.zeros(tot.shape[:1], jnp.int64)
-    for li in range(lo_limbs, nlimbs):
-        s_hi = s_hi + (t64[:, li] << jnp.int64(b * li - b * lo_limbs))
-    shift = jnp.int64(b * lo_limbs)
-    # sum(x) = (s_hi - count * 2^(63 - shift)) * 2^shift + s_lo  (mod 2^64)
-    a = s_hi - (count << jnp.int64(63 - b * lo_limbs))
-    return (a << shift) + s_lo
+    return s - (count << jnp.int64(63))
 
 
 _F_BITS = 43  # fixed-point fraction bits per word of a float sum
@@ -200,20 +219,17 @@ def _float_fixedpoint(x64: jnp.ndarray, contribute: jnp.ndarray,
 def _recombine_fixed_word(tot: jnp.ndarray, count: jnp.ndarray,
                           b: int) -> jnp.ndarray:
     """float64 value of one word's per-slot sum(xi) from its limb totals.
-    Splits at bit 24 so both partial reconstructions stay exact integers in
-    int64 before the single float64 rounding at the end."""
+    Pure-f64 reconstruction (see _f64_limb_word for why int64 is
+    unusable here): the high/low halves each stay below 2^42, the bias
+    subtraction happens in the small-magnitude high half, and every
+    scale is a power of two — bit-exact."""
     nlimbs = tot.shape[1]
-    t64 = tot.astype(jnp.int64)
     lo_limbs = -(-24 // b)
-    s_lo = jnp.zeros(tot.shape[:1], jnp.int64)
-    for li in range(min(lo_limbs, nlimbs)):
-        s_lo = s_lo + (t64[:, li] << jnp.int64(b * li))
-    s_hi = jnp.zeros(tot.shape[:1], jnp.int64)
-    for li in range(lo_limbs, nlimbs):
-        s_hi = s_hi + (t64[:, li] << jnp.int64(b * li - b * lo_limbs))
-    a = s_hi - (count << jnp.int64(_F_BITS - b * lo_limbs))
-    return (a.astype(jnp.float64) * jnp.float64(1 << (b * lo_limbs))
-            + s_lo.astype(jnp.float64))
+    s_lo = _f64_limb_word(tot, 0, min(lo_limbs, nlimbs), b, 0)
+    s_hi = _f64_limb_word(tot, lo_limbs, nlimbs, b, b * lo_limbs)
+    a = s_hi - count.astype(jnp.float64) * jnp.float64(
+        1 << (_F_BITS - b * lo_limbs))
+    return a * jnp.float64(1 << (b * lo_limbs)) + s_lo
 
 
 def _recombine_float(tot: jnp.ndarray, count: jnp.ndarray, q: jnp.ndarray,

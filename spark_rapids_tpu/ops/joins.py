@@ -50,30 +50,6 @@ def _key_valid(batch: DeviceBatch, key_idx: Sequence[int]) -> jnp.ndarray:
     return v
 
 
-def _key_images(batch: DeviceBatch, key_idx: Sequence[int],
-                dict_ok: Sequence[bool] = ()) -> List[jnp.ndarray]:
-    """Exact per-row equality-image vectors for the join keys (one or more
-    u64 arrays per key column; see module docstring). ``dict_ok[i]``:
-    both sides of key i share the identical dictionary, so the code alone
-    is an exact equality image (no prefix chunks, no poly hashes, no char
-    reads) — codes from DIFFERENT dictionaries are never comparable, so
-    the caller asserts the tuples match (join_probe)."""
-    from spark_rapids_tpu.ops.hashing import string_poly_hashes
-    from spark_rapids_tpu.ops.sortops import u64_key_image
-    imgs: List[jnp.ndarray] = []
-    for j, ki in enumerate(key_idx):
-        col = batch.columns[ki]
-        if (col.dtype.is_string and j < len(dict_ok) and dict_ok[j]
-                and col.dict_values is not None):
-            imgs.append(col.dict_codes.astype(jnp.uint64))
-            continue
-        imgs.extend(u64_key_image(col))
-        if col.dtype.is_string:
-            h1, h2 = string_poly_hashes(col.offsets, col.data, col.validity)
-            imgs.extend([h1, h2])
-    return imgs
-
-
 def _union_string_extents(bcol: DeviceColumn, scol: DeviceColumn):
     """(chars, starts, lens) of the build-then-stream row union (row order
     matching the probe's image concatenation) for exact full-length key
@@ -109,15 +85,55 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
             is_stable=True)
         return counts, bstart, bperm
 
-    # per-key: both sides share one identical dictionary -> the code is
-    # the exact equality image (and the >64-byte repair is unnecessary)
-    dict_ok = tuple(
-        build.columns[bk].dtype.is_string
-        and build.columns[bk].dict_values is not None
-        and build.columns[bk].dict_values == stream.columns[sk].dict_values
-        for bk, sk in zip(build_keys, stream_keys))
-    b_imgs = _key_images(build, build_keys, dict_ok)
-    s_imgs = _key_images(stream, stream_keys, dict_ok)
+    # per-key image assembly. String keys where BOTH sides are
+    # dict-encoded never touch chars:
+    #   - identical dictionaries: the code IS the exact equality image;
+    #   - different dictionaries (e.g. the two tables of a join were
+    #     scanned separately): the dictionaries are STATIC host tuples,
+    #     so a union id map is built at trace time and baked in as
+    #     constants — one tiny-table gather per side yields an exact
+    #     full-value equality image. This replaces the 11-operand
+    #     prefix-chunk+hash image (64 char gathers + 2 poly-hash scans
+    #     per side) that dominated string-keyed join profiles.
+    import numpy as np
+    from spark_rapids_tpu.ops.hashing import string_poly_hashes
+    from spark_rapids_tpu.ops.sortops import u64_key_image
+    b_imgs: List[jnp.ndarray] = []
+    s_imgs: List[jnp.ndarray] = []
+    plain_str_pairs = []  # string keys that DID take the char-image path
+    for bk, sk in zip(build_keys, stream_keys):
+        bc, sc = build.columns[bk], stream.columns[sk]
+        if (bc.dtype.is_string and bc.dict_values is not None
+                and sc.dict_values is not None):
+            if bc.dict_values == sc.dict_values:
+                b_imgs.append(bc.dict_codes.astype(jnp.uint64))
+                s_imgs.append(sc.dict_codes.astype(jnp.uint64))
+            else:
+                union: dict = {}
+                for v in bc.dict_values:
+                    union.setdefault(v, len(union))
+                for v in sc.dict_values:
+                    union.setdefault(v, len(union))
+                null_id = len(union)  # codes==card mark NULL/padding
+                bmap = jnp.asarray(np.asarray(
+                    [union[v] for v in bc.dict_values] + [null_id],
+                    np.uint64))
+                smap = jnp.asarray(np.asarray(
+                    [union[v] for v in sc.dict_values] + [null_id],
+                    np.uint64))
+                b_imgs.append(bmap[jnp.clip(bc.dict_codes, 0,
+                                            len(bc.dict_values))])
+                s_imgs.append(smap[jnp.clip(sc.dict_codes, 0,
+                                            len(sc.dict_values))])
+            continue
+        b_imgs.extend(u64_key_image(bc))
+        s_imgs.extend(u64_key_image(sc))
+        if bc.dtype.is_string:
+            h1, h2 = string_poly_hashes(bc.offsets, bc.data, bc.validity)
+            b_imgs.extend([h1, h2])
+            h1, h2 = string_poly_hashes(sc.offsets, sc.data, sc.validity)
+            s_imgs.extend([h1, h2])
+            plain_str_pairs.append((bc, sc))
     assert len(b_imgs) == len(s_imgs), (len(b_imgs), len(s_imgs))
     bkv = _key_valid(build, build_keys)
     skv = _key_valid(stream, stream_keys)
@@ -157,9 +173,7 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
     # poly hashes AND interleaving in the tie run. With
     # exact_long_strings=False the dual-hash tiebreak stands (incompat,
     # spark.rapids.sql.join.exactLongStrings).
-    str_pairs = [(build.columns[bk], stream.columns[sk])
-                 for j, (bk, sk) in enumerate(zip(build_keys, stream_keys))
-                 if build.columns[bk].dtype.is_string and not dict_ok[j]]
+    str_pairs = plain_str_pairs
     if exact_long_strings and str_pairs:
         prev_valid = jnp.concatenate(
             [jnp.zeros((1,), jnp.bool_), valid_s[:-1]])

@@ -42,20 +42,56 @@ class DevCol:
     ``dict_codes``/``dict_values``/``prefix8``: upload-computed metadata
     carried through from scanned DeviceColumns (columnar/column.py) —
     string predicates compile to dense code/image compares instead of
-    per-row char gathers when present. Derived values carry None."""
+    per-row char gathers when present. Derived values carry None.
 
-    __slots__ = ("dtype", "data", "validity", "offsets", "dict_codes",
-                 "dict_values", "prefix8")
+    Lazy (codes-only) source columns keep their laziness here: ``data``/
+    ``offsets`` materialize chars from the static dictionary only when an
+    expression actually reads them (``_src`` holds the backing
+    DeviceColumn). An eager read in the eval-context constructor would
+    rebuild the full char slab inside EVERY projection kernel touching a
+    dict-encoded string the projection never inspects."""
+
+    __slots__ = ("dtype", "_data", "validity", "_offsets", "dict_codes",
+                 "dict_values", "prefix8", "_src")
 
     def __init__(self, dtype: DType, data, validity, offsets=None,
-                 dict_codes=None, dict_values=None, prefix8=None):
+                 dict_codes=None, dict_values=None, prefix8=None,
+                 src=None):
         self.dtype = dtype
-        self.data = data          # (capacity,) or chars for strings
+        self._data = data         # (capacity,) or chars for strings
         self.validity = validity  # (capacity,) bool
-        self.offsets = offsets    # strings: (capacity+1,) int32
+        self._offsets = offsets   # strings: (capacity+1,) int32
         self.dict_codes = dict_codes
         self.dict_values = dict_values
         self.prefix8 = prefix8
+        self._src = src           # lazy backing DeviceColumn (or None)
+
+    @property
+    def data(self):
+        if self._data is None and self._src is not None:
+            self._data = self._src.data  # materializes lazy chars
+            self._offsets = self._src.offsets
+        return self._data
+
+    @data.setter
+    def data(self, v) -> None:
+        self._data = v
+
+    @property
+    def offsets(self):
+        if (self._offsets is None and self._src is not None
+                and self.dtype.is_string):
+            self._data = self._src.data
+            self._offsets = self._src.offsets
+        return self._offsets
+
+    @offsets.setter
+    def offsets(self, v) -> None:
+        self._offsets = v
+
+    @property
+    def is_lazy(self) -> bool:
+        return self._data is None and self._src is not None
 
     def with_(self, data=None, validity=None, dtype=None) -> "DevCol":
         return DevCol(dtype or self.dtype,

@@ -14,10 +14,18 @@ from spark_rapids_tpu.sql.exprs.core import (
 
 
 def make_context(batch: DeviceBatch) -> EvalContext:
-    cols = [DevCol(c.dtype, c.data, c.validity, c.offsets,
-                   dict_codes=c.dict_codes, dict_values=c.dict_values,
-                   prefix8=c.prefix8)
-            for c in batch.columns]
+    # lazy (codes-only) string columns stay lazy: chars materialize only
+    # if an expression reads .data/.offsets (DevCol._src) — an eager read
+    # here would rebuild the char slab inside every projection kernel
+    cols = []
+    for c in batch.columns:
+        lazy = c.dtype.is_string and c.is_lazy
+        cols.append(DevCol(c.dtype,
+                           None if lazy else c.data, c.validity,
+                           None if lazy else c.offsets,
+                           dict_codes=c.dict_codes,
+                           dict_values=c.dict_values,
+                           prefix8=c.prefix8, src=c))
     mask = jnp.arange(batch.capacity, dtype=jnp.int32) < batch.num_rows
     return EvalContext(cols, mask, batch.num_rows, batch.capacity)
 
@@ -26,6 +34,21 @@ def to_device_column(ctx: EvalContext, v: DevValue) -> DeviceColumn:
     c = ctx.broadcast(v)
     # mask out padding rows so stale values never leak past num_rows
     validity = c.validity & ctx.row_mask
+    if (c.dtype.is_string and getattr(c, "dict_values", None) is not None
+            and c.dict_codes is not None):
+        # dictionary metadata survives the projection: codes re-normalized
+        # so masked rows carry the NULL sentinel (= card), matching the
+        # scan contract consumers rely on for slot addressing
+        card = len(c.dict_values)
+        codes = jnp.where(validity, c.dict_codes, jnp.int32(card))
+        pre = (jnp.where(validity, c.prefix8, jnp.uint64(0))
+               if c.prefix8 is not None else None)
+        lazy = isinstance(c, DevCol) and c.is_lazy
+        return DeviceColumn(c.dtype,
+                            None if lazy else c.data, validity,
+                            None if lazy else c.offsets,
+                            prefix8=pre, dict_codes=codes,
+                            dict_values=c.dict_values)
     return DeviceColumn(c.dtype, c.data, validity, c.offsets)
 
 

@@ -103,13 +103,64 @@ class Floor(Expression):
     def sql_name(self, schema=None) -> str:
         return f"{self.fname}({self.children[0].sql_name(schema)})"
 
+    def _int_div_expr(self, schema=None):
+        """floor(a / b) / ceil(a / b) with INTEGER a, b: computed as an
+        exact int64 floor-division instead of float64 divide+floor —
+        float64 is software-emulated on TPU and the divide dominated
+        profiles (mortgage ETL's josh_mody projections, 1.5s of a 2.9M-row
+        batch). Exact for all int64 (f64 rounds above 2^53); both paths
+        use it so CPU/TPU agree bit-for-bit. Returns the Divide node
+        when the rewrite statically applies (integer operand dtypes),
+        else None — decided WITHOUT evaluating the operands, so the
+        generic path never pays a double evaluation."""
+        from spark_rapids_tpu.sql.exprs.arithmetic import Divide
+        ch = self.children[0]
+        if not isinstance(ch, Divide):
+            return None
+        try:
+            ldt = ch.children[0].dtype(schema)
+            rdt = ch.children[1].dtype(schema)
+        except Exception:  # noqa: BLE001 — unresolvable statically
+            return None
+        if not (np.issubdtype(np.dtype(ldt.np_dtype), np.integer)
+                and np.issubdtype(np.dtype(rdt.np_dtype), np.integer)):
+            return None
+        return ch
+
     def eval_device(self, ctx: EvalContext) -> DevValue:
+        intdiv = self._int_div_expr()
+        if intdiv is not None:
+            lv = ctx.broadcast(intdiv.children[0].eval_device(ctx))
+            rv = ctx.broadcast(intdiv.children[1].eval_device(ctx))
+            a = lv.data.astype(jnp.int64)
+            b = rv.data.astype(jnp.int64)
+            zero = b == 0
+            safe = jnp.where(zero, jnp.int64(1), b)
+            q = (jnp.floor_divide(a, safe) if self.fname == "floor"
+                 else -jnp.floor_divide(-a, safe))
+            return DevCol(dtypes.INT64, q,
+                          lv.validity & rv.validity & ~zero)
         v = ctx.broadcast(self.children[0].eval_device(ctx))
         x = v.data.astype(jnp.float64)
         fn = jnp.floor if self.fname == "floor" else jnp.ceil
         return DevCol(dtypes.INT64, fn(x).astype(jnp.int64), v.validity)
 
     def eval_host(self, df: pd.DataFrame) -> pd.Series:
+        from spark_rapids_tpu.columnar.batch import Schema
+        intdiv = self._int_div_expr(Schema.from_pandas(df))
+        if intdiv is not None:
+            (av, avalid, aidx) = host_unary_values(
+                intdiv.children[0].eval_host(df))
+            (bv, bvalid, _bidx) = host_unary_values(
+                intdiv.children[1].eval_host(df))
+            a = av.astype(np.int64)
+            b = bv.astype(np.int64)
+            zero = b == 0
+            safe = np.where(zero, 1, b)
+            q = (np.floor_divide(a, safe) if self.fname == "floor"
+                 else -np.floor_divide(-a, safe))
+            return rebuild_series(q, avalid & bvalid & ~zero,
+                                  dtypes.INT64, aidx)
         values, validity, index = host_unary_values(self.children[0].eval_host(df))
         fn = np.floor if self.fname == "floor" else np.ceil
         with np.errstate(all="ignore"):

@@ -20,6 +20,32 @@ from spark_rapids_tpu.exec.base import ExecContext, Partition
 
 _DATA_UID_COUNTER = itertools.count(1)
 
+# uploads at or under this size get a CONTENT-derived uid: queries built
+# inside a function frequently re-create small lookup frames (a name
+# mapping, a 12-row month sequence) on every call, and a fresh
+# counter-uid per upload changes every downstream plan fingerprint —
+# capacity speculation and subtree reuse then miss on every run, each
+# miss costing a full device->host sync round trip (~0.1-0.25s tunneled)
+_CONTENT_UID_MAX_BYTES = 1 << 16
+
+
+def _content_uid(df: pd.DataFrame, num_partitions: int):
+    """Deterministic digest of a small frame's data+schema+partitioning,
+    or None when the frame is too big to hash cheaply or unhashable."""
+    import hashlib
+    try:
+        if int(df.memory_usage(deep=True).sum()) > _CONTENT_UID_MAX_BYTES:
+            return None
+        h = hashlib.blake2b(digest_size=8)
+        h.update(("|".join(f"{c}:{t}" for c, t in
+                           zip(map(str, df.columns), map(str, df.dtypes)))
+                  + f"|p{num_partitions}|n{len(df)}").encode())
+        h.update(pd.util.hash_pandas_object(df, index=False)
+                 .to_numpy().tobytes())
+        return "c" + h.hexdigest()
+    except (TypeError, ValueError):
+        return None
+
 
 class DataSource:
     schema: Schema
@@ -29,11 +55,18 @@ class DataSource:
         session's adaptive caches: two scans of the same source object
         (or projection views of it, ``with_columns``) share a uid; a new
         upload gets a fresh one (a process-unique counter, never an
-        ``id()`` that the allocator could reuse)."""
+        ``id()`` that the allocator could reuse). Small in-memory frames
+        use a content digest so re-created identical lookup tables keep
+        plan fingerprints stable across executions; stale-stats risk is
+        nil because every adaptive consumer verifies on device."""
         base = getattr(self, "_base", self)
         uid = getattr(base, "_data_uid", None)
         if uid is None:
-            uid = base._data_uid = next(_DATA_UID_COUNTER)
+            if isinstance(base, InMemorySource):
+                uid = _content_uid(base.df, base.num_partitions)
+            if uid is None:
+                uid = next(_DATA_UID_COUNTER)
+            base._data_uid = uid
         return f"{type(base).__name__}#{uid}"
 
     def describe(self) -> str:

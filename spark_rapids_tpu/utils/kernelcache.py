@@ -11,12 +11,57 @@ per input shape (capacity bucket).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Callable, Dict
 
 _CACHE: Dict[str, Any] = {}
 _LOCK = threading.Lock()
 _STATS = {"hits": 0, "misses": 0}
+
+# SRT_KERNEL_PROFILE=1: wrap every cached kernel so each call forces
+# device completion and records (calls, seconds) per signature. True
+# per-KERNEL wall attribution — finer than the per-operator syncEachOp —
+# at the cost of one fetch round trip (~0.1s) per call; compare kernels
+# by their EXCESS over that baseline. Diagnostics only, never default.
+_PROFILE = os.environ.get("SRT_KERNEL_PROFILE", "") == "1"
+_PROF: Dict[str, list] = {}
+
+
+def _force_complete(out) -> None:
+    import jax
+    leaves = [leaf for leaf in jax.tree_util.tree_leaves(out)
+              if hasattr(leaf, "shape")]
+    if leaves:
+        jax.device_get(leaves[0])
+
+
+def _wrap_profiled(signature: str, fn):
+    import time
+
+    def wrapped(*a, **kw):
+        t0 = time.perf_counter()
+        out = fn(*a, **kw)
+        _force_complete(out)
+        dt = time.perf_counter() - t0
+        with _LOCK:
+            ent = _PROF.setdefault(signature, [0, 0.0])
+            ent[0] += 1
+            ent[1] += dt
+        return out
+    return wrapped
+
+
+def kernel_profile() -> Dict[str, list]:
+    """signature -> [calls, total_seconds] recorded under
+    SRT_KERNEL_PROFILE=1 (reset with kernel_profile_reset)."""
+    with _LOCK:
+        return {k: list(v) for k, v in _PROF.items()}
+
+
+def kernel_profile_reset() -> None:
+    with _LOCK:
+        _PROF.clear()
 
 
 def cached_jit(signature: str, builder: Callable[[], Any]):
@@ -28,6 +73,8 @@ def cached_jit(signature: str, builder: Callable[[], Any]):
             return fn
         _STATS["misses"] += 1
     fn = builder()
+    if _PROFILE:
+        fn = _wrap_profiled(signature, fn)
     with _LOCK:
         return _CACHE.setdefault(signature, fn)
 
