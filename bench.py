@@ -111,11 +111,30 @@ def _results_match(tpu_df, cpu_df) -> bool:
         # an int count off by one is a wrong answer, not noise
         if tv.dtype.kind == "f" or (hasattr(tv.dtype, "numpy_dtype")
                                     and tv.dtype.numpy_dtype.kind == "f"):
-            tf = tv.to_numpy(dtype=float)
-            cf = cv.to_numpy(dtype=float)
-            if not np.allclose(tf[both], cf[both], rtol=1e-6, atol=1e-9,
-                               equal_nan=True):
-                return False
+            tf = tv.to_numpy(dtype=float)[both]
+            cf = cv.to_numpy(dtype=float)[both]
+            ok = np.isclose(tf, cf, rtol=1e-6, atol=1e-9, equal_nan=True)
+            if not ok.all():
+                # explicitly-rounded outputs (round(x, p)): the two
+                # backends' pre-round sums differ in the last ulps and
+                # can snap to ADJACENT grid points. Detect the ACTUAL
+                # precision (smallest p putting every value on the
+                # 10^-p grid) and allow one grid step — but only for
+                # p >= 2, so integral-valued floats (count-like) stay
+                # exact and an off-by-one can never pass as rounding.
+                fin = np.isfinite(tf) & np.isfinite(cf)
+                for p in range(2, 7):
+                    g = 10.0 ** -p
+                    on_grid = (
+                        np.abs(np.round(tf[fin] / g) * g - tf[fin])
+                        < 1e-8).all() and (
+                        np.abs(np.round(cf[fin] / g) * g - cf[fin])
+                        < 1e-8).all()
+                    if on_grid:
+                        ok = ok | (np.abs(tf - cf) <= 1.5 * g)
+                        break
+                if not ok.all():
+                    return False
         else:
             if not (tv[both].astype(str).to_numpy()
                     == cv[both].astype(str).to_numpy()).all():
