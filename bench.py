@@ -118,20 +118,22 @@ def _results_match(tpu_df, cpu_df) -> bool:
                 # explicitly-rounded outputs (round(x, p)): the two
                 # backends' pre-round sums differ in the last ulps and
                 # can snap to ADJACENT grid points. Detect the ACTUAL
-                # precision (smallest p putting every value on the
-                # 10^-p grid) and allow one grid step — but only for
-                # p >= 2, so integral-valued floats (count-like) stay
-                # exact and an off-by-one can never pass as rounding.
+                # precision: the smallest p >= 2 putting every value on
+                # the 10^-p grid while NOT every value sits on the
+                # coarser 10^-(p-1) grid — integral-valued floats lie
+                # on every grid, fail the coarser-grid test at any p,
+                # and therefore always compare strictly.
                 fin = np.isfinite(tf) & np.isfinite(cf)
+
+                def on_grid(a, g):
+                    return (np.abs(np.round(a / g) * g - a) < 1e-8).all()
+
                 for p in range(2, 7):
                     g = 10.0 ** -p
-                    on_grid = (
-                        np.abs(np.round(tf[fin] / g) * g - tf[fin])
-                        < 1e-8).all() and (
-                        np.abs(np.round(cf[fin] / g) * g - cf[fin])
-                        < 1e-8).all()
-                    if on_grid:
-                        ok = ok | (np.abs(tf - cf) <= 1.5 * g)
+                    if on_grid(tf[fin], g) and on_grid(cf[fin], g):
+                        if not (on_grid(tf[fin], g * 10)
+                                and on_grid(cf[fin], g * 10)):
+                            ok = ok | (np.abs(tf - cf) <= 1.5 * g)
                         break
                 if not ok.all():
                     return False

@@ -373,6 +373,16 @@ def _np_prefix8(chars: np.ndarray, offsets: np.ndarray,
 # the column is plausibly low-cardinality).
 DICT_MAX_CARD = 256
 _DICT_PROBE = 4096
+# small-TABLE dictionary pre-seeding (exec/tpu.py TpuScanExec): a scan
+# of a small in-memory table seeds its per-scan dictionary registry from
+# the WHOLE column, so even all-distinct strings (a dimension table's
+# natural key) encode — joins fan such columns out into fact-scale
+# batches where dictionary codes make grouping/join images one u64
+# operand instead of prefix-chunks+hashes, and results fetch as codes.
+# The limits gate on the full table size, never on a chunk's length
+# (host_dict_encode's own probe keeps protecting fact-scale uploads).
+DICT_SMALL_TABLE_ROWS = 1 << 15
+DICT_MAX_CARD_SMALL = 1 << 14
 
 
 def string_host_buffers_have_nul(bufs, n: int) -> bool:

@@ -17,6 +17,9 @@ import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema, bucket_capacity
+from spark_rapids_tpu.columnar.column import (
+    DICT_MAX_CARD_SMALL, DICT_SMALL_TABLE_ROWS, _char_bucket,
+)
 from spark_rapids_tpu.exec.aggutil import AggPlan
 from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
 from spark_rapids_tpu.ops import aggregate as agg_ops
@@ -862,8 +865,23 @@ class TpuScanExec(TpuExec):
         # one dictionary registry per scan: every batch of this scan
         # encodes against the first batch's dictionaries, so the
         # aggregation fast path compiles ONE program per scan (a racing
-        # concurrent partition at worst costs one extra retrace)
+        # concurrent partition at worst costs one extra retrace).
+        # Small in-memory tables PRE-SEED the registry from the whole
+        # column: a dimension table split across partitions would
+        # otherwise disable encoding the moment partition 2 shows a
+        # value outside partition 1's dictionary — exactly the natural-
+        # key columns (all-distinct) whose codes joins fan out to fact
+        # scale.
         dict_state: dict = {}
+        src_df = getattr(self.source, "df", None)
+        if src_df is not None and 0 < len(src_df) <= DICT_SMALL_TABLE_ROWS:
+            for ci, dt in enumerate(schema.dtypes):
+                if not dt.is_string:
+                    continue
+                vals = src_df.iloc[:, ci].dropna().unique()
+                if (0 < len(vals) <= DICT_MAX_CARD_SMALL
+                        and all(isinstance(v, str) for v in vals)):
+                    dict_state[ci] = tuple(sorted(vals))
 
         # mesh execution: partition i uploads to mesh device i so scan data
         # is born distributed (reference map tasks produce data already
@@ -1177,10 +1195,30 @@ class TpuShuffleExchangeExec(TpuExec):
                 # full re-execution at verify time.
                 need = (list(batches) if cache is not None
                         else [b for b in batches if b._host_rows is None])
+                # per-batch stats: row count + each plain (non-dict)
+                # string column's live char total — shrinking the char
+                # slab alongside the rows stops every downstream string
+                # kernel from paying the pre-aggregation char padding.
+                # Layout is computed PER BATCH (a scan can close a
+                # dictionary mid-stream, so batches of one exchange may
+                # disagree on which string columns are plain); the
+                # speculation entry keys on the layout so a mismatch can
+                # never mis-assign a char total as a row count.
+                def batch_stats(b):
+                    vals = [b.num_rows]
+                    for col in b.columns:
+                        if col.dtype.is_string and col.dict_values is None:
+                            vals.append(col.offsets[jnp.minimum(
+                                b.num_rows.astype(jnp.int32),
+                                jnp.int32(col.offsets.shape[0] - 1))])
+                    return vals
+
                 if need:
-                    counts_d = [b.num_rows for b in need]
+                    per_batch = [batch_stats(b) for b in need]
+                    layout = tuple(len(v) for v in per_batch)
+                    counts_d = [v for vals in per_batch for v in vals]
                     if (entry is not None
-                            and entry.get("n") == len(need)
+                            and entry.get("layout") == layout
                             and entry.get("stable")):
                         from spark_rapids_tpu.exec.tpujoin import (
                             _start_host_copies,
@@ -1189,29 +1227,53 @@ class TpuShuffleExchangeExec(TpuExec):
                         ctx.session.capacity_spec_hits += 1
                         ctx.spec_pending.append(
                             (skey, counts_d, [], [], entry["counts"]))
-                        for b, c in zip(need, entry["counts"]):
-                            b._host_rows = int(c)
+                        stats = entry["counts"]
                     else:
-                        counts = [int(c)
-                                  for c in _jax.device_get(counts_d)]
+                        stats = [int(c)
+                                 for c in _jax.device_get(counts_d)]
                         if cache is not None:
                             if (entry is not None
-                                    and entry.get("n") == len(need)
-                                    and entry["counts"] == counts):
+                                    and entry.get("layout") == layout
+                                    and entry["counts"] == stats):
                                 entry["stable"] = True
                             else:
-                                cache[skey] = {"n": len(need),
-                                               "counts": counts}
-                        for b, c in zip(need, counts):
-                            b._host_rows = c
+                                cache[skey] = {"layout": layout,
+                                               "counts": stats}
+                    pos = 0
+                    for b, vals in zip(need, per_batch):
+                        b._host_rows = int(stats[pos])
+                        b._host_chars = [int(c) for c in
+                                         stats[pos + 1:pos + len(vals)]]
+                        pos += len(vals)
                 shrunk = []
                 for b in batches:
                     target = bucket_capacity(max(b._host_rows, 1), growth)
-                    if target < b.capacity:
+                    # full char_caps tuple: one entry per string column
+                    # (0 = keep; dict-backed strings move codes only)
+                    ccaps = []
+                    hc = list(getattr(b, "_host_chars", []) or [])
+                    for col in b.columns:
+                        if not col.dtype.is_string:
+                            continue
+                        if col.dict_values is None and hc:
+                            ccaps.append(_char_bucket(max(hc.pop(0), 1)))
+                        else:
+                            ccaps.append(0)
+                    char_shrink = any(
+                        cc and col.dtype.is_string
+                        and col.dict_values is None and not col.is_lazy
+                        and cc < col.data.shape[0]
+                        for cc, col in zip(
+                            ccaps, [c for c in b.columns
+                                    if c.dtype.is_string]))
+                    if target < b.capacity or char_shrink:
+                        ccaps_t = tuple(ccaps)
                         kern = cached_jit(
-                            f"shrink|{target}", lambda t=target: jax.jit(
+                            f"shrink|{target}|{ccaps_t}",
+                            lambda t=target, cc=ccaps_t: jax.jit(
                                 lambda bb, c: rowops.slice_batch_to(
-                                    bb, jnp.asarray(0, jnp.int32), c, t)))
+                                    bb, jnp.asarray(0, jnp.int32), c, t,
+                                    cc)))
                         sb = kern(b, jnp.asarray(b._host_rows, jnp.int32))
                         sb._host_rows = b._host_rows
                         shrunk.append(sb)

@@ -766,6 +766,25 @@ def _rowspace_reduce(batch: DeviceBatch, key_idx: List[int],
         from spark_rapids_tpu.ops.rowops import gather_column
         for ki in key_idx:
             kcol = gather_column(batch.columns[ki], rep_row, group_live)
+            if kcol.dtype.is_string and kcol.dict_values is not None:
+                # dictionary strings stay codes-only: materializing a
+                # char slab here would give the two cond branches
+                # DIFFERENT char capacities (width-dependent lazy
+                # buckets). 2 leaves (codes, validity), padded with the
+                # NULL sentinel; dict presence is trace-static so both
+                # branches agree on the layout.
+                card = jnp.int32(len(kcol.dict_values))
+                codes = kcol.dict_codes
+                validity = kcol.validity
+                if width != capacity:
+                    codes = jnp.concatenate(
+                        [codes, jnp.full((capacity - width,), card,
+                                         jnp.int32)])
+                    validity = pad(validity)
+                outs.append(DeviceColumn(kcol.dtype, None, validity,
+                                         dict_codes=codes,
+                                         dict_values=kcol.dict_values))
+                continue
             if kcol.prefix8 is not None or kcol.dict_values is not None:
                 # group outputs are tiny; drop the prefix image and the
                 # dictionary so the cond's flat-leaf layout stays fixed
@@ -855,8 +874,16 @@ def _rowspace_reduce(batch: DeviceBatch, key_idx: List[int],
     out_cols: List[DeviceColumn] = []
     it = iter(leaves)
     for ki in key_idx:
-        dt = batch.columns[ki].dtype
-        if dt.is_string:
+        col = batch.columns[ki]
+        dt = col.dtype
+        if dt.is_string and col.dict_values is not None:
+            # lazy-column leaf order is (validity, codes) — column.py
+            # tree_flatten
+            validity, codes = next(it), next(it)
+            out_cols.append(DeviceColumn(dt, None, validity,
+                                         dict_codes=codes,
+                                         dict_values=col.dict_values))
+        elif dt.is_string:
             chars, validity, offsets = next(it), next(it), next(it)
             out_cols.append(DeviceColumn(dt, chars, validity, offsets))
         else:

@@ -340,16 +340,22 @@ def slice_batch(batch: DeviceBatch, start: jnp.ndarray,
 
 
 def slice_batch_to(batch: DeviceBatch, start: jnp.ndarray,
-                   count: jnp.ndarray, out_capacity: int) -> DeviceBatch:
+                   count: jnp.ndarray, out_capacity: int,
+                   char_caps=()) -> DeviceBatch:
     """slice_batch gathering into an ``out_capacity``-row batch. Callers
     that learn row counts on the host (the exchange's bucket split) use
     this to SHRINK capacity, so downstream kernels stop paying for the
     pre-aggregation padding (a 4-group result inheriting a 32k-row input
-    bucket would otherwise keep every later sort/agg at 32k)."""
+    bucket would otherwise keep every later sort/agg at 32k).
+    ``char_caps``: optional per-STRING-column output char capacities —
+    shrinking the char slab too stops downstream string kernels (poly
+    hashes, char gathers, the result fetch) from paying the
+    pre-aggregation CHAR padding, which dwarfs the row padding for
+    string-keyed aggregates."""
     idx = jnp.arange(out_capacity, dtype=jnp.int32)
     perm = jnp.clip(idx + start.astype(jnp.int32), 0, batch.capacity - 1)
     n = jnp.minimum(count.astype(jnp.int32),
                     jnp.maximum(batch.num_rows - start.astype(jnp.int32), 0))
     live = idx < n
-    cols = gather_columns(batch.columns, perm, live)
+    cols = gather_columns(batch.columns, perm, live, char_caps)
     return DeviceBatch(batch.schema, cols, n.astype(jnp.int32))

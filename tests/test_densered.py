@@ -34,6 +34,9 @@ def test_dict_encoding_attached_and_propagated(session):
     })
     b = DeviceBatch.from_pandas(df)
     assert b.column("k").dict_values == ("x", "y", "z")
+    # direct uploads keep the probe heuristic: high-cardinality bails
+    # (scans of small tables pre-seed instead — see
+    # test_small_table_scan_preseeds_dictionary)
     assert b.column("hi").dict_values is None
     # codes survive a filter's gather
     from spark_rapids_tpu.ops.rowops import filter_batch
@@ -105,9 +108,33 @@ def test_null_keys_and_all_null_groups(session):
     assert len(tpu) == 3
 
 
+def test_small_table_scan_preseeds_dictionary(session):
+    """A SCAN of a small in-memory table dictionary-encodes even an
+    all-distinct string column (pre-seeded from the whole column across
+    partitions), and grouping on it matches the oracle."""
+    n = 4000
+    rng = np.random.default_rng(9)
+    df = pd.DataFrame({
+        "id": [f"ITEM#{i:06d}" for i in range(n)],
+        "v": rng.integers(0, 50, n).astype(np.int64),
+    })
+    d = session.create_dataframe(df, 4)
+    session.capture_plans = True
+    out = d.group_by("id").agg(F.count("*").alias("c"))
+    session.set_conf("spark.rapids.sql.enabled", True)
+    tpu = out.collect().sort_values("id").reset_index(drop=True)
+    session.capture_plans = False
+    session.set_conf("spark.rapids.sql.enabled", False)
+    cpu = out.collect().sort_values("id").reset_index(drop=True)
+    session.set_conf("spark.rapids.sql.enabled", True)
+    assert (tpu["id"].to_numpy() == cpu["id"].to_numpy()).all()
+    assert (tpu["c"].to_numpy() == cpu["c"].to_numpy()).all()
+    assert len(tpu) == n
+
+
 def test_high_cardinality_falls_back(session):
-    # > DICT_MAX_CARD distinct keys: no dictionary, the hash/sort paths
-    # still answer correctly
+    # > DICT_MAX_CARD distinct keys on a direct upload: no dictionary,
+    # the hash/sort paths still answer correctly
     n = 3000
     rng = np.random.default_rng(5)
     df = pd.DataFrame({
