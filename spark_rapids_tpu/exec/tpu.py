@@ -492,32 +492,31 @@ class TpuHashAggregateExec(TpuExec):
                             self.children[0].output_schema()))
                         return
                     # adaptive statistics (Spark-AQE-style): the session
-                    # remembers each aggregate signature's observed
-                    # reduction ratio; a known-poor reducer skips its
-                    # partial pass from batch 0 — including single-batch
-                    # partitions, where the ratio is otherwise only
-                    # learnable AFTER paying the full pass. Entries expire
-                    # after a bounded number of skips (the signature is
-                    # structural, so a different data source under the
-                    # same shape must get a chance to re-learn), and a
-                    # signature already in the cache never re-pays the
-                    # row-count sync.
+                    # remembers each aggregate's observed reduction
+                    # ratio; a known-poor reducer skips its partial pass
+                    # from batch 0 — including single-batch partitions,
+                    # where the ratio is otherwise only learnable AFTER
+                    # paying the full pass. Keyed on the PLAN FINGERPRINT
+                    # (data-uid-stamped, exec/base.py): a different data
+                    # source mints a different key, so entries never need
+                    # a use-count expiry — the old structural-signature
+                    # key's periodic expiry flipped the skip decision in
+                    # steady state, changing batch shapes downstream and
+                    # forcing a retrace in the bench's timed window.
                     cache = getattr(ctx.session, "agg_ratio_cache", None) \
                         if ctx.session else None
-                    sig = self.plan.signature
+                    from spark_rapids_tpu.exec.base import plan_fingerprint
+                    sig = plan_fingerprint(self) + "|ratio"
                     adaptive = (skip_ratio < 1.0 and cache is not None
                                 and self.plan.num_keys > 0)
                     if adaptive and sig in cache:
                         ratio_known, uses = cache[sig]
                         if ratio_known > skip_ratio:
-                            if uses >= 8:
-                                del cache[sig]  # expire: re-learn below
-                            else:
-                                cache[sig][1] = uses + 1
-                                yield self._passthrough_kernel(first)
-                                for b in it:
-                                    yield self._passthrough_kernel(b)
-                                return
+                            cache[sig][1] = uses + 1
+                            yield self._passthrough_kernel(first)
+                            for b in it:
+                                yield self._passthrough_kernel(b)
+                            return
                     p0 = update_kernel(first)
                     second = next(it, None)
                     # learn the ratio (one row-count sync, first execution
