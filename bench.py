@@ -269,6 +269,30 @@ def _worker():
             if rec["tpu_s"] > 0 else float("inf")
         return rec
 
+    # scan-cost probes (VERDICT r4 next #8): the sweep runs with
+    # cacheDeviceScans=true on BOTH paths (symmetric residency), which
+    # hides host-decode + upload cost. For a few representative queries,
+    # time the TPU path WITHOUT the device scan cache so the per-suite
+    # scan cost is a published number instead of a blind spot
+    # (ref: GpuParquetScan.scala:316-373 — decode cost is first-class).
+    scan_cost_queries = set(os.environ.get(
+        "BENCH_SCAN_COST_QUERIES",
+        "q6,tpcxbb.q9,mortgage.agg_join").split(","))
+
+    def measure_scan_off(fn):
+        session.set_conf("spark.rapids.sql.cacheDeviceScans", False)
+        session.clear_device_cache()
+        try:
+            run_query(fn, True)  # warm compiles at uncached shapes
+            out = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                run_query(fn, True)
+                out.append(round(time.perf_counter() - t0, 4))
+            return out
+        finally:
+            session.set_conf("spark.rapids.sql.cacheDeviceScans", True)
+
     out = os.fdopen(os.dup(1), "w", buffering=1)
     os.dup2(2, 1)  # anything stray printed inside the engine -> stderr
     for line in sys.stdin:
@@ -287,6 +311,11 @@ def _worker():
             if sn not in suites:
                 suites[sn] = _build_suite(sn)
             rec = measure(suites[sn][q])
+            if req["name"] in scan_cost_queries:
+                so = measure_scan_off(suites[sn][q])
+                rec["tpu_scan_off_iters"] = so
+                rec["tpu_scan_off_s"] = min(so)
+                rec["scan_cost_s"] = round(min(so) - rec["tpu_s"], 4)
             out.write(json.dumps({"query": req["name"], "result": rec})
                       + "\n")
         except BaseException as e:  # noqa: BLE001 — reported to parent

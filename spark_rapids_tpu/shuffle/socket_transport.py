@@ -87,6 +87,13 @@ class SocketTransport(ShuffleTransport):
         self._closed = False
         with SocketTransport._registry_lock:
             SocketTransport._registry[executor_id] = self.port
+        # publish for executors in other processes (see lookup_port)
+        import os
+        reg_path = os.environ.get("SRT_SHUFFLE_REGISTRY_FILE")
+        if reg_path:
+            with open(reg_path, "a") as f:
+                f.write(f"{executor_id} {self.port}\n")
+                f.flush()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name=f"shuffle-accept-{executor_id}")
@@ -122,7 +129,31 @@ class SocketTransport(ShuffleTransport):
     @classmethod
     def lookup_port(cls, executor_id: str) -> int:
         with cls._registry_lock:
-            return cls._registry[executor_id]
+            port = cls._registry.get(executor_id)
+        if port is not None:
+            return port
+        # cross-process resolution: executors in OTHER processes publish
+        # "<executor_id> <port>" lines to SRT_SHUFFLE_REGISTRY_FILE (the
+        # role the cluster block-manager directory plays for the
+        # reference, RapidsShuffleInternalManager.scala:157-172). Poll
+        # briefly: a freshly-spawned peer may not have bound yet.
+        import os
+        import time
+        path = os.environ.get("SRT_SHUFFLE_REGISTRY_FILE")
+        if path:
+            deadline = time.monotonic() + float(os.environ.get(
+                "SRT_SHUFFLE_REGISTRY_WAIT_S", "10"))
+            while time.monotonic() < deadline:
+                try:
+                    with open(path) as f:
+                        for line in f:
+                            parts = line.split()
+                            if len(parts) == 2 and parts[0] == executor_id:
+                                return int(parts[1])
+                except OSError:
+                    pass
+                time.sleep(0.05)
+        raise KeyError(executor_id)
 
     @classmethod
     def clear_registry(cls) -> None:

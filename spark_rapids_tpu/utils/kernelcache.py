@@ -29,11 +29,26 @@ _PROF: Dict[str, list] = {}
 
 
 def _force_complete(out) -> None:
+    """Wait for the kernel's result by fetching ONE element of its
+    smallest leaf — fetching a whole buffer would add the tunnel's
+    ~25-45 MB/s transfer time to the measurement and misattribute it
+    as kernel compute."""
     import jax
     leaves = [leaf for leaf in jax.tree_util.tree_leaves(out)
               if hasattr(leaf, "shape")]
-    if leaves:
-        jax.device_get(leaves[0])
+    if not leaves:
+        return
+    leaf = min(leaves, key=lambda x: getattr(x, "nbytes", 1 << 60))
+    if getattr(leaf, "nbytes", 0) > 4096 and leaf.ndim >= 1:
+        leaf = leaf.reshape(-1)[:1]
+    jax.device_get(leaf)
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+    return sum(int(leaf.nbytes)
+               for leaf in jax.tree_util.tree_leaves(tree)
+               if hasattr(leaf, "nbytes"))
 
 
 def _wrap_profiled(signature: str, fn):
@@ -44,17 +59,19 @@ def _wrap_profiled(signature: str, fn):
         out = fn(*a, **kw)
         _force_complete(out)
         dt = time.perf_counter() - t0
+        nb = _tree_bytes((a, kw)) + _tree_bytes(out)
         with _LOCK:
-            ent = _PROF.setdefault(signature, [0, 0.0])
+            ent = _PROF.setdefault(signature, [0, 0.0, 0])
             ent[0] += 1
             ent[1] += dt
+            ent[2] += nb
         return out
     return wrapped
 
 
 def kernel_profile() -> Dict[str, list]:
-    """signature -> [calls, total_seconds] recorded under
-    SRT_KERNEL_PROFILE=1 (reset with kernel_profile_reset)."""
+    """signature -> [calls, total_seconds, arg+result_bytes] recorded
+    under SRT_KERNEL_PROFILE=1 (reset with kernel_profile_reset)."""
     with _LOCK:
         return {k: list(v) for k, v in _PROF.items()}
 

