@@ -36,7 +36,7 @@ import jax.numpy as jnp
 # one-hot blocks above this many elements are scan-chunked so the
 # materialized (block, T) one-hot stays <= ~128 MB bf16 (measured best
 # on v5e at 1M rows x 8192 slots: 128-step scan beats 512-step by ~35%)
-_MAX_ONEHOT_ELEMS = 1 << 26
+_MAX_ONEHOT_ELEMS = 1 << 27
 
 # kinds this engine can evaluate; everything else (min/max/first/last/any,
 # string payloads) falls back to T-width segment ops in the caller

@@ -368,9 +368,14 @@ def _convert_cartesian(meta: ExecMeta, children) -> PhysicalPlan:
     return TpuCartesianProductExec(children[0], children[1])
 
 
+# Deviation from the reference's default (GpuOverrides gates
+# CartesianProduct off): on this backend a device-resident cartesian is
+# strictly better than the fallback, which pays TWO device->host result
+# fetches (~0.1s each over the tunnel) plus a re-upload — scalar-
+# subquery cross joins (tpch q11's threshold) hit it on every query.
+# The conf remains available to disable.
 _register(ExecRule(cpu.CpuCartesianProductExec, "cartesian product",
-                   _tag_nothing, _convert_cartesian,
-                   disabled_by_default=True))
+                   _tag_nothing, _convert_cartesian))
 
 
 def _tag_bnlj(meta: ExecMeta) -> None:
