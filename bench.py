@@ -424,7 +424,9 @@ def _wait_for_idle_box():
     co-tenant inflates the CPU-path (pandas) times ~2x, which once
     produced a phantom sign flip — gating beats annotating."""
     ncpu = os.cpu_count() or 1
-    gate = float(os.environ.get("BENCH_LOAD_GATE", 0.5 * ncpu + 0.25))
+    # the gate must be at least as strict as the post-run load_warning
+    # threshold (0.6 * ncpu), else a gated start can still warn
+    gate = float(os.environ.get("BENCH_LOAD_GATE", 0.5 * ncpu))
     max_wait = float(os.environ.get("BENCH_LOAD_WAIT_S", "600"))
     t0 = time.monotonic()
     waited = False
