@@ -245,6 +245,12 @@ def _worker():
         rec["timed_compiles"] = compile_counts["n"] - c0
         rec["timed_kc_misses"] = kernelcache.cache_stats()["misses"] - k0
         rec["tpu_iters"] = tpu_iters
+        # per-query profile artifact (obs/profile.py): captured NOW, off
+        # the last timed TPU iteration — the CPU-path runs below would
+        # overwrite session.last_profile with the CPU plan's profile
+        prof = getattr(session, "last_profile", None)
+        if prof is not None:
+            rec["_profile"] = prof.to_json()
 
         run_query(fn, False)  # warm CPU caches too
         cpu_iters = []
@@ -311,6 +317,25 @@ def _worker():
             if sn not in suites:
                 suites[sn] = _build_suite(sn)
             rec = measure(suites[sn][q])
+            # archive the per-query profile JSON (attribution for free in
+            # later rounds; see docs/observability.md). BENCH_PROFILE_DIR=
+            # empty disables.
+            prof = rec.pop("_profile", None)
+            prof_dir = os.environ.get("BENCH_PROFILE_DIR",
+                                      "docs/bench_profiles")
+            if sn.startswith("_"):  # harness selftests leave no artifacts
+                prof = None
+            if prof is not None and prof_dir:
+                try:
+                    os.makedirs(prof_dir, exist_ok=True)
+                    pf = os.path.join(
+                        prof_dir,
+                        req["name"].replace(".", "_") + ".profile.json")
+                    with open(pf, "w") as f:
+                        json.dump(prof, f, indent=1)
+                    rec["profile_file"] = pf
+                except OSError:
+                    pass
             if req["name"] in scan_cost_queries:
                 so = measure_scan_off(suites[sn][q])
                 rec["tpu_scan_off_iters"] = so

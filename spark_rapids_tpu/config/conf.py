@@ -300,9 +300,11 @@ CSV_READ_ENABLED = register(
     "Enable accelerated CSV scans.")
 METRICS_ENABLED = register(
     "spark.rapids.sql.metrics.enabled", _to_bool, True,
-    "Collect per-operator SQL metrics (rows/batches/time) and emit "
-    "profiler trace ranges per operator (the reference's GpuMetricNames "
-    "and NVTX ranges, GpuExec.scala:24-41, NvtxWithMetrics.scala:17-44).")
+    "Collect per-operator SQL metrics (rows/batches/time; the reference's "
+    "GpuMetricNames, GpuExec.scala:24-41) and per-query profile reports "
+    "(session.profile_report()). Disabling removes every timer from the "
+    "batch hot path. Profiler trace ranges are separate: see the "
+    "spark.rapids.tpu.trace.* keys.")
 
 ORC_ENABLED = register(
     "spark.rapids.sql.format.orc.enabled", _to_bool, True,
@@ -417,6 +419,29 @@ EXPORT_COLUMNAR_RDD = register(
     "spark.rapids.sql.exportColumnarRdd", _to_bool, False,
     "Expose query output as device-resident columnar data for ML frameworks "
     "(the reference's ColumnarRdd zero-copy export, ColumnarRdd.scala:41-50).")
+
+# --- observability (obs/: tracing + profile reports) -----------------------
+TRACE_ENABLED = register(
+    "spark.rapids.tpu.trace.enabled", _to_bool, False,
+    "Collect structured tracer spans (exec operators, shuffle fetches, "
+    "spill tier transitions, semaphore waits, kernel-cache events) during "
+    "query execution. Implied by a non-empty spark.rapids.tpu.trace.path. "
+    "The NVTX-range analogue (NvtxWithMetrics.scala:17-44); see "
+    "docs/observability.md for the span taxonomy.")
+
+TRACE_PATH = register(
+    "spark.rapids.tpu.trace.path", str, "",
+    "When set, every query execution exports its spans as Chrome "
+    "trace-event JSON to this file (overwritten per query), viewable in "
+    "Perfetto (ui.perfetto.dev) or chrome://tracing. Setting a path "
+    "enables tracing.")
+
+TRACE_JAX_ANNOTATIONS = register(
+    "spark.rapids.tpu.trace.jaxAnnotations", _to_bool, False,
+    "Mirror tracer spans into jax.profiler.TraceAnnotation ranges so they "
+    "appear in a captured jax/XLA profiler trace alongside the compiler's "
+    "own events. Off by default: annotations cost a context manager per "
+    "span even when no jax profiler session is active.")
 
 
 class TpuConf:

@@ -54,15 +54,20 @@ class PhysicalPlan:
         raise NotImplementedError
 
     def executed_partitions(self, ctx: "ExecContext") -> List[Partition]:
-        """``partitions`` wrapped with per-operator SQL metrics and profiler
-        ranges (reference: GpuMetricNames per-exec Spark metrics,
+        """``partitions`` wrapped with per-operator SQL metrics and tracer
+        spans (reference: GpuMetricNames per-exec Spark metrics,
         GpuExec.scala:24-41, + NvtxWithMetrics.scala:17-44). Consumers call
-        this; operators implement ``partitions``."""
+        this; operators implement ``partitions``. With metrics AND tracing
+        disabled the partitions pass through untouched — no timers on the
+        hot path."""
         parts = self.partitions(ctx)
-        if not ctx.metrics_enabled:
+        from spark_rapids_tpu.obs.trace import TRACER
+        if not ctx.metrics_enabled and not TRACER.enabled:
             return parts
         import time
         op = self.describe()
+        record = ctx.metrics_enabled
+        node_id = id(self)
         # profile mode: force a device sync after every operator's batch
         # so totalTime is ATTRIBUTABLE per kernel — without it dispatch is
         # async and all queued compute lands on whichever operator first
@@ -76,40 +81,38 @@ class PhysicalPlan:
             if nr is not None:
                 import jax
                 jax.device_get(nr)
-        try:
-            from jax.profiler import TraceAnnotation
-        except ImportError:  # pragma: no cover
-            import contextlib
-            TraceAnnotation = lambda _name: contextlib.nullcontext()  # noqa: E731
 
-        def wrap(part: Partition) -> Partition:
+        def wrap(part: Partition, pidx: int) -> Partition:
             def run():
                 it = part()
                 while True:
                     t0 = time.perf_counter()
-                    with TraceAnnotation(self.name):
+                    with TRACER.span(self.name, op=op,
+                                     partition=pidx) as sp:
                         try:
                             batch = next(it)
                         except StopIteration:
                             return
+                        rows = (batch._host_rows
+                                if hasattr(batch, "_host_rows")
+                                else len(batch))
+                        if sp is not None:
+                            sp.set(batch_rows=rows)
                     if sync_each:
                         _force_sync(batch)
                         # per-node-identity inclusive time: the profiler
                         # subtracts children to get exclusive per-kernel
                         # attribution (describe() keys merge same-shaped
                         # operators, which hides where time goes)
-                        ctx.node_times[id(self)] = ctx.node_times.get(
-                            id(self), 0.0) + (time.perf_counter() - t0)
-                    ctx.metric_add(op, "totalTime",
-                                   time.perf_counter() - t0)
-                    ctx.metric_add(op, "numOutputBatches", 1)
-                    rows = (batch._host_rows
-                            if hasattr(batch, "_host_rows") else len(batch))
-                    if rows is not None:
-                        ctx.metric_add(op, "numOutputRows", rows)
+                        with ctx._stats_lock:
+                            ctx.node_times[node_id] = ctx.node_times.get(
+                                node_id, 0.0) + (time.perf_counter() - t0)
+                    if record:
+                        ctx.record_op(op, node_id,
+                                      time.perf_counter() - t0, rows)
                     yield batch
             return run
-        return [wrap(p) for p in parts]
+        return [wrap(p, i) for i, p in enumerate(parts)]
 
     def map_children(self, fn) -> "PhysicalPlan":
         import copy
@@ -164,11 +167,22 @@ class ExecContext:
     """Per-query execution context: conf, session services, metrics."""
 
     def __init__(self, conf, session=None, speculate: bool = True):
+        from spark_rapids_tpu.obs.metrics import MetricsRegistry
         self.conf = conf
         self.session = session
-        self.metrics: dict = {}
+        # per-query metrics registry: per-op counters carry an op= label
+        # and render back into the legacy {op: {metric: value}} dict via
+        # the ``metrics`` property (session.last_query_metrics shape).
+        # Thread-safe — the shuffle server and partition executor threads
+        # accumulate concurrently.
+        self.registry = MetricsRegistry()
         self.metrics_enabled = conf.get_bool(
             "spark.rapids.sql.metrics.enabled", True)
+        # per-plan-node (identity-keyed) inclusive time/rows/batches for
+        # the profile report (obs/profile.py)
+        import threading
+        self.node_stats: dict = {}
+        self._stats_lock = threading.Lock()
         # per-operator sync for kernel attribution (tools/profile_query.py)
         self.profile_sync = conf.get_bool(
             "spark.rapids.sql.profile.syncEachOp", False)
@@ -196,5 +210,35 @@ class ExecContext:
         self.reuse_state: dict = {}
 
     def metric_add(self, op: str, name: str, value):
-        self.metrics.setdefault(op, {}).setdefault(name, 0)
-        self.metrics[op][name] += value
+        self.registry.counter(name, op=op).add(value)
+
+    def record_op(self, op: str, node_id: int, seconds: float, rows):
+        """One executed batch of one operator: per-op SQL metrics plus the
+        per-node-identity stats the profile report attributes time with."""
+        self.metric_add(op, "totalTime", seconds)
+        self.metric_add(op, "numOutputBatches", 1)
+        if rows is not None:
+            self.metric_add(op, "numOutputRows", rows)
+        with self._stats_lock:
+            st = self.node_stats.get(node_id)
+            if st is None:
+                st = self.node_stats[node_id] = {
+                    "time": 0.0, "rows": 0, "batches": 0}
+            st["time"] += seconds
+            st["batches"] += 1
+            if rows is not None:
+                st["rows"] += rows
+
+    def op_metrics(self) -> dict:
+        """Legacy nested-dict render of the registry: {op: {metric:
+        value}} (the session.last_query_metrics shape)."""
+        out: dict = {}
+        for m in self.registry.metrics():
+            op = m.labels.get("op")
+            if op is not None:
+                out.setdefault(op, {})[m.name] = m.value
+        return out
+
+    @property
+    def metrics(self) -> dict:
+        return self.op_metrics()

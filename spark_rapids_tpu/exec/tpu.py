@@ -1453,6 +1453,17 @@ class TpuShuffleExchangeExec(TpuExec):
                                 attempt += 1
                                 if attempt > max_retries:
                                     raise
+                                from spark_rapids_tpu.obs.metrics import (
+                                    REGISTRY,
+                                )
+                                from spark_rapids_tpu.obs.trace import (
+                                    TRACER,
+                                )
+                                REGISTRY.counter(
+                                    "shuffle.fetch.retries").add(1)
+                                TRACER.instant(
+                                    "shuffle.fetch.retry",
+                                    peer=str(peer), attempt=attempt)
                                 import logging
                                 logging.getLogger(__name__).warning(
                                     "shuffle fetch failed (%s); retrying "

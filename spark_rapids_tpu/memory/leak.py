@@ -38,8 +38,26 @@ class LeakTracker:
         self._lock = threading.Lock()
         self._live: Dict[int, LeakRecord] = {}
         self._seq = 0
+        self._live_bytes = 0
+        self._gauges = None  # (liveBuffers, liveBytes), resolved lazily
         self.capture_stacks = (
             os.environ.get("SPARK_RAPIDS_TPU_LEAK_STACKS", "0") == "1")
+
+    def _publish_locked(self) -> None:
+        """Mirror the live set into the process-wide registry so the
+        observability layer sees leak candidates without calling report()
+        (obs/: memory.liveBuffers / memory.liveBytes gauges). Caller holds
+        self._lock — publishing under it keeps the gauges ordered with
+        the mutations (an unlocked publish could land a stale count last
+        and leave phantom leaked bytes on the gauge). The registry lock
+        nests inside the tracker lock, never the reverse. Gauge handles
+        are resolved once — this runs per buffer alloc/free."""
+        if self._gauges is None:
+            from spark_rapids_tpu.obs.metrics import REGISTRY
+            self._gauges = (REGISTRY.gauge("memory.liveBuffers"),
+                            REGISTRY.gauge("memory.liveBytes"))
+        self._gauges[0].set(len(self._live))
+        self._gauges[1].set(self._live_bytes)
 
     def register(self, size_bytes: int, label: str = "buffer") -> int:
         stack = None
@@ -49,11 +67,16 @@ class LeakTracker:
             self._seq += 1
             token = self._seq
             self._live[token] = LeakRecord(token, size_bytes, stack, label)
+            self._live_bytes += size_bytes
+            self._publish_locked()
         return token
 
     def unregister(self, token: int) -> None:
         with self._lock:
-            self._live.pop(token, None)
+            rec = self._live.pop(token, None)
+            if rec is not None:
+                self._live_bytes -= rec.size_bytes
+            self._publish_locked()
 
     @property
     def live_count(self) -> int:
@@ -63,7 +86,7 @@ class LeakTracker:
     @property
     def live_bytes(self) -> int:
         with self._lock:
-            return sum(r.size_bytes for r in self._live.values())
+            return self._live_bytes
 
     def report(self) -> List[str]:
         """Human-readable lines, one per live (leaked) buffer."""
@@ -84,6 +107,8 @@ class LeakTracker:
     def clear(self) -> None:
         with self._lock:
             self._live.clear()
+            self._live_bytes = 0
+            self._publish_locked()
 
 
 TRACKER = LeakTracker()

@@ -35,7 +35,18 @@ class TpuSemaphore:
             if held:
                 self._holders[tid] = held + 1
                 return
-        self._sem.acquire()
+        # contended acquires are the interesting signal (tasks stalled
+        # behind concurrentTpuTasks); the uncontended path stays timer-free
+        if not self._sem.acquire(blocking=False):
+            import time
+
+            from spark_rapids_tpu.obs.metrics import REGISTRY
+            from spark_rapids_tpu.obs.trace import TRACER
+            t0 = time.perf_counter()
+            with TRACER.span("semaphore.wait", permits=self.permits):
+                self._sem.acquire()
+            REGISTRY.timer("semaphore.waitTime") \
+                .record(time.perf_counter() - t0)
         with self._state_lock:
             self._holders[tid] = 1
 

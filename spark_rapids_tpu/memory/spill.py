@@ -37,6 +37,8 @@ import numpy as np
 
 from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
 from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.obs.metrics import REGISTRY
+from spark_rapids_tpu.obs.trace import TRACER
 
 
 class StorageTier(IntEnum):
@@ -89,7 +91,9 @@ class SpillableBuffer:
                 return 0
             batch = self._device_batch
             leaves, treedef = jax.tree_util.tree_flatten(batch)
-            host_leaves = jax.device_get(leaves)
+            with TRACER.span("spill.toHost", buffer=self.id,
+                             bytes=self.size):
+                host_leaves = jax.device_get(leaves)
             entry = {"leaves": host_leaves, "treedef": treedef}
             if arena is not None:
                 placed = self._try_arena_place(arena, host_leaves)
@@ -148,7 +152,9 @@ class SpillableBuffer:
             leaves = self._host_leaves()
             arrays = {f"a{i}": np.asarray(leaf)
                       for i, leaf in enumerate(leaves)}
-            np.savez(path, **arrays)
+            with TRACER.span("spill.toDisk", buffer=self.id,
+                             bytes=self.size):
+                np.savez(path, **arrays)
             self._treedef = self._host_data["treedef"]
             self._nleaves = len(leaves)
             self._disk_path = path
@@ -166,14 +172,19 @@ class SpillableBuffer:
             assert not self.closed, f"buffer {self.id} already freed"
             if self.tier == StorageTier.DEVICE:
                 return self._device_batch
-            if self.tier == StorageTier.HOST:
-                leaves = self._host_leaves()
-                treedef = self._host_data["treedef"]
-            else:
-                with np.load(self._disk_path) as z:
-                    leaves = [z[f"a{i}"] for i in range(self._nleaves)]
-                treedef = self._treedef
-            dev_leaves = [jax.numpy.asarray(leaf) for leaf in leaves]
+            REGISTRY.counter("spill.faultBacks",
+                             tier=self.tier.name.lower()).add(1)
+            with TRACER.span("spill.faultBack", buffer=self.id,
+                             bytes=self.size,
+                             tier=self.tier.name.lower()):
+                if self.tier == StorageTier.HOST:
+                    leaves = self._host_leaves()
+                    treedef = self._host_data["treedef"]
+                else:
+                    with np.load(self._disk_path) as z:
+                        leaves = [z[f"a{i}"] for i in range(self._nleaves)]
+                    treedef = self._treedef
+                dev_leaves = [jax.numpy.asarray(leaf) for leaf in leaves]
             batch = jax.tree_util.tree_unflatten(treedef, dev_leaves)
             old_tier = self.tier
             self._device_batch = batch
@@ -290,6 +301,10 @@ class DeviceStore(BufferStore):
     def spill_one(self, buf: SpillableBuffer) -> int:
         freed = buf.spill_to_host(arena=self.spill_store.arena)
         if freed:
+            REGISTRY.counter("spill.events", direction="device_to_host") \
+                .add(1)
+            REGISTRY.counter("spill.bytes", direction="device_to_host") \
+                .add(freed)
             self.spill_store.add(buf)
             # keep the host tier within its bound
             self.spill_store.enforce_limit()
@@ -311,6 +326,10 @@ class HostStore(BufferStore):
     def spill_one(self, buf: SpillableBuffer) -> int:
         freed = buf.spill_to_disk(self.spill_store.disk_dir)
         if freed:
+            REGISTRY.counter("spill.events", direction="host_to_disk") \
+                .add(1)
+            REGISTRY.counter("spill.bytes", direction="host_to_disk") \
+                .add(freed)
             self.spill_store.add(buf)
         return freed
 
@@ -404,6 +423,18 @@ class BufferCatalog:
         for store in (self.device_store, self.host_store, self.disk_store):
             store.remove(buffer_id)
         buf.close()
+
+    def publish_metrics(self, registry=REGISTRY) -> None:
+        """Per-tier resident bytes + buffer counts into the registry
+        (spill EVENT counts accumulate at the spill sites; this publishes
+        the resident-state gauges the events move bytes between)."""
+        for store in (self.device_store, self.host_store, self.disk_store):
+            tier = store.tier.name.lower()
+            registry.gauge("memory.tier.bytes", tier=tier) \
+                .set(store.total_size)
+            with store._lock:
+                n = sum(1 for b in store._buffers.values() if not b.closed)
+            registry.gauge("memory.tier.buffers", tier=tier).set(n)
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,310 @@
+"""Metrics registry: counters, gauges, timers and histograms with labels.
+
+The reference plugin surfaces per-operator SQL metrics through Spark's
+accumulator framework (GpuMetricNames, GpuExec.scala:24-41); this build has
+no driver UI, so the registry is the single structured store every subsystem
+reports through: exec operators (per-op rows/batches/time via ExecContext),
+the spill tiers (memory/spill.py), the shuffle transport (client/server
+fetch counters), the kernel cache (utils/kernelcache.py) and the leak
+tracker (memory/leak.py).
+
+Two registries exist:
+
+  * ``ExecContext.registry`` — per-query, rebuilt per execution; renders the
+    legacy ``session.last_query_metrics`` nested-dict shape.
+  * ``REGISTRY`` (module-level) — process-wide, for subsystems that outlive
+    a query (kernel cache, spill stores, transports). The session snapshots
+    it at query start and publishes per-query deltas in the profile report.
+
+All mutation is thread-safe: the shuffle server and partition executor
+threads update metrics concurrently (one lock per registry; metric updates
+take the owning registry's lock).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class Metric:
+    """Base: identity + the owning registry's lock (shared so snapshot()
+    sees a consistent cut across metrics)."""
+
+    kind = "metric"
+
+    def __init__(self, name: str, labels: Dict[str, Any],
+                 lock: threading.Lock):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = lock
+
+    def snapshot(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, name, labels, lock):
+        super().__init__(name, labels, lock)
+        self._value = 0
+
+    def add(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    inc = add
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "name": self.name,
+                "labels": self.labels, "value": self.value}
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, name, labels, lock):
+        super().__init__(name, labels, lock)
+        self._value = 0
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    def add(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "name": self.name,
+                "labels": self.labels, "value": self.value}
+
+
+class Timer(Metric):
+    """Accumulated wall time: count, total, min, max. ``with timer.time():``
+    or ``timer.record(seconds)``."""
+
+    kind = "timer"
+
+    def __init__(self, name, labels, lock):
+        super().__init__(name, labels, lock)
+        self._count = 0
+        self._total = 0.0
+        self._min = float("inf")
+        self._max = 0.0
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total += seconds
+            if seconds < self._min:
+                self._min = seconds
+            if seconds > self._max:
+                self._max = seconds
+
+    def time(self) -> "_TimerCtx":
+        return _TimerCtx(self)
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def total_seconds(self):
+        with self._lock:
+            return self._total
+
+    @property
+    def value(self):
+        return self.total_seconds
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"kind": self.kind, "name": self.name,
+                    "labels": self.labels, "count": self._count,
+                    "total_s": self._total,
+                    "min_s": self._min if self._count else 0.0,
+                    "max_s": self._max, "value": self._total}
+
+
+class _TimerCtx:
+    __slots__ = ("_timer", "_t0")
+
+    def __init__(self, timer: Timer):
+        self._timer = timer
+
+    def __enter__(self):
+        import time
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+        self._timer.record(time.perf_counter() - self._t0)
+        return False
+
+
+class Histogram(Metric):
+    """Value distribution with exact percentiles over a bounded reservoir.
+
+    Keeps every observation up to ``max_samples``; past that, decimates by
+    keeping every other retained sample (doubling the implicit stride), so
+    memory stays bounded while the tail quantiles remain representative for
+    the smooth latency distributions this records (fetch RTTs, span
+    durations)."""
+
+    kind = "histogram"
+    max_samples = 8192
+
+    def __init__(self, name, labels, lock):
+        super().__init__(name, labels, lock)
+        self._samples: List[float] = []
+        self._stride = 1
+        self._pending = 0
+        self._count = 0
+        self._total = 0.0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total += v
+            self._pending += 1
+            if self._pending >= self._stride:
+                self._pending = 0
+                self._samples.append(v)
+                if len(self._samples) > self.max_samples:
+                    self._samples = self._samples[::2]
+                    self._stride *= 2
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._count
+
+    def percentile(self, p: float) -> float:
+        """Exact percentile of the retained reservoir (p in [0, 100])."""
+        with self._lock:
+            samples = sorted(self._samples)
+        if not samples:
+            return 0.0
+        if len(samples) == 1:
+            return samples[0]
+        rank = (p / 100.0) * (len(samples) - 1)
+        lo = int(rank)
+        hi = min(lo + 1, len(samples) - 1)
+        frac = rank - lo
+        return samples[lo] * (1 - frac) + samples[hi] * frac
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            count, total = self._count, self._total
+        return {"kind": self.kind, "name": self.name, "labels": self.labels,
+                "count": count, "total": total,
+                "p50": self.percentile(50), "p95": self.percentile(95),
+                "p99": self.percentile(99), "value": count}
+
+
+class MetricsRegistry:
+    """Labelled metric factory + store. ``counter/gauge/timer/histogram``
+    return the same instance for the same (name, labels), creating on first
+    use — call sites never pre-register."""
+
+    _KINDS = {"counter": Counter, "gauge": Gauge, "timer": Timer,
+              "histogram": Histogram}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, str, LabelKey], Metric] = {}
+
+    def _get(self, kind: str, name: str, labels: Dict[str, Any]) -> Metric:
+        key = (kind, name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._KINDS[kind](name, labels, self._lock)
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", name, labels)
+
+    def timer(self, name: str, **labels) -> Timer:
+        return self._get("timer", name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get("histogram", name, labels)
+
+    def metrics(self) -> List[Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        return [m.snapshot() for m in self.metrics()]
+
+    def values(self) -> Dict[Tuple[str, LabelKey], Any]:
+        """(name, labels) -> scalar value, for start/end delta diffing
+        (timers report total seconds, histograms report count). Gauges
+        are state, not flow — excluded, their delta is meaningless."""
+        return {(m.name, _label_key(m.labels)): m.value
+                for m in self.metrics() if m.kind != "gauge"}
+
+    def value(self, name: str, default=0, **labels):
+        lk = _label_key(labels)
+        with self._lock:
+            for kind in self._KINDS:
+                m = self._metrics.get((kind, name, lk))
+                if m is not None:
+                    break
+            else:
+                return default
+        return m.value
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+def registry_delta(before: Dict[Tuple[str, LabelKey], Any],
+                   after: Dict[Tuple[str, LabelKey], Any]) -> Dict[str, Any]:
+    """Per-query delta of a values() snapshot pair, rendered as
+    ``name{k=v,...} -> delta`` (only non-zero deltas; gauges report their
+    final value, diffing a gauge is meaningless for bytes-resident)."""
+    out: Dict[str, Any] = {}
+    for key, v in after.items():
+        d = v - before.get(key, 0)
+        if d:
+            name, labels = key
+            suffix = ",".join(f"{k}={val}" for k, val in labels)
+            out[f"{name}{{{suffix}}}" if suffix else name] = d
+    return out
+
+
+# Process-wide registry for subsystems that outlive a single query
+# (kernel cache, spill stores, shuffle transports, leak tracker).
+REGISTRY = MetricsRegistry()

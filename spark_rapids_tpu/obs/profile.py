@@ -1,0 +1,117 @@
+"""Per-query profile report: the executed plan tree annotated with
+inclusive/exclusive time, rows, batches, plus the query-scoped deltas of
+the process-wide subsystem counters (spill bytes/events, shuffle fetch
+retries, kernel-cache hits/misses/compile time).
+
+The reference answers "where did this query's time go" with the Spark UI's
+per-operator SQL metrics + NVTX timelines; this report is the headless
+equivalent: ``session.profile_report()`` renders it, ``session.
+profile_json()`` returns the machine shape for tooling
+(tools/trace_summary.py consumes it, bench.py archives one per query).
+
+Inclusive/exclusive semantics: operator time is measured around each
+batch-pull in ``PhysicalPlan.executed_partitions``, so a parent's time
+includes the children it pulls through; exclusive time subtracts the
+children's inclusive time (clamped at zero — pipelined operators across
+threads can overlap).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def _node_profile(node, ctx, op_metrics: Dict[str, Any]) -> Dict[str, Any]:
+    st = ctx.node_stats.get(id(node))
+    incl = st["time"] if st else 0.0
+    children = [_node_profile(c, ctx, op_metrics) for c in node.children]
+    excl = max(incl - sum(c["inclusive_s"] for c in children), 0.0)
+    out: Dict[str, Any] = {
+        "op": node.describe(),
+        "inclusive_s": round(incl, 6),
+        "exclusive_s": round(excl, 6),
+        "rows": st["rows"] if st else 0,
+        "batches": st["batches"] if st else 0,
+        "children": children,
+    }
+    metrics = op_metrics.get(node.describe())
+    if metrics:
+        out["metrics"] = dict(metrics)
+    return out
+
+
+def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
+                  wall_s: Optional[float] = None) -> "ProfileReport":
+    """Assemble the report from the executed plan + its ExecContext.
+    ``global_delta`` is the per-query diff of the process-wide registry
+    (obs.metrics.registry_delta) carrying spill/fetch/compile activity."""
+    op_metrics = ctx.op_metrics()
+    tree = _node_profile(plan, ctx, op_metrics)
+    summary: Dict[str, Any] = {}
+    delta = dict(global_delta or {})
+
+    def take(prefix: str) -> Dict[str, Any]:
+        got = {k: v for k, v in delta.items() if k.startswith(prefix)}
+        for k in got:
+            del delta[k]
+        return got
+
+    summary["spill"] = take("spill.")
+    summary["shuffle"] = take("shuffle.")
+    summary["kernelCache"] = take("kernelCache.")
+    if delta:
+        summary["other"] = delta
+    mem = op_metrics.get("memory")
+    if mem:
+        summary["memory"] = dict(mem)
+    return ProfileReport(tree, summary, wall_s=wall_s)
+
+
+class ProfileReport:
+    def __init__(self, tree: Dict[str, Any], summary: Dict[str, Any],
+                 wall_s: Optional[float] = None):
+        self.tree = tree
+        self.summary = summary
+        self.wall_s = wall_s
+
+    # -- machine shape ------------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        doc: Dict[str, Any] = {"version": 1, "plan": self.tree,
+                               "summary": self.summary}
+        if self.wall_s is not None:
+            doc["wall_s"] = round(self.wall_s, 6)
+        return doc
+
+    def save(self, path: str) -> None:
+        import os
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=1)
+
+    # -- human shape --------------------------------------------------------
+    def render(self) -> str:
+        lines: List[str] = []
+        if self.wall_s is not None:
+            lines.append(f"query wall: {self.wall_s:.3f}s")
+
+        def rec(node: Dict[str, Any], indent: int) -> None:
+            lines.append(
+                "  " * indent
+                + f"{node['op']}  "
+                + f"[incl {node['inclusive_s']:.3f}s "
+                + f"excl {node['exclusive_s']:.3f}s "
+                + f"rows {node['rows']} batches {node['batches']}]")
+            for c in node["children"]:
+                rec(c, indent + 1)
+        rec(self.tree, 0)
+        for section, vals in self.summary.items():
+            if not vals:
+                continue
+            lines.append(f"-- {section}")
+            for k, v in sorted(vals.items()):
+                if isinstance(v, float):
+                    v = round(v, 6)
+                lines.append(f"   {k}: {v}")
+        return "\n".join(lines)

@@ -141,6 +141,11 @@ class TpuSparkSession:
         # rename provenance: alias -> {source column names} recorded by
         # rename-only projections, so stats resolve through `.alias(...)`
         self.column_aliases: dict = {}
+        # observability state of the last executed query (obs/)
+        self.last_query_metrics: dict = {}
+        self.last_node_times: dict = {}
+        self.last_plan = None
+        self.last_profile = None
 
     def clear_device_cache(self) -> None:
         for _source, parts in self.device_scan_cache.values():
@@ -328,8 +333,35 @@ class TpuSparkSession:
             TpuOverrides, TransitionOverrides, assert_is_on_tpu,
         )
 
+        import time
+
+        from spark_rapids_tpu.obs import metrics as obs_metrics
+        from spark_rapids_tpu.obs.trace import TRACER
+
         conf = self.conf
         ctx = ExecContext(conf, self)
+        # per-query tracer window: configure from conf, clear so an
+        # exported file holds exactly this query (a speculation re-run is
+        # part of the same query and keeps its spans)
+        trace_path = str(conf.get("spark.rapids.tpu.trace.path", "") or "")
+        trace_on = (conf.get_bool("spark.rapids.tpu.trace.enabled", False)
+                    or bool(trace_path))
+        TRACER.configure(trace_on, conf.get_bool(
+            "spark.rapids.tpu.trace.jaxAnnotations", False))
+        if trace_on:
+            TRACER.clear()
+        # reset NOW, not on the success path: a failed query must not
+        # leave the previous query's profile/metrics masquerading as "the
+        # last executed query" in a post-mortem
+        self.last_query_metrics = {}
+        self.last_node_times = {}
+        self.last_plan = None
+        self.last_profile = None
+        # process-wide registry snapshot: the profile reports this query's
+        # DELTA of spill/fetch/compile activity
+        global_before = (obs_metrics.REGISTRY.values()
+                         if ctx.metrics_enabled else None)
+        t_query0 = time.perf_counter()
         # record rename provenance (alias -> source names) from the
         # LOGICAL plan — physical projections can fuse away, but the
         # logical tree always carries `.alias(...)` / USING-join renames.
@@ -382,7 +414,8 @@ class TpuSparkSession:
             # Capacity syncs stay exact under write commands.
             ctx.speculate = False
         try:
-            outs = self._drain(plan, ctx, conf)
+            with TRACER.span("Query", speculative=bool(ctx.speculate)):
+                outs = self._drain(plan, ctx, conf)
             if ctx.spec_pending and not self._verify_speculation(ctx):
                 # a speculated capacity did not cover its actual size:
                 # the speculative output may be truncated. Re-execute the
@@ -397,7 +430,9 @@ class TpuSparkSession:
                 self.release_active_shuffles()
                 self.release_transient_buffers()
                 ctx = ExecContext(conf, self, speculate=False)
-                outs = self._drain(plan, ctx, conf)
+                with TRACER.span("Query", speculative=False,
+                                 rerun=True):
+                    outs = self._drain(plan, ctx, conf)
         finally:
             self.release_active_shuffles()
             self.release_transient_buffers()
@@ -407,17 +442,48 @@ class TpuSparkSession:
         # the reference's gpuOpTime/spill metrics, GpuMetricNames)
         if ctx.metrics_enabled:
             cat = self.buffer_catalog
-            ctx.metrics["memory"] = {
+            mem = {
                 "allocatedBytes": self.device_manager.allocated,
                 "spillCount": self.memory_event_handler.spill_count,
                 "deviceStoreBytes": cat.device_store.total_size,
                 "hostStoreBytes": cat.host_store.total_size,
                 "diskStoreBytes": cat.disk_store.total_size,
             }
+            for k, v in mem.items():
+                ctx.registry.gauge(k, op="memory").set(v)
+            # per-tier resident bytes into the process-wide registry
+            cat.publish_metrics()
         self.last_query_metrics = ctx.metrics
         self.last_node_times = ctx.node_times  # profiler (syncEachOp)
+        self.last_plan = plan
+        self.last_profile = None
+        if ctx.metrics_enabled:
+            from spark_rapids_tpu.obs.profile import build_profile
+            delta = obs_metrics.registry_delta(
+                global_before, obs_metrics.REGISTRY.values())
+            self.last_profile = build_profile(
+                plan, ctx, delta,
+                wall_s=time.perf_counter() - t_query0)
+        if trace_on and trace_path:
+            TRACER.export_chrome(trace_path)
         self._sweep_adaptive_caches()
         return plan, outs
+
+    # --- observability ------------------------------------------------------
+    def profile_report(self) -> str:
+        """Human-readable profile of the last executed query: plan tree
+        annotated with inclusive/exclusive time, rows, batches, plus the
+        query's spill/fetch/compile-cache activity (obs/profile.py).
+        Empty string when no profiled query has run (metrics disabled)."""
+        return "" if self.last_profile is None else \
+            self.last_profile.render()
+
+    def profile_json(self) -> Optional[dict]:
+        """Machine shape of the last query's profile (None when no
+        profiled query has run). Consumed by tools/trace_summary.py and
+        archived per query by bench.py."""
+        return None if self.last_profile is None else \
+            self.last_profile.to_json()
 
     # adaptive-state size cap: fingerprints embed per-upload data uids,
     # so a workload that keeps creating DataFrames mints fresh keys every

@@ -20,6 +20,8 @@ import struct
 import threading
 from typing import List, Tuple
 
+from spark_rapids_tpu.obs.metrics import REGISTRY
+from spark_rapids_tpu.obs.trace import TRACER
 from spark_rapids_tpu.shuffle import wire
 from spark_rapids_tpu.shuffle.catalogs import ReceivedBufferCatalog
 from spark_rapids_tpu.shuffle.server import (
@@ -37,11 +39,15 @@ class ShuffleFetchFailedError(RuntimeError):
 class ShuffleClient:
     def __init__(self, executor_id: str, connection: ClientConnection,
                  received: ReceivedBufferCatalog, bounce_buffer_size: int,
-                 max_bytes_in_flight: int = 128 << 20):
+                 max_bytes_in_flight: int = 128 << 20,
+                 peer_id: str = ""):
         self.executor_id = executor_id
         self.connection = connection
         self.received = received
         self.bounce_buffer_size = bounce_buffer_size
+        # REMOTE peer this client fetches from — trace attribution keys on
+        # it (the local executor_id goes on the wire for reply routing)
+        self.peer_id = peer_id or getattr(connection, "peer_id", "")
         # inflight-bytes throttle (reference:
         # spark.rapids.shuffle.ucx.maximumBytesInFlight,
         # RapidsConf.scala:532-537 + UCXShuffleTransport's throttle):
@@ -70,21 +76,40 @@ class ShuffleClient:
         a mid-fetch failure unregisters the blocks already received, so a
         task-level retry (exec/tpu.py maxFetchRetries) cannot pile up
         duplicate registered copies in the spillable received catalog."""
-        metas = self._fetch_metadata(blocks)
-        out: List[int] = []
-        try:
-            for bid, length, tag in metas:
-                self._acquire_inflight(length)
-                try:
-                    blob = self._receive_buffer(length, tag)
-                finally:
-                    self._release_inflight(length)
-                batch = wire.deserialize_batch(blob)
-                out.append(self.received.add_batch(batch))
-        except BaseException:
-            for rbid in out:
-                self.received.remove_batch(rbid)
-            raise
+        import time
+        t0 = time.perf_counter()
+        with TRACER.span("shuffle.fetch", peer=self.peer_id,
+                         blocks=len(blocks)) as sp:
+            out: List[int] = []
+            total = 0
+            try:
+                with TRACER.span("shuffle.fetch.meta",
+                                 blocks=len(blocks)):
+                    metas = self._fetch_metadata(blocks)
+                for bid, length, tag in metas:
+                    self._acquire_inflight(length)
+                    try:
+                        with TRACER.span("shuffle.fetch.buffer",
+                                         bytes=length):
+                            blob = self._receive_buffer(length, tag)
+                    finally:
+                        self._release_inflight(length)
+                    total += length
+                    batch = wire.deserialize_batch(blob)
+                    out.append(self.received.add_batch(batch))
+            except BaseException:
+                REGISTRY.counter("shuffle.fetch.failures").add(1)
+                for rbid in out:
+                    self.received.remove_batch(rbid)
+                raise
+            if sp is not None:
+                sp.set(bytes=total)
+        # fetch RTT distribution — the round-5 tail-attribution question
+        # (VERDICT) asked of every slow sweep, now always on record
+        REGISTRY.histogram("shuffle.fetch.rtt") \
+            .observe(time.perf_counter() - t0)
+        REGISTRY.counter("shuffle.fetch.count").add(1)
+        REGISTRY.counter("shuffle.fetch.bytes").add(total)
         return out
 
     def _fetch_metadata(self, blocks) -> List[Tuple[int, int, int]]:

@@ -72,7 +72,8 @@ class ShuffleEnv:
                 c = ShuffleClient(self.executor_id,
                                   self.transport.make_client(peer_executor_id),
                                   self.received_catalog,
-                                  self.bounce_buffer_size)
+                                  self.bounce_buffer_size,
+                                  peer_id=peer_executor_id)
                 self._clients[peer_executor_id] = c
             return c
 

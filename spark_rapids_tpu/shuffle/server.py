@@ -20,6 +20,8 @@ import struct
 import threading
 from typing import Dict, List
 
+from spark_rapids_tpu.obs.metrics import REGISTRY
+from spark_rapids_tpu.obs.trace import TRACER
 from spark_rapids_tpu.shuffle import wire
 from spark_rapids_tpu.shuffle.catalogs import ShuffleBufferCatalog
 from spark_rapids_tpu.shuffle.transport import (
@@ -70,17 +72,19 @@ class ShuffleServer:
         back through the catalog) and stage it under a fresh tag range."""
         n = len(payload) // META_REQ.size
         out = []
-        for i in range(n):
-            sid, mid, pid = META_REQ.unpack_from(payload, i * META_REQ.size)
-            for bid in self.catalog.buffer_ids(sid, mid, pid):
-                batch = self.catalog.catalog.acquire_batch(bid)
-                blob = wire.serialize_batch(batch)
-                size = self.bounce.buffer_size
-                nchunks = (len(blob) + size - 1) // size or 1
-                tag = self._next_tag(nchunks)
-                with self._tag_lock:
-                    self._staged[tag] = blob
-                out.append(META_RESP.pack(bid, len(blob), tag))
+        with TRACER.span("shuffle.server.meta", blocks=n):
+            for i in range(n):
+                sid, mid, pid = META_REQ.unpack_from(payload,
+                                                     i * META_REQ.size)
+                for bid in self.catalog.buffer_ids(sid, mid, pid):
+                    batch = self.catalog.catalog.acquire_batch(bid)
+                    blob = wire.serialize_batch(batch)
+                    size = self.bounce.buffer_size
+                    nchunks = (len(blob) + size - 1) // size or 1
+                    tag = self._next_tag(nchunks)
+                    with self._tag_lock:
+                        self._staged[tag] = blob
+                    out.append(META_RESP.pack(bid, len(blob), tag))
         return b"".join(out)
 
     def handle_transfer(self, payload: bytes) -> bytes:
@@ -92,13 +96,17 @@ class ShuffleServer:
         peer_id = payload[2:2 + peer_len].decode("utf-8")
         body = payload[2 + peer_len:]
         n = len(body) // TRANSFER_REQ.size
-        for i in range(n):
-            bid, tag = TRANSFER_REQ.unpack_from(body, i * TRANSFER_REQ.size)
-            with self._tag_lock:
-                blob = self._staged.pop(tag, None)
-            if blob is None:
-                raise RuntimeError(f"transfer for unknown tag {tag}")
-            self._send_chunked(peer_id, tag, blob)
+        with TRACER.span("shuffle.server.transfer", peer=peer_id,
+                         buffers=n):
+            for i in range(n):
+                bid, tag = TRANSFER_REQ.unpack_from(body,
+                                                    i * TRANSFER_REQ.size)
+                with self._tag_lock:
+                    blob = self._staged.pop(tag, None)
+                if blob is None:
+                    raise RuntimeError(f"transfer for unknown tag {tag}")
+                self._send_chunked(peer_id, tag, blob)
+                REGISTRY.counter("shuffle.server.bytesSent").add(len(blob))
         return b"ok"
 
     def _send_chunked(self, peer_id: str, tag: int, blob: bytes) -> None:

@@ -276,6 +276,9 @@ class _SocketServer(ServerConnection):
             return txn
         if self.transport._fault_should_drop():
             self.transport.stats["faults_fired"] += 1
+            from spark_rapids_tpu.obs.trace import TRACER
+            TRACER.instant("shuffle.transport.drop", peer=peer_id,
+                           injected=True)
             try:
                 conn.shutdown(socket.SHUT_RDWR)
                 conn.close()
@@ -392,6 +395,14 @@ class _SocketClient(ClientConnection):
             recvs = list(self._recvs.values())
             self._recvs.clear()
             self._pending_tagged.clear()
+        if reqs or recvs:
+            # only a drop with outstanding ops is a LOST connection; the
+            # reader loop also lands here on clean transport shutdown
+            from spark_rapids_tpu.obs.metrics import REGISTRY
+            from spark_rapids_tpu.obs.trace import TRACER
+            REGISTRY.counter("shuffle.transport.connectionsLost").add(1)
+            TRACER.instant("shuffle.transport.connectionLost",
+                           peer=self.peer_id, inflight=len(reqs) + len(recvs))
         for cb in reqs:
             txn = Transaction()
             txn.complete(TransactionStatus.ERROR, 0, msg)

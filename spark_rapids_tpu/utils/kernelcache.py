@@ -81,15 +81,43 @@ def kernel_profile_reset() -> None:
         _PROF.clear()
 
 
+# Observability handles, resolved once: the hit path runs per kernel
+# fetch (per batch per operator) and must stay one lock + one counter add
+# on top of the cache dict get.
+from spark_rapids_tpu.obs.metrics import REGISTRY as _REGISTRY  # noqa: E402
+from spark_rapids_tpu.obs.trace import TRACER as _TRACER  # noqa: E402
+
+_HITS = _REGISTRY.counter("kernelCache.hits")
+_MISSES = _REGISTRY.counter("kernelCache.misses")
+_BUILD_TIME = _REGISTRY.timer("kernelCache.buildTime")
+
+
 def cached_jit(signature: str, builder: Callable[[], Any]):
-    """Return the cached kernel for ``signature``, building it once."""
+    """Return the cached kernel for ``signature``, building it once.
+
+    Hit/miss/build-time counters feed the process-wide observability
+    registry (obs/metrics.py REGISTRY, names kernelCache.*); when the
+    tracer is on, hits emit instant events and builds emit spans (the
+    XLA executable compile itself happens lazily at first call — the
+    build span covers kernel CONSTRUCTION, backend_compile listeners
+    cover compilation, see bench.py)."""
     with _LOCK:
         fn = _CACHE.get(signature)
         if fn is not None:
             _STATS["hits"] += 1
-            return fn
-        _STATS["misses"] += 1
-    fn = builder()
+        else:
+            _STATS["misses"] += 1
+    if fn is not None:
+        _HITS.add(1)
+        if _TRACER.enabled:
+            _TRACER.instant("kernelcache.hit", signature=signature[:160])
+        return fn
+    _MISSES.add(1)
+    import time
+    t0 = time.perf_counter()
+    with _TRACER.span("kernelcache.build", signature=signature[:160]):
+        fn = builder()
+    _BUILD_TIME.record(time.perf_counter() - t0)
     if _PROFILE:
         fn = _wrap_profiled(signature, fn)
     with _LOCK:

@@ -39,3 +39,108 @@ def test_metrics_disabled(session):
         assert session.last_query_metrics == {}
     finally:
         session.set_conf("spark.rapids.sql.metrics.enabled", True)
+
+
+# --- obs/metrics.py registry (the store behind the dicts above) ------------
+
+class TestMetricsRegistry:
+    def _reg(self):
+        from spark_rapids_tpu.obs.metrics import MetricsRegistry
+        return MetricsRegistry()
+
+    def test_counter_label_identity(self):
+        reg = self._reg()
+        a = reg.counter("rows", op="scan")
+        b = reg.counter("rows", op="scan")
+        c = reg.counter("rows", op="filter")
+        assert a is b and a is not c
+        a.add(3)
+        b.add(2)
+        c.add(10)
+        assert reg.value("rows", op="scan") == 5
+        assert reg.value("rows", op="filter") == 10
+        assert reg.value("rows", op="nope", default=-1) == -1
+
+    def test_gauge_and_timer(self):
+        reg = self._reg()
+        g = reg.gauge("resident")
+        g.set(42)
+        g.add(8)
+        assert g.value == 50
+        t = reg.timer("wait")
+        t.record(0.5)
+        with t.time():
+            pass
+        assert t.count == 2
+        snap = t.snapshot()
+        assert snap["total_s"] >= 0.5
+        assert snap["max_s"] >= snap["min_s"] >= 0.0
+
+    def test_histogram_percentiles(self):
+        reg = self._reg()
+        h = reg.histogram("lat")
+        for v in range(1, 101):
+            h.observe(float(v))
+        assert h.count == 100
+        assert abs(h.percentile(50) - 50.5) < 1.5
+        assert h.percentile(0) == 1.0
+        assert h.percentile(100) == 100.0
+        assert h.percentile(99) > h.percentile(50) > h.percentile(10)
+
+    def test_histogram_reservoir_bounded(self):
+        from spark_rapids_tpu.obs.metrics import Histogram
+        reg = self._reg()
+        h = reg.histogram("big")
+        n = Histogram.max_samples * 3
+        for v in range(n):
+            h.observe(float(v))
+        assert h.count == n
+        assert len(h._samples) <= Histogram.max_samples
+        # decimated reservoir still spans the distribution
+        assert h.percentile(95) > h.percentile(5)
+
+    def test_thread_safety_smoke(self):
+        import threading
+        reg = self._reg()
+        c = reg.counter("n", op="agg")
+        h = reg.histogram("obs")
+
+        def work():
+            for i in range(1000):
+                c.add(1)
+                h.observe(float(i))
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000
+        assert h.count == 8000
+
+    def test_registry_delta(self):
+        from spark_rapids_tpu.obs.metrics import registry_delta
+        reg = self._reg()
+        reg.counter("spill.events", direction="device_to_host").add(2)
+        before = reg.values()
+        reg.counter("spill.events", direction="device_to_host").add(3)
+        reg.counter("shuffle.fetch.retries").add(1)
+        reg.gauge("memory.tier.bytes", tier="host").set(1 << 20)
+        delta = registry_delta(before, reg.values())
+        assert delta["spill.events{direction=device_to_host}"] == 3
+        assert delta["shuffle.fetch.retries"] == 1
+        # gauges are state, not flow: excluded from deltas
+        assert not any("memory.tier.bytes" in k for k in delta)
+
+
+def test_exec_context_legacy_view(session):
+    """metric_add -> registry -> legacy {op: {metric: value}} rendering."""
+    from spark_rapids_tpu.exec.base import ExecContext
+    ctx = ExecContext(session.conf, None)
+    ctx.metric_add("TpuFilterExec", "numOutputRows", 7)
+    ctx.metric_add("TpuFilterExec", "numOutputRows", 3)
+    ctx.metric_add("TpuFilterExec", "totalTime", 0.25)
+    ctx.registry.gauge("deviceStoreBytes", op="memory").set(123)
+    m = ctx.metrics
+    assert m["TpuFilterExec"]["numOutputRows"] == 10
+    assert m["TpuFilterExec"]["totalTime"] == 0.25
+    assert m["memory"]["deviceStoreBytes"] == 123

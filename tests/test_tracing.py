@@ -1,0 +1,245 @@
+"""Span tracer + Chrome trace export + profile report (obs/).
+
+Validates span nesting, that the exported Chrome trace JSON is well-formed
+and loadable, and that a real query under tracing produces spans for exec
+operators, a shuffle fetch, and a kernel-cache event (the ISSUE 1
+acceptance cross-section)."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.obs.trace import TRACER, Tracer
+from spark_rapids_tpu.sql import functions as F
+
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
+
+
+@pytest.fixture(autouse=True)
+def _tracer_off_after():
+    yield
+    TRACER.configure(False)
+    TRACER.clear()
+
+
+class TestTracer:
+    def test_span_nesting(self):
+        tr = Tracer()
+        tr.configure(True)
+        with tr.span("outer", kind="test"):
+            with tr.span("inner") as sp:
+                sp.set(rows=5)
+            tr.instant("marker", n=1)
+        events = tr.events()
+        names = [e["name"] for e in events]
+        # inner exits (and records) before outer
+        assert names == ["inner", "marker", "outer"]
+        inner = events[0]
+        assert inner["args"]["depth"] == 1
+        assert inner["args"]["parent"] == "outer"
+        assert inner["args"]["rows"] == 5
+        marker = events[1]
+        assert marker["ph"] == "i"
+        assert marker["args"]["parent"] == "outer"
+        outer = events[2]
+        assert outer["args"]["depth"] == 0
+        assert outer["ph"] == "X"
+        # the parent span covers the child
+        assert outer["ts"] <= inner["ts"]
+        assert outer["ts"] + outer["dur"] >= inner["ts"] + inner["dur"]
+
+    def test_disabled_is_free(self):
+        tr = Tracer()
+        assert not tr.enabled
+        cm1 = tr.span("a", x=1)
+        cm2 = tr.span("b")
+        # shared null context: no allocation per span when disabled
+        assert cm1 is cm2
+        with cm1 as sp:
+            assert sp is None
+        tr.instant("nothing")
+        assert tr.events() == []
+
+    def test_error_span_recorded(self):
+        tr = Tracer()
+        tr.configure(True)
+        with pytest.raises(ValueError):
+            with tr.span("boom"):
+                raise ValueError("x")
+        (ev,) = tr.events()
+        assert ev["args"]["error"] == "ValueError"
+
+    def test_chrome_export_wellformed(self, tmp_path):
+        tr = Tracer()
+        tr.configure(True)
+        with tr.span("parent"):
+            with tr.span("child", bytes=10):
+                pass
+        path = str(tmp_path / "t.trace.json")
+        doc = tr.export_chrome(path)
+        with open(path) as f:
+            loaded = json.load(f)
+        assert loaded == json.loads(json.dumps(doc))
+        assert loaded["displayTimeUnit"] == "ms"
+        for ev in loaded["traceEvents"]:
+            assert ev["ph"] in ("X", "i")
+            assert isinstance(ev["ts"], (int, float))
+            assert isinstance(ev["pid"], int)
+            assert isinstance(ev["tid"], int)
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0
+
+    def test_event_cap(self):
+        tr = Tracer()
+        tr.configure(True)
+        tr.max_events = 10
+        for i in range(20):
+            tr.instant("e", i=i)
+        assert len(tr.events()) == 10
+        assert tr.export_chrome()["otherData"]["droppedEvents"] == 10
+
+
+def _query_df(s, pdf_l, pdf_r):
+    return (s.create_dataframe(pdf_l, 4)
+            .join(s.create_dataframe(pdf_r, 2), on="k", how="inner")
+            .group_by("tag")
+            .agg(F.sum("v").alias("sv"), F.count("*").alias("n")))
+
+
+def test_query_trace_has_exec_shuffle_and_kernel_spans(session, rng,
+                                                       tmp_path):
+    """TPC-H-shaped query (scan -> join -> aggregate) with the accelerated
+    shuffle manager striped over 2 executors, traced end to end: the
+    export must json.load and contain exec-operator, shuffle-fetch and
+    kernel-cache spans."""
+    n = 4000
+    left = pd.DataFrame({"k": rng.integers(0, 40, n).astype(np.int64),
+                         "v": rng.random(n) * 100.0})
+    right = pd.DataFrame({"k": np.arange(40, dtype=np.int64),
+                          "tag": np.array(["t%d" % (i % 7)
+                                           for i in range(40)])})
+    path = str(tmp_path / "query.trace.json")
+    session.set_conf("spark.rapids.sql.enabled", True)
+    session.set_conf("spark.rapids.tpu.trace.path", path)
+    session.set_conf("spark.rapids.shuffle.transport.enabled", True)
+    session.set_conf("spark.rapids.shuffle.executors", 2)
+    session.set_conf("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    try:
+        out = _query_df(session, left, right).collect()
+        assert len(out) > 0
+    finally:
+        # the striped 2-executor pool must not leak into later tests
+        if session._shuffle_env is not None:
+            for env in session._shuffle_env:
+                env.close()
+            session._shuffle_env = None
+    with open(path) as f:
+        doc = json.load(f)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert any(nm.startswith("Tpu") for nm in names), names
+    assert "shuffle.fetch" in names, names
+    assert any(nm.startswith("kernelcache.") for nm in names), names
+    assert "Query" in names
+    # tracer window is per query: a second query overwrites the file
+    session.create_dataframe(left.head(10), 1).collect()
+    with open(path) as f:
+        doc2 = json.load(f)
+    assert not any(e["name"] == "shuffle.fetch"
+                   for e in doc2["traceEvents"])
+
+
+def test_profile_report(session, rng):
+    n = 2000
+    pdf = pd.DataFrame({"k": rng.integers(0, 10, n).astype(np.int64),
+                        "v": rng.random(n)})
+    session.set_conf("spark.rapids.sql.enabled", True)
+    df = (session.create_dataframe(pdf, 2).filter(F.col("v") > 0.1)
+          .group_by("k").agg(F.sum("v").alias("sv")))
+    df.collect()
+    text = session.profile_report()
+    assert "incl" in text and "excl" in text
+    assert "Tpu" in text
+    doc = session.profile_json()
+    json.dumps(doc)  # machine shape is JSON-serializable
+    assert doc["version"] == 1
+    assert doc["wall_s"] > 0
+
+    def walk(node):
+        yield node
+        for c in node["children"]:
+            yield from walk(c)
+    nodes = list(walk(doc["plan"]))
+    assert any(n["op"].startswith("Tpu") for n in nodes)
+    for nd in nodes:
+        assert nd["exclusive_s"] <= nd["inclusive_s"] + 1e-9
+    # root inclusive covers the whole tree's exclusive time
+    root = doc["plan"]
+    assert root["inclusive_s"] <= doc["wall_s"] + 1e-6
+
+
+def test_profile_disabled_with_metrics(session, rng):
+    session.set_conf("spark.rapids.sql.metrics.enabled", False)
+    try:
+        pdf = pd.DataFrame({"x": np.arange(10, dtype=np.int64)})
+        session.create_dataframe(pdf, 1).filter(F.col("x") > 3).collect()
+        assert session.profile_json() is None
+        assert session.profile_report() == ""
+    finally:
+        session.set_conf("spark.rapids.sql.metrics.enabled", True)
+
+
+def test_trace_summary_tool(tmp_path, capsys, session, rng):
+    """tools/trace_summary.py import+run smoke on both artifact kinds."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "trace_summary.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    tr = Tracer()
+    tr.configure(True)
+    with tr.span("TpuProjectExec", op="p"):
+        with tr.span("TpuScanExec", op="s"):
+            pass
+    tr.instant("shuffle.fetch.retry", peer="x")
+    tpath = str(tmp_path / "t.trace.json")
+    tr.export_chrome(tpath)
+    assert mod.main([tpath, "-n", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "TpuProjectExec" in out
+    assert "shuffle.fetch.retry: 1" in out
+
+    pdf = pd.DataFrame({"k": np.arange(100, dtype=np.int64) % 4,
+                        "v": rng.random(100)})
+    (session.create_dataframe(pdf, 2).group_by("k")
+     .agg(F.sum("v").alias("sv"))).collect()
+    ppath = str(tmp_path / "q.profile.json")
+    session.last_profile.save(ppath)
+    assert mod.main([ppath]) == 0
+    out = capsys.readouterr().out
+    assert "operator" in out
+
+
+def test_disabled_metrics_no_wrapping(session):
+    """Overhead contract: metrics + tracing off -> executed_partitions
+    returns the operator's raw partitions untouched."""
+    from spark_rapids_tpu.exec.base import ExecContext, PhysicalPlan
+
+    sentinel = [lambda: iter(())]
+
+    class P(PhysicalPlan):
+        def partitions(self, ctx):
+            return sentinel
+
+    session.set_conf("spark.rapids.sql.metrics.enabled", False)
+    try:
+        ctx = ExecContext(session.conf, None)
+        assert not TRACER.enabled
+        assert P().executed_partitions(ctx) is sentinel
+    finally:
+        session.set_conf("spark.rapids.sql.metrics.enabled", True)

@@ -1,0 +1,127 @@
+"""Summarize an observability artifact: top exclusive-time spans + event
+counts.
+
+Reads either artifact the obs/ subsystem emits:
+
+  * a Chrome trace JSON (``spark.rapids.tpu.trace.path`` export) — computes
+    per-span exclusive time (duration minus directly-nested child spans on
+    the same thread), aggregates by span name, and counts instant events
+    (fetch retries, transport drops);
+  * a per-query profile JSON (``session.profile_json()`` /
+    ``docs/bench_profiles/*.profile.json``) — walks the plan tree for
+    exclusive operator time and prints the spill/shuffle/kernel-cache
+    summary sections.
+
+Usage:
+    python tools/trace_summary.py FILE [-n TOP_N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def _exclusive_times(events: List[Dict[str, Any]]) -> Dict[str, List[float]]:
+    """name -> list of exclusive durations (seconds), from "X" events.
+    Spans nest per thread; a sweep with a stack attributes each span's
+    child time to its innermost enclosing span."""
+    out: Dict[str, List[float]] = {}
+    by_tid: Dict[Any, List[Dict[str, Any]]] = {}
+    for ev in events:
+        if ev.get("ph") == "X" and "dur" in ev:
+            by_tid.setdefault(ev.get("tid"), []).append(ev)
+    for evs in by_tid.values():
+        # children start at or after the parent and end no later; sorting
+        # by (start, -dur) yields parents before their children
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: List[Dict[str, Any]] = []  # open spans, with child_us accum
+        for ev in evs:
+            end = ev["ts"] + ev["dur"]
+            while stack and stack[-1]["ts"] + stack[-1]["dur"] <= ev["ts"]:
+                done = stack.pop()
+                out.setdefault(done["name"], []).append(
+                    max(done["dur"] - done.get("_child_us", 0.0), 0.0) / 1e6)
+            if stack:
+                stack[-1]["_child_us"] = (stack[-1].get("_child_us", 0.0)
+                                          + ev["dur"])
+            stack.append(dict(ev, _end=end))
+        while stack:
+            done = stack.pop()
+            out.setdefault(done["name"], []).append(
+                max(done["dur"] - done.get("_child_us", 0.0), 0.0) / 1e6)
+    return out
+
+
+def _summarize_trace(doc: Dict[str, Any], top_n: int) -> None:
+    events = doc.get("traceEvents", [])
+    excl = _exclusive_times(events)
+    rows = sorted(((sum(v), len(v), name) for name, v in excl.items()),
+                  reverse=True)
+    print(f"{'exclusive_s':>12}  {'count':>6}  span")
+    for total, count, name in rows[:top_n]:
+        print(f"{total:12.4f}  {count:6d}  {name}")
+    instants: Dict[str, int] = {}
+    for ev in events:
+        if ev.get("ph") == "i":
+            instants[ev["name"]] = instants.get(ev["name"], 0) + 1
+    if instants:
+        print("-- events")
+        for name, n in sorted(instants.items()):
+            print(f"  {name}: {n}")
+    dropped = doc.get("otherData", {}).get("droppedEvents")
+    if dropped:
+        print(f"-- WARNING: {dropped} events dropped (tracer cap)")
+
+
+def _walk_profile(node: Dict[str, Any],
+                  acc: List[Dict[str, Any]]) -> None:
+    acc.append(node)
+    for c in node.get("children", []):
+        _walk_profile(c, acc)
+
+
+def _summarize_profile(doc: Dict[str, Any], top_n: int) -> None:
+    nodes: List[Dict[str, Any]] = []
+    _walk_profile(doc["plan"], nodes)
+    nodes.sort(key=lambda n: n.get("exclusive_s", 0.0), reverse=True)
+    if "wall_s" in doc:
+        print(f"query wall: {doc['wall_s']:.3f}s")
+    print(f"{'exclusive_s':>12}  {'rows':>10}  {'batches':>7}  operator")
+    for n in nodes[:top_n]:
+        print(f"{n.get('exclusive_s', 0.0):12.4f}  "
+              f"{n.get('rows', 0):10d}  {n.get('batches', 0):7d}  "
+              f"{n['op']}")
+    for section, vals in doc.get("summary", {}).items():
+        if not vals:
+            continue
+        print(f"-- {section}")
+        for k, v in sorted(vals.items()):
+            print(f"  {k}: {v}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Top exclusive-time spans and event counts of a trace "
+                    "or profile JSON")
+    ap.add_argument("file", help="Chrome trace JSON or profile JSON")
+    ap.add_argument("-n", "--top", type=int, default=15,
+                    help="rows to print (default 15)")
+    args = ap.parse_args(argv)
+    with open(args.file) as f:
+        doc = json.load(f)
+    if "traceEvents" in doc:
+        _summarize_trace(doc, args.top)
+    elif "plan" in doc:
+        _summarize_profile(doc, args.top)
+    else:
+        print("unrecognized artifact: expected 'traceEvents' (Chrome "
+              "trace) or 'plan' (profile JSON) key", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
