@@ -159,6 +159,7 @@ class DeviceBatch:
                     schema: Optional[Schema] = None,
                     dict_encode: bool = True,
                     dict_state: Optional[dict] = None,
+                    dict_numerics: bool = True,
                     device=None) -> "DeviceBatch":
         """Host -> device transition (reference: GpuRowToColumnarExec /
         HostColumnarToGpu, GpuRowToColumnarExec.scala:45-502).
@@ -174,6 +175,11 @@ class DeviceBatch:
             schema = Schema.from_pandas(df)
         n = len(df)
         cap = capacity if capacity is not None else bucket_capacity(n)
+        # per-column factorize hints precomputed by the scan pipeline's
+        # decode workers (sources._attach_dict_hints), keyed by column
+        # name; only trusted when the frame was not re-chunked since
+        hints = getattr(df, "attrs", None)
+        hints = hints.get("srt_dict_fact") if hints else None
         # build every column's device-layout buffers host-side, then ship
         # the whole batch in ONE device_put (per-buffer uploads each pay a
         # round trip on remote attachments)
@@ -183,9 +189,17 @@ class DeviceBatch:
         for i, dt in enumerate(schema.dtypes):
             values, validity = _pandas_to_numpy(df.iloc[:, i], dt)
             bufs = DeviceColumn.build_host_buffers(values, validity, dt, cap)
+            fact = hints.get(str(df.columns[i])) if hints else None
+            if fact is not None and len(fact[0]) != n:
+                fact = None
+            # ``dict_numerics=False`` (file-scan uploads): only string
+            # columns are dictionary-probed — the numeric probe+encode is
+            # an element-wise pass per column per batch on the upload hot
+            # path, and integer grouping keys ride the dense-key path
+            # (spark.rapids.sql.agg.denseKeys) instead of dictionaries
             enc = host_dict_encode_stateful(values, validity, dt, cap,
-                                            dict_state, i) \
-                if dict_encode else None
+                                            dict_state, i, fact=fact) \
+                if dict_encode and (dict_numerics or dt.is_string) else None
             if enc is not None and dt.is_string:
                 # only pay the slab scan when a dictionary was actually
                 # built (high-cardinality columns already bailed at the
@@ -533,7 +547,15 @@ def _pandas_to_numpy(s: pd.Series, dt: DType) -> Tuple[np.ndarray, np.ndarray]:
             return vals.astype(np.int64).astype(np.int32), validity
         return s.to_numpy(dtype=np.int32, na_value=0), validity
     if dt == dtypes.TIMESTAMP_US:
-        if str(s.dtype).startswith("datetime64") or str(s.dtype) == "object":
+        if str(s.dtype).startswith("datetime64"):
+            # already datetime64: unit-cast directly — pd.to_datetime on
+            # an existing datetime column pays a should_cache element
+            # sweep per batch, pure overhead on the scan upload hot path
+            out = s.to_numpy(dtype="datetime64[us]").astype(np.int64)
+            if not validity.all():
+                out = np.where(validity, out, 0)
+            return out, validity
+        if str(s.dtype) == "object":
             vals = pd.to_datetime(s).to_numpy(dtype="datetime64[us]")
             out = vals.astype(np.int64)
             out = np.where(validity, out, 0)

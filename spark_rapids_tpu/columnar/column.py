@@ -282,11 +282,17 @@ class DeviceColumn:
             return (chars, vpad, offsets, prefix8)
 
         fill = dtypes.null_fill_value(dtype)
-        dpad = np.full(capacity, fill, dtype=dtype.np_dtype)
         vals = np.asarray(values, dtype=dtype.np_dtype)
-        # canonicalize nulls to the fill value so device math is deterministic
-        vals = np.where(validity[:n], vals, np.asarray(fill, dtype=dtype.np_dtype))
+        dpad = np.empty(capacity, dtype=dtype.np_dtype)
         dpad[:n] = vals
+        dpad[n:] = fill
+        # canonicalize nulls to the fill value so device math is
+        # deterministic; the all-valid scan hot path skips the rewrite
+        # (np.full + np.where paid two extra full-column passes here)
+        v = validity[:n]
+        if not v.all():
+            np.copyto(dpad[:n], np.asarray(fill, dtype=dtype.np_dtype),
+                      where=~v)
         return (dpad, vpad)
 
     # --- host access -------------------------------------------------------
@@ -398,27 +404,55 @@ def string_host_buffers_have_nul(bufs, n: int) -> bool:
     return bool(used and (chars[:used] == 0).any())
 
 
-def host_dict_encode(values: np.ndarray, validity: Optional[np.ndarray],
-                     dtype: DType, capacity: int):
-    """Host-side dictionary probe+encode of a column being uploaded.
+def dict_factorize_hint(values, is_string: bool):
+    """Cardinality probe + full-column factorize, precomputed OFF the
+    consuming task thread by the scan pipeline's decode workers
+    (sql/scan_pipeline.py) and attached to decoded frames
+    (``df.attrs["srt_dict_fact"]``). The per-batch dictionary encode was
+    the largest single consumer-side upload cost (an element-wise
+    searchsorted per low-cardinality column per batch); with the hint,
+    ``host_dict_encode_stateful`` only remaps the ~cardinality uniques.
 
-    Returns (codes int32 (capacity,), values tuple) or None. Codes are in
-    [0, card] with card = NULL/padding; ``values`` is sorted so identical
-    value SETS across batches produce identical (compile-key) dictionaries.
-    """
+    Returns (codes (n,), uniques) or None when the column is not a
+    dictionary candidate."""
     import pandas as pd
     n = len(values)
     if n == 0:
         return None
     probe = values[:_DICT_PROBE]
     try:
-        nu = pd.unique(probe[~pd.isna(probe)] if dtype.is_string
-                       else probe)
+        nu = pd.unique(probe[~pd.isna(probe)] if is_string else probe)
     except TypeError:
         return None
     if len(nu) > DICT_MAX_CARD or len(nu) > max(64, len(probe) // 4):
         return None
-    codes, uniques = pd.factorize(values, use_na_sentinel=True)
+    try:
+        codes, uniques = pd.factorize(values, use_na_sentinel=True)
+    except TypeError:
+        return None
+    if len(uniques) > DICT_MAX_CARD or len(uniques) == 0:
+        return None
+    return codes, uniques
+
+
+def host_dict_encode(values: np.ndarray, validity: Optional[np.ndarray],
+                     dtype: DType, capacity: int, fact=None):
+    """Host-side dictionary probe+encode of a column being uploaded.
+
+    Returns (codes int32 (capacity,), values tuple) or None. Codes are in
+    [0, card] with card = NULL/padding; ``values`` is sorted so identical
+    value SETS across batches produce identical (compile-key) dictionaries.
+    ``fact``: precomputed (codes, uniques) from ``dict_factorize_hint``
+    (skips the probe + factorize here).
+    """
+    n = len(values)
+    if n == 0:
+        return None
+    if fact is None:
+        fact = dict_factorize_hint(values, dtype.is_string)
+        if fact is None:
+            return None
+    codes, uniques = fact
     card = len(uniques)
     if card > DICT_MAX_CARD or card == 0:
         return None
@@ -465,19 +499,21 @@ def host_dict_encode(values: np.ndarray, validity: Optional[np.ndarray],
 def host_dict_encode_stateful(values: np.ndarray,
                               validity: Optional[np.ndarray], dtype: DType,
                               capacity: int, state: Optional[dict],
-                              key) -> Optional[tuple]:
+                              key, fact=None) -> Optional[tuple]:
     """host_dict_encode with a per-scan registry: the FIRST batch of a scan
     establishes the dictionary and every later batch encodes against it,
     so all batches of one scan share one static dictionary (one compiled
     aggregation program, no per-batch retraces). A later batch holding a
     value outside the established dictionary switches the column off for
     the remainder of the scan (bounded structure churn: at most two
-    program shapes per scan)."""
+    program shapes per scan). ``fact``: precomputed (codes, uniques) from
+    ``dict_factorize_hint`` — later batches then pay only an
+    O(cardinality) remap here instead of an element-wise searchsorted."""
     st = state.get(key) if state is not None else None
     if st is False:
         return None
     if st is None:
-        enc = host_dict_encode(values, validity, dtype, capacity)
+        enc = host_dict_encode(values, validity, dtype, capacity, fact=fact)
         if state is not None:
             state[key] = enc[1] if enc is not None else False
         return enc
@@ -490,6 +526,31 @@ def host_dict_encode_stateful(values: np.ndarray,
                      dtype=object if dtype.is_string else dtype.np_dtype)
     need = (np.asarray(validity[:n], dtype=bool) if validity is not None
             else np.ones(n, dtype=bool))
+    if fact is not None:
+        codes2, uniq2 = fact
+        try:
+            u = np.asarray(uniq2,
+                           dtype=object if dtype.is_string
+                           else dtype.np_dtype)
+            idx = np.searchsorted(arr, u)
+        except (TypeError, ValueError):
+            state[key] = False
+            return None
+        idx_c = np.clip(idx, 0, card - 1)
+        ok_u = arr[idx_c] == u
+        # remap table over the batch's OWN uniques (+1 slot for the
+        # factorize NA sentinel); -1 marks a value outside the
+        # established dictionary
+        remap = np.empty(len(u) + 1, dtype=np.int32)
+        remap[:len(u)] = np.where(ok_u, idx_c, -1)
+        remap[len(u)] = -1
+        codes_n = np.asarray(codes2[:n])
+        c = remap[np.where(codes_n < 0, len(u), codes_n)]
+        if bool(((c < 0) & need).any()):
+            state[key] = False  # unseen value in a valid row
+            return None
+        out[:n] = np.where(need, c, card).astype(np.int32)
+        return out, st
     vals_n = np.asarray(values[:n],
                         dtype=object if dtype.is_string else dtype.np_dtype)
     # null slots may hold None/NaN fills that break object comparisons;

@@ -316,6 +316,61 @@ ORC_WRITE_ENABLED = register(
     "spark.rapids.sql.format.orc.write.enabled", _to_bool, True,
     "Enable accelerated ORC writes.")
 
+# --- scan pipeline (sql/scan_pipeline.py; the reference's MULTITHREADED/
+# COALESCING reader modes, GpuParquetScan + GpuMultiFileReader) -------------
+_non_negative = (lambda v: None if v >= 0
+                 else f"must be >= 0, got {v}")
+
+SCAN_PREFETCH_DEPTH = register(
+    "spark.rapids.sql.scan.prefetchDepth", int, 2,
+    "How many scan splits (Parquet row groups, ORC stripes, CSV files, "
+    "in-memory slices) may decode on the shared host pool AHEAD of the "
+    "consuming task, overlapping host decode with device upload/compute "
+    "(the reference's MULTITHREADED reader, GpuParquetScan). Also gates "
+    "the double-buffered upload in the host->device transition (batch "
+    "i+1's device_put dispatched while batch i computes). 0 selects the "
+    "LEGACY serial reader end to end (the reference's PERFILE mode "
+    "analogue): synchronous full arrow->pandas decode on the consuming "
+    "thread in strict pull order, pre-pipeline behavior exactly — the "
+    "safe rollback path.",
+    validator=_non_negative)
+
+SCAN_DECODE_THREADS = register(
+    "spark.rapids.sql.scan.decodeThreads", int, 0,
+    "Worker threads in the process-wide scan decode pool (pyarrow "
+    "releases the GIL, so decode genuinely overlaps python-side "
+    "upload/compute). 0 = auto: min(4, max(2, cpu_count - 1)), leaving "
+    "a core for the consuming task thread.",
+    validator=_non_negative)
+
+SCAN_PREFETCH_MAX_BYTES = register(
+    "spark.rapids.sql.scan.prefetchMaxBytes", _to_bytes, 256 << 20,
+    "Host-memory budget for decoded-but-unconsumed prefetched frames "
+    "across one scan; submission stalls past it (clamped to "
+    "spark.rapids.memory.host.spillStorageSize so prefetch never "
+    "outgrows the spill framework's own host budget).")
+
+SCAN_DICT_NUMERICS = register(
+    "spark.rapids.sql.scan.dictEncodeNumerics", _to_bool, False,
+    "Dictionary-probe NUMERIC columns on FILE-scan uploads. Off by "
+    "default: the probe + per-batch encode cost an element-wise pass "
+    "per column per batch on the scan upload hot path, integer grouping "
+    "keys already ride the dense-key path "
+    "(spark.rapids.sql.agg.denseKeys), and float dictionary keys are "
+    "rare. String columns are always probed, and in-memory uploads keep "
+    "full probing (their small-table dictionaries pre-seed the "
+    "aggregation fast path).")
+
+SCAN_DIRECT_DECODE = register(
+    "spark.rapids.sql.scan.directDecode", _to_bool, True,
+    "Arrow->numpy direct decode for non-nullable primitive (int/float/"
+    "bool) columns, skipping the pandas nullable-extension "
+    "materialization on the scan hot path; columns with nulls, strings, "
+    "dates and dictionaries fall back to the full arrow->pandas "
+    "conversion. Value-identical either way. Part of the pipelined "
+    "reader: ignored when spark.rapids.sql.scan.prefetchDepth is 0 (the "
+    "legacy reader keeps the full conversion).")
+
 # --- test hooks (ref RapidsConf.scala:476-501) -----------------------------
 TEST_ENABLED = register(
     "spark.rapids.sql.test.enabled", _to_bool, False,

@@ -889,64 +889,17 @@ class TpuScanExec(TpuExec):
         mesh = getattr(ctx.session, "mesh", None) if ctx.session else None
         mesh_devs = list(mesh.devices.flat) if mesh is not None else None
 
+        from spark_rapids_tpu.exec.transitions import (
+            scan_dict_numerics, upload_partition,
+        )
+        dict_numerics = scan_dict_numerics(ctx, self.source)
+
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
-                from spark_rapids_tpu.exec import taskctx
-                sem = ctx.session.semaphore if ctx.session else None
-                if sem is not None:
-                    sem.acquire_if_necessary()
-                if cache is not None and i in cache:
-                    # replay with each batch's origin file restored so
-                    # input_file_name() stays correct on cache hits; the
-                    # catalog faults spilled batches back to the device
-                    catalog = ctx.session.buffer_catalog
-                    for fname, bid in cache[i]:
-                        taskctx.set_input_file(fname)
-                        yield catalog.acquire_batch(bid)
-                    taskctx.clear_input_file()
-                    return
-                out = [] if cache is not None else None
-                dm = ctx.session.device_manager if ctx.session else None
-                try:
-                    for df in part():
-                        from spark_rapids_tpu.exec.transitions import (
-                            note_scan_stats,
-                        )
-                        note_scan_stats(ctx.session, df)
-                        for lo in range(0, max(len(df), 1), max_rows):
-                            chunk = df.iloc[lo:lo + max_rows]
-                            batch = DeviceBatch.from_pandas(
-                                chunk.reset_index(drop=True), schema=schema,
-                                dict_state=dict_state,
-                                device=(mesh_devs[i % len(mesh_devs)]
-                                        if mesh_devs else None))
-                            if out is not None:
-                                # cached batches live in the spillable
-                                # catalog (budget-metered, evictable)
-                                from spark_rapids_tpu.memory.spill import (
-                                    SpillPriorities,
-                                )
-                                bid = ctx.session.buffer_catalog.add_batch(
-                                    batch, SpillPriorities.CACHED_SCAN)
-                                out.append((taskctx.input_file(), bid))
-                            elif dm is not None:
-                                dm.meter_batch(batch)
-                            yield batch
-                    if out is not None:
-                        if i in cache:  # concurrent filler won the publish
-                            out, published = None, out
-                            for _f, bid in published:
-                                ctx.session.buffer_catalog.remove(bid)
-                        else:
-                            cache[i] = out
-                except BaseException:
-                    # abandoned/failed scan: unpublished bids would leak
-                    # catalog buffers forever (clear_device_cache only
-                    # walks published entries)
-                    if out is not None and cache.get(i) is not out:
-                        for _f, bid in out:
-                            ctx.session.buffer_catalog.remove(bid)
-                    raise
+                return upload_partition(ctx, part, schema, max_rows,
+                                        dict_state, cache, i,
+                                        mesh_devs=mesh_devs,
+                                        dict_numerics=dict_numerics)
             return run
         return [make(i, p) for i, p in enumerate(cpu_parts)]
 

@@ -10,10 +10,9 @@ from __future__ import annotations
 
 from typing import Iterator, List
 
-import jax.numpy as jnp
 import pandas as pd
 
-from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema, bucket_capacity
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
 from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
 
 
@@ -66,6 +65,151 @@ def note_scan_stats(session, df: pd.DataFrame) -> None:
         reg[str(name)] = (lo, hi)
 
 
+def scan_dict_numerics(ctx: ExecContext, source) -> bool:
+    """Whether file-scan uploads dictionary-probe NUMERIC columns
+    (spark.rapids.sql.scan.dictEncodeNumerics, default off with the
+    pipelined reader): the probe + per-batch encode cost an element-wise
+    pass per column on the scan upload hot path, integer grouping keys
+    already ride the dense-key path, and float dictionary keys are rare.
+    In-memory uploads keep full probing — their small-table dictionaries
+    pre-seed the aggregation fast path (TpuScanExec) and upload once per
+    session. The legacy serial reader (prefetchDepth=0) also keeps full
+    probing: the rollback path reproduces pre-pipeline behavior exactly."""
+    if source is None or not hasattr(source, "paths"):
+        return True
+    if int(ctx.conf.get("spark.rapids.sql.scan.prefetchDepth", 2) or 0) \
+            <= 0:
+        return True
+    return ctx.conf.get_bool("spark.rapids.sql.scan.dictEncodeNumerics",
+                             False)
+
+
+def upload_partition(ctx: ExecContext, part: Partition, schema: Schema,
+                     max_rows: int, dict_state: dict, cache, i: int,
+                     mesh_devs=None, is_scan: bool = True,
+                     dict_numerics: bool = True) -> Iterator[DeviceBatch]:
+    """Shared host->device upload runner for TpuScanExec and
+    HostToDeviceExec: pandas frames from ``part`` -> chunked, capacity-
+    bucketed DeviceBatches, with device-scan-cache replay/fill and HBM
+    metering.
+
+    With the scan pipeline on (spark.rapids.sql.scan.prefetchDepth > 0)
+    uploads are DOUBLE-BUFFERED: batch i+1's host buffer build +
+    ``device_put`` are dispatched before batch i is yielded, so the
+    transfer commits while the consumer computes on batch i. Each yielded
+    batch re-publishes ITS origin file to the task context right before
+    the yield — the read-ahead already moved the thread-local on.
+    prefetchDepth=0 keeps the strict pull-driven serial order.
+    """
+    from spark_rapids_tpu.exec import taskctx
+    from spark_rapids_tpu.obs.trace import TRACER
+    sem = ctx.session.semaphore if ctx.session else None
+    if sem is not None:
+        sem.acquire_if_necessary()
+    if cache is not None and i in cache:
+        # replay with each batch's origin file restored so
+        # input_file_name() stays correct on cache hits; the catalog
+        # faults spilled batches back to the device
+        catalog = ctx.session.buffer_catalog
+        for fname, bid in cache[i]:
+            taskctx.set_input_file(fname)
+            yield catalog.acquire_batch(bid)
+        taskctx.clear_input_file()
+        return
+    out = [] if cache is not None else None
+    dm = ctx.session.device_manager if ctx.session else None
+    double_buffer = int(ctx.conf.get(
+        "spark.rapids.sql.scan.prefetchDepth", 2) or 0) > 0
+
+    def uploads():
+        for df in part():
+            if is_scan:
+                note_scan_stats(ctx.session, df)
+            fname = taskctx.input_file()
+            for lo in range(0, max(len(df), 1), max_rows):
+                if double_buffer and lo == 0 and len(df) <= max_rows:
+                    # whole-frame chunk: decode already produced a fresh
+                    # RangeIndex frame; the reset_index copy is pure cost
+                    # on the upload hot path (legacy reader keeps it —
+                    # rollback reproduces the old path exactly)
+                    chunk = df
+                else:
+                    chunk = df.iloc[lo:lo + max_rows].reset_index(drop=True)
+                    hints = getattr(df, "attrs", {}).get("srt_dict_fact")
+                    if hints:
+                        # re-chunked split: slice the worker's factorize
+                        # hints positionally so they survive (from_pandas
+                        # drops length-mismatched hints)
+                        chunk.attrs["srt_dict_fact"] = {
+                            nm: (codes[lo:lo + max_rows], u)
+                            for nm, (codes, u) in hints.items()}
+                with TRACER.span("scan.upload", partition=i,
+                                 rows=len(chunk)):
+                    batch = DeviceBatch.from_pandas(
+                        chunk, schema=schema, dict_state=dict_state,
+                        dict_numerics=dict_numerics,
+                        device=(mesh_devs[i % len(mesh_devs)]
+                                if mesh_devs else None))
+                yield fname, batch
+
+    def account(fname: str, batch: DeviceBatch) -> None:
+        if out is not None:
+            # cached batches live in the spillable catalog
+            # (budget-metered, evictable)
+            from spark_rapids_tpu.memory.spill import SpillPriorities
+            bid = ctx.session.buffer_catalog.add_batch(
+                batch, SpillPriorities.CACHED_SCAN)
+            out.append((fname, bid))
+        elif dm is not None:
+            dm.meter_batch(batch)
+
+    try:
+        gen = uploads()
+        if double_buffer:
+            # dispatch the NEXT chunk's host build + device_put before
+            # handing the current batch downstream: device_put is async,
+            # so the transfer commits while the consumer computes, and
+            # the decode prefetcher keeps feeding the next splits
+            # meanwhile. (An off-thread upload step was measured SLOWER
+            # here: host buffer building is GIL/core-bound and a fourth
+            # thread just thrashes the decode pool on small boxes.)
+            # The CURRENT batch is metered/cataloged BEFORE the next
+            # build so the read-ahead never holds more than one
+            # unmetered batch — metering can trigger synchronous spill,
+            # and budget enforcement must see batch i before i+1's
+            # device_put allocates.
+            pending = next(gen, None)
+            while pending is not None:
+                fname, batch = pending
+                account(fname, batch)
+                nxt = next(gen, None)
+                taskctx.set_input_file(fname)
+                yield batch
+                pending = nxt
+        else:
+            for fname, batch in gen:
+                account(fname, batch)
+                taskctx.set_input_file(fname)
+                yield batch
+        if out is not None:
+            if i in cache:  # concurrent filler won the publish
+                out, published = None, out
+                for _f, bid in published:
+                    ctx.session.buffer_catalog.remove(bid)
+            else:
+                cache[i] = out
+    except BaseException:
+        # abandoned/failed scan: unpublished bids would leak catalog
+        # buffers forever (clear_device_cache only walks published
+        # entries)
+        if out is not None and cache.get(i) is not out:
+            for _f, bid in out:
+                ctx.session.buffer_catalog.remove(bid)
+        raise
+    finally:
+        taskctx.clear_input_file()
+
+
 class HostToDeviceExec(PhysicalPlan):
     """pandas partition chunks -> DeviceBatch, chunked to the conf'd batch
     size and padded to capacity buckets."""
@@ -98,54 +242,15 @@ class HostToDeviceExec(PhysicalPlan):
         # (see TpuScanExec: bounds program-shape churn to one dict/scan)
         dict_state: dict = {}
 
+        dict_numerics = scan_dict_numerics(
+            ctx, getattr(child, "source", None)) if is_scan else True
+
         def make(i: int, part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
-                from spark_rapids_tpu.exec import taskctx
-                sem = ctx.session.semaphore if ctx.session else None
-                if sem is not None:
-                    sem.acquire_if_necessary()
-                if cache is not None and i in cache:
-                    catalog = ctx.session.buffer_catalog
-                    for fname, bid in cache[i]:
-                        taskctx.set_input_file(fname)
-                        yield catalog.acquire_batch(bid)
-                    taskctx.clear_input_file()
-                    return
-                out = [] if cache is not None else None
-                dm = ctx.session.device_manager if ctx.session else None
-                try:
-                    for df in part():
-                        if is_scan:
-                            note_scan_stats(ctx.session, df)
-                        for lo in range(0, max(len(df), 1), max_rows):
-                            chunk = df.iloc[lo:lo + max_rows]
-                            batch = DeviceBatch.from_pandas(
-                                chunk.reset_index(drop=True), schema=schema,
-                                dict_state=dict_state)
-                            if out is not None:
-                                from spark_rapids_tpu.memory.spill import (
-                                    SpillPriorities,
-                                )
-                                bid = ctx.session.buffer_catalog.add_batch(
-                                    batch, SpillPriorities.CACHED_SCAN)
-                                out.append((taskctx.input_file(), bid))
-                            elif dm is not None:
-                                dm.meter_batch(batch)
-                            yield batch
-                    if out is not None:
-                        if i in cache:  # concurrent filler won the publish
-                            out, published = None, out
-                            for _f, bid in published:
-                                ctx.session.buffer_catalog.remove(bid)
-                        else:
-                            cache[i] = out
-                except BaseException:
-                    # abandoned/failed scan: unpublished bids would leak
-                    # catalog buffers forever
-                    if out is not None and cache.get(i) is not out:
-                        for _f, bid in out:
-                            ctx.session.buffer_catalog.remove(bid)
-                    raise
+                return upload_partition(ctx, part, schema, max_rows,
+                                        dict_state, cache, i,
+                                        is_scan=is_scan,
+                                        dict_numerics=dict_numerics)
             return run
         return [make(i, p) for i, p in enumerate(child_parts)]
 

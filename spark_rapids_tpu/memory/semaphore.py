@@ -20,6 +20,23 @@ class TpuSemaphore:
         self._sem = threading.Semaphore(permits)
         self._holders: Dict[int, int] = {}  # task id -> acquire count
         self._state_lock = threading.Lock()
+        self._holders_gauge = None  # resolved lazily, once
+
+    def _publish_locked(self) -> None:
+        """Mirror the holder count into the process-wide registry
+        (semaphore.holders gauge) so the scan pipeline's queue-depth view
+        and profile reports see device-admission pressure without polling.
+        Caller holds self._state_lock."""
+        if self._holders_gauge is None:
+            from spark_rapids_tpu.obs.metrics import REGISTRY
+            self._holders_gauge = REGISTRY.gauge("semaphore.holders")
+        self._holders_gauge.set(len(self._holders))
+
+    def available_permits(self) -> int:
+        """Permits not currently held by any task thread (introspection
+        for tests and backpressure diagnostics)."""
+        with self._state_lock:
+            return max(self.permits - len(self._holders), 0)
 
     @classmethod
     def get(cls, permits: int) -> "TpuSemaphore":
@@ -49,11 +66,13 @@ class TpuSemaphore:
                 .record(time.perf_counter() - t0)
         with self._state_lock:
             self._holders[tid] = 1
+            self._publish_locked()
 
     def release(self, task_id: Optional[int] = None) -> None:
         tid = task_id if task_id is not None else threading.get_ident()
         with self._state_lock:
             held = self._holders.pop(tid, 0)
+            self._publish_locked()
         if held:
             self._sem.release()
 

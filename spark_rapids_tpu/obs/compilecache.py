@@ -1,0 +1,76 @@
+"""Persistent-compile-cache attribution: jax monitoring -> metrics registry.
+
+Round-5 grading burned 559.5s of first-run warmup in XLA compiles with no
+first-class attribution — warmup cost hid inside per-query wall time. jax
+emits monitoring events for both the backend compiler and the persistent
+executable cache (enabled on accelerated backends by
+``enable_persistent_cache_if_accelerated``, package __init__); this module
+mirrors them into the process-wide registry (obs/metrics.py REGISTRY) so
+warmup shows up per query in ``session.profile_report()`` (the
+``compileCache`` summary section, obs/profile.py) and in
+``tools/trace_summary.py``'s warmup-attribution line:
+
+    compileCache.backendCompiles / backendCompileTime  — XLA compiles that
+        actually ran (cache misses end up here)
+    compileCache.persistentHits / persistentMisses     — persistent-cache
+        lookups (a hit skips the backend compile entirely)
+    compileCache.timeSaved                              — compile seconds
+        the persistent cache avoided (jax's own estimate)
+    compileCache.retrievalTime                          — time spent
+        deserializing cached executables
+
+Listeners are process-wide and registered once (jax keeps them for the
+interpreter's lifetime); ``install()`` is idempotent and called at session
+construction.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_LOCK = threading.Lock()
+_installed = False
+
+
+def install() -> bool:
+    """Register the jax monitoring listeners once. Returns True when the
+    listeners are active (already-installed counts)."""
+    global _installed
+    with _LOCK:
+        if _installed:
+            return True
+        try:
+            from jax import monitoring
+        except ImportError:  # pragma: no cover - jax is a hard dep
+            return False
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+
+        hits = REGISTRY.counter("compileCache.persistentHits")
+        misses = REGISTRY.counter("compileCache.persistentMisses")
+        requests = REGISTRY.counter("compileCache.requests")
+        compiles = REGISTRY.counter("compileCache.backendCompiles")
+        compile_time = REGISTRY.timer("compileCache.backendCompileTime")
+        saved = REGISTRY.timer("compileCache.timeSaved")
+        retrieval = REGISTRY.timer("compileCache.retrievalTime")
+
+        def on_event(name: str, **kw) -> None:
+            if name == "/jax/compilation_cache/cache_hits":
+                hits.add(1)
+            elif name == "/jax/compilation_cache/cache_misses":
+                misses.add(1)
+            elif name == "/jax/compilation_cache/compile_requests_use_cache":
+                requests.add(1)
+
+        def on_duration(name: str, secs: float, **kw) -> None:
+            if "backend_compile" in name:
+                compiles.add(1)
+                compile_time.record(secs)
+            elif "compile_time_saved" in name:
+                saved.record(secs)
+            elif "cache_retrieval_time" in name:
+                retrieval.record(secs)
+
+        monitoring.register_event_listener(on_event)
+        monitoring.register_event_duration_secs_listener(on_duration)
+        _installed = True
+        return True

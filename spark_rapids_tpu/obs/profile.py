@@ -60,6 +60,15 @@ def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
     summary["spill"] = take("spill.")
     summary["shuffle"] = take("shuffle.")
     summary["kernelCache"] = take("kernelCache.")
+    summary["scan"] = take("scan.")
+    summary["compileCache"] = take("compileCache.")
+    if summary["scan"]:
+        # gauges are state, not flow — excluded from the delta, but the
+        # pipeline's depth gauges are exactly what a scan profile needs
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        for m in REGISTRY.metrics():
+            if m.kind == "gauge" and m.name.startswith("scan.prefetch."):
+                summary["scan"].setdefault(m.name, m.value)
     if delta:
         summary["other"] = delta
     mem = op_metrics.get("memory")

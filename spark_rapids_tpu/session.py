@@ -77,6 +77,11 @@ class TpuSparkSession:
         )
         self.device_manager = TpuDeviceManager.get(conf)
         self.semaphore = TpuSemaphore.get(conf.concurrent_tpu_tasks)
+        # persistent-compile-cache hit/miss counters (obs/compilecache.py):
+        # registered once per process so first-run warmup attribution is
+        # first-class in profile reports
+        from spark_rapids_tpu.obs import compilecache
+        compilecache.install()
         # spillable-buffer runtime wired into execution: cached scan
         # batches register here and over-budget allocations spill them
         # device->host->disk (reference: GpuShuffleEnv.initStorage,
@@ -361,6 +366,12 @@ class TpuSparkSession:
         # DELTA of spill/fetch/compile activity
         global_before = (obs_metrics.REGISTRY.values()
                          if ctx.metrics_enabled else None)
+        if ctx.metrics_enabled:
+            # the scan pipeline's peak gauge is state, not flow: reset it
+            # per query so the profile's queueDepthPeak is THIS query's
+            # peak, not the process's all-time high (obs/profile.py)
+            obs_metrics.REGISTRY.gauge("scan.prefetch.queueDepthPeak") \
+                .set(0)
         t_query0 = time.perf_counter()
         # record rename provenance (alias -> source names) from the
         # LOGICAL plan — physical projections can fuse away, but the

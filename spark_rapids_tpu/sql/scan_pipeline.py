@@ -1,0 +1,348 @@
+"""Asynchronous scan pipeline: bounded-depth split prefetch on a shared
+decode thread pool.
+
+The reference closes its scan gap with a multithreaded, coalescing Parquet
+reader that overlaps host decode with device transfer (GpuParquetScan's
+MULTITHREADED/COALESCING reader modes, GpuMultiFileReader.scala); the
+analogue here overlaps the three serial stages of a file scan —
+
+    host decode (pyarrow, GIL-released)  ->  host->device upload
+                                         ->  device compute
+
+— by decoding up to ``spark.rapids.sql.scan.prefetchDepth`` splits ahead of
+the consuming task on a shared daemon pool, while the upload side
+double-buffers (exec/transitions.py): batch i+1's ``device_put`` is
+dispatched while batch i computes.
+
+Contract (tests/test_scan_pipeline.py):
+
+  * partition order is preserved exactly — split i's frames are yielded by
+    partition i, in decode order;
+  * the first decode exception propagates to the consumer of the failing
+    split, and no further splits are submitted after a failure;
+  * abandoning a partition generator early (CollectLimit, errors) cancels
+    every not-yet-started decode and drops decoded-frame references, so the
+    pipeline holds no buffers after GC;
+  * ``prefetchDepth=0`` selects the LEGACY reader end to end (the
+    reference keeps its PERFILE reader as a separate code path the same
+    way): synchronous full arrow->pandas decode on the consuming thread
+    in strict pull order, no hints, no direct decode — pre-pipeline
+    behavior exactly (the safe rollback path).
+
+Backpressure: decoded-but-unconsumed frames are host memory; submission
+stalls once their estimated bytes exceed
+``spark.rapids.sql.scan.prefetchMaxBytes`` (clamped to the host spill
+budget) or while the device manager is over its HBM spill budget — prefetch
+can never race the spill framework for memory it is trying to free. The
+device side needs no extra gate: uploads happen on the consuming task
+thread, which already holds a TpuSemaphore permit, and every uploaded batch
+is metered against the HBM budget (memory/device.py meter_batch).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Callable, List, Optional, Tuple
+
+from spark_rapids_tpu.obs.metrics import REGISTRY
+from spark_rapids_tpu.obs.trace import TRACER
+
+# one decode task per split: () -> pd.DataFrame
+DecodeFn = Callable[[], "pd.DataFrame"]  # noqa: F821
+# (input_file path or None for non-file sources, decode)
+ScanTask = Tuple[Optional[str], DecodeFn]
+
+_POOL: Optional[ThreadPoolExecutor] = None
+_POOL_SIZE = 0
+_POOL_LOCK = threading.Lock()
+
+# observability handles, resolved once (the pipeline hot path is one
+# future.result() per split; metrics must not add registry lookups)
+_STALL_TIME = REGISTRY.timer("scan.prefetch.stallTime")
+_DECODE_TIME = REGISTRY.timer("scan.prefetch.decodeTime")
+_QUEUE_DEPTH = REGISTRY.gauge("scan.prefetch.queueDepth")
+_QUEUE_PEAK = REGISTRY.gauge("scan.prefetch.queueDepthPeak")
+_SPLITS = REGISTRY.counter("scan.prefetch.splits")
+_CANCELLED = REGISTRY.counter("scan.prefetch.cancelled")
+_BYTES = REGISTRY.counter("scan.prefetch.bytesDecoded")
+_BUDGET_STALLS = REGISTRY.counter("scan.prefetch.budgetStalls")
+
+
+def decode_pool(threads: int) -> ThreadPoolExecutor:
+    """Shared daemon decode pool. One per process; rebuilt (old pool left
+    to drain) if a session reconfigures the thread count."""
+    global _POOL, _POOL_SIZE
+    with _POOL_LOCK:
+        if _POOL is None or _POOL_SIZE != threads:
+            if _POOL is not None:
+                # idle executor workers never exit on their own; release
+                # the displaced pool's threads once in-flight decodes
+                # drain (repeated reconfiguration must not leak threads)
+                _POOL.shutdown(wait=False)
+            _POOL = ThreadPoolExecutor(
+                max_workers=threads, thread_name_prefix="srt-scan-decode")
+            _POOL_SIZE = threads
+        return _POOL
+
+
+def _conf_int(conf, key: str, default: int) -> int:
+    try:
+        return int(conf.get(key, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def pipeline_config(conf):
+    """(prefetch_depth, decode_threads, max_bytes) from a TpuConf."""
+    import os
+    depth = _conf_int(conf, "spark.rapids.sql.scan.prefetchDepth", 2)
+    threads = _conf_int(conf, "spark.rapids.sql.scan.decodeThreads", 0)
+    if threads <= 0:
+        # the workers carry decode + the dictionary factorize hints, so
+        # even a 2-core box wants 2 (the consuming thread's residual work
+        # is upload memcpys and compute dispatch, largely GIL-released)
+        threads = min(4, max(2, (os.cpu_count() or 2) - 1))
+    max_bytes = _conf_int(conf, "spark.rapids.sql.scan.prefetchMaxBytes",
+                          256 << 20)
+    # decoded frames that overflow host memory would fight the spill
+    # framework for the same RAM; clamp to the host spill budget
+    spill = _conf_int(conf, "spark.rapids.memory.host.spillStorageSize",
+                      1 << 30)
+    return depth, threads, min(max_bytes, spill)
+
+
+class ScanPrefetcher:
+    """Bounded-depth, order-preserving prefetch over one scan's splits.
+
+    ``get(i)`` submits splits ``i .. i+depth`` (so while the consumer
+    drains split i, up to ``depth`` later splits decode concurrently),
+    blocks on split i's future, and hands the frame over — the prefetcher
+    drops its own reference so consumed frames are GC-eligible the moment
+    the consumer releases them.
+    """
+
+    def __init__(self, tasks: List[ScanTask], depth: int,
+                 pool: ThreadPoolExecutor, max_bytes: int):
+        self._tasks = tasks
+        self._depth = max(1, depth)
+        self._pool = pool
+        self._max_bytes = max(1, max_bytes)
+        self._lock = threading.Lock()
+        self._futures: dict = {}          # split index -> Future
+        self._submitted: set = set()
+        self._cancelled = False
+        self._failed = False
+        self._pending_bytes = 0           # decoded, not yet consumed
+        self._inflight = 0
+        self._skip: set = set()           # submitted splits never consumed
+
+    # -- worker side --------------------------------------------------------
+    def _decode(self, i: int):
+        path, fn = self._tasks[i]
+        try:
+            with self._lock:
+                if self._cancelled:
+                    return None
+            with _DECODE_TIME.time():
+                with TRACER.span("scan.decode", split=i,
+                                 file=path or "<memory>"):
+                    df = fn()
+            nbytes = int(df.memory_usage(deep=False).sum()) \
+                if df is not None else 0
+            with self._lock:
+                if self._cancelled or i in self._skip:
+                    # raced a cancel (or a skip of a never-consumed
+                    # split) mid-decode: drop the frame so the abandoned
+                    # work retains no buffers or budget
+                    self._skip.discard(i)
+                    return None
+                self._pending_bytes += nbytes
+            _BYTES.add(nbytes)
+            return df
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                _QUEUE_DEPTH.set(self._inflight)
+
+    # -- consumer side ------------------------------------------------------
+    def _over_budget_locked(self) -> bool:
+        if self._pending_bytes >= self._max_bytes:
+            return True
+        # device spill pressure: while the HBM budget is exceeded the
+        # spill handlers are freeing memory — do not pile more host
+        # frames (whose uploads would immediately re-pressure it)
+        from spark_rapids_tpu.memory.device import TpuDeviceManager
+        dm = TpuDeviceManager.current()
+        return dm is not None and dm.allocated > dm.hbm_budget
+
+    def _submit_window_locked(self, i: int) -> None:
+        if self._cancelled or self._failed:
+            # the requested split itself must still decode
+            hi = i
+        else:
+            hi = min(i + self._depth, len(self._tasks) - 1)
+        for j in range(i, hi + 1):
+            if j in self._submitted:
+                continue
+            if j > i and self._over_budget_locked():
+                _BUDGET_STALLS.add(1)
+                break
+            self._submitted.add(j)
+            self._inflight += 1
+            _QUEUE_DEPTH.set(self._inflight)
+            if self._inflight > int(_QUEUE_PEAK.value):
+                _QUEUE_PEAK.set(self._inflight)
+            self._futures[j] = self._pool.submit(self._decode, j)
+
+    def get(self, i: int):
+        """Decoded frame of split ``i`` (blocking). Re-raises the split's
+        decode exception; marks the pipeline failed so no later splits are
+        submitted after the first error."""
+        with self._lock:
+            # earlier splits submitted but never consumed (device-scan-
+            # cache replay bypasses their partitions entirely): reclaim
+            # their budget, or their frames would pin _pending_bytes for
+            # the scan's lifetime and starve the window. A genuinely
+            # out-of-order consumer just re-decodes inline (fut-is-None
+            # path below) — correctness over overlap for that rare case.
+            for j in [k for k in self._futures if k < i]:
+                f = self._futures.pop(j)
+                if f.cancel():
+                    self._inflight -= 1
+                    _QUEUE_DEPTH.set(self._inflight)
+                    _CANCELLED.add(1)
+                elif f.done():
+                    try:
+                        dfj = f.result()
+                    except BaseException:
+                        dfj = None
+                    if dfj is not None:
+                        self._pending_bytes -= int(
+                            dfj.memory_usage(deep=False).sum())
+                else:
+                    # running: drop its result on finish. The done
+                    # callback reclaims the budget if the decode raced
+                    # past its own skip check before the marker landed.
+                    self._skip.add(j)
+                    f.add_done_callback(
+                        lambda fr, j=j: self._reclaim_skipped(j, fr))
+            self._submit_window_locked(i)
+            fut = self._futures.pop(i, None)
+        _SPLITS.add(1)
+        if fut is None:
+            # split consumed before (a concurrently re-driven partition,
+            # e.g. a racing device-scan-cache filler): decode inline —
+            # correctness over overlap for the rare second consumer
+            return self._tasks[i][1]()
+        if not fut.done():
+            import time
+            t0 = time.perf_counter()
+            with TRACER.span("scan.prefetch.stall", split=i):
+                wait([fut], return_when=FIRST_COMPLETED)
+            _STALL_TIME.record(time.perf_counter() - t0)
+        try:
+            df = fut.result()
+        except BaseException:
+            with self._lock:
+                self._failed = True
+            raise
+        if df is not None:
+            with self._lock:
+                self._pending_bytes -= int(
+                    df.memory_usage(deep=False).sum())
+        return df
+
+    def _reclaim_skipped(self, j: int, fr) -> None:
+        """Done-callback for a skipped-while-running decode: if _decode
+        raced past its skip check (frame returned, bytes accounted),
+        reclaim the budget here — otherwise the orphaned bytes would pin
+        _pending_bytes for the scan's lifetime."""
+        try:
+            df = fr.result()
+        except BaseException:  # noqa: BLE001 — skipped split, error moot
+            df = None
+        with self._lock:
+            if self._cancelled or j not in self._skip:
+                return  # _decode saw the marker (or cancel reset budget)
+            self._skip.discard(j)
+            if df is not None:
+                self._pending_bytes -= int(
+                    df.memory_usage(deep=False).sum())
+
+    def cancel(self) -> None:
+        """Early consumer exit: cancel unstarted decodes, drop every
+        retained frame reference. Running decodes finish (pyarrow reads
+        are not interruptible) but their results are discarded."""
+        with self._lock:
+            self._cancelled = True
+            futures = list(self._futures.values())
+            self._futures.clear()
+            self._pending_bytes = 0
+        n = sum(1 for f in futures if f.cancel())
+        if n:
+            _CANCELLED.add(n)
+            with self._lock:
+                # cancelled-before-start futures never run _decode's
+                # accounting; settle the in-flight gauge for them here
+                self._inflight -= n
+                _QUEUE_DEPTH.set(self._inflight)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait for in-flight decodes to finish (tests; bounded)."""
+        with self._lock:
+            futures = list(self._futures.values())
+        done, not_done = wait(futures, timeout=timeout)
+        return not not_done
+
+
+def build_partitions(ctx, tasks: List[ScanTask]) -> List["Partition"]:  # noqa: F821
+    """Partition list over one scan's splits, honoring
+    ``spark.rapids.sql.scan.prefetchDepth``.
+
+    Each partition publishes its split's input file to the task context
+    around the yield (try/finally — the file context must not leak across
+    tasks when the consumer abandons the generator or decode raises) and,
+    with a positive depth, pulls its frame from a shared ScanPrefetcher.
+    """
+    from spark_rapids_tpu.exec import taskctx
+
+    depth, threads, max_bytes = pipeline_config(ctx.conf)
+
+    if depth <= 0:
+        # serial rollback path: decode on the consuming thread at pull
+        # time, nothing shared, no pool — the pre-pipeline behavior
+        def make_serial(path: Optional[str], fn: DecodeFn) -> "Partition":  # noqa: F821
+            def run():
+                if path is not None:
+                    taskctx.set_input_file(path)
+                try:
+                    yield fn()
+                finally:
+                    if path is not None:
+                        taskctx.clear_input_file()
+            return run
+        return [make_serial(p, fn) for p, fn in tasks]
+
+    prefetcher = ScanPrefetcher(tasks, depth, decode_pool(threads),
+                                max_bytes)
+
+    def make(i: int, path: Optional[str]) -> "Partition":  # noqa: F821
+        def run():
+            df = prefetcher.get(i)
+            if df is None:  # cancelled scan re-consumed: decode inline
+                df = tasks[i][1]()
+            if path is not None:
+                taskctx.set_input_file(path)
+            try:
+                yield df
+            except BaseException:
+                # abandoned mid-yield (GeneratorExit) or a downstream
+                # error thrown into the generator: stop feeding the pool
+                prefetcher.cancel()
+                raise
+            finally:
+                if path is not None:
+                    taskctx.clear_input_file()
+        return run
+    return [make(i, p) for i, (p, _fn) in enumerate(tasks)]

@@ -113,16 +113,22 @@ class InMemorySource(DataSource):
     def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
         n = len(self.df)
         per = math.ceil(n / self.num_partitions) if n else 0
+        if per == 0:
+            def empty():
+                yield self.df.iloc[0:0]
 
-        def make(i: int) -> Partition:
-            def run():
-                if per == 0:
-                    if i == 0:
-                        yield self.df.iloc[0:0]
-                    return
-                yield self.df.iloc[i * per:(i + 1) * per].reset_index(drop=True)
-            return run
-        return [make(i) for i in range(self.num_partitions)]
+            def nothing():
+                return iter(())
+            return [empty] + [nothing] * (self.num_partitions - 1)
+
+        def slice_task(i: int):
+            def decode():
+                return self.df.iloc[i * per:(i + 1) * per] \
+                    .reset_index(drop=True)
+            return decode
+        from spark_rapids_tpu.sql.scan_pipeline import build_partitions
+        return build_partitions(
+            ctx, [(None, slice_task(i)) for i in range(self.num_partitions)])
 
 
 def _expand_paths(paths: List[str], suffix: str):
@@ -241,11 +247,27 @@ class ParquetSource(DataSource):
                             [self.schema.dtypes[idx[n]] for n in names])
         return src
 
+    # row-group-stats cache bound: footers are tiny, but a long session
+    # scanning many files would otherwise grow the dict forever
+    _RG_STATS_CACHE_CAP = 4096
+
     def _rg_stats(self, path: str, rg: int):
-        """{col: (min, max, null_count, num_values)} from the footer."""
+        """{col: (min, max, null_count, num_values)} from the footer.
+        Keyed by (path, mtime, rg): a rewritten file's stale stats must
+        not keep pruning row groups of its replacement. Insertion-ordered
+        dict, oldest-half eviction past the cap."""
+        import os
         base = getattr(self, "_base", self)
         cache = base.__dict__.setdefault("_stats_cache", {})
-        if (path, rg) not in cache:
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = None
+        if (path, mtime, rg) not in cache:
+            if len(cache) >= self._RG_STATS_CACHE_CAP:
+                for k in list(cache)[:len(cache)
+                                     - self._RG_STATS_CACHE_CAP // 2]:
+                    del cache[k]
             md = self._pq.ParquetFile(path).metadata.row_group(rg)
             stats = {}
             for ci in range(md.num_columns):
@@ -258,8 +280,8 @@ class ParquetSource(DataSource):
                         s.min if s.has_min_max else None,
                         s.max if s.has_min_max else None,
                         s.null_count, s.num_values)
-            cache[(path, rg)] = stats
-        return cache[(path, rg)]
+            cache[(path, mtime, rg)] = stats
+        return cache[(path, mtime, rg)]
 
     def prune_splits(self, filters) -> Tuple[list, int]:
         """(surviving splits, pruned count): row-group statistics +
@@ -300,13 +322,23 @@ class ParquetSource(DataSource):
                 ctx.metric_add(self.describe(), "numRowGroupsPruned",
                                pruned)
 
-        def make(path: str, rg: int, pvals) -> Partition:
-            def run():
-                from spark_rapids_tpu.exec import taskctx
-                taskctx.set_input_file(path)
+        from spark_rapids_tpu.sql.scan_pipeline import (
+            build_partitions, pipeline_config,
+        )
+        # prefetchDepth=0 selects the LEGACY reader end to end (the
+        # reference's PERFILE mode keeps its own code path the same way):
+        # synchronous decode through the full arrow->pandas conversion,
+        # no hints — the safe rollback path reproduces pre-pipeline
+        # behavior exactly, not just its thread count
+        pipelined = pipeline_config(ctx.conf)[0] > 0
+        direct = pipelined and ctx.conf.get_bool(
+            "spark.rapids.sql.scan.directDecode", True)
+
+        def decode_task(path: str, rg: int, pvals):
+            def decode():
                 f = pq.ParquetFile(path)
                 table = f.read_row_group(rg, columns=self.columns)
-                df = _arrow_to_pandas(table)
+                df = _arrow_decode(table, direct)
                 for k in self._pkeys:
                     v = (_infer_partition_value(pvals[k])
                          if k in pvals else None)
@@ -318,14 +350,14 @@ class ParquetSource(DataSource):
                     df[k] = pd.Series([v] * len(df),
                                       dtype=dt.pandas_nullable
                                       if not dt.is_string else object)
-                yield df
-                taskctx.clear_input_file()
-            return run
+                return _attach_dict_hints(df) if pipelined else df
+            return decode
         if not splits:
             def empty():
                 yield _empty_from_schema(self.schema)
             return [empty]
-        return [make(p, rg, pv) for p, rg, pv in splits]
+        return build_partitions(
+            ctx, [(p, decode_task(p, rg, pv)) for p, rg, pv in splits])
 
 
 class CsvSource(DataSource):
@@ -353,18 +385,22 @@ class CsvSource(DataSource):
 
     def cpu_partitions(self, ctx: ExecContext) -> List[Partition]:
         pacsv = self._pacsv
+        from spark_rapids_tpu.sql.scan_pipeline import (
+            build_partitions, pipeline_config,
+        )
+        pipelined = pipeline_config(ctx.conf)[0] > 0
+        direct = pipelined and ctx.conf.get_bool(
+            "spark.rapids.sql.scan.directDecode", True)
 
-        def make(path: str) -> Partition:
-            def run():
-                from spark_rapids_tpu.exec import taskctx
-                taskctx.set_input_file(path)
+        def decode_task(path: str):
+            def decode():
                 t = pacsv.read_csv(path)
-                df = _arrow_to_pandas(t)
+                df = _arrow_decode(t, direct)
                 df.columns = list(self.schema.names)
-                yield df
-                taskctx.clear_input_file()
-            return run
-        return [make(p) for p in self.paths]
+                return _attach_dict_hints(df) if pipelined else df
+            return decode
+        return build_partitions(
+            ctx, [(p, decode_task(p)) for p in self.paths])
 
 
 class OrcSource(DataSource):
@@ -465,27 +501,100 @@ class OrcSource(DataSource):
             if ctx.metrics_enabled:
                 ctx.metric_add(self.describe(), "numStripesPruned", pruned)
 
-        def make(path: str, stripe: int) -> Partition:
-            def run():
-                from spark_rapids_tpu.exec import taskctx
-                taskctx.set_input_file(path)
+        from spark_rapids_tpu.sql.scan_pipeline import (
+            build_partitions, pipeline_config,
+        )
+        pipelined = pipeline_config(ctx.conf)[0] > 0
+        direct = pipelined and ctx.conf.get_bool(
+            "spark.rapids.sql.scan.directDecode", True)
+
+        def decode_task(path: str, stripe: int):
+            def decode():
                 f = paorc.ORCFile(path)
                 table = f.read_stripe(stripe, columns=self.columns)
                 import pyarrow as pa
                 if isinstance(table, pa.RecordBatch):
                     table = pa.Table.from_batches([table])
-                yield _arrow_to_pandas(table)
-                taskctx.clear_input_file()
-            return run
+                df = _arrow_decode(table, direct)
+                return _attach_dict_hints(df) if pipelined else df
+            return decode
         if not splits:
             def empty():
                 yield _empty_from_schema(self.schema)
             return [empty]
-        return [make(p, s) for p, s in splits]
+        return build_partitions(
+            ctx, [(p, decode_task(p, s)) for p, s in splits])
 
 
 def _arrow_to_pandas(table) -> pd.DataFrame:
     df = table.to_pandas(types_mapper=_types_mapper)
+    return df
+
+
+def _attach_dict_hints(df: pd.DataFrame) -> pd.DataFrame:
+    """Precompute per-column dictionary factorizations ON THE DECODE
+    WORKER (the scan pipeline runs this inside the split's decode task)
+    and attach them as ``df.attrs["srt_dict_fact"]`` keyed by column
+    name. The host->device upload then pays only an O(cardinality) remap
+    per dictionary column (columnar/column.py dict_factorize_hint) — the
+    probe + factorize were the largest consumer-thread upload cost.
+
+    Only object/string columns are hinted: file-scan uploads skip the
+    numeric dictionary probe entirely (exec/transitions.py
+    scan_dict_numerics), and string ``to_numpy(object)`` is exactly the
+    value space ``_pandas_to_numpy`` hands the encoder; datetime and
+    nullable-extension columns convert through fills and unit casts, so
+    they would need a value-space translation the hint cannot do."""
+    from spark_rapids_tpu.columnar.column import dict_factorize_hint
+    hints = {}
+    for i in range(df.shape[1]):
+        s = df.iloc[:, i]
+        if (isinstance(s.dtype, np.dtype) and s.dtype.kind == "O") \
+                or str(s.dtype) in ("str", "string"):
+            h = dict_factorize_hint(s.to_numpy(dtype=object),
+                                    is_string=True)
+            if h is not None:
+                hints[str(df.columns[i])] = h
+    if hints:
+        df.attrs["srt_dict_fact"] = hints
+    return df
+
+
+def _arrow_decode(table, direct: bool = True) -> pd.DataFrame:
+    """arrow Table -> pandas for the scan hot path.
+
+    ``direct``: non-nullable primitive (int/float/bool) columns convert
+    arrow -> numpy -> Series directly (zero-copy where arrow allows),
+    skipping the pandas nullable-extension materialization — on wide
+    numeric scans that conversion is a large share of decode time.
+    Columns with nulls, strings, dates/timestamps and dictionaries fall
+    back to ``_arrow_to_pandas`` per column, so values (incl. null
+    masks) are identical either way; only the no-null numeric dtype
+    differs (plain numpy instead of the nullable extension, which every
+    downstream consumer already handles — _pandas_to_numpy branches on
+    exactly this)."""
+    if not direct or table.num_rows == 0 or table.num_columns == 0:
+        return _arrow_to_pandas(table)
+    import pyarrow as pa
+    series: List = []
+    fallback_idx = []
+    for i in range(table.num_columns):
+        col = table.column(i)
+        t = col.type
+        if (col.null_count == 0
+                and (pa.types.is_integer(t) or pa.types.is_floating(t)
+                     or pa.types.is_boolean(t))):
+            series.append(pd.Series(col.to_numpy(zero_copy_only=False),
+                                    copy=False))
+        else:
+            series.append(None)
+            fallback_idx.append(i)
+    if fallback_idx:
+        fb = _arrow_to_pandas(table.select(fallback_idx))
+        for j, i in enumerate(fallback_idx):
+            series[i] = fb.iloc[:, j].reset_index(drop=True)
+    df = pd.concat(series, axis=1)
+    df.columns = list(table.column_names)
     return df
 
 

@@ -100,6 +100,17 @@ def _summarize_profile(doc: Dict[str, Any], top_n: int) -> None:
         print(f"-- {section}")
         for k, v in sorted(vals.items()):
             print(f"  {k}: {v}")
+    cc = doc.get("summary", {}).get("compileCache") or {}
+    if cc:
+        # warmup attribution at a glance: compile time that ran vs
+        # compile time the persistent cache avoided (obs/compilecache.py)
+        ran = cc.get("compileCache.backendCompileTime", 0.0)
+        n = cc.get("compileCache.backendCompiles", 0)
+        hits = cc.get("compileCache.persistentHits", 0)
+        saved = cc.get("compileCache.timeSaved", 0.0)
+        print(f"-- warmup attribution: {ran:.1f}s backend compile "
+              f"({n} compiles), {hits} persistent-cache hits "
+              f"({saved:.1f}s saved)")
 
 
 def main(argv=None) -> int:
