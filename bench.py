@@ -46,6 +46,11 @@ Env knobs:
   BENCH_QUERIES comma list overriding the suite default, entries either
                 bare (q1) or namespaced (tpcxbb.q5)
   BENCH_QUERY_TIMEOUT_S  per-query wall deadline (default 600)
+  BENCH_EVENT_LOG  path for the structured event journal (obs/events.py);
+                `--event-log` defaults it to BENCH_EVENTS.jsonl. The run
+                then leaves a JSONL record (query lifecycle, fallback
+                reasons, spills, fetch retries, compiles) minable with
+                tools/qualification.py.
 
 Scan-inclusive mode (`--include-scan` or BENCH_INCLUDE_SCAN=1): for the
 tpch queries in BENCH_SCAN_QUERIES (default q1,q6,q14), additionally time
@@ -174,6 +179,13 @@ def _worker():
         # symmetric residency: the CPU path holds its pandas tables in
         # RAM, the TPU path holds uploaded scan batches in HBM
         "spark.rapids.sql.cacheDeviceScans", True).get_or_create()
+
+    # --event-log: every query of the sweep journals durable facts
+    # (query lifecycle, fallbacks, spills, retries, compiles) so the run
+    # leaves a record tools/qualification.py can mine (obs/events.py)
+    ev_path = os.environ.get("BENCH_EVENT_LOG", "")
+    if ev_path:
+        session.set_conf("spark.rapids.tpu.eventLog.path", ev_path)
 
     suites = {}  # suite name -> {query name -> thunk}
 
@@ -575,6 +587,11 @@ def main():
         # worker inherits the env; the flag form exists so CI invocations
         # read as `python bench.py --include-scan`
         os.environ["BENCH_INCLUDE_SCAN"] = "1"
+    if "--event-log" in sys.argv:
+        # workers inherit BENCH_EVENT_LOG and journal every query there
+        # (appended across worker respawns — rotation bounds the size);
+        # default artifact name parallels BENCH_DETAIL.json
+        os.environ.setdefault("BENCH_EVENT_LOG", "BENCH_EVENTS.jsonl")
 
     suite_names, sweep = _parse_sweep()
     sf = float(os.environ.get("BENCH_SF", "0.5"))

@@ -498,6 +498,44 @@ TRACE_JAX_ANNOTATIONS = register(
     "own events. Off by default: annotations cost a context manager per "
     "span even when no jax profiler session is active.")
 
+EVENT_LOG_ENABLED = register(
+    "spark.rapids.tpu.eventLog.enabled", _to_bool, False,
+    "Write the process-wide structured event journal (obs/events.py): "
+    "query start/end with conf fingerprint and plan digest, per-operator "
+    "CPU-fallback reasons, spill/memory-pressure events, shuffle fetch "
+    "retries/failures, compile-cache misses and scan-pipeline stalls, as "
+    "line-delimited JSON. The durable cross-query record "
+    "tools/qualification.py mines (the reference's history-server "
+    "event-log role). Implied by a non-empty "
+    "spark.rapids.tpu.eventLog.path.")
+
+EVENT_LOG_PATH = register(
+    "spark.rapids.tpu.eventLog.path", str, "",
+    "Destination of the event journal (appended, rotated at "
+    "spark.rapids.tpu.eventLog.maxFileBytes). Setting a path enables the "
+    "journal; enabled with no path writes ./tpu-eventlog.jsonl.")
+
+EVENT_LOG_MAX_BYTES = register(
+    "spark.rapids.tpu.eventLog.maxFileBytes", _to_bytes, 16 << 20,
+    "Size bound of the active event-log file; past it the file rotates "
+    "to <path>.1 (older rotations shift up). Rotation and write-failure "
+    "counts surface in the profile report's observability section.",
+    validator=_positive)
+
+EVENT_LOG_ROTATIONS = register(
+    "spark.rapids.tpu.eventLog.rotatedFiles", int, 2,
+    "How many rotated event-log files (<path>.1 .. <path>.N) to keep; "
+    "0 truncates in place at the size bound instead of rotating.",
+    validator=_non_negative)
+
+FLIGHT_RECORDER_SIZE = register(
+    "spark.rapids.tpu.eventLog.flightRecorderSize", int, 256,
+    "Entries in the always-on flight-recorder ring (last N events, plus "
+    "spans while tracing is on), auto-dumped into the event log when a "
+    "query fails and exposed as session.dump_flight_recorder(). The ring "
+    "runs even with the event log and tracer disabled — one deque append "
+    "per (rare) event.", validator=_positive)
+
 
 class TpuConf:
     """Immutable snapshot of settings, with typed accessors.

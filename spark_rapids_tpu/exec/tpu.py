@@ -1417,6 +1417,12 @@ class TpuShuffleExchangeExec(TpuExec):
                                 TRACER.instant(
                                     "shuffle.fetch.retry",
                                     peer=str(peer), attempt=attempt)
+                                from spark_rapids_tpu.obs.events import (
+                                    EVENTS,
+                                )
+                                EVENTS.emit("fetchRetry", peer=str(peer),
+                                            attempt=attempt,
+                                            error=str(e)[:200])
                                 import logging
                                 logging.getLogger(__name__).warning(
                                     "shuffle fetch failed (%s); retrying "

@@ -37,6 +37,7 @@ import numpy as np
 
 from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
 from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.obs.events import EVENTS
 from spark_rapids_tpu.obs.metrics import REGISTRY
 from spark_rapids_tpu.obs.trace import TRACER
 
@@ -305,6 +306,8 @@ class DeviceStore(BufferStore):
                 .add(1)
             REGISTRY.counter("spill.bytes", direction="device_to_host") \
                 .add(freed)
+            EVENTS.emit("spill", direction="device_to_host",
+                        bytes=freed, buffer=buf.id)
             self.spill_store.add(buf)
             # keep the host tier within its bound
             self.spill_store.enforce_limit()
@@ -330,6 +333,8 @@ class HostStore(BufferStore):
                 .add(1)
             REGISTRY.counter("spill.bytes", direction="host_to_disk") \
                 .add(freed)
+            EVENTS.emit("spill", direction="host_to_disk",
+                        bytes=freed, buffer=buf.id)
             self.spill_store.add(buf)
         return freed
 
@@ -459,4 +464,8 @@ class MemoryEventHandler:
         freed = self.device_store.synchronous_spill(target)
         if freed:
             self.spill_count += 1
+            # the alloc-backoff fact (distinct from the per-buffer spill
+            # events it triggered): HOW MUCH pressure forced the pass
+            EVENTS.emit("memoryPressure", neededBytes=needed_bytes,
+                        freedBytes=freed)
         return freed

@@ -53,11 +53,16 @@ def install() -> bool:
         saved = REGISTRY.timer("compileCache.timeSaved")
         retrieval = REGISTRY.timer("compileCache.retrievalTime")
 
+        from spark_rapids_tpu.obs.events import EVENTS
+
         def on_event(name: str, **kw) -> None:
             if name == "/jax/compilation_cache/cache_hits":
                 hits.add(1)
             elif name == "/jax/compilation_cache/cache_misses":
                 misses.add(1)
+                # a miss means a real XLA compile is coming: the durable
+                # warmup fact the qualification report attributes
+                EVENTS.emit("compileCacheMiss")
             elif name == "/jax/compilation_cache/compile_requests_use_cache":
                 requests.add(1)
 
@@ -65,6 +70,7 @@ def install() -> bool:
             if "backend_compile" in name:
                 compiles.add(1)
                 compile_time.record(secs)
+                EVENTS.emit("backendCompile", seconds=round(secs, 4))
             elif "compile_time_saved" in name:
                 saved.record(secs)
             elif "cache_retrieval_time" in name:

@@ -1,0 +1,337 @@
+"""Process-wide structured event journal (JSONL) + always-on flight recorder.
+
+The reference ecosystem's qualification/profiling tools mine Spark's
+history-server event logs to answer "which workloads benefit, and what
+blocked the rest?" — a durable, cross-query record, not a per-query
+report. This module is that record for this build: every subsystem
+reports durable facts through ``EVENTS.emit(kind, **fields)`` and the
+journal lands as line-delimited JSON a tool can stream
+(tools/qualification.py consumes it; tools/trace_summary.py summarizes
+it).
+
+Event taxonomy (one JSON object per line; every event carries ``kind``,
+``ts`` epoch seconds, ``seq``, and — between queryStart/queryEnd —
+``query``):
+
+  queryStart        session      confFingerprint
+  queryPlan         session      planDigest, tpuOps, cpuOps, coveragePct
+  cpuFallback       tag pass     op, describe, reasons[] (sql/overrides.py)
+  queryEnd          session      status success|failed, wall_s, error,
+                                 coveragePct, cpuOpTime {op: seconds}
+  spill             memory       direction, bytes, buffer (memory/spill.py)
+  memoryPressure    memory       neededBytes, freedBytes (alloc backoff)
+  fetchRetry        exec         peer, attempt (exec/tpu.py retry loop)
+  fetchFailure      shuffle      peer, error (shuffle/client.py)
+  compileCacheMiss  compile      persistent-cache miss (obs/compilecache.py)
+  backendCompile    compile      seconds (an XLA compile that actually ran)
+  scanStall         scan         split, stall_s (sql/scan_pipeline.py)
+  scanBudgetStall   scan         split (prefetch submission backpressure)
+  flightRecorder    session      reason, events[] (ring dump, see below)
+
+Journal mechanics:
+
+  * thread-safe: one lock serializes seq assignment, the ring append and
+    the file write (subsystem threads — shuffle server, decode pool,
+    partition executors — emit concurrently);
+  * size-bounded with rotation: past
+    ``spark.rapids.tpu.eventLog.maxFileBytes`` the file rotates to
+    ``<path>.1`` (shifting older rotations up, keeping
+    ``spark.rapids.tpu.eventLog.rotatedFiles``); ``rotations`` and
+    ``dropped`` (failed writes) counters surface in the profile report's
+    ``observability`` section so truncation is never silent;
+  * disabled by default: without ``spark.rapids.tpu.eventLog.enabled``
+    (or a non-empty ``...eventLog.path``, which implies enabled) nothing
+    touches the filesystem — events only feed the flight recorder ring.
+
+The **flight recorder** is the always-on part: a bounded ring of the last
+N events (``spark.rapids.tpu.eventLog.flightRecorderSize``) kept at the
+cost of a deque append even when both the journal and the tracer are
+disabled. When the tracer IS enabled its spans mirror into the ring too
+(``TRACER.flight_hook``). On query failure the session dumps the ring
+into the journal as one ``flightRecorder`` event — so a dead query still
+leaves its last moments on record — and ``session.dump_flight_recorder()``
+exposes the same snapshot programmatically.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+DEFAULT_PATH = "tpu-eventlog.jsonl"
+DEFAULT_MAX_BYTES = 16 << 20
+DEFAULT_ROTATIONS = 2
+DEFAULT_RING_SIZE = 256
+
+
+def conf_fingerprint(settings: Dict[str, Any]) -> str:
+    """Stable short hash of a conf settings dict: two queries with the
+    same fingerprint ran under the same explicit configuration (defaults
+    excluded — they are code, not configuration)."""
+    blob = json.dumps({k: str(v) for k, v in settings.items()},
+                      sort_keys=True)
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def plan_digest(plan) -> str:
+    """Short structural hash of a physical plan (describe() of every node
+    in walk order): the cross-run join key for "the same query shape"."""
+    blob = "\n".join(n.describe() for n in plan.walk())
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:12]
+
+
+class EventLog:
+    """One process-wide journal; ``EVENTS`` is the shared instance."""
+
+    def __init__(self, ring_size: int = DEFAULT_RING_SIZE):
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.path = ""
+        self.max_bytes = DEFAULT_MAX_BYTES
+        self.max_rotations = DEFAULT_ROTATIONS
+        self._fh = None
+        self._written = 0
+        self._seq = 0
+        self._query_counter = 0
+        self._current_query: Optional[str] = None
+        # truncation visibility (profile "observability" section)
+        self.dropped = 0      # events whose file write failed
+        self.rotations = 0
+        self.rotate_failures = 0  # size bound breached, rename failed
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(1, ring_size))
+
+    # -- configuration ------------------------------------------------------
+    def configure(self, enabled: bool, path: str = "",
+                  max_bytes: int = DEFAULT_MAX_BYTES,
+                  rotations: int = DEFAULT_ROTATIONS,
+                  ring_size: Optional[int] = None) -> None:
+        """(Re)configure the journal. A non-empty ``path`` implies
+        enabled; enabled with no path writes ``DEFAULT_PATH``. Reopening
+        appends — one journal accumulates across sessions/queries."""
+        with self._lock:
+            enabled = bool(enabled) or bool(path)
+            path = path or (DEFAULT_PATH if enabled else "")
+            if self._fh is not None and (not enabled
+                                         or path != self.path):
+                self._close_locked()
+            self.enabled = enabled
+            self.path = path
+            self.max_bytes = max(1, int(max_bytes))
+            self.max_rotations = max(0, int(rotations))
+            if ring_size is not None and \
+                    self._ring.maxlen != max(1, int(ring_size)):
+                self._ring = collections.deque(
+                    self._ring, maxlen=max(1, int(ring_size)))
+
+    def configure_from_conf(self, conf) -> bool:
+        """Session hook: read the ``spark.rapids.tpu.eventLog.*`` keys.
+        Returns whether the journal is enabled."""
+        path = str(conf.get("spark.rapids.tpu.eventLog.path", "") or "")
+        enabled = conf.get_bool("spark.rapids.tpu.eventLog.enabled",
+                                False) or bool(path)
+        self.configure(
+            enabled, path,
+            max_bytes=int(conf.get(
+                "spark.rapids.tpu.eventLog.maxFileBytes",
+                DEFAULT_MAX_BYTES)),
+            rotations=int(conf.get(
+                "spark.rapids.tpu.eventLog.rotatedFiles",
+                DEFAULT_ROTATIONS)),
+            ring_size=int(conf.get(
+                "spark.rapids.tpu.eventLog.flightRecorderSize",
+                DEFAULT_RING_SIZE)))
+        return self.enabled
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+        self._written = 0
+
+    # -- recording ----------------------------------------------------------
+    def emit(self, kind: str, **fields) -> Dict[str, Any]:
+        """Record one durable fact. Always lands in the flight-recorder
+        ring; additionally appended to the JSONL journal when enabled.
+        Never raises — a broken sink must not fail the query."""
+        with self._lock:
+            self._seq += 1
+            ev = {"kind": kind, "ts": round(time.time(), 6),
+                  "seq": self._seq}
+            if self._current_query is not None and "query" not in fields:
+                ev["query"] = self._current_query
+            ev.update(fields)
+            if kind != "flightRecorder":
+                # a dump must never re-enter the ring: the next dump
+                # would nest it and grow ~2x per failed query
+                self._ring.append(ev)
+            if self.enabled:
+                self._write_locked(ev)
+        return ev
+
+    def _write_locked(self, ev: Dict[str, Any]) -> None:
+        try:
+            line = (json.dumps(ev, default=str) + "\n").encode("utf-8")
+            if self._fh is None:
+                d = os.path.dirname(os.path.abspath(self.path))
+                os.makedirs(d, exist_ok=True)
+                self._fh = open(self.path, "ab")
+                self._written = self._fh.tell()
+            if self._written + len(line) > self.max_bytes \
+                    and self._written > 0:
+                self._rotate_locked()
+            self._fh.write(line)
+            self._fh.flush()
+            self._written += len(line)
+        except (OSError, TypeError, ValueError):
+            self.dropped += 1
+
+    def _rotate_locked(self) -> None:
+        """Shift ``path`` -> ``path.1`` -> ... -> ``path.<n>`` (oldest
+        dropped); with rotatedFiles=0 the journal truncates in place.
+        When the rename fails (file-writable but directory-unwritable
+        paths), appending continues on the oversized file with honest
+        accounting — ``rotate_failures`` marks the breached size bound
+        instead of faking a rotation."""
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        self._fh = None
+        try:
+            if self.max_rotations > 0:
+                oldest = f"{self.path}.{self.max_rotations}"
+                if os.path.exists(oldest):
+                    os.unlink(oldest)
+                for i in range(self.max_rotations - 1, 0, -1):
+                    src = f"{self.path}.{i}"
+                    if os.path.exists(src):
+                        os.replace(src, f"{self.path}.{i + 1}")
+                os.replace(self.path, f"{self.path}.1")
+            else:
+                os.unlink(self.path)
+        except OSError:
+            self.rotate_failures += 1
+            self._fh = open(self.path, "ab")
+            self._written = self._fh.tell()
+            return
+        self.rotations += 1
+        self._fh = open(self.path, "ab")
+        self._written = 0
+
+    # -- query lifecycle ----------------------------------------------------
+    def query_start(self, **fields) -> str:
+        """Open a query window: subsequent events auto-attach the query
+        id until query_end. Returns the id (``q-<n>``, process-wide).
+
+        One window at a time: the engine executes queries serially (one
+        driver thread per process; subsystem threads WITHIN a query are
+        what the lock covers). Were two sessions ever to interleave
+        queries, events would attribute to whichever window opened last
+        — acceptable for a post-hoc mining record, noted here so the
+        limitation is deliberate rather than discovered."""
+        with self._lock:
+            self._query_counter += 1
+            qid = f"q-{self._query_counter}"
+            self._current_query = qid
+        self.emit("queryStart", query=qid, **fields)
+        return qid
+
+    def query_end(self, status: str, flight_dump: bool = False,
+                  **fields) -> None:
+        if flight_dump:
+            self.dump_flight(reason=f"query {status}")
+        self.emit("queryEnd", status=status, **fields)
+        with self._lock:
+            self._current_query = None
+
+    @property
+    def current_query(self) -> Optional[str]:
+        return self._current_query
+
+    # -- flight recorder ----------------------------------------------------
+    def flight_events(self) -> List[Dict[str, Any]]:
+        """Snapshot of the ring, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def dump_flight(self, reason: str = "manual") -> Dict[str, Any]:
+        """Write the ring into the journal as ONE ``flightRecorder``
+        event (the dump excludes itself). Returns the dump event."""
+        snap = self.flight_events()
+        return self.emit("flightRecorder", reason=reason, count=len(snap),
+                         events=snap)
+
+    def _note_span(self, ev: Dict[str, Any]) -> None:
+        """Tracer hook (TRACER.flight_hook): mirror finished spans into
+        the ring in compact form. Only called while tracing is enabled —
+        the disabled-tracer hot path never reaches here."""
+        entry = {"kind": "span", "name": ev.get("name"),
+                 "ph": ev.get("ph"), "ts": ev.get("ts")}
+        if "dur" in ev:
+            entry["dur_us"] = ev["dur"]
+        with self._lock:
+            self._ring.append(entry)
+
+    # -- tests --------------------------------------------------------------
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._close_locked()
+            self.enabled = False
+            self.path = ""
+            self.max_bytes = DEFAULT_MAX_BYTES
+            self.max_rotations = DEFAULT_ROTATIONS
+            self.dropped = 0
+            self.rotations = 0
+            self.rotate_failures = 0
+            self._current_query = None
+            self._ring.clear()
+
+
+EVENTS = EventLog()
+
+# spans feed the flight recorder whenever the tracer is on (the tracer
+# itself stays import-light: the hook is just an attribute it calls)
+from spark_rapids_tpu.obs.trace import TRACER  # noqa: E402
+
+TRACER.flight_hook = EVENTS._note_span
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Load one journal INCLUDING its rotations (``path.<n>`` oldest
+    first, then ``path``). Unparseable lines are skipped — a crashed
+    writer can leave a torn tail."""
+    files: List[str] = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        files.append(f"{path}.{i}")
+        i += 1
+    files.reverse()
+    if os.path.exists(path):
+        files.append(path)
+    out: List[Dict[str, Any]] = []
+    for f in files:
+        with open(f) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(ev, dict) and "kind" in ev:
+                    out.append(ev)
+    return out

@@ -42,10 +42,14 @@ def _node_profile(node, ctx, op_metrics: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
-                  wall_s: Optional[float] = None) -> "ProfileReport":
+                  wall_s: Optional[float] = None,
+                  obs_before: Optional[tuple] = None) -> "ProfileReport":
     """Assemble the report from the executed plan + its ExecContext.
     ``global_delta`` is the per-query diff of the process-wide registry
-    (obs.metrics.registry_delta) carrying spill/fetch/compile activity."""
+    (obs.metrics.registry_delta) carrying spill/fetch/compile activity;
+    ``obs_before`` is the query-start snapshot of (tracer dropped,
+    event-log dropped, event-log rotations, event-log rotate failures)
+    so truncation reports as a per-query delta like everything else."""
     op_metrics = ctx.op_metrics()
     tree = _node_profile(plan, ctx, op_metrics)
     summary: Dict[str, Any] = {}
@@ -74,6 +78,24 @@ def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
     mem = op_metrics.get("memory")
     if mem:
         summary["memory"] = dict(mem)
+    # silent-truncation visibility: tracer events dropped at the buffer
+    # cap, event-journal write failures and file rotations (obs/events.py)
+    # during THIS query — a profile that says "no spills" must not be
+    # hiding a clipped record
+    from spark_rapids_tpu.obs.events import EVENTS
+    from spark_rapids_tpu.obs.trace import TRACER
+    t0, e0, r0, f0 = obs_before or (0, 0, 0, 0)
+    obs = {}
+    if TRACER.dropped - t0 > 0:
+        obs["trace.droppedEvents"] = TRACER.dropped - t0
+    if EVENTS.dropped - e0 > 0:
+        obs["eventLog.droppedEvents"] = EVENTS.dropped - e0
+    if EVENTS.rotations - r0 > 0:
+        obs["eventLog.rotations"] = EVENTS.rotations - r0
+    if EVENTS.rotate_failures - f0 > 0:
+        obs["eventLog.rotateFailures"] = EVENTS.rotate_failures - f0
+    if obs:
+        summary["observability"] = obs
     return ProfileReport(tree, summary, wall_s=wall_s)
 
 

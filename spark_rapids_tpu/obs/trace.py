@@ -100,6 +100,10 @@ class Tracer:
         # a long session (~100 bytes/event -> ~50 MB worst case)
         self.max_events = 500_000
         self._dropped = 0
+        # flight-recorder mirror (obs/events.py installs it): called with
+        # each recorded event dict while tracing is enabled, so the
+        # always-on ring holds recent spans too. None = no mirroring.
+        self.flight_hook = None
 
     # -- configuration ------------------------------------------------------
     def configure(self, enabled: bool,
@@ -155,6 +159,19 @@ class Tracer:
                 self._dropped += 1
                 return
             self._events.append(ev)
+        hook = self.flight_hook
+        if hook is not None:
+            try:
+                hook(ev)
+            except Exception:  # noqa: BLE001 — observability must not fail
+                pass
+
+    @property
+    def dropped(self) -> int:
+        """Events dropped at the buffer cap (surfaced in the profile
+        report's ``observability`` section — truncation must be loud)."""
+        with self._lock:
+            return self._dropped
 
     # -- export -------------------------------------------------------------
     def events(self) -> List[Dict[str, Any]]:

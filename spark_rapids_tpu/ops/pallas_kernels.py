@@ -71,8 +71,11 @@ def _dual_prefix_kernel(keep_ref, kex_ref, dex_ref, tot_ref, carry):
 
     @pl.when(i == 0)
     def _():
-        carry[0] = 0
-        carry[1] = 0
+        # explicit int32 zeros: with jax x64 enabled a bare python 0
+        # lands as int64 and interpret mode's ref-write discharge rejects
+        # the dtype mismatch against the int32 SMEM scratch
+        carry[0] = jnp.int32(0)
+        carry[1] = jnp.int32(0)
 
     k = keep_ref[:].astype(jnp.float32)           # (16, 128) of 0/1
     d = 1.0 - k

@@ -334,12 +334,9 @@ class TpuSparkSession:
     def _execute(self, logical: lp.LogicalPlan):
         """logical -> CPU physical -> TPU overrides -> run; returns
         (final physical plan, list of output pandas DataFrames)."""
-        from spark_rapids_tpu.sql.overrides import (
-            TpuOverrides, TransitionOverrides, assert_is_on_tpu,
-        )
-
         import time
 
+        from spark_rapids_tpu.obs import events as obs_events
         from spark_rapids_tpu.obs import metrics as obs_metrics
         from spark_rapids_tpu.obs.trace import TRACER
 
@@ -366,6 +363,12 @@ class TpuSparkSession:
         # DELTA of spill/fetch/compile activity
         global_before = (obs_metrics.REGISTRY.values()
                          if ctx.metrics_enabled else None)
+        # truncation counters snapshot: the profile's observability
+        # section reports this query's DELTA, not the process totals
+        obs_before = (TRACER.dropped, obs_events.EVENTS.dropped,
+                      obs_events.EVENTS.rotations,
+                      obs_events.EVENTS.rotate_failures) \
+            if ctx.metrics_enabled else None
         if ctx.metrics_enabled:
             # the scan pipeline's peak gauge is state, not flow: reset it
             # per query so the profile's queueDepthPeak is THIS query's
@@ -373,6 +376,44 @@ class TpuSparkSession:
             obs_metrics.REGISTRY.gauge("scan.prefetch.queueDepthPeak") \
                 .set(0)
         t_query0 = time.perf_counter()
+        # durable event journal (obs/events.py): the query window opens
+        # HERE so planning failures are on record too; the failure path
+        # below dumps the always-on flight recorder into the log
+        obs_events.EVENTS.configure_from_conf(conf)
+        obs_events.EVENTS.query_start(
+            confFingerprint=obs_events.conf_fingerprint(conf._settings))
+        try:
+            plan, outs, ctx = self._plan_and_run(
+                logical, ctx, conf, obs_metrics, global_before, t_query0,
+                trace_on, trace_path, obs_before)
+        except BaseException as e:
+            obs_events.EVENTS.query_end(
+                status="failed", flight_dump=True,
+                error=f"{type(e).__name__}: {e}"[:300],
+                wall_s=round(time.perf_counter() - t_query0, 6))
+            raise
+        obs_events.EVENTS.query_end(
+            status="success",
+            wall_s=round(time.perf_counter() - t_query0, 6),
+            **self._coverage_fields(plan, ctx))
+        self._sweep_adaptive_caches()
+        return plan, outs
+
+    def _plan_and_run(self, logical, ctx, conf, obs_metrics, global_before,
+                      t_query0, trace_on, trace_path, obs_before=None):
+        """The planning + execution body of ``_execute``, factored out so
+        the event journal's failure path wraps it in one place. Returns
+        (plan, outputs, final ExecContext) — a speculation re-run swaps
+        in a fresh context, and the coverage event reads the one that
+        actually executed."""
+        import time
+
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs.trace import TRACER
+        from spark_rapids_tpu.sql.overrides import (
+            TpuOverrides, TransitionOverrides, assert_is_on_tpu,
+        )
+
         # record rename provenance (alias -> source names) from the
         # LOGICAL plan — physical projections can fuse away, but the
         # logical tree always carries `.alias(...)` / USING-join renames.
@@ -393,8 +434,10 @@ class TpuSparkSession:
             cpu_plan = planner.plan_collect_limit(logical)
         else:
             cpu_plan = planner.plan(logical)
+        overrides = None
         if conf.sql_enabled:
-            plan = TpuOverrides(conf).apply(cpu_plan)
+            overrides = TpuOverrides(conf)
+            plan = overrides.apply(cpu_plan)
             plan = TransitionOverrides(conf).apply(plan)
             if (getattr(self, "mesh", None) is None and conf.get_bool(
                     "spark.rapids.sql.agg.fuseCountDistinct", True)):
@@ -414,6 +457,19 @@ class TpuSparkSession:
             assert_is_on_tpu(plan, conf)
         if self.capture_plans:
             self.captured_plans.append(plan)
+        # durable plan facts: structural digest + operator coverage, and
+        # one cpuFallback event per tagged-off operator with the tag
+        # pass's will-not-work reasons (the explain-why-not record the
+        # qualification tool ranks by time impact)
+        obs_events.EVENTS.emit(
+            "queryPlan", planDigest=obs_events.plan_digest(plan),
+            **self._coverage_fields(plan))
+        if overrides is not None:
+            for meta in overrides.fallback_metas():
+                obs_events.EVENTS.emit(
+                    "cpuFallback", op=meta.plan.name,
+                    describe=meta.plan.describe()[:200],
+                    reasons=list(meta.reasons))
         # final output to host
         outs: List[pd.DataFrame] = []
         if ctx.speculate and any(
@@ -474,13 +530,52 @@ class TpuSparkSession:
                 global_before, obs_metrics.REGISTRY.values())
             self.last_profile = build_profile(
                 plan, ctx, delta,
-                wall_s=time.perf_counter() - t_query0)
+                wall_s=time.perf_counter() - t_query0,
+                obs_before=obs_before)
         if trace_on and trace_path:
             TRACER.export_chrome(trace_path)
-        self._sweep_adaptive_caches()
-        return plan, outs
+        return plan, outs, ctx
 
     # --- observability ------------------------------------------------------
+    def _coverage_fields(self, plan, ctx=None) -> dict:
+        """TPU-vs-CPU operator census of a converted plan (transitions
+        excluded — they are the boundary, not a side), plus — given the
+        executed context — observed per-CPU-operator inclusive seconds,
+        the qualification tool's estimated fallback time impact."""
+        tpu = cpu = 0
+        cpu_time: dict = {}
+        for node in plan.walk():
+            if node.name in ("HostToDeviceExec", "DeviceToHostExec"):
+                continue
+            if node.columnar_output or getattr(node, "columnar_input",
+                                               False):
+                tpu += 1
+                continue
+            cpu += 1
+            if ctx is not None:
+                st = ctx.node_stats.get(id(node))
+                if st is not None:
+                    d = node.describe()[:200]
+                    cpu_time[d] = round(
+                        cpu_time.get(d, 0.0) + st["time"], 6)
+        total = tpu + cpu
+        out = {"tpuOps": tpu, "cpuOps": cpu,
+               "coveragePct": round(100.0 * tpu / total, 2)
+               if total else 100.0}
+        if cpu_time:
+            out["cpuOpTime"] = cpu_time
+        return out
+
+    def dump_flight_recorder(self) -> List[dict]:
+        """Snapshot the always-on flight recorder (obs/events.py): the
+        last N events — and spans, while tracing is on — regardless of
+        whether the event log is enabled. Also writes the snapshot into
+        the journal as a ``flightRecorder`` event when it is."""
+        from spark_rapids_tpu.obs.events import EVENTS
+        # one snapshot serves both the journal and the caller — a second
+        # flight_events() here could diverge under concurrent emitters
+        return EVENTS.dump_flight(reason="manual")["events"]
+
     def profile_report(self) -> str:
         """Human-readable profile of the last executed query: plan tree
         annotated with inclusive/exclusive time, rows, batches, plus the

@@ -20,6 +20,7 @@ import struct
 import threading
 from typing import List, Tuple
 
+from spark_rapids_tpu.obs.events import EVENTS
 from spark_rapids_tpu.obs.metrics import REGISTRY
 from spark_rapids_tpu.obs.trace import TRACER
 from spark_rapids_tpu.shuffle import wire
@@ -97,8 +98,14 @@ class ShuffleClient:
                     total += length
                     batch = wire.deserialize_batch(blob)
                     out.append(self.received.add_batch(batch))
-            except BaseException:
+            except BaseException as e:
                 REGISTRY.counter("shuffle.fetch.failures").add(1)
+                # durable record of the failure (timeouts included — they
+                # surface as ShuffleFetchFailedError messages): the
+                # qualification tool's fetch-hotspot input
+                EVENTS.emit("fetchFailure", peer=self.peer_id,
+                            blocks=len(blocks),
+                            error=f"{type(e).__name__}: {e}"[:200])
                 for rbid in out:
                     self.received.remove_batch(rbid)
                 raise

@@ -45,6 +45,7 @@ import threading
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Callable, List, Optional, Tuple
 
+from spark_rapids_tpu.obs.events import EVENTS
 from spark_rapids_tpu.obs.metrics import REGISTRY
 from spark_rapids_tpu.obs.trace import TRACER
 
@@ -136,6 +137,14 @@ class ScanPrefetcher:
         self._pending_bytes = 0           # decoded, not yet consumed
         self._inflight = 0
         self._skip: set = set()           # submitted splits never consumed
+        # journal sampling state: the event log records rare facts, not
+        # per-split streams — budget stalls emit on the entering
+        # transition only, decode stalls emit the first _EVENT_CAP per
+        # scan (exact aggregates live in the REGISTRY timers/counters)
+        self._budget_stalled = False
+        self._stall_events = 0
+
+    _EVENT_CAP = 16
 
     # -- worker side --------------------------------------------------------
     def _decode(self, i: int):
@@ -187,6 +196,13 @@ class ScanPrefetcher:
                 continue
             if j > i and self._over_budget_locked():
                 _BUDGET_STALLS.add(1)
+                if not self._budget_stalled:
+                    # backpressure fact, on the ENTERING transition only
+                    # (sustained pressure re-trips per split): prefetch
+                    # submission stopped here, the pipeline runs at
+                    # consumer speed until the budget drains
+                    self._budget_stalled = True
+                    EVENTS.emit("scanBudgetStall", split=j)
                 break
             self._submitted.add(j)
             self._inflight += 1
@@ -194,6 +210,10 @@ class ScanPrefetcher:
             if self._inflight > int(_QUEUE_PEAK.value):
                 _QUEUE_PEAK.set(self._inflight)
             self._futures[j] = self._pool.submit(self._decode, j)
+        else:
+            # full window submitted without hitting the budget: the next
+            # budget trip is a NEW stall episode and journals again
+            self._budget_stalled = False
 
     def get(self, i: int):
         """Decoded frame of split ``i`` (blocking). Re-raises the split's
@@ -240,7 +260,17 @@ class ScanPrefetcher:
             t0 = time.perf_counter()
             with TRACER.span("scan.prefetch.stall", split=i):
                 wait([fut], return_when=FIRST_COMPLETED)
-            _STALL_TIME.record(time.perf_counter() - t0)
+            stall_s = time.perf_counter() - t0
+            _STALL_TIME.record(stall_s)
+            with self._lock:
+                self._stall_events += 1
+                sample = self._stall_events <= self._EVENT_CAP
+            if sample:
+                # bounded sample per scan: a thousand-split scan must not
+                # flood the journal/flight ring (scan.prefetch.stallTime
+                # carries the exact aggregate)
+                EVENTS.emit("scanStall", split=i,
+                            stall_s=round(stall_s, 6))
         try:
             df = fut.result()
         except BaseException:

@@ -1,0 +1,308 @@
+"""Structured event journal + flight recorder (obs/events.py).
+
+Covers the ISSUE 3 tentpole contract: thread-safe JSONL writing,
+rotation at the size bound, the always-on flight recorder ring with its
+auto-dump on query failure, session lifecycle events (start/plan/end,
+conf fingerprint, plan digest, operator coverage, cpuFallback reasons),
+and the silent-truncation counters surfacing in the profile report."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.obs.events import EVENTS, EventLog, read_events
+from spark_rapids_tpu.sql import functions as F
+
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
+
+
+@pytest.fixture(autouse=True)
+def _events_reset_after():
+    yield
+    EVENTS.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# EventLog unit behavior (own instances — the singleton stays untouched)
+# ---------------------------------------------------------------------------
+
+class TestEventLog:
+    def test_disabled_writes_nothing_but_rings(self, tmp_path):
+        log = EventLog(ring_size=16)
+        log.emit("spill", bytes=10)
+        assert log.flight_events()[-1]["kind"] == "spill"
+        assert not os.path.exists(str(tmp_path / "never.jsonl"))
+
+    def test_jsonl_lines_parse(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        log = EventLog()
+        log.configure(True, path)
+        log.emit("a", x=1)
+        log.emit("b", y="s")
+        log.close()
+        lines = [json.loads(ln) for ln in open(path)]
+        assert [ev["kind"] for ev in lines] == ["a", "b"]
+        assert lines[0]["seq"] < lines[1]["seq"]
+        assert all("ts" in ev for ev in lines)
+
+    def test_rotation_at_size_bound(self, tmp_path):
+        path = str(tmp_path / "rot.jsonl")
+        log = EventLog()
+        log.configure(True, path, max_bytes=2000, rotations=2)
+        for i in range(100):
+            log.emit("tick", i=i, pad="x" * 40)
+        log.close()
+        assert log.rotations >= 3
+        assert log.dropped == 0
+        assert os.path.exists(path)
+        assert os.path.exists(path + ".1")
+        assert os.path.exists(path + ".2")
+        assert not os.path.exists(path + ".3")
+        for f in (path, path + ".1", path + ".2"):
+            assert os.path.getsize(f) <= 2000
+        # read_events folds rotations oldest-first: seq stays increasing
+        events = read_events(path)
+        seqs = [ev["seq"] for ev in events]
+        assert seqs == sorted(seqs)
+        # oldest rotations fell off the end — the tail is intact
+        assert events[-1]["i"] == 99
+
+    def test_truncate_in_place_with_zero_rotations(self, tmp_path):
+        path = str(tmp_path / "trunc.jsonl")
+        log = EventLog()
+        log.configure(True, path, max_bytes=500, rotations=0)
+        for i in range(50):
+            log.emit("tick", i=i)
+        log.close()
+        assert not os.path.exists(path + ".1")
+        assert os.path.getsize(path) <= 500
+
+    def test_concurrent_writers(self, tmp_path):
+        path = str(tmp_path / "conc.jsonl")
+        log = EventLog()
+        log.configure(True, path, max_bytes=1 << 20)
+        n_threads, per_thread = 8, 50
+
+        def work(t):
+            for i in range(per_thread):
+                log.emit("tick", thread=t, i=i)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        log.close()
+        events = [json.loads(ln) for ln in open(path)]
+        assert len(events) == n_threads * per_thread
+        assert log.dropped == 0
+        seqs = [ev["seq"] for ev in events]
+        assert len(set(seqs)) == len(seqs)  # no torn/duplicated writes
+        for t in range(n_threads):
+            mine = [ev["i"] for ev in events if ev["thread"] == t]
+            assert mine == sorted(mine)  # per-thread order preserved
+
+    def test_ring_is_bounded(self):
+        log = EventLog(ring_size=8)
+        for i in range(20):
+            log.emit("tick", i=i)
+        ring = log.flight_events()
+        assert len(ring) == 8
+        assert [ev["i"] for ev in ring] == list(range(12, 20))
+
+    def test_dump_flight_excludes_itself(self, tmp_path):
+        path = str(tmp_path / "fd.jsonl")
+        log = EventLog(ring_size=8)
+        log.configure(True, path)
+        log.emit("a")
+        log.emit("b")
+        dump = log.dump_flight(reason="test")
+        assert dump["kind"] == "flightRecorder"
+        assert [ev["kind"] for ev in dump["events"]] == ["a", "b"]
+        log.close()
+        written = [json.loads(ln) for ln in open(path)]
+        assert written[-1]["kind"] == "flightRecorder"
+        assert written[-1]["count"] == 2
+        # dumps never re-enter the ring: repeated failures must not nest
+        # prior dumps and grow ~2x each (the exponential-dump bug class)
+        dump2 = log.dump_flight(reason="again")
+        assert [ev["kind"] for ev in dump2["events"]] == ["a", "b"]
+
+    def test_write_failure_counts_dropped(self, tmp_path):
+        log = EventLog()
+        log.configure(True, str(tmp_path))  # a DIRECTORY: open() fails
+        log.emit("a")
+        assert log.dropped == 1
+
+    def test_rotation_failure_keeps_appending_honestly(self, tmp_path):
+        """A breached size bound whose rename fails must keep the
+        journal appending (no lost events), count rotate_failures, and
+        NOT fake dropped/rotations."""
+        path = str(tmp_path / "rf.jsonl")
+        log = EventLog()
+        log.configure(True, path, max_bytes=300, rotations=2)
+        os.mkdir(path + ".2")  # unlink(dir) fails -> rotation impossible
+        for i in range(20):
+            log.emit("tick", i=i)
+        log.close()
+        assert log.rotate_failures >= 1
+        assert log.rotations == 0
+        assert log.dropped == 0
+        events = [json.loads(ln) for ln in open(path)]
+        assert len(events) == 20  # every event survived, file oversized
+
+
+# ---------------------------------------------------------------------------
+# Session integration: lifecycle events + failure path + flight recorder
+# ---------------------------------------------------------------------------
+
+def _df(session, n=64):
+    pdf = pd.DataFrame({"k": np.arange(n, dtype=np.int64) % 4,
+                        "v": np.linspace(0.0, 1.0, n)})
+    return session.create_dataframe(pdf, 2)
+
+
+@pytest.fixture
+def journal(session, tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    session.set_conf("spark.rapids.tpu.eventLog.path", path)
+    yield path
+    session.set_conf("spark.rapids.tpu.eventLog.path", "")
+    EVENTS.reset_for_tests()
+
+
+class TestSessionJournal:
+    def test_query_lifecycle(self, session, journal):
+        _df(session).group_by("k").agg(F.sum("v").alias("sv")).collect()
+        events = read_events(journal)
+        kinds = [ev["kind"] for ev in events]
+        assert "queryStart" in kinds and "queryPlan" in kinds \
+            and "queryEnd" in kinds
+        start = next(ev for ev in events if ev["kind"] == "queryStart")
+        assert start["confFingerprint"]
+        plan = next(ev for ev in events if ev["kind"] == "queryPlan")
+        assert plan["planDigest"]
+        assert plan["tpuOps"] > 0
+        assert plan["query"] == start["query"]
+        end = next(ev for ev in events if ev["kind"] == "queryEnd")
+        assert end["status"] == "success"
+        assert end["wall_s"] > 0
+        assert end["coveragePct"] == 100.0
+
+    def test_cpu_fallback_reasons_and_coverage(self, session, journal):
+        session.set_conf("spark.rapids.sql.exec.ProjectExec", False)
+        try:
+            _df(session).select((F.col("v") * 2).alias("v2")).collect()
+        finally:
+            session.set_conf("spark.rapids.sql.exec.ProjectExec", True)
+        events = read_events(journal)
+        fbs = [ev for ev in events if ev["kind"] == "cpuFallback"]
+        assert fbs, events
+        assert fbs[0]["op"] == "CpuProjectExec"
+        assert any("disabled by conf" in r for r in fbs[0]["reasons"])
+        end = next(ev for ev in events if ev["kind"] == "queryEnd")
+        assert end["cpuOps"] >= 1
+        assert end["coveragePct"] < 100.0
+        # observed CPU-op seconds recorded for impact ranking
+        assert any("CpuProjectExec" in k
+                   for k in end.get("cpuOpTime", {}))
+
+    def test_failure_dumps_flight_recorder(self, session, journal,
+                                           monkeypatch):
+        df = _df(session)
+        from spark_rapids_tpu.session import TpuSparkSession
+
+        def boom(self, plan, ctx, conf):
+            raise RuntimeError("synthetic drain failure")
+        monkeypatch.setattr(TpuSparkSession, "_drain", boom)
+        with pytest.raises(RuntimeError, match="synthetic"):
+            df.collect()
+        events = read_events(journal)
+        end = next(ev for ev in events if ev["kind"] == "queryEnd")
+        assert end["status"] == "failed"
+        assert "synthetic drain failure" in end["error"]
+        dump = next(ev for ev in events if ev["kind"] == "flightRecorder")
+        assert dump["count"] > 0
+        # the dump precedes its queryEnd and holds the query's start
+        assert any(ev["kind"] == "queryStart" for ev in dump["events"])
+        assert events.index(dump) < events.index(end)
+
+    def test_dump_flight_recorder_api(self, session, journal):
+        _df(session).filter(F.col("v") > 0.5).collect()
+        snap = session.dump_flight_recorder()
+        assert any(ev["kind"] == "queryEnd" for ev in snap)
+        # the manual dump also lands in the journal
+        events = read_events(journal)
+        assert events[-1]["kind"] == "flightRecorder"
+        assert events[-1]["reason"] == "manual"
+
+    def test_journal_disabled_ring_still_runs(self, session):
+        assert not EVENTS.enabled
+        _df(session).filter(F.col("v") > 0.5).collect()
+        kinds = [ev["kind"] for ev in EVENTS.flight_events()]
+        assert "queryStart" in kinds and "queryEnd" in kinds
+
+    def test_spans_mirror_into_ring_while_tracing(self, session):
+        from spark_rapids_tpu.obs.trace import TRACER
+        session.set_conf("spark.rapids.tpu.trace.enabled", True)
+        try:
+            _df(session).filter(F.col("v") > 0.5).collect()
+        finally:
+            session.set_conf("spark.rapids.tpu.trace.enabled", False)
+            TRACER.configure(False)
+            TRACER.clear()
+        spans = [ev for ev in EVENTS.flight_events()
+                 if ev["kind"] == "span"]
+        assert any(ev["name"] == "Query" for ev in spans)
+
+
+class TestTruncationVisibility:
+    def test_dropped_and_rotations_in_profile(self, session, monkeypatch):
+        """Counters that move DURING the query surface as that query's
+        delta in the profile's observability section."""
+        from spark_rapids_tpu.obs.trace import TRACER
+        from spark_rapids_tpu.session import TpuSparkSession
+        orig = TpuSparkSession._drain
+
+        def bumping(self, plan, ctx, conf):
+            EVENTS.dropped += 3
+            EVENTS.rotations += 2
+            TRACER._dropped += 5
+            return orig(self, plan, ctx, conf)
+        monkeypatch.setattr(TpuSparkSession, "_drain", bumping)
+        try:
+            _df(session).filter(F.col("v") > 0.5).collect()
+            report = session.profile_report()
+            assert "observability" in report
+            assert "eventLog.droppedEvents: 3" in report
+            assert "eventLog.rotations: 2" in report
+            assert "trace.droppedEvents: 5" in report
+            doc = session.profile_json()
+            assert doc["summary"]["observability"] == {
+                "trace.droppedEvents": 5, "eventLog.droppedEvents": 3,
+                "eventLog.rotations": 2}
+        finally:
+            TRACER.clear()
+
+    def test_prior_query_truncation_not_reattributed(self, session):
+        """Cumulative process counters from EARLIER queries must not show
+        up in a clean query's profile (delta, not totals)."""
+        from spark_rapids_tpu.obs.trace import TRACER
+        TRACER.clear()
+        EVENTS.dropped = 7  # damage from some earlier query
+        EVENTS.rotations = 4
+        _df(session).filter(F.col("v") > 0.5).collect()
+        assert "observability" not in (session.profile_json() or
+                                       {}).get("summary", {})
+
+    def test_clean_run_has_no_observability_section(self, session):
+        from spark_rapids_tpu.obs.trace import TRACER
+        TRACER.clear()
+        _df(session).filter(F.col("v") > 0.5).collect()
+        assert "observability" not in (session.profile_json() or
+                                       {}).get("summary", {})

@@ -1,0 +1,174 @@
+"""tools/qualification.py round trip + smoke over checked-in artifacts.
+
+Generates a real event log through the session (successful queries, one
+forced CPU fallback, one failed query), runs the qualification tool over
+it, and checks the report answers the reference tool's questions:
+per-query TPU coverage %, fallback reasons ranked by time impact, and
+failed-query visibility. Also smokes the tool over the checked-in
+``docs/bench_profiles/`` and ``tools/trace_summary.py`` over the same
+event log."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.obs.events import EVENTS
+from spark_rapids_tpu.sql import functions as F
+
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
+
+
+def _load_tool(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(__file__), "..", "tools",
+                           name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+qualification = _load_tool("qualification")
+trace_summary = _load_tool("trace_summary")
+
+
+@pytest.fixture
+def mixed_log(session, tmp_path, monkeypatch):
+    """An event log holding two successful queries (one with a forced
+    fallback) and one failed query."""
+    path = str(tmp_path / "mixed.jsonl")
+    session.set_conf("spark.rapids.tpu.eventLog.path", path)
+    pdf = pd.DataFrame({"k": np.arange(64, dtype=np.int64) % 4,
+                        "v": np.linspace(0.0, 1.0, 64)})
+    df = session.create_dataframe(pdf, 2)
+    df.group_by("k").agg(F.sum("v").alias("sv")).collect()
+    session.set_conf("spark.rapids.sql.exec.ProjectExec", False)
+    try:
+        df.select((F.col("v") + 1).alias("v1")).collect()
+    finally:
+        session.set_conf("spark.rapids.sql.exec.ProjectExec", True)
+    from spark_rapids_tpu.session import TpuSparkSession
+    orig = TpuSparkSession._drain
+
+    def boom(self, plan, ctx, conf):
+        raise RuntimeError("injected failure")
+    monkeypatch.setattr(TpuSparkSession, "_drain", boom)
+    with pytest.raises(RuntimeError):
+        df.filter(F.col("v") > 0.5).collect()
+    monkeypatch.setattr(TpuSparkSession, "_drain", orig)
+    yield path
+    session.set_conf("spark.rapids.tpu.eventLog.path", "")
+    EVENTS.reset_for_tests()
+
+
+class TestQualification:
+    def test_event_log_roundtrip(self, mixed_log, capsys, tmp_path):
+        out_json = str(tmp_path / "report.json")
+        rc = qualification.main([mixed_log, "--json", out_json])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "workload qualification: 3 queries" in text
+        assert "2 succeeded, 1 failed" in text
+        assert "fallback reasons ranked by estimated time impact" in text
+        assert "disabled by conf spark.rapids.sql.exec.ProjectExec" in text
+        assert "injected failure" in text
+        assert "flight recorder dumped" in text
+
+        report = json.load(open(out_json))
+        assert report["totals"]["queries"] == 3
+        assert report["totals"]["failed"] == 1
+        covs = {r["query"]: r["coverage_pct"] for r in report["queries"]}
+        assert any(c == 100.0 for c in covs.values())
+        assert any(c is not None and c < 100.0 for c in covs.values())
+        fb = report["fallback_reasons"][0]
+        assert "ProjectExec" in " ".join(fb["ops"])
+        assert fb["impact_s"] >= 0.0
+        failed = [r for r in report["queries"] if r["status"] == "failed"]
+        assert failed and failed[0]["flight_dumped"]
+
+    def test_rotated_log_folds_in(self, session, tmp_path, capsys):
+        path = str(tmp_path / "rot.jsonl")
+        session.set_conf("spark.rapids.tpu.eventLog.path", path)
+        session.set_conf("spark.rapids.tpu.eventLog.maxFileBytes", 4096)
+        pdf = pd.DataFrame({"v": np.arange(32, dtype=np.int64)})
+        df = session.create_dataframe(pdf, 1).filter(F.col("v") > 3)
+        try:
+            for _ in range(8):
+                df.collect()
+        finally:
+            session.set_conf("spark.rapids.tpu.eventLog.path", "")
+            session.set_conf("spark.rapids.tpu.eventLog.maxFileBytes",
+                             16 << 20)
+            EVENTS.reset_for_tests()
+        assert os.path.exists(path + ".1")  # rotation actually happened
+        rc = qualification.main([path])
+        assert rc == 0
+        text = capsys.readouterr().out
+        # the report spans rotations: more queries than one file holds
+        assert "workload qualification:" in text
+        n = int(text.split("workload qualification: ")[1].split()[0])
+        assert n >= 2
+
+    def test_reused_query_ids_stay_separate(self):
+        """A journal appended across process restarts (bench worker
+        respawns) reuses q-1, q-2...: each queryStart must open a fresh
+        record, not merge two different queries."""
+        events = [
+            {"kind": "queryStart", "query": "q-1", "seq": 1, "ts": 1.0},
+            {"kind": "spill", "query": "q-1", "bytes": 100, "seq": 2,
+             "ts": 1.1},
+            {"kind": "queryEnd", "query": "q-1", "status": "failed",
+             "error": "boom", "seq": 3, "ts": 1.2},
+            # second process run, counter restarted
+            {"kind": "queryStart", "query": "q-1", "seq": 1, "ts": 2.0},
+            {"kind": "queryEnd", "query": "q-1", "status": "success",
+             "wall_s": 0.5, "coveragePct": 100.0, "seq": 2, "ts": 2.5},
+        ]
+        recs = qualification.records_from_events(events, source="t")
+        assert len(recs) == 2
+        assert recs[0]["query"] == "q-1"
+        assert recs[0]["status"] == "failed"
+        assert recs[0]["spill"]["bytes"] == 100
+        assert recs[1]["query"] == "q-1#2"
+        assert recs[1]["status"] == "success"
+        assert recs[1]["spill"]["bytes"] == 0
+
+    def test_bench_profiles_smoke(self, capsys):
+        profiles = sorted(glob.glob(
+            os.path.join(os.path.dirname(__file__), "..", "docs",
+                         "bench_profiles", "*.profile.json")))
+        assert profiles, "checked-in bench profiles missing"
+        rc = qualification.main(profiles)
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert f"{len(profiles)} queries" in text
+        assert "q6" in text
+
+    def test_mixed_inputs(self, mixed_log, capsys):
+        profile = os.path.join(os.path.dirname(__file__), "..", "docs",
+                               "bench_profiles", "q6.profile.json")
+        rc = qualification.main([mixed_log, profile])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "workload qualification: 4 queries" in text
+
+
+class TestTraceSummaryEventLog:
+    def test_event_log_input(self, mixed_log, capsys):
+        rc = trace_summary.main([mixed_log])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "event log:" in text
+        assert "queryEnd" in text
+        assert "failed" in text
+
+    def test_profile_input_still_works(self, capsys):
+        profile = os.path.join(os.path.dirname(__file__), "..", "docs",
+                               "bench_profiles", "q6.profile.json")
+        rc = trace_summary.main([profile])
+        assert rc == 0
+        assert "operator" in capsys.readouterr().out

@@ -93,9 +93,16 @@ def test_prefetcher_depth_honored():
     time.sleep(0.3)  # let the window submit and workers start
     assert max(started, default=0) <= depth
     assert max(pf._submitted) <= depth
+    # ...and no fewer: the full window 0..depth must actually be
+    # SUBMITTED while the consumer blocks (a prefetcher degraded to
+    # serial decode-on-get would still pass every upper-bound and
+    # ordering assertion in this file via get()'s inline fallback)
+    assert pf._submitted == set(range(depth + 1))
     gate.set()
     t.join(timeout=10)
     assert not t.is_alive()
+    # prefetch genuinely ran ahead: splits beyond 0 decoded on the pool
+    assert set(started) == set(range(depth + 1))
 
 
 def test_prefetcher_cancel_leaves_no_work(session):

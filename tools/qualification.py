@@ -1,0 +1,415 @@
+"""Workload qualification report over structured event logs.
+
+The reference pairs the plugin with a qualification tool that mines Spark
+history-server event logs to answer "which of my workloads benefit from
+acceleration, and what blocked the rest?"; this is the analogue over the
+journal ``spark_rapids_tpu/obs/events.py`` writes
+(``spark.rapids.tpu.eventLog.*``). It also accepts per-query profile
+JSONs (``session.profile_json()`` / ``docs/bench_profiles/*.profile.json``)
+so archived bench attribution feeds the same report.
+
+Per query it computes:
+
+  * **TPU operator coverage %** — converted vs kept-on-CPU operators
+    (transitions excluded), plus a time-weighted coverage when observed
+    CPU-operator seconds are on record;
+  * **fallback reasons ranked by estimated time impact** — each
+    ``cpuFallback`` reason weighted by the tagged operator's observed
+    inclusive seconds (count-weighted when the query never ran);
+  * **spill pressure** — bytes/events through the tiers, memory-pressure
+    backoffs;
+  * **fetch-retry hotspots** — shuffle retries/failures per peer;
+  * **compile-warmup share** — backend-compile seconds vs query wall.
+
+Usage:
+    python tools/qualification.py LOG_OR_PROFILE [...] [--json OUT] [-n N]
+
+Event-log rotations (``<path>.1`` ...) are folded in automatically when
+the base path is given. Failed queries report alongside successful ones
+(their flight-recorder dumps are counted), so a log mixing both still
+yields a complete report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+# ---------------------------------------------------------------------------
+# Input loading
+# ---------------------------------------------------------------------------
+
+def _load_any(path: str):
+    """('events', [...]) | ('profile', doc) by sniffing the file."""
+    with open(path) as f:
+        # full first non-blank line, however long (a post-rotation file
+        # can open with a flightRecorder dump far past any fixed window)
+        head_line = ""
+        for line in f:
+            if line.strip():
+                head_line = line
+                break
+    try:
+        first = json.loads(head_line) if head_line else None
+        if isinstance(first, dict) and "kind" in first:
+            from spark_rapids_tpu.obs.events import read_events
+            return "events", read_events(path)
+    except json.JSONDecodeError:
+        pass
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict) and "plan" in doc:
+        return "profile", doc
+    raise ValueError(
+        f"{path}: neither a JSONL event log (kind-keyed lines) nor a "
+        "profile JSON ('plan' key)")
+
+
+# ---------------------------------------------------------------------------
+# Per-query records from an event stream
+# ---------------------------------------------------------------------------
+
+def _new_record(name: str, source: str) -> Dict[str, Any]:
+    return {
+        "query": name, "source": source, "status": "unknown",
+        "wall_s": None, "tpu_ops": 0, "cpu_ops": 0, "coverage_pct": None,
+        "time_coverage_pct": None, "fallbacks": [],
+        "spill": {"bytes": 0, "events": 0, "pressure_events": 0},
+        "fetch": {"retries": 0, "failures": 0, "by_peer": {}},
+        "compile": {"compiles": 0, "seconds": 0.0, "cache_misses": 0,
+                    "warmup_share_pct": None},
+        "scan": {"stalls": 0, "stall_s": 0.0, "budget_stalls": 0},
+        "flight_dumped": False, "error": None,
+    }
+
+
+def records_from_events(events: List[Dict[str, Any]],
+                        source: str) -> List[Dict[str, Any]]:
+    # query ids are process-local counters (q-1, q-2, ...): a journal
+    # appended across runs (bench worker respawns) reuses them, so a
+    # queryStart for an already-seen id opens a FRESH record ("q-1#2")
+    # instead of merging two different queries into one
+    live: Dict[str, Dict[str, Any]] = {}
+    seen_count: Dict[str, int] = {}
+    out: List[Dict[str, Any]] = []
+
+    def new_rec(qid: str) -> Dict[str, Any]:
+        n = seen_count.get(qid, 0) + 1
+        seen_count[qid] = n
+        r = _new_record(qid if n == 1 else f"{qid}#{n}", source)
+        live[qid] = r
+        out.append(r)
+        return r
+
+    def rec_for(ev) -> Optional[Dict[str, Any]]:
+        qid = ev.get("query")
+        if qid is None:
+            return None
+        if ev.get("kind") == "queryStart":
+            return new_rec(qid)
+        r = live.get(qid)
+        return r if r is not None else new_rec(qid)
+
+    for ev in events:
+        kind = ev.get("kind")
+        r = rec_for(ev)
+        if r is None:
+            continue
+        if kind == "queryStart":
+            r["conf_fingerprint"] = ev.get("confFingerprint")
+        elif kind == "queryPlan":
+            r["plan_digest"] = ev.get("planDigest")
+            r["tpu_ops"] = ev.get("tpuOps", 0)
+            r["cpu_ops"] = ev.get("cpuOps", 0)
+            r["coverage_pct"] = ev.get("coveragePct")
+        elif kind == "cpuFallback":
+            r["fallbacks"].append({
+                "op": ev.get("op"), "describe": ev.get("describe"),
+                "reasons": list(ev.get("reasons") or []),
+                "impact_s": 0.0})
+        elif kind == "queryEnd":
+            r["status"] = ev.get("status", "unknown")
+            r["wall_s"] = ev.get("wall_s")
+            r["error"] = ev.get("error")
+            if "coveragePct" in ev:
+                r["coverage_pct"] = ev["coveragePct"]
+                r["tpu_ops"] = ev.get("tpuOps", r["tpu_ops"])
+                r["cpu_ops"] = ev.get("cpuOps", r["cpu_ops"])
+            cpu_time = ev.get("cpuOpTime") or {}
+            for fb in r["fallbacks"]:
+                fb["impact_s"] = round(
+                    cpu_time.get(fb.get("describe"), 0.0), 6)
+            cpu_s = sum(cpu_time.values())
+            if r["wall_s"]:
+                r["time_coverage_pct"] = round(
+                    100.0 * max(r["wall_s"] - cpu_s, 0.0) / r["wall_s"], 2)
+                if r["compile"]["seconds"]:
+                    r["compile"]["warmup_share_pct"] = round(min(
+                        100.0 * r["compile"]["seconds"] / r["wall_s"],
+                        100.0), 2)
+        elif kind == "spill":
+            r["spill"]["events"] += 1
+            r["spill"]["bytes"] += int(ev.get("bytes", 0))
+        elif kind == "memoryPressure":
+            r["spill"]["pressure_events"] += 1
+        elif kind == "fetchRetry":
+            r["fetch"]["retries"] += 1
+            peer = str(ev.get("peer", "?"))
+            r["fetch"]["by_peer"][peer] = \
+                r["fetch"]["by_peer"].get(peer, 0) + 1
+        elif kind == "fetchFailure":
+            r["fetch"]["failures"] += 1
+        elif kind == "backendCompile":
+            r["compile"]["compiles"] += 1
+            r["compile"]["seconds"] = round(
+                r["compile"]["seconds"] + float(ev.get("seconds", 0.0)), 4)
+        elif kind == "compileCacheMiss":
+            r["compile"]["cache_misses"] += 1
+        elif kind == "scanStall":
+            r["scan"]["stalls"] += 1
+            r["scan"]["stall_s"] = round(
+                r["scan"]["stall_s"] + float(ev.get("stall_s", 0.0)), 6)
+        elif kind == "scanBudgetStall":
+            r["scan"]["budget_stalls"] += 1
+        elif kind == "flightRecorder":
+            r["flight_dumped"] = True
+    for r in out:
+        r["fallbacks"].sort(key=lambda f: -f["impact_s"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Per-query records from a profile JSON (archived bench attribution)
+# ---------------------------------------------------------------------------
+
+_TRANSITIONS = ("HostToDeviceExec", "DeviceToHostExec")
+
+
+def record_from_profile(doc: Dict[str, Any], name: str) -> Dict[str, Any]:
+    r = _new_record(name, "profile")
+    r["status"] = "success"  # bench archives profiles of completed runs
+    r["wall_s"] = doc.get("wall_s")
+    cpu_s = 0.0
+
+    def walk(node):
+        nonlocal cpu_s
+        op = node.get("op", "")
+        base = op.split("(", 1)[0].strip()
+        if base not in _TRANSITIONS:
+            if base.startswith("Tpu"):
+                r["tpu_ops"] += 1
+            else:
+                r["cpu_ops"] += 1
+                cpu_s += node.get("inclusive_s", 0.0)
+                r["fallbacks"].append({
+                    "op": base, "describe": op,
+                    "reasons": ["stayed on CPU (profile record; run with "
+                                "the event log for tag reasons)"],
+                    "impact_s": round(node.get("inclusive_s", 0.0), 6)})
+        for c in node.get("children", []):
+            walk(c)
+
+    walk(doc.get("plan", {}))
+    total = r["tpu_ops"] + r["cpu_ops"]
+    r["coverage_pct"] = round(100.0 * r["tpu_ops"] / total, 2) \
+        if total else 100.0
+    if r["wall_s"]:
+        r["time_coverage_pct"] = round(
+            100.0 * max(r["wall_s"] - cpu_s, 0.0) / r["wall_s"], 2)
+    summary = doc.get("summary", {})
+    for k, v in (summary.get("spill") or {}).items():
+        if k.startswith("spill.bytes"):
+            r["spill"]["bytes"] += int(v)
+        elif k.startswith("spill.events"):
+            r["spill"]["events"] += int(v)
+    for k, v in (summary.get("shuffle") or {}).items():
+        if k.startswith("shuffle.fetch.retries"):
+            r["fetch"]["retries"] += int(v)
+        elif k.startswith("shuffle.fetch.failures"):
+            r["fetch"]["failures"] += int(v)
+    cc = summary.get("compileCache") or {}
+    r["compile"]["compiles"] = int(cc.get(
+        "compileCache.backendCompiles", 0))
+    r["compile"]["seconds"] = round(float(cc.get(
+        "compileCache.backendCompileTime", 0.0)), 4)
+    if r["wall_s"] and r["compile"]["seconds"]:
+        r["compile"]["warmup_share_pct"] = round(min(
+            100.0 * r["compile"]["seconds"] / r["wall_s"], 100.0), 2)
+    sc = summary.get("scan") or {}
+    for k, v in sc.items():
+        if k.startswith("scan.prefetch.stallTime"):
+            r["scan"]["stall_s"] = round(float(v), 6)
+        elif k.startswith("scan.prefetch.budgetStalls"):
+            r["scan"]["budget_stalls"] = int(v)
+    r["fallbacks"].sort(key=lambda f: -f["impact_s"])
+    return r
+
+
+# ---------------------------------------------------------------------------
+# Report assembly
+# ---------------------------------------------------------------------------
+
+def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    reasons: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        for fb in r["fallbacks"]:
+            for reason in fb["reasons"] or ["(no reason recorded)"]:
+                agg = reasons.setdefault(reason, {
+                    "reason": reason, "impact_s": 0.0, "queries": set(),
+                    "ops": set()})
+                agg["impact_s"] = round(agg["impact_s"] + fb["impact_s"], 6)
+                agg["queries"].add(r["query"])
+                if fb.get("op"):
+                    agg["ops"].add(fb["op"])
+    ranked = sorted(reasons.values(),
+                    key=lambda a: (-a["impact_s"], -len(a["queries"])))
+    for a in ranked:
+        a["queries"] = sorted(a["queries"])
+        a["ops"] = sorted(a["ops"])
+    n_ok = sum(1 for r in records if r["status"] == "success")
+    n_fail = sum(1 for r in records if r["status"] == "failed")
+    covs = [r["coverage_pct"] for r in records
+            if r["coverage_pct"] is not None]
+    totals = {
+        "queries": len(records), "succeeded": n_ok, "failed": n_fail,
+        "mean_coverage_pct": round(sum(covs) / len(covs), 2)
+        if covs else None,
+        "fully_on_tpu": sum(1 for c in covs if c >= 100.0),
+        "spill_bytes": sum(r["spill"]["bytes"] for r in records),
+        "fetch_retries": sum(r["fetch"]["retries"] for r in records),
+        "compile_seconds": round(sum(r["compile"]["seconds"]
+                                     for r in records), 2),
+    }
+    return {"version": 1, "totals": totals, "queries": records,
+            "fallback_reasons": ranked}
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def render_text(report: Dict[str, Any], top_n: int = 15) -> str:
+    t = report["totals"]
+    lines: List[str] = []
+    lines.append(
+        f"workload qualification: {t['queries']} queries "
+        f"({t['succeeded']} succeeded, {t['failed']} failed), "
+        f"mean TPU op coverage "
+        f"{t['mean_coverage_pct'] if t['mean_coverage_pct'] is not None else '?'}%, "
+        f"{t['fully_on_tpu']} fully on TPU")
+    lines.append("")
+    lines.append(f"{'query':<18} {'status':<8} {'wall_s':>8} {'cov%':>6} "
+                 f"{'tcov%':>6} {'spill':>9} {'retries':>7} "
+                 f"{'compile_s':>9} {'top fallback'}")
+    for r in report["queries"]:
+        top_fb = ""
+        if r["fallbacks"]:
+            fb = r["fallbacks"][0]
+            reason = (fb["reasons"][0] if fb["reasons"] else "?")
+            top_fb = f"{fb['op']}: {reason}"[:60]
+        wall = f"{r['wall_s']:.3f}" if r["wall_s"] is not None else "-"
+        cov = f"{r['coverage_pct']:.0f}" \
+            if r["coverage_pct"] is not None else "-"
+        tcov = f"{r['time_coverage_pct']:.0f}" \
+            if r["time_coverage_pct"] is not None else "-"
+        lines.append(
+            f"{str(r['query'])[:18]:<18} {r['status']:<8} {wall:>8} "
+            f"{cov:>6} {tcov:>6} "
+            f"{_fmt_bytes(r['spill']['bytes']):>9} "
+            f"{r['fetch']['retries']:>7} "
+            f"{r['compile']['seconds']:>9.2f} {top_fb}")
+    ranked = report["fallback_reasons"]
+    if ranked:
+        lines.append("")
+        lines.append("-- fallback reasons ranked by estimated time impact")
+        lines.append(f"{'impact_s':>9} {'queries':>7}  reason")
+        for a in ranked[:top_n]:
+            lines.append(f"{a['impact_s']:>9.4f} {len(a['queries']):>7}  "
+                         f"{a['reason'][:100]}")
+    hot = {}
+    for r in report["queries"]:
+        for peer, n in r["fetch"]["by_peer"].items():
+            hot[peer] = hot.get(peer, 0) + n
+    if hot:
+        lines.append("")
+        lines.append("-- fetch-retry hotspots (peer: retries)")
+        for peer, n in sorted(hot.items(), key=lambda kv: -kv[1])[:top_n]:
+            lines.append(f"   {peer}: {n}")
+    if t["spill_bytes"]:
+        lines.append("")
+        lines.append(f"-- spill pressure: {_fmt_bytes(t['spill_bytes'])} "
+                     f"across "
+                     f"{sum(r['spill']['events'] for r in report['queries'])}"
+                     f" spill events")
+    failed = [r for r in report["queries"] if r["status"] == "failed"]
+    if failed:
+        lines.append("")
+        lines.append("-- failed queries")
+        for r in failed:
+            dump = " [flight recorder dumped]" if r["flight_dumped"] else ""
+            lines.append(f"   {r['query']}: {r['error'] or '?'}"[:140]
+                         + dump)
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Workload qualification report from event logs "
+                    "(obs/events.py JSONL) and/or profile JSONs")
+    ap.add_argument("inputs", nargs="+",
+                    help="event-log files (rotations folded in) and/or "
+                         "*.profile.json files")
+    ap.add_argument("--json", metavar="OUT", default="",
+                    help="also write the machine-shape report here "
+                         "('-' for stdout)")
+    ap.add_argument("-n", "--top", type=int, default=15,
+                    help="rows per ranking section (default 15)")
+    args = ap.parse_args(argv)
+
+    records: List[Dict[str, Any]] = []
+    for path in args.inputs:
+        try:
+            kind, data = _load_any(path)
+        except (ValueError, OSError) as e:
+            print(f"qualification: {e}", file=sys.stderr)
+            return 2
+        if kind == "events":
+            records.extend(records_from_events(data, source=path))
+        else:
+            name = os.path.basename(path).replace(".profile.json", "")
+            records.append(record_from_profile(data, name))
+    report = build_report(records)
+    if args.json == "-":
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_text(report, args.top))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `| head` closed the pipe: not an error
+        sys.exit(0)

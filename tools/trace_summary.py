@@ -1,7 +1,7 @@
 """Summarize an observability artifact: top exclusive-time spans + event
 counts.
 
-Reads either artifact the obs/ subsystem emits:
+Reads any artifact the obs/ subsystem emits:
 
   * a Chrome trace JSON (``spark.rapids.tpu.trace.path`` export) — computes
     per-span exclusive time (duration minus directly-nested child spans on
@@ -10,7 +10,11 @@ Reads either artifact the obs/ subsystem emits:
   * a per-query profile JSON (``session.profile_json()`` /
     ``docs/bench_profiles/*.profile.json``) — walks the plan tree for
     exclusive operator time and prints the spill/shuffle/kernel-cache
-    summary sections.
+    summary sections;
+  * a JSONL event log (``spark.rapids.tpu.eventLog.path``, obs/events.py)
+    — per-kind event counts and a one-line-per-query digest (status,
+    wall, coverage). ``tools/qualification.py`` is the full report over
+    the same file.
 
 Usage:
     python tools/trace_summary.py FILE [-n TOP_N]
@@ -113,26 +117,65 @@ def _summarize_profile(doc: Dict[str, Any], top_n: int) -> None:
               f"({saved:.1f}s saved)")
 
 
+def _summarize_event_log(path: str, top_n: int) -> None:
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from spark_rapids_tpu.obs.events import read_events
+    events = read_events(path)
+    kinds: Dict[str, int] = {}
+    for ev in events:
+        kinds[ev["kind"]] = kinds.get(ev["kind"], 0) + 1
+    print(f"event log: {len(events)} events")
+    print(f"{'count':>7}  kind")
+    for kind, n in sorted(kinds.items(), key=lambda kv: -kv[1])[:top_n]:
+        print(f"{n:7d}  {kind}")
+    ends = [ev for ev in events if ev["kind"] == "queryEnd"]
+    if ends:
+        print("-- queries")
+        for ev in ends:
+            wall = ev.get("wall_s")
+            cov = ev.get("coveragePct")
+            print(f"   {ev.get('query', '?')}: {ev.get('status')}"
+                  + (f" wall={wall:.3f}s" if wall is not None else "")
+                  + (f" coverage={cov:.0f}%" if cov is not None else "")
+                  + (f" error={ev.get('error')}"[:120]
+                     if ev.get("error") else ""))
+        print("(full report: python tools/qualification.py "
+              f"{path})")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Top exclusive-time spans and event counts of a trace "
-                    "or profile JSON")
-    ap.add_argument("file", help="Chrome trace JSON or profile JSON")
+                    "JSON, profile JSON, or JSONL event log")
+    ap.add_argument("file", help="Chrome trace JSON, profile JSON, or "
+                                 "event-log JSONL")
     ap.add_argument("-n", "--top", type=int, default=15,
                     help="rows to print (default 15)")
     args = ap.parse_args(argv)
     with open(args.file) as f:
-        doc = json.load(f)
-    if "traceEvents" in doc:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError:
+            doc = None  # not one JSON document: try JSONL event log
+    if doc is None or (isinstance(doc, dict) and "kind" in doc):
+        # a single-event file is still a (one-line) event log
+        _summarize_event_log(args.file, args.top)
+    elif "traceEvents" in doc:
         _summarize_trace(doc, args.top)
     elif "plan" in doc:
         _summarize_profile(doc, args.top)
     else:
         print("unrecognized artifact: expected 'traceEvents' (Chrome "
-              "trace) or 'plan' (profile JSON) key", file=sys.stderr)
+              "trace), 'plan' (profile JSON), or JSONL event lines",
+              file=sys.stderr)
         return 2
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `| head` closed the pipe: not an error
+        sys.exit(0)
