@@ -52,6 +52,14 @@ Env knobs:
                 reasons, spills, fetch retries, compiles) minable with
                 tools/qualification.py.
 
+AQE sweep (`--aqe-sweep` or BENCH_AQE=1): every sweep query additionally
+runs with spark.rapids.sql.adaptive.enabled=true (steady-state min over
+BENCH_ITERS, verified against the CPU oracle) and the per-query AQE-off
+vs AQE-on wall times, the runtime plan shape and the adaptive decisions
+(stages, coalesced reads, broadcast demotions, skew splits) land in
+BENCH_AQE.json (BENCH_AQE_FILE to override) — the perf trajectory's AQE
+axis.
+
 Scan-inclusive mode (`--include-scan` or BENCH_INCLUDE_SCAN=1): for the
 tpch queries in BENCH_SCAN_QUERIES (default q1,q6,q14), additionally time
 the TPU path over real multi-row-group Parquet files with the device scan
@@ -381,6 +389,33 @@ def _worker():
             session.set_conf("spark.rapids.tpu.trace.path", "")
         return rec
 
+    # --aqe-sweep: the same query AQE-on, steady state + decisions. The
+    # AQE-off number is the main record's tpu_s (measured just before),
+    # so the pair shares warm caches symmetrically.
+    def measure_aqe(fn):
+        rec = {}
+        session.set_conf("spark.rapids.sql.adaptive.enabled", True)
+        try:
+            run_query(fn, True)  # warm AQE shapes (stage-split uploads)
+            it = []
+            out = None
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                out = run_query(fn, True)
+                it.append(round(time.perf_counter() - t0, 4))
+            rec["aqe_iters"] = it
+            rec["aqe_s"] = min(it)
+            aqe = getattr(session, "last_aqe", None) or {}
+            rec["stages"] = aqe.get("stages", 0)
+            rec["decisions"] = aqe.get("decisions", [])
+            rec["plan_changed"] = bool(aqe.get("planChanged"))
+            rec["plan"] = (aqe.get("plan") or "").splitlines()
+            cpu_out = run_query(fn, False)  # oracle under the same conf
+            rec["verified"] = _results_match(out, cpu_out)
+        finally:
+            session.set_conf("spark.rapids.sql.adaptive.enabled", False)
+        return rec
+
     # scan-cost probes (VERDICT r4 next #8): the sweep runs with
     # cacheDeviceScans=true on BOTH paths (symmetric residency), which
     # hides host-decode + upload cost. For a few representative queries,
@@ -442,6 +477,8 @@ def _worker():
                     rec["profile_file"] = pf
                 except OSError:
                     pass
+            if os.environ.get("BENCH_AQE", "") == "1":
+                rec["aqe"] = measure_aqe(suites[sn][q])
             if req["name"] in scan_cost_queries:
                 so = measure_scan_off(suites[sn][q])
                 rec["tpu_scan_off_iters"] = so
@@ -592,6 +629,8 @@ def main():
         # (appended across worker respawns — rotation bounds the size);
         # default artifact name parallels BENCH_DETAIL.json
         os.environ.setdefault("BENCH_EVENT_LOG", "BENCH_EVENTS.jsonl")
+    if "--aqe-sweep" in sys.argv:
+        os.environ["BENCH_AQE"] = "1"
 
     suite_names, sweep = _parse_sweep()
     sf = float(os.environ.get("BENCH_SF", "0.5"))
@@ -747,6 +786,36 @@ def main():
                 json.dump(scan_doc, f, indent=1)
         except OSError as e:
             print(f"bench: could not write {scan_file}: {e}",
+                  file=sys.stderr, flush=True)
+
+    # AQE sidecar (--aqe-sweep): per-query AQE-off vs AQE-on wall time +
+    # the runtime-chosen plan shape and decisions, so the perf trajectory
+    # finally has an adaptive axis next to BENCH_DETAIL/BENCH_SCAN
+    aqe_detail = {k: v["aqe"] for k, v in detail.items()
+                  if isinstance(v, dict) and "aqe" in v}
+    if aqe_detail:
+        aqe_file = os.environ.get("BENCH_AQE_FILE", "BENCH_AQE.json")
+        aqe_doc = {
+            "sf": sf, "iters": iters, "steady_state": "min_of_iters",
+            "mode": "aqe_sweep: spark.rapids.sql.adaptive.enabled on vs "
+                    "off per query; AQE-on results verified against the "
+                    "CPU oracle; aqe_off_s is the main sweep's tpu_s",
+            "queries": {
+                name: dict(aq, aqe_off_s=detail[name].get("tpu_s"),
+                           aqe_speedup=round(
+                               detail[name]["tpu_s"] / aq["aqe_s"], 3)
+                           if aq.get("aqe_s") and detail[name].get("tpu_s")
+                           else None)
+                for name, aq in aqe_detail.items()},
+            "plan_changed_queries": sorted(
+                n for n, aq in aqe_detail.items()
+                if aq.get("plan_changed")),
+        }
+        try:
+            with open(aqe_file, "w") as f:
+                json.dump(aqe_doc, f, indent=1)
+        except OSError as e:
+            print(f"bench: could not write {aqe_file}: {e}",
                   file=sys.stderr, flush=True)
 
     scored = {k: v for k, v in detail.items() if "speedup" in v}

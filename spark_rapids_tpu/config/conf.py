@@ -528,6 +528,68 @@ EVENT_LOG_ROTATIONS = register(
     "0 truncates in place at the size bound instead of rotating.",
     validator=_non_negative)
 
+# --- adaptive query execution (sql/adaptive/; the reference's AQE role:
+# GpuShuffleExchangeExec reports MapOutputStatistics so Spark re-plans at
+# runtime — coalesced partitions, demoted broadcasts, split skew) ----------
+ADAPTIVE_ENABLED = register(
+    "spark.rapids.sql.adaptive.enabled", _to_bool, False,
+    "Adaptive query execution: cut the physical plan into query stages at "
+    "hash-exchange boundaries, materialize each stage's map side, fold the "
+    "observed per-partition sizes into MapOutputStatistics and re-optimize "
+    "the not-yet-executed remainder (partition coalescing, dynamic "
+    "broadcast conversion, skew-join splitting — sql/adaptive/). false "
+    "(default) keeps the LEGACY single-shot planner byte-identical. "
+    "Ignored on a device mesh (mesh exchanges are real ICI collectives; "
+    "host-side stage materialization would defeat them).")
+
+ADAPTIVE_COALESCE_ENABLED = register(
+    "spark.rapids.sql.adaptive.coalesce.enabled", _to_bool, True,
+    "With AQE on, merge adjacent reduce partitions whose combined "
+    "measured size is below "
+    "spark.rapids.sql.adaptive.coalesce.minPartitionSize, so the reduce "
+    "side runs fewer, fuller tasks (Spark's CoalesceShufflePartitions). "
+    "Join inputs coalesce jointly (combined sizes) to stay "
+    "co-partitioned.")
+
+ADAPTIVE_COALESCE_MIN_SIZE = register(
+    "spark.rapids.sql.adaptive.coalesce.minPartitionSize", _to_bytes,
+    8 << 20,
+    "Target (and minimum) measured byte size of one post-coalesce reduce "
+    "partition; adjacent partitions merge until the group reaches it. "
+    "Also the advisory target size of one skew-split sub-partition.",
+    validator=_positive)
+
+ADAPTIVE_BROADCAST_ENABLED = register(
+    "spark.rapids.sql.adaptive.broadcast.enabled", _to_bool, True,
+    "With AQE on, replace a planned shuffled-hash join with a broadcast "
+    "hash join when the build side's MEASURED materialized size comes in "
+    "under spark.rapids.sql.autoBroadcastJoinThreshold (which the static "
+    "planner could not prove from estimates). The already-materialized "
+    "map output is reused as the broadcast table — the source is never "
+    "re-read — and a not-yet-materialized stream-side shuffle is elided "
+    "entirely.")
+
+ADAPTIVE_SKEW_ENABLED = register(
+    "spark.rapids.sql.adaptive.skewJoin.enabled", _to_bool, True,
+    "With AQE on, split a skewed reduce partition of a shuffled join "
+    "into map-range sub-partitions on the skewed side and replicate the "
+    "matching partition on the other side (Spark's "
+    "OptimizeSkewedJoin). A partition is skewed when its measured size "
+    "exceeds skewedPartitionFactor x the median AND "
+    "skewedPartitionThreshold.")
+
+ADAPTIVE_SKEW_FACTOR = register(
+    "spark.rapids.sql.adaptive.skewJoin.skewedPartitionFactor", float, 5.0,
+    "Multiple of the median reduce-partition size beyond which a join "
+    "partition counts as skewed.", validator=_positive)
+
+ADAPTIVE_SKEW_THRESHOLD = register(
+    "spark.rapids.sql.adaptive.skewJoin.skewedPartitionThreshold",
+    _to_bytes, 4 << 20,
+    "Minimum measured byte size for a reduce partition to count as "
+    "skewed (guards the factor test against tiny shuffles).",
+    validator=_positive)
+
 FLIGHT_RECORDER_SIZE = register(
     "spark.rapids.tpu.eventLog.flightRecorderSize", int, 256,
     "Entries in the always-on flight-recorder ring (last N events, plus "
@@ -630,6 +692,26 @@ class TpuConf:
     def shuffle_bounce_buffer_count(self) -> int: return self.get(SHUFFLE_BOUNCE_BUFFER_COUNT.key)
     @property
     def export_columnar_rdd(self) -> bool: return self.get(EXPORT_COLUMNAR_RDD.key)
+    @property
+    def adaptive_enabled(self) -> bool: return self.get(ADAPTIVE_ENABLED.key)
+    @property
+    def adaptive_coalesce_enabled(self) -> bool:
+        return self.get(ADAPTIVE_COALESCE_ENABLED.key)
+    @property
+    def adaptive_coalesce_min_size(self) -> int:
+        return self.get(ADAPTIVE_COALESCE_MIN_SIZE.key)
+    @property
+    def adaptive_broadcast_enabled(self) -> bool:
+        return self.get(ADAPTIVE_BROADCAST_ENABLED.key)
+    @property
+    def adaptive_skew_enabled(self) -> bool:
+        return self.get(ADAPTIVE_SKEW_ENABLED.key)
+    @property
+    def adaptive_skew_factor(self) -> float:
+        return float(self.get(ADAPTIVE_SKEW_FACTOR.key))
+    @property
+    def adaptive_skew_threshold(self) -> int:
+        return self.get(ADAPTIVE_SKEW_THRESHOLD.key)
 
     def is_operator_enabled(self, key: str, incompat: bool = False,
                             disabled_by_default: bool = False) -> bool:

@@ -23,13 +23,76 @@ from spark_rapids_tpu.sql.exprs.hostutil import host_unary_values
 from spark_rapids_tpu.sql.functions import SortOrder
 
 
-def _concat_parts(it: Iterator[pd.DataFrame], schema: Schema) -> pd.DataFrame:
-    dfs = [df for df in it]
+def _is_masked(s: pd.Series) -> bool:
+    """Is this series backed by a masked (nullable-extension) array —
+    Int64/Float64/boolean — i.e. does it carry an explicit null mask?"""
+    arr = getattr(s, "array", None)
+    return hasattr(arr, "_mask") and hasattr(arr, "_data")
+
+
+def _lift_masked(s: pd.Series) -> pd.Series:
+    """Plain-numpy series -> the matching masked extension dtype with an
+    all-False mask. Constructed from the raw buffer (NOT pd.array/astype,
+    which coerce float NaN to NA) so a genuine NaN VALUE survives as a
+    value — NaN and NULL are distinct in this engine's null discipline
+    (columnar/batch.py)."""
+    if _is_masked(s):
+        return s
+    vals = s.to_numpy()
+    mask = np.zeros(len(vals), dtype=bool)
+    try:
+        if vals.dtype.kind == "f":
+            arr = pd.arrays.FloatingArray(vals, mask)
+        elif vals.dtype.kind in "iu":
+            arr = pd.arrays.IntegerArray(vals, mask)
+        elif vals.dtype.kind == "b":
+            arr = pd.arrays.BooleanArray(vals, mask)
+        else:
+            return s
+    except (TypeError, ValueError):
+        return s
+    return pd.Series(arr, name=s.name)
+
+
+def concat_host_frames(dfs: List[pd.DataFrame],
+                       schema: Schema) -> pd.DataFrame:
+    """Null-mask-preserving concat of partition frames.
+
+    pd.concat decides the result dtype from the pieces: a masked
+    (nullable-extension) column next to plain-numpy siblings downcasts to
+    plain float and its NA values become NaN — but NaN is a VALUE here,
+    so the null mask is silently destroyed (tpcxbb q17: a partial
+    aggregate's NULL sum from an empty partition merged as NaN and
+    poisoned the final sum). When pieces disagree, plain pieces are
+    lifted to the masked dtype first (all-False mask — genuine NaN values
+    keep being values)."""
+    dfs = [df for df in dfs]
     if not dfs:
         return _empty_df(schema)
     if len(dfs) == 1:
         return dfs[0]
+    ncols = dfs[0].shape[1]
+    mixed = []
+    for i in range(ncols):
+        kinds = [_is_masked(df.iloc[:, i]) for df in dfs]
+        mixed.append(any(kinds) and not all(kinds))
+    if any(mixed):
+        lifted = []
+        for df in dfs:
+            series = [(_lift_masked(df.iloc[:, i]) if mixed[i]
+                       else df.iloc[:, i]).reset_index(drop=True)
+                      for i in range(ncols)]
+            # positional assembly: join outputs may carry duplicate names
+            nd = (pd.concat(series, axis=1) if series
+                  else pd.DataFrame(index=range(len(df))))
+            nd.columns = list(df.columns)
+            lifted.append(nd)
+        dfs = lifted
     return pd.concat(dfs, ignore_index=True)
+
+
+def _concat_parts(it: Iterator[pd.DataFrame], schema: Schema) -> pd.DataFrame:
+    return concat_host_frames(list(it), schema)
 
 
 def _empty_df(schema: Schema) -> pd.DataFrame:
@@ -240,6 +303,25 @@ class CpuShuffleExchangeExec(PhysicalPlan):
     def describe(self) -> str:
         return f"CpuShuffleExchangeExec({self.partitioning[0]})"
 
+    def materialize_stage(self, ctx: ExecContext):
+        """AQE query-stage materialization (sql/adaptive/): run the map
+        side (this exchange's child), split every map partition by the
+        CANONICAL hash of the key columns, and report per-(map, reduce
+        partition) byte sizes — the host-side role of
+        MapStatus.partition_sizes on the manager path. Returns
+        (map_outputs[map][pid] -> DataFrame, MapOutputStatistics-shaped
+        stats from sql/adaptive/stats.py)."""
+        from spark_rapids_tpu.sql.adaptive import stats as aqestats
+        assert self.partitioning[0] == "hash", self.partitioning
+        key_idx = list(self.partitioning[1])
+        n = self.partitioning[2]
+        schema = self.children[0].output_schema()
+        map_outputs = []
+        for part in self.children[0].executed_partitions(ctx):
+            df = concat_host_frames(list(part()), schema)
+            map_outputs.append(aqestats.split_frame(df, key_idx, n))
+        return map_outputs, aqestats.stats_from_map_outputs(map_outputs)
+
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child_parts = self.children[0].executed_partitions(ctx)
         schema = self.children[0].output_schema()
@@ -247,8 +329,7 @@ class CpuShuffleExchangeExec(PhysicalPlan):
         if kind == "single":
             def single():
                 dfs = [df for p in child_parts for df in p()]
-                yield (pd.concat(dfs, ignore_index=True) if dfs
-                       else _empty_df(schema))
+                yield concat_host_frames(dfs, schema)
             return [single]
         if kind in ("hash", "roundrobin"):
             n = self.partitioning[-1]
@@ -269,13 +350,22 @@ class CpuShuffleExchangeExec(PhysicalPlan):
                         sel = df[pids == pid]
                         if len(sel):
                             buckets[pid].append(sel.reset_index(drop=True))
+            if kind == "hash" and n > 1 and ctx.metrics_enabled:
+                # shuffle-skew observability, independent of AQE: per-
+                # shuffle max/median partition-size ratio (obs/shuffleobs)
+                from spark_rapids_tpu.obs.shuffleobs import (
+                    record_shuffle_skew,
+                )
+                from spark_rapids_tpu.sql.adaptive.stats import (
+                    estimate_frame_bytes,
+                )
+                record_shuffle_skew(
+                    [sum(estimate_frame_bytes(f) for f in b)
+                     for b in buckets], source="cpu:hash")
 
             def make(pid: int) -> Partition:
                 def run():
-                    if buckets[pid]:
-                        yield pd.concat(buckets[pid], ignore_index=True)
-                    else:
-                        yield _empty_df(schema)
+                    yield concat_host_frames(buckets[pid], schema)
                 return run
             return [make(i) for i in range(n)]
         if kind == "range":
@@ -296,8 +386,7 @@ class CpuShuffleExchangeExec(PhysicalPlan):
                 if "parts" in state:
                     return state["parts"]
                 dfs = [df for p in child_parts for df in p()]
-                df = (pd.concat(dfs, ignore_index=True) if dfs
-                      else _empty_df(schema))
+                df = concat_host_frames(dfs, schema)
                 idx = host_sort_indices(df, orders)
                 df = df.iloc[idx].reset_index(drop=True)
                 per = -(-len(df) // n) if len(df) else 0

@@ -991,6 +991,43 @@ class TpuShuffleExchangeExec(TpuExec):
     def fingerprint_extra(self) -> str:
         return repr(self.partitioning)
 
+    def materialize_stage(self, ctx: ExecContext):
+        """AQE query-stage materialization (sql/adaptive/): run the map
+        side on device, bring the batches to the host in one fused fetch
+        (DeviceBatch.to_pandas_many — two round trips for the whole
+        stage), split each map partition with the canonical host hash
+        and report per-(map, partition) sizes. AQE is a statistics
+        barrier by design: the map output must become host-addressable
+        for the runtime to measure and re-partition it — the role the
+        reference's shuffle catalog registration plays
+        (RapidsCachingWriter -> MapStatus.partition_sizes)."""
+        from spark_rapids_tpu.exec.cpu import concat_host_frames
+        from spark_rapids_tpu.sql.adaptive import stats as aqestats
+        assert self.partitioning[0] == "hash", self.partitioning
+        key_idx = list(self.partitioning[1])
+        n = self.partitioning[-1]
+        schema = self.output_schema()
+        sess = ctx.session
+        per_map: List[List[DeviceBatch]] = []
+        for part in self.children[0].executed_partitions(ctx):
+            try:
+                per_map.append(list(part()))
+            finally:
+                if sess is not None and sess.semaphore is not None:
+                    sess.semaphore.release()
+        flat = [b for bs in per_map for b in bs]
+        frames = DeviceBatch.to_pandas_many(
+            flat, fused_fetch_bytes=int(ctx.conf.get(
+                "spark.rapids.sql.collect.fusedFetchBytes", 4 << 20)))
+        map_outputs = []
+        pos = 0
+        for bs in per_map:
+            dfs = frames[pos:pos + len(bs)]
+            pos += len(bs)
+            df = concat_host_frames(dfs, schema)
+            map_outputs.append(aqestats.split_frame(df, key_idx, n))
+        return map_outputs, aqestats.stats_from_map_outputs(map_outputs)
+
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child_parts = self.children[0].executed_partitions(ctx)
         schema = self.output_schema()
@@ -1369,6 +1406,21 @@ class TpuShuffleExchangeExec(TpuExec):
                     writer = CachingShuffleWriter(envs[mi % len(envs)],
                                                   shuffle_id, mi)
                     statuses.append(writer.write(per_pid))
+                if statuses and ctx.metrics_enabled:
+                    # per-shuffle skew from the EXACT device byte sizes
+                    # the writer recorded (MapStatus.partition_sizes) —
+                    # the satellite observability AQE's stage stats also
+                    # report on the host path (obs/shuffleobs.py)
+                    from spark_rapids_tpu.obs.shuffleobs import (
+                        record_shuffle_skew,
+                    )
+                    from spark_rapids_tpu.shuffle.manager import (
+                        aggregate_map_statistics,
+                    )
+                    record_shuffle_skew(
+                        aggregate_map_statistics(statuses)
+                        .bytes_by_partition,
+                        source=f"tpu:manager-{shuffle_id}")
                 mstate["statuses"] = (shuffle_id, statuses)
                 return mstate["statuses"]
 

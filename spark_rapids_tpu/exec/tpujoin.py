@@ -69,8 +69,21 @@ class TpuBroadcastExchangeExec(PhysicalPlan):
                 masks = [mask_kernel(b) for b in batches]
                 if out_sel is not None:
                     batches = [_select_view(b, out_sel) for b in batches]
-            return _concat_device(batches, child.output_schema(), growth,
-                                  masks)
+            out = _concat_device(batches, child.output_schema(), growth,
+                                 masks)
+            if ctx.metrics_enabled:
+                # build-table size on record: the broadcast twin of the
+                # exchanges' MapStatus sizes, so a (static or AQE-demoted)
+                # broadcast's actual footprint is visible next to the
+                # threshold that chose it (obs/events.py taxonomy)
+                from spark_rapids_tpu.obs.events import EVENTS
+                from spark_rapids_tpu.obs.metrics import REGISTRY
+                nbytes = out.device_memory_size()
+                REGISTRY.gauge("shuffle.broadcast.bytes").set(nbytes)
+                REGISTRY.counter("shuffle.broadcast.builds").add(1)
+                EVENTS.emit("broadcastMaterialized", bytes=int(nbytes),
+                            batches=len(batches))
+            return out
 
         if ctx.session is None:
             def run():

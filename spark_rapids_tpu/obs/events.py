@@ -26,6 +26,22 @@ Event taxonomy (one JSON object per line; every event carries ``kind``,
   backendCompile    compile      seconds (an XLA compile that actually ran)
   scanStall         scan         split, stall_s (sql/scan_pipeline.py)
   scanBudgetStall   scan         split (prefetch submission backpressure)
+  shuffleSkew       shuffle      source, partitions, totalBytes, maxBytes,
+                                 medianBytes, maxMedianRatio — every
+                                 materialized shuffle's size distribution,
+                                 AQE on or off (obs/shuffleobs.py)
+  broadcastMaterialized  exec    bytes, batches — a broadcast build table's
+                                 measured device size (exec/tpujoin.py)
+  aqeStageStats     adaptive     stage, partitions, maps, totalBytes,
+                                 maxBytes, medianBytes, rows — one per
+                                 materialized query stage
+  aqeCoalesce       adaptive     stages[], fromPartitions, toPartitions
+  aqeBroadcastDemote adaptive    stage, joinType, side, measuredBytes,
+                                 threshold, elidedStreamShuffle
+  aqeSkewSplit      adaptive     stage, side, partition, splits, bytes
+                                 (all four: sql/adaptive/executor.py; the
+                                 queryPlan event additionally carries
+                                 adaptive=true + aqeStages/aqeDecisions)
   flightRecorder    session      reason, events[] (ring dump, see below)
 
 Journal mechanics:

@@ -62,10 +62,21 @@ def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
         return got
 
     summary["spill"] = take("spill.")
+    # shuffle-skew section BEFORE the generic shuffle take so the skew
+    # counters land in their own section (obs/shuffleobs.py); the ratio
+    # gauges are state, not flow — appended only when this query actually
+    # materialized a measured shuffle (the counter delta says so)
+    summary["shuffleSkew"] = take("shuffle.skew.")
+    summary["adaptive"] = take("aqe.")
     summary["shuffle"] = take("shuffle.")
     summary["kernelCache"] = take("kernelCache.")
     summary["scan"] = take("scan.")
     summary["compileCache"] = take("compileCache.")
+    if summary["shuffleSkew"]:
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        for m in REGISTRY.metrics():
+            if m.kind == "gauge" and m.name.startswith("shuffle.skew."):
+                summary["shuffleSkew"].setdefault(m.name, m.value)
     if summary["scan"]:
         # gauges are state, not flow — excluded from the delta, but the
         # pipeline's depth gauges are exactly what a scan profile needs

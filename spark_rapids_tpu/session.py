@@ -151,6 +151,10 @@ class TpuSparkSession:
         self.last_node_times: dict = {}
         self.last_plan = None
         self.last_profile = None
+        # adaptive-execution record of the last AQE query: stage count,
+        # rule decisions, final plan tree (sql/adaptive/executor.py);
+        # bench.py --aqe-sweep archives it per query
+        self.last_aqe: Optional[dict] = None
 
     def clear_device_cache(self) -> None:
         for _source, parts in self.device_scan_cache.values():
@@ -359,6 +363,7 @@ class TpuSparkSession:
         self.last_node_times = {}
         self.last_plan = None
         self.last_profile = None
+        self.last_aqe = None
         # process-wide registry snapshot: the profile reports this query's
         # DELTA of spill/fetch/compile activity
         global_before = (obs_metrics.REGISTRY.values()
@@ -434,6 +439,21 @@ class TpuSparkSession:
             cpu_plan = planner.plan_collect_limit(logical)
         else:
             cpu_plan = planner.plan(logical)
+        # adaptive query execution (sql/adaptive/): cut the plan into
+        # stages at hash-exchange boundaries, materialize map sides,
+        # re-optimize the remainder from the observed sizes. Off (the
+        # default) — and on a mesh, and for stage-less plans — the
+        # legacy single-shot path below runs byte-identically.
+        if (conf.get_bool("spark.rapids.sql.adaptive.enabled", False)
+                and getattr(self, "mesh", None) is None):
+            from spark_rapids_tpu.sql.adaptive.executor import (
+                has_adaptive_stages,
+            )
+            if has_adaptive_stages(cpu_plan):
+                return self._run_adaptive(cpu_plan, ctx, conf,
+                                          obs_metrics, global_before,
+                                          t_query0, trace_on, trace_path,
+                                          obs_before)
         overrides = None
         if conf.sql_enabled:
             overrides = TpuOverrides(conf)
@@ -503,10 +523,61 @@ class TpuSparkSession:
         finally:
             self.release_active_shuffles()
             self.release_transient_buffers()
-        # per-operator SQL metrics of the last executed query (the
-        # reference surfaces these in the Spark UI, GpuExec.scala:61-67),
-        # plus the memory runtime's counters (allocated/spill activity —
-        # the reference's gpuOpTime/spill metrics, GpuMetricNames)
+        self._finish_query(plan, ctx, conf, obs_metrics, global_before,
+                           t_query0, trace_on, trace_path, obs_before)
+        return plan, outs, ctx
+
+    def _run_adaptive(self, cpu_plan, ctx, conf, obs_metrics,
+                      global_before, t_query0, trace_on, trace_path,
+                      obs_before):
+        """Adaptive branch of ``_plan_and_run``: the executor owns
+        per-stage conversion + materialization + re-planning; this wraps
+        it with the same event/metrics/profile bookkeeping as the legacy
+        path. Capacity speculation is off — AQE's stage barriers are the
+        syncs speculation avoids, and a speculative re-execution would
+        invalidate the statistics its own re-planning consumed."""
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs.trace import TRACER
+        from spark_rapids_tpu.sql.adaptive.executor import AdaptiveExecutor
+
+        ctx.speculate = False
+        adaptive = AdaptiveExecutor(self, conf, ctx)
+        # static-shape digest FIRST, so a query that dies mid-stage still
+        # leaves a plan record next to its flight-recorder dump (the
+        # legacy path emits queryPlan before the drain); no coverage
+        # census — the plan is unconverted at this point
+        obs_events.EVENTS.emit(
+            "queryPlan", planDigest=obs_events.plan_digest(cpu_plan),
+            adaptive=True, phase="static")
+        try:
+            with TRACER.span("Query", adaptive=True):
+                plan, outs = adaptive.execute(cpu_plan)
+        finally:
+            self.release_active_shuffles()
+            self.release_transient_buffers()
+        if self.capture_plans:
+            self.captured_plans.append(plan)
+        # the digest is of the runtime-re-planned FINAL plan: it differs
+        # from the static shape exactly when an AQE rule fired
+        obs_events.EVENTS.emit(
+            "queryPlan", planDigest=obs_events.plan_digest(plan),
+            adaptive=True, phase="final", aqeStages=len(adaptive.stages),
+            aqeDecisions=len(adaptive.decisions),
+            **self._coverage_fields(plan))
+        self._finish_query(plan, ctx, conf, obs_metrics, global_before,
+                           t_query0, trace_on, trace_path, obs_before)
+        return plan, outs, ctx
+
+    def _finish_query(self, plan, ctx, conf, obs_metrics, global_before,
+                      t_query0, trace_on, trace_path, obs_before):
+        """Shared post-run bookkeeping of both execution paths:
+        per-operator SQL metrics of the last executed query (the
+        reference surfaces these in the Spark UI, GpuExec.scala:61-67),
+        the memory runtime's counters, the profile report and the trace
+        export."""
+        import time
+
+        from spark_rapids_tpu.obs.trace import TRACER
         if ctx.metrics_enabled:
             cat = self.buffer_catalog
             mem = {
@@ -534,7 +605,6 @@ class TpuSparkSession:
                 obs_before=obs_before)
         if trace_on and trace_path:
             TRACER.export_chrome(trace_path)
-        return plan, outs, ctx
 
     # --- observability ------------------------------------------------------
     def _coverage_fields(self, plan, ctx=None) -> dict:
@@ -1183,11 +1253,10 @@ class DataFrame:
     # --- actions -----------------------------------------------------------
     def collect(self) -> pd.DataFrame:
         _, outs = self.session._execute(self._plan)
-        if not outs:
-            from spark_rapids_tpu.exec.cpu import _empty_df
-            return _empty_df(self.schema)
-        out = pd.concat(outs, ignore_index=True)
-        return out
+        # null-mask-preserving concat: partition frames can mix masked
+        # and plain dtypes across partitions (exec/cpu.py)
+        from spark_rapids_tpu.exec.cpu import concat_host_frames
+        return concat_host_frames(outs, self.schema)
 
     toPandas = collect
 

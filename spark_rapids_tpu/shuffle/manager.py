@@ -38,6 +38,17 @@ class MapStatus:
         self.partition_sizes = partition_sizes
 
 
+def aggregate_map_statistics(statuses: List[MapStatus]):
+    """Fold per-map MapStatus.partition_sizes into MapOutputStatistics
+    (sql/adaptive/stats.py) — the aggregation Spark's MapOutputTracker
+    performs for AQE (the reference's GpuShuffleExchangeExec reports the
+    same shape so Spark can coalesce/demote/split at runtime). Shared by
+    the manager path's skew observability and the adaptive executor."""
+    from spark_rapids_tpu.sql.adaptive.stats import MapOutputStatistics
+    return MapOutputStatistics([list(ms.partition_sizes)
+                                for ms in statuses])
+
+
 class ShuffleEnv:
     """Per-executor shuffle environment."""
 
@@ -153,6 +164,50 @@ class CachingShuffleReader:
             batches.append(self.env.received_catalog.acquire_batch(bid))
             self.env.received_catalog.remove_batch(bid)
         return batches
+
+    def read_coalesced_group(self, shuffle_id: int,
+                             partition_ids: List[int],
+                             peer: Optional[str],
+                             group: List[MapStatus]) -> List[DeviceBatch]:
+        """Coalesced-partition read: ALL of one peer group's blocks for a
+        RANGE of reduce partitions in ONE metadata/transfer round trip —
+        the shuffle-reader face of AQE partition coalescing (merged
+        partitions are fetched as one, not one round trip per merged
+        piece)."""
+        if peer is None:
+            out: List[DeviceBatch] = []
+            for ms in group:
+                for pid in partition_ids:
+                    out.extend(self.env.shuffle_catalog.acquire_batches(
+                        shuffle_id, ms.map_id, pid))
+            return out
+        client = self.env.client_for(peer)
+        blocks = [(shuffle_id, ms.map_id, pid)
+                  for ms in group for pid in partition_ids]
+        batches = []
+        for bid in client.fetch_blocks(blocks):
+            batches.append(self.env.received_catalog.acquire_batch(bid))
+            self.env.received_catalog.remove_batch(bid)
+        return batches
+
+    def read_coalesced(self, shuffle_id: int, partition_ids: List[int],
+                       map_statuses: List[MapStatus]
+                       ) -> Iterator[DeviceBatch]:
+        for peer, group in self.peer_groups(map_statuses):
+            yield from self.read_coalesced_group(shuffle_id,
+                                                 list(partition_ids),
+                                                 peer, group)
+
+    def read_partial(self, shuffle_id: int, partition_id: int,
+                     map_statuses: List[MapStatus], map_lo: int,
+                     map_hi: int) -> Iterator[DeviceBatch]:
+        """Ranged read: one reduce partition restricted to map ids
+        [map_lo, map_hi) — the reader face of AQE skew splitting (each
+        sub-partition of a skewed reduce partition fetches only its map
+        range; the sibling ranges are other tasks' reads)."""
+        sel = [ms for ms in map_statuses
+               if map_lo <= ms.map_id < map_hi]
+        return self.read(shuffle_id, partition_id, sel)
 
     def read(self, shuffle_id: int, partition_id: int,
              map_statuses: List[MapStatus]) -> Iterator[DeviceBatch]:

@@ -1,0 +1,19 @@
+"""Adaptive query execution (AQE): stage-based runtime re-planning from
+shuffle statistics.
+
+The reference plugin leans on Spark's AQE — GpuShuffleExchangeExec reports
+MapOutputStatistics so Spark can coalesce partitions, demote shuffled
+joins to broadcast and split skewed partitions at runtime. This package
+is that loop for this engine:
+
+  * ``stats``    — map-output statistics + canonical hash splitting
+  * ``stages``   — query stages, stage refs and the stage readers
+  * ``rules``    — coalesce / broadcast-demotion / skew-split planning
+  * ``executor`` — the stage-at-a-time driver (session._plan_and_run
+                   dispatches here under spark.rapids.sql.adaptive.enabled)
+
+Import submodules explicitly; this package init stays import-light so
+exec-layer call sites (exec/cpu.py, exec/tpu.py) can reach ``stats``
+without pulling the rewrite engine (sql/overrides.py) into their import
+cycle.
+"""

@@ -1,0 +1,230 @@
+"""Query stages and stage readers.
+
+A **ShuffleStage** is one materialized hash exchange: the map side ran to
+completion, its output sits partitioned on the host (per map task, per
+reduce partition) and its ``MapOutputStatistics`` drove the re-planning
+rules. The not-yet-executed remainder of the plan references the stage
+through ``ShuffleStageRef`` placeholders until read planning replaces
+them with ``AqeShuffleReadExec`` leaves carrying partition *specs*:
+
+  * ``CoalescedSpec(pids)``          — reduce partitions merged into one
+    task (Spark's CoalescedPartitionSpec);
+  * ``PartialSpec(pid, lo, hi)``     — one reduce partition restricted to
+    the map range [lo, hi) — a skew-split sub-partition (Spark's
+    PartialReducerPartitionSpec).
+
+``AqeShuffleReadExec`` is a CPU leaf (host frames); the rewrite engine
+converts it to ``TpuAqeShuffleReadExec``, which re-uploads each spec's
+merged frame through the shared ``upload_partition`` runner — the stage
+boundary is a real host materialization point, the engine's analogue of
+the reference registering map output in the shuffle catalog.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import pandas as pd
+
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
+from spark_rapids_tpu.sql.adaptive.stats import MapOutputStatistics
+
+
+class CoalescedSpec:
+    """Read these reduce partitions, fully, merged as one task."""
+
+    __slots__ = ("pids",)
+
+    def __init__(self, pids: Sequence[int]):
+        self.pids = tuple(pids)
+
+    def __repr__(self) -> str:
+        return f"coalesce{list(self.pids)}"
+
+
+class PartialSpec:
+    """Read ONE reduce partition's output from map tasks [lo, hi) — a
+    skew-split sub-partition; the join's other side replicates the full
+    partition against every sub-range."""
+
+    __slots__ = ("pid", "map_lo", "map_hi")
+
+    def __init__(self, pid: int, map_lo: int, map_hi: int):
+        self.pid = pid
+        self.map_lo = map_lo
+        self.map_hi = map_hi
+
+    def __repr__(self) -> str:
+        return f"skew(p{self.pid}, maps[{self.map_lo}:{self.map_hi}])"
+
+
+class ShuffleStage:
+    """One materialized shuffle stage's output + statistics."""
+
+    def __init__(self, stage_id: int, schema: Schema,
+                 partitioning, map_outputs: List[List[pd.DataFrame]],
+                 stats: MapOutputStatistics):
+        self.id = stage_id
+        self.schema = schema
+        self.partitioning = partitioning
+        self.map_outputs = map_outputs
+        self.stats = stats
+
+    @property
+    def n_partitions(self) -> int:
+        return self.partitioning[-1]
+
+    @property
+    def num_maps(self) -> int:
+        return len(self.map_outputs)
+
+    @property
+    def total_bytes(self) -> int:
+        return self.stats.total_bytes
+
+    def frames_for(self, spec) -> List[pd.DataFrame]:
+        if self.map_outputs is None:
+            raise RuntimeError(
+                f"stage {self.id} already released (stage outputs free "
+                "at query end)")
+        out: List[pd.DataFrame] = []
+        if isinstance(spec, PartialSpec):
+            for m in range(spec.map_lo, spec.map_hi):
+                f = self.map_outputs[m][spec.pid]
+                if len(f):
+                    out.append(f)
+            return out
+        for m in range(len(self.map_outputs)):
+            for pid in spec.pids:
+                f = self.map_outputs[m][pid]
+                if len(f):
+                    out.append(f)
+        return out
+
+    def release(self) -> None:
+        """Free the materialized host frames (the executed plan object
+        outlives the query in session.last_plan; only the statistics are
+        needed post-hoc)."""
+        self.map_outputs = None
+
+
+class ShuffleStageRef(PhysicalPlan):
+    """Plan placeholder for a materialized stage, replaced by an
+    ``AqeShuffleReadExec`` once its consumer's partition specs are
+    decided. Never executes."""
+
+    def __init__(self, stage: ShuffleStage):
+        super().__init__()
+        self.stage = stage
+
+    def output_schema(self) -> Schema:
+        return self.stage.schema
+
+    def describe(self) -> str:
+        return f"ShuffleStageRef(#{self.stage.id})"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        raise RuntimeError(
+            "ShuffleStageRef executed before read planning — the adaptive "
+            "executor must finalize reads first (sql/adaptive/executor.py)")
+
+
+class AqeShuffleReadExec(PhysicalPlan):
+    """Leaf reader over a materialized stage: one output partition per
+    spec (Spark's AQEShuffleReadExec over a ShuffleQueryStage)."""
+
+    def __init__(self, stage: ShuffleStage, specs: List):
+        super().__init__()
+        self.stage = stage
+        self.specs = list(specs)
+
+    def output_schema(self) -> Schema:
+        return self.stage.schema
+
+    def describe(self) -> str:
+        merged = sum(1 for s in self.specs
+                     if isinstance(s, CoalescedSpec) and len(s.pids) > 1)
+        skews = sum(1 for s in self.specs if isinstance(s, PartialSpec))
+        return (f"AqeShuffleReadExec(stage=#{self.stage.id}, "
+                f"parts={len(self.specs)}, coalesced={merged}, "
+                f"skewSplits={skews})")
+
+    def fingerprint_extra(self) -> str:
+        return f"stage{self.stage.id}|{self.specs!r}"
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        from spark_rapids_tpu.exec.cpu import concat_host_frames
+        schema = self.stage.schema
+
+        def make(spec) -> Partition:
+            def run() -> Iterator[pd.DataFrame]:
+                yield concat_host_frames(self.stage.frames_for(spec),
+                                         schema)
+            return run
+        return [make(s) for s in self.specs]
+
+
+class TpuAqeShuffleReadExec(PhysicalPlan):
+    """Columnar stage reader: each spec's merged host frame re-uploads
+    through the shared upload runner (exec/transitions.upload_partition,
+    the path TpuScanExec and HostToDeviceExec ride)."""
+
+    columnar_output = True
+
+    def __init__(self, read: AqeShuffleReadExec):
+        super().__init__()
+        self.read = read
+
+    def output_schema(self) -> Schema:
+        return self.read.output_schema()
+
+    def describe(self) -> str:
+        return "Tpu" + self.read.describe()
+
+    def fingerprint_extra(self) -> str:
+        return self.read.fingerprint_extra()
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        from spark_rapids_tpu.columnar.batch import DeviceBatch
+        from spark_rapids_tpu.exec.transitions import upload_partition
+        schema = self.output_schema()
+        max_rows = ctx.conf.batch_size_rows
+        cpu_parts = self.read.partitions(ctx)
+        # one dictionary registry per reader (the TpuScanExec pattern):
+        # every spec's upload encodes against the first batch's
+        # dictionaries so downstream kernels compile one program
+        dict_state: dict = {}
+
+        def make(i: int, part: Partition) -> Partition:
+            def run() -> Iterator[DeviceBatch]:
+                got = False
+                for b in upload_partition(ctx, part, schema, max_rows,
+                                          dict_state, None, i,
+                                          is_scan=False):
+                    got = True
+                    yield b
+                if not got:
+                    # consumers (joins, aggregates) expect >= 1 batch per
+                    # partition, like the legacy exchange's empty yield
+                    yield DeviceBatch.empty(schema)
+            return run
+        return [make(i, p) for i, p in enumerate(cpu_parts)]
+
+
+def _register_read_rule() -> None:
+    from spark_rapids_tpu.sql import overrides as ov
+
+    def _tag(meta) -> None:
+        pass
+
+    def _convert(meta, children):
+        return TpuAqeShuffleReadExec(meta.plan)
+
+    ov._register(ov.ExecRule(
+        AqeShuffleReadExec,
+        "adaptive shuffle read (materialized query-stage output)",
+        _tag, _convert))
+
+
+_register_read_rule()

@@ -154,10 +154,16 @@ class Planner:
         ridx, right = _key_indices(right, rkeys, rs)
         # broadcast the build side when its estimate fits under the
         # threshold (reference: GpuBroadcastHashJoinExec; build side is the
-        # non-preserved side, so full outer never broadcasts)
+        # non-preserved side, so full outer never broadcasts).
+        # threshold = -1 explicitly disables broadcast; an unknown
+        # estimate (None mid-tree — width-changing operators return
+        # unknown — or a source whose size probe fails) falls back to the
+        # shuffled join, never raises: a bad estimate must cost
+        # performance, not the query. AQE (sql/adaptive/) re-makes this
+        # call later from MEASURED sizes.
         threshold = self.conf.broadcast_threshold
         build_node = node.children[0] if jt == "right" else node.children[1]
-        est = build_node.estimated_size_bytes()
+        est = _estimated_size(build_node)
         can_broadcast = (jt != "full" and threshold >= 0 and est is not None
                          and est <= threshold)
         if can_broadcast:
@@ -235,6 +241,22 @@ class Planner:
         else:
             child = cpu.CpuShuffleExchangeExec(child, ("single",))
         return CpuWindowExec(child, bound)
+
+
+def _estimated_size(node: lp.LogicalPlan):
+    """Broadcast size hint, hardened: a raising or non-integer estimate
+    reads as unknown (None) so planning falls back to the shuffled join
+    instead of failing the query."""
+    try:
+        est = node.estimated_size_bytes()
+    except Exception:  # noqa: BLE001 — estimates are advisory by contract
+        return None
+    if est is None:
+        return None
+    try:
+        return int(est)
+    except (TypeError, ValueError):
+        return None
 
 
 def _key_indices(child: PhysicalPlan, keys, schema):

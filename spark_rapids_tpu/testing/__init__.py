@@ -1,4 +1,5 @@
 from spark_rapids_tpu.testing.datagen import (  # noqa: F401
     BooleanGen, ByteGen, DateGen, DoubleGen, FloatGen, IntegerGen, LongGen,
-    RepeatSeqGen, ShortGen, StringGen, StructGen, TimestampGen, gen_df,
+    RepeatSeqGen, ShortGen, SkewedKeyGen, StringGen, StructGen,
+    TimestampGen, gen_df, gen_skewed_join_frames,
 )

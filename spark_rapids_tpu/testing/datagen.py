@@ -188,6 +188,51 @@ class TimestampGen(DataGen):
         return out
 
 
+class SkewedKeyGen(DataGen):
+    """Integer join/group key with a hot-key mass: fraction ``hot_prob``
+    of rows carry ``hot_key``, the rest spread uniformly over
+    ``[1, num_keys]`` — the shape that lands one reduce partition far
+    over the skew factor (the AQE skew-join test distribution;
+    sql/adaptive/rules.py splits it by map ranges)."""
+
+    pandas_dtype = "Int64"
+
+    def __init__(self, hot_key: int = 0, hot_prob: float = 0.75,
+                 num_keys: int = 1000, **kw):
+        assert 0.0 <= hot_prob <= 1.0, hot_prob
+        self.hot_key = hot_key
+        self.hot_prob = hot_prob
+        self.num_keys = max(1, int(num_keys))
+        kw.setdefault("nullable", False)
+        super().__init__(**kw)
+
+    def _values(self, rng, n):
+        hot = rng.random(n) < self.hot_prob
+        cold = rng.integers(1, self.num_keys + 1, n, dtype=np.int64)
+        return np.where(hot, np.int64(self.hot_key), cold)
+
+
+def gen_skewed_join_frames(rng: np.random.Generator, n_fact: int = 20000,
+                           n_dim: int = 200, hot_prob: float = 0.75,
+                           ) -> Tuple[pd.DataFrame, pd.DataFrame]:
+    """(fact, dim) pair for skew-join tests: ``fact.k`` is hot-key
+    skewed, ``dim.k`` covers every key once."""
+    # no extreme specials on the value column: ±1e300 makes per-key sums
+    # ill-conditioned under the re-grouped summation order skew splits
+    # introduce, and the differential harness compares sums
+    fact = gen_df(rng, [
+        ("k", SkewedKeyGen(hot_key=0, hot_prob=hot_prob,
+                           num_keys=n_dim - 1)),
+        ("v", DoubleGen(nullable=False, no_nans=True,
+                        special_cases=())),
+    ], n=n_fact)
+    dim = pd.DataFrame({
+        "k": np.arange(n_dim, dtype=np.int64),
+        "w": rng.normal(size=n_dim),
+    })
+    return fact, dim
+
+
 class RepeatSeqGen(DataGen):
     """Cycles a small value set — the reference's low-cardinality group-key
     generator (data_gen.py RepeatSeqGen)."""

@@ -19,7 +19,12 @@ Per query it computes:
   * **spill pressure** — bytes/events through the tiers, memory-pressure
     backoffs;
   * **fetch-retry hotspots** — shuffle retries/failures per peer;
-  * **compile-warmup share** — backend-compile seconds vs query wall.
+  * **compile-warmup share** — backend-compile seconds vs query wall;
+  * **shuffle skew** — per-query max/median partition-size ratio from
+    ``shuffleSkew`` events (obs/shuffleobs.py), AQE on or off — the
+    "this workload would benefit from adaptive execution" signal;
+  * **adaptive decisions** — stages materialized, coalesced reads,
+    broadcast demotions and skew splits per AQE query (sql/adaptive/).
 
 Usage:
     python tools/qualification.py LOG_OR_PROFILE [...] [--json OUT] [-n N]
@@ -90,6 +95,10 @@ def _new_record(name: str, source: str) -> Dict[str, Any]:
         "compile": {"compiles": 0, "seconds": 0.0, "cache_misses": 0,
                     "warmup_share_pct": None},
         "scan": {"stalls": 0, "stall_s": 0.0, "budget_stalls": 0},
+        "shuffle_skew": {"shuffles": 0, "max_ratio": None,
+                         "max_bytes": 0},
+        "aqe": {"adaptive": False, "stages": 0, "coalesced_reads": 0,
+                "broadcast_demotions": 0, "skew_splits": 0},
         "flight_dumped": False, "error": None,
     }
 
@@ -133,6 +142,8 @@ def records_from_events(events: List[Dict[str, Any]],
             r["tpu_ops"] = ev.get("tpuOps", 0)
             r["cpu_ops"] = ev.get("cpuOps", 0)
             r["coverage_pct"] = ev.get("coveragePct")
+            if ev.get("adaptive"):
+                r["aqe"]["adaptive"] = True
         elif kind == "cpuFallback":
             r["fallbacks"].append({
                 "op": ev.get("op"), "describe": ev.get("describe"),
@@ -182,6 +193,26 @@ def records_from_events(events: List[Dict[str, Any]],
                 r["scan"]["stall_s"] + float(ev.get("stall_s", 0.0)), 6)
         elif kind == "scanBudgetStall":
             r["scan"]["budget_stalls"] += 1
+        elif kind == "shuffleSkew":
+            sk = r["shuffle_skew"]
+            sk["shuffles"] += 1
+            ratio = float(ev.get("maxMedianRatio", 0.0) or 0.0)
+            if sk["max_ratio"] is None or ratio > sk["max_ratio"]:
+                sk["max_ratio"] = ratio
+            sk["max_bytes"] = max(sk["max_bytes"],
+                                  int(ev.get("maxBytes", 0) or 0))
+        elif kind == "aqeStageStats":
+            r["aqe"]["adaptive"] = True
+            r["aqe"]["stages"] += 1
+        elif kind == "aqeCoalesce":
+            r["aqe"]["adaptive"] = True
+            r["aqe"]["coalesced_reads"] += 1
+        elif kind == "aqeBroadcastDemote":
+            r["aqe"]["adaptive"] = True
+            r["aqe"]["broadcast_demotions"] += 1
+        elif kind == "aqeSkewSplit":
+            r["aqe"]["adaptive"] = True
+            r["aqe"]["skew_splits"] += 1
         elif kind == "flightRecorder":
             r["flight_dumped"] = True
     for r in out:
@@ -252,6 +283,25 @@ def record_from_profile(doc: Dict[str, Any], name: str) -> Dict[str, Any]:
             r["scan"]["stall_s"] = round(float(v), 6)
         elif k.startswith("scan.prefetch.budgetStalls"):
             r["scan"]["budget_stalls"] = int(v)
+    sk = summary.get("shuffleSkew") or {}
+    for k, v in sk.items():
+        if k.startswith("shuffle.skew.shuffles"):
+            r["shuffle_skew"]["shuffles"] = int(v)
+        elif k == "shuffle.skew.maxMedianRatio":
+            r["shuffle_skew"]["max_ratio"] = float(v)
+        elif k == "shuffle.skew.maxPartitionBytes":
+            r["shuffle_skew"]["max_bytes"] = int(v)
+    aq = summary.get("adaptive") or {}
+    for k, v in aq.items():
+        if k.startswith("aqe.stages"):
+            r["aqe"]["adaptive"] = True
+            r["aqe"]["stages"] = int(v)
+        elif k.startswith("aqe.coalescedReads"):
+            r["aqe"]["coalesced_reads"] = int(v)
+        elif k.startswith("aqe.broadcastDemotions"):
+            r["aqe"]["broadcast_demotions"] = int(v)
+        elif k.startswith("aqe.skewSplits"):
+            r["aqe"]["skew_splits"] = int(v)
     r["fallbacks"].sort(key=lambda f: -f["impact_s"])
     return r
 
@@ -350,6 +400,34 @@ def render_text(report: Dict[str, Any], top_n: int = 15) -> str:
         lines.append("-- fetch-retry hotspots (peer: retries)")
         for peer, n in sorted(hot.items(), key=lambda kv: -kv[1])[:top_n]:
             lines.append(f"   {peer}: {n}")
+    skewed = [r for r in report["queries"]
+              if (r.get("shuffle_skew") or {}).get("max_ratio")
+              and r["shuffle_skew"]["max_ratio"] >= 2.0]
+    if skewed:
+        lines.append("")
+        lines.append("-- shuffle skew (queries with max/median partition "
+                     "ratio >= 2; AQE skew-join splits these)")
+        for r in sorted(skewed,
+                        key=lambda x: -x["shuffle_skew"]["max_ratio"])[
+                            :top_n]:
+            sk = r["shuffle_skew"]
+            lines.append(
+                f"   {r['query']}: ratio {sk['max_ratio']:.1f} over "
+                f"{sk['shuffles']} shuffles, largest partition "
+                f"{_fmt_bytes(sk['max_bytes'])}")
+    aqed = [r for r in report["queries"]
+            if (r.get("aqe") or {}).get("adaptive")]
+    if aqed:
+        lines.append("")
+        lines.append("-- adaptive execution (stages / coalesced reads / "
+                     "broadcast demotions / skew splits)")
+        for r in aqed[:top_n]:
+            a = r["aqe"]
+            lines.append(
+                f"   {r['query']}: {a['stages']} stages, "
+                f"{a['coalesced_reads']} coalesced, "
+                f"{a['broadcast_demotions']} demoted to broadcast, "
+                f"{a['skew_splits']} skew splits")
     if t["spill_bytes"]:
         lines.append("")
         lines.append(f"-- spill pressure: {_fmt_bytes(t['spill_bytes'])} "
