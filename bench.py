@@ -60,6 +60,16 @@ vs AQE-on wall times, the runtime plan shape and the adaptive decisions
 BENCH_AQE.json (BENCH_AQE_FILE to override) — the perf trajectory's AQE
 axis.
 
+Live monitoring (`--serve` or BENCH_UI=1): the worker serves the
+embedded monitor (obs/monitor.py) on BENCH_UI_PORT (default 4040) for
+the sweep's duration — curl /metrics for Prometheus counters,
+/api/queries and /api/query/<id> for live per-operator and AQE-stage
+progress, /api/tenants for per-suite accounting (each query runs under
+its suite's job group). Pairs with --event-log: afterwards
+`python tools/history_server.py BENCH_EVENTS.jsonl` serves the same
+pages from the record, and `python tools/perfdiff.py OLD.json
+BENCH_DETAIL.json` gates the round against the previous one.
+
 Scan-inclusive mode (`--include-scan` or BENCH_INCLUDE_SCAN=1): for the
 tpch queries in BENCH_SCAN_QUERIES (default q1,q6,q14), additionally time
 the TPU path over real multi-row-group Parquet files with the device scan
@@ -194,6 +204,20 @@ def _worker():
     ev_path = os.environ.get("BENCH_EVENT_LOG", "")
     if ev_path:
         session.set_conf("spark.rapids.tpu.eventLog.path", ev_path)
+
+    # --serve: live monitoring while the sweep runs (obs/monitor.py) —
+    # watch /metrics, /api/queries and /api/query/<id> advance from a
+    # browser or curl while queries execute
+    if os.environ.get("BENCH_UI", "") == "1":
+        session.set_conf("spark.rapids.tpu.ui.enabled", True)
+        session.set_conf("spark.rapids.tpu.ui.port",
+                         int(os.environ.get("BENCH_UI_PORT", "4040")))
+        from spark_rapids_tpu.obs import monitor as _monitor
+        _srv = _monitor.maybe_serve(session.conf)
+        if _srv is not None:
+            print(f"bench: live monitor at {_srv.url}/ "
+                  f"(/metrics, /api/queries, /api/tenants)",
+                  file=sys.stderr, flush=True)
 
     suites = {}  # suite name -> {query name -> thunk}
 
@@ -457,6 +481,10 @@ def _worker():
             sn, q = req["suite"], req["query"]
             if sn not in suites:
                 suites[sn] = _build_suite(sn)
+            # tenant tag: suite as the job group, query as description —
+            # per-suite accounting in the event log, /metrics and
+            # /api/tenants comes for free
+            session.set_job_group(sn, req["name"])
             rec = measure(suites[sn][q])
             # archive the per-query profile JSON (attribution for free in
             # later rounds; see docs/observability.md). BENCH_PROFILE_DIR=
@@ -631,6 +659,11 @@ def main():
         os.environ.setdefault("BENCH_EVENT_LOG", "BENCH_EVENTS.jsonl")
     if "--aqe-sweep" in sys.argv:
         os.environ["BENCH_AQE"] = "1"
+    if "--serve" in sys.argv:
+        # worker inherits the env and serves the live monitor on
+        # BENCH_UI_PORT (default 4040) for the sweep's duration
+        os.environ["BENCH_UI"] = "1"
+        os.environ.setdefault("BENCH_UI_PORT", "4040")
 
     suite_names, sweep = _parse_sweep()
     sf = float(os.environ.get("BENCH_SF", "0.5"))

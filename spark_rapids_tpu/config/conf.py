@@ -598,6 +598,62 @@ FLIGHT_RECORDER_SIZE = register(
     "runs even with the event log and tracer disabled — one deque append "
     "per (rare) event.", validator=_positive)
 
+EVENT_LOG_COMPRESS = register(
+    "spark.rapids.tpu.eventLog.compress", _to_bool, False,
+    "Gzip-compress rotated event-log segments: at the size bound the "
+    "active file compresses to <path>.1.gz instead of renaming to "
+    "<path>.1 (the active file stays plaintext so appends never pay "
+    "per-event compression). tools/qualification.py, "
+    "tools/trace_summary.py and tools/history_server.py read plaintext "
+    "and gzip segments transparently (magic-byte sniff), including "
+    "mixed chains from toggling this mid-run. Bounds the on-disk "
+    "footprint of long sweeps (~10-20x smaller rotated segments on "
+    "typical JSONL).")
+
+# --- live monitoring UI (obs/monitor.py: Prometheus /metrics, query-
+# progress API, per-tenant accounting; the headless Spark-UI analogue) -----
+UI_ENABLED = register(
+    "spark.rapids.tpu.ui.enabled", _to_bool, False,
+    "Serve the embedded live monitoring service (obs/monitor.py): "
+    "GET /metrics (process-wide registry in Prometheus text format), "
+    "/healthz, /api/status (device + HBM pool watermarks, semaphore "
+    "permits, event-log drop counts), /api/queries + /api/query/<id> "
+    "(live per-query progress: plan tree with per-operator rows/batches/"
+    "time so far, AQE stage progress and decisions, scan/shuffle/spill "
+    "counters), /api/tenants (per-tenant accounting from "
+    "session.set_job_group tags), and a minimal HTML live view at /. "
+    "false (default): no server thread starts and the progress "
+    "heartbeat path is a single disabled-flag check — zero overhead.")
+
+UI_PORT = register(
+    "spark.rapids.tpu.ui.port", int, 4040,
+    "TCP port of the live monitoring service (the Spark-UI port by "
+    "convention). 0 binds an ephemeral port (tests); the bound port is "
+    "available as obs.monitor.server().port. A bind failure logs a "
+    "warning and disables the UI for the process instead of failing "
+    "queries.", validator=_non_negative)
+
+UI_HOST = register(
+    "spark.rapids.tpu.ui.host", str, "127.0.0.1",
+    "Bind address of the live monitoring service. Loopback by default; "
+    "set 0.0.0.0 to expose it beyond the host (the service is read-only "
+    "but unauthenticated — front it appropriately).")
+
+UI_RECENT_QUERIES = register(
+    "spark.rapids.tpu.ui.recentQueries", int, 64,
+    "How many recently-finished queries /api/queries keeps alongside the "
+    "in-flight set (a bounded ring; oldest evicted first).",
+    validator=_positive)
+
+UI_SIGNAL_DIAGNOSTICS = register(
+    "spark.rapids.tpu.ui.signalDiagnostics", _to_bool, True,
+    "Install a SIGUSR1 handler at session creation that dumps the "
+    "flight recorder, all-thread stack traces and current query-progress "
+    "snapshots into the event log (kill -USR1 <pid>) — hung-query "
+    "debugging without a REPL. Main-thread sessions only; the handler "
+    "itself never raises. Independent of ui.enabled: the dump works "
+    "with the HTTP service off.")
+
 
 class TpuConf:
     """Immutable snapshot of settings, with typed accessors.

@@ -62,7 +62,9 @@ class PhysicalPlan:
         hot path."""
         parts = self.partitions(ctx)
         from spark_rapids_tpu.obs.trace import TRACER
-        if not ctx.metrics_enabled and not TRACER.enabled:
+        prog = ctx.progress  # live monitoring (obs/progress.py)
+        if not ctx.metrics_enabled and not TRACER.enabled \
+                and prog is None:
             return parts
         import time
         op = self.describe()
@@ -110,6 +112,11 @@ class PhysicalPlan:
                     if record:
                         ctx.record_op(op, node_id,
                                       time.perf_counter() - t0, rows)
+                    if prog is not None:
+                        # per-batch heartbeat: per-operator rows/batches/
+                        # time so far, served live at /api/query/<id>
+                        prog.op_batch(node_id, op, rows,
+                                      time.perf_counter() - t0)
                     yield batch
             return run
         return [wrap(p, i) for i, p in enumerate(parts)]
@@ -208,6 +215,10 @@ class ExecContext:
         # (exec/reuse.TpuReuseSubtreeExec) — context-scoped so a fresh
         # context (speculation re-execution) re-runs the subtree
         self.reuse_state: dict = {}
+        # live QueryProgress record (obs/progress.py), set by the session
+        # only when the monitoring UI is enabled; None (the default)
+        # keeps every heartbeat site a single is-None check
+        self.progress = None
 
     def metric_add(self, op: str, name: str, value):
         self.registry.counter(name, op=op).add(value)

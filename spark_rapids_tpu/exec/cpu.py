@@ -316,10 +316,13 @@ class CpuShuffleExchangeExec(PhysicalPlan):
         key_idx = list(self.partitioning[1])
         n = self.partitioning[2]
         schema = self.children[0].output_schema()
+        from spark_rapids_tpu.obs.progress import PROGRESS
         map_outputs = []
         for part in self.children[0].executed_partitions(ctx):
             df = concat_host_frames(list(part()), schema)
             map_outputs.append(aqestats.split_frame(df, key_idx, n))
+            if PROGRESS.enabled:  # live per-map-partition stage progress
+                PROGRESS.shuffle_map_partition()
         return map_outputs, aqestats.stats_from_map_outputs(map_outputs)
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:

@@ -1475,6 +1475,11 @@ class TpuShuffleExchangeExec(TpuExec):
                                 EVENTS.emit("fetchRetry", peer=str(peer),
                                             attempt=attempt,
                                             error=str(e)[:200])
+                                from spark_rapids_tpu.obs.progress import (
+                                    PROGRESS,
+                                )
+                                if PROGRESS.enabled:
+                                    PROGRESS.shuffle_retry()
                                 import logging
                                 logging.getLogger(__name__).warning(
                                     "shuffle fetch failed (%s); retrying "

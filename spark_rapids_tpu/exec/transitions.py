@@ -102,6 +102,7 @@ def upload_partition(ctx: ExecContext, part: Partition, schema: Schema,
     prefetchDepth=0 keeps the strict pull-driven serial order.
     """
     from spark_rapids_tpu.exec import taskctx
+    from spark_rapids_tpu.obs.progress import PROGRESS
     from spark_rapids_tpu.obs.trace import TRACER
     sem = ctx.session.semaphore if ctx.session else None
     if sem is not None:
@@ -150,6 +151,8 @@ def upload_partition(ctx: ExecContext, part: Partition, schema: Schema,
                         dict_numerics=dict_numerics,
                         device=(mesh_devs[i % len(mesh_devs)]
                                 if mesh_devs else None))
+                if PROGRESS.enabled:  # live upload progress
+                    PROGRESS.scan_upload(len(chunk))
                 yield fname, batch
 
     def account(fname: str, batch: DeviceBatch) -> None:

@@ -39,6 +39,7 @@ from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.obs.events import EVENTS
 from spark_rapids_tpu.obs.metrics import REGISTRY
+from spark_rapids_tpu.obs.progress import PROGRESS
 from spark_rapids_tpu.obs.trace import TRACER
 
 
@@ -308,6 +309,8 @@ class DeviceStore(BufferStore):
                 .add(freed)
             EVENTS.emit("spill", direction="device_to_host",
                         bytes=freed, buffer=buf.id)
+            if PROGRESS.enabled:  # live spill counter (/api/query/<id>)
+                PROGRESS.spill(freed)
             self.spill_store.add(buf)
             # keep the host tier within its bound
             self.spill_store.enforce_limit()
@@ -335,6 +338,8 @@ class HostStore(BufferStore):
                 .add(freed)
             EVENTS.emit("spill", direction="host_to_disk",
                         bytes=freed, buffer=buf.id)
+            if PROGRESS.enabled:
+                PROGRESS.spill(freed)
             self.spill_store.add(buf)
         return freed
 

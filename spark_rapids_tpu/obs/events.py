@@ -42,7 +42,17 @@ Event taxonomy (one JSON object per line; every event carries ``kind``,
                                  (all four: sql/adaptive/executor.py; the
                                  queryPlan event additionally carries
                                  adaptive=true + aqeStages/aqeDecisions)
+  diagnostics       monitor      reason, threads{name: stack[]},
+                                 queries[] — SIGUSR1 / manual dump of
+                                 all-thread stacks + live query progress
+                                 (obs/monitor.dump_diagnostics)
   flightRecorder    session      reason, events[] (ring dump, see below)
+
+Every event between queryStart and queryEnd additionally carries the
+``tenant`` tag when the session has a job group set
+(``session.set_job_group`` — the per-tenant accounting key), and the
+``queryPlan`` event carries ``planTree`` (the physical tree string) so
+the history server can render plan pages from the log alone.
 
 Journal mechanics:
 
@@ -115,6 +125,11 @@ class EventLog:
         self._seq = 0
         self._query_counter = 0
         self._current_query: Optional[str] = None
+        # tenant/job-group window (session.set_job_group): like the query
+        # window, every event between queryStart/queryEnd carries it
+        self._current_tenant: Optional[str] = None
+        # gzip rotated segments (spark.rapids.tpu.eventLog.compress)
+        self.compress = False
         # truncation visibility (profile "observability" section)
         self.dropped = 0      # events whose file write failed
         self.rotations = 0
@@ -126,7 +141,8 @@ class EventLog:
     def configure(self, enabled: bool, path: str = "",
                   max_bytes: int = DEFAULT_MAX_BYTES,
                   rotations: int = DEFAULT_ROTATIONS,
-                  ring_size: Optional[int] = None) -> None:
+                  ring_size: Optional[int] = None,
+                  compress: bool = False) -> None:
         """(Re)configure the journal. A non-empty ``path`` implies
         enabled; enabled with no path writes ``DEFAULT_PATH``. Reopening
         appends — one journal accumulates across sessions/queries."""
@@ -140,6 +156,7 @@ class EventLog:
             self.path = path
             self.max_bytes = max(1, int(max_bytes))
             self.max_rotations = max(0, int(rotations))
+            self.compress = bool(compress)
             if ring_size is not None and \
                     self._ring.maxlen != max(1, int(ring_size)):
                 self._ring = collections.deque(
@@ -161,7 +178,9 @@ class EventLog:
                 DEFAULT_ROTATIONS)),
             ring_size=int(conf.get(
                 "spark.rapids.tpu.eventLog.flightRecorderSize",
-                DEFAULT_RING_SIZE)))
+                DEFAULT_RING_SIZE)),
+            compress=conf.get_bool(
+                "spark.rapids.tpu.eventLog.compress", False))
         return self.enabled
 
     def close(self) -> None:
@@ -188,6 +207,9 @@ class EventLog:
                   "seq": self._seq}
             if self._current_query is not None and "query" not in fields:
                 ev["query"] = self._current_query
+            if self._current_tenant is not None and \
+                    "tenant" not in fields:
+                ev["tenant"] = self._current_tenant
             ev.update(fields)
             if kind != "flightRecorder":
                 # a dump must never re-enter the ring: the next dump
@@ -217,10 +239,13 @@ class EventLog:
     def _rotate_locked(self) -> None:
         """Shift ``path`` -> ``path.1`` -> ... -> ``path.<n>`` (oldest
         dropped); with rotatedFiles=0 the journal truncates in place.
-        When the rename fails (file-writable but directory-unwritable
-        paths), appending continues on the oversized file with honest
-        accounting — ``rotate_failures`` marks the breached size bound
-        instead of faking a rotation."""
+        With ``compress`` on, the fresh rotation lands gzipped as
+        ``path.1.gz`` (the shift chain handles both extensions, so a
+        mid-run toggle leaves a readable mixed chain). When the rename
+        fails (file-writable but directory-unwritable paths), appending
+        continues on the oversized file with honest accounting —
+        ``rotate_failures`` marks the breached size bound instead of
+        faking a rotation."""
         try:
             self._fh.close()
         except OSError:
@@ -228,14 +253,38 @@ class EventLog:
         self._fh = None
         try:
             if self.max_rotations > 0:
-                oldest = f"{self.path}.{self.max_rotations}"
-                if os.path.exists(oldest):
-                    os.unlink(oldest)
+                for ext in ("", ".gz"):
+                    oldest = f"{self.path}.{self.max_rotations}{ext}"
+                    if os.path.exists(oldest):
+                        os.unlink(oldest)
                 for i in range(self.max_rotations - 1, 0, -1):
-                    src = f"{self.path}.{i}"
-                    if os.path.exists(src):
-                        os.replace(src, f"{self.path}.{i + 1}")
-                os.replace(self.path, f"{self.path}.1")
+                    for ext in ("", ".gz"):
+                        src = f"{self.path}.{i}{ext}"
+                        if os.path.exists(src):
+                            os.replace(src, f"{self.path}.{i + 1}{ext}")
+                if self.compress:
+                    import gzip
+                    import shutil
+                    dst_path = f"{self.path}.1.gz"
+                    try:
+                        # moderate level: the copy runs under the emit
+                        # lock, so level 9's extra CPU would stall every
+                        # concurrent emitter for the whole 16MB pass
+                        with open(self.path, "rb") as src_f, \
+                                gzip.open(dst_path, "wb",
+                                          compresslevel=5) as dst_f:
+                            shutil.copyfileobj(src_f, dst_f)
+                    except OSError:
+                        # a torn half-written .gz must not shadow data
+                        # that still lives in the uncompressed active file
+                        try:
+                            os.unlink(dst_path)
+                        except OSError:
+                            pass
+                        raise
+                    os.unlink(self.path)
+                else:
+                    os.replace(self.path, f"{self.path}.1")
             else:
                 os.unlink(self.path)
         except OSError:
@@ -248,9 +297,10 @@ class EventLog:
         self._written = 0
 
     # -- query lifecycle ----------------------------------------------------
-    def query_start(self, **fields) -> str:
+    def query_start(self, tenant: Optional[str] = None, **fields) -> str:
         """Open a query window: subsequent events auto-attach the query
-        id until query_end. Returns the id (``q-<n>``, process-wide).
+        id — and the tenant/job-group tag, when one is set — until
+        query_end. Returns the id (``q-<n>``, process-wide).
 
         One window at a time: the engine executes queries serially (one
         driver thread per process; subsystem threads WITHIN a query are
@@ -262,6 +312,7 @@ class EventLog:
             self._query_counter += 1
             qid = f"q-{self._query_counter}"
             self._current_query = qid
+            self._current_tenant = tenant or None
         self.emit("queryStart", query=qid, **fields)
         return qid
 
@@ -272,6 +323,7 @@ class EventLog:
         self.emit("queryEnd", status=status, **fields)
         with self._lock:
             self._current_query = None
+            self._current_tenant = None
 
     @property
     def current_query(self) -> Optional[str]:
@@ -312,7 +364,9 @@ class EventLog:
             self.dropped = 0
             self.rotations = 0
             self.rotate_failures = 0
+            self.compress = False
             self._current_query = None
+            self._current_tenant = None
             self._ring.clear()
 
 
@@ -325,21 +379,47 @@ from spark_rapids_tpu.obs.trace import TRACER  # noqa: E402
 TRACER.flight_hook = EVENTS._note_span
 
 
+def open_event_file(path: str):
+    """Text handle over a possibly-gzipped file, sniffed by magic bytes
+    (not extension — a renamed ``.gz`` still reads). The shared opener of
+    every event-log consumer (read_events, tools/qualification.py,
+    tools/trace_summary.py, tools/history_server.py)."""
+    import gzip
+    with open(path, "rb") as f:
+        magic = f.read(2)
+    if magic == b"\x1f\x8b":
+        return gzip.open(path, "rt", encoding="utf-8", errors="replace")
+    return open(path, "r", encoding="utf-8", errors="replace")
+
+
 def read_events(path: str) -> List[Dict[str, Any]]:
-    """Load one journal INCLUDING its rotations (``path.<n>`` oldest
-    first, then ``path``). Unparseable lines are skipped — a crashed
-    writer can leave a torn tail."""
+    """Load one journal INCLUDING its rotations (``path.<n>`` /
+    ``path.<n>.gz`` oldest first, then ``path``; gzip segments from
+    ``spark.rapids.tpu.eventLog.compress`` decompress transparently).
+    Unparseable lines are skipped — a crashed writer can leave a torn
+    tail."""
     files: List[str] = []
-    i = 1
-    while os.path.exists(f"{path}.{i}"):
-        files.append(f"{path}.{i}")
+    # tolerate HOLES in the rotation chain: a failed compress (ENOSPC
+    # mid-gzip) can leave e.g. '.1.gz' and '.3.gz' with no '.2' — a
+    # break-on-first-gap walk would silently drop every older segment.
+    # A short run of consecutive misses (not one) ends the scan.
+    i, misses = 1, 0
+    while misses < 4 and i <= 256:
+        if os.path.exists(f"{path}.{i}.gz"):
+            files.append(f"{path}.{i}.gz")
+            misses = 0
+        elif os.path.exists(f"{path}.{i}"):
+            files.append(f"{path}.{i}")
+            misses = 0
+        else:
+            misses += 1
         i += 1
     files.reverse()
     if os.path.exists(path):
         files.append(path)
     out: List[Dict[str, Any]] = []
     for f in files:
-        with open(f) as fh:
+        with open_event_file(f) as fh:
             for line in fh:
                 line = line.strip()
                 if not line:

@@ -1,12 +1,14 @@
 """Metrics registry: counters, gauges, timers and histograms with labels.
 
 The reference plugin surfaces per-operator SQL metrics through Spark's
-accumulator framework (GpuMetricNames, GpuExec.scala:24-41); this build has
-no driver UI, so the registry is the single structured store every subsystem
-reports through: exec operators (per-op rows/batches/time via ExecContext),
-the spill tiers (memory/spill.py), the shuffle transport (client/server
-fetch counters), the kernel cache (utils/kernelcache.py) and the leak
-tracker (memory/leak.py).
+accumulator framework (GpuMetricNames, GpuExec.scala:24-41); here the
+registry is the single structured store every subsystem reports through:
+exec operators (per-op rows/batches/time via ExecContext), the spill
+tiers (memory/spill.py), the shuffle transport (client/server fetch
+counters), the kernel cache (utils/kernelcache.py) and the leak tracker
+(memory/leak.py). The live monitoring service renders the process-wide
+registry in Prometheus text format at ``GET /metrics``
+(obs/monitor.py, ``spark.rapids.tpu.ui.enabled``).
 
 Two registries exist:
 

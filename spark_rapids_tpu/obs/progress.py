@@ -1,0 +1,361 @@
+"""Live query-progress tracking for the monitoring service.
+
+The reference surfaces in-flight queries through the Spark UI's SQL tab:
+per-operator accumulators update while the query runs and the page shows
+which stage is executing right now. This build is headless, so the same
+live view is a process-wide ``ProgressTracker`` (``PROGRESS``) serving
+``obs/monitor.py``'s ``/api/queries`` and ``/api/query/<id>`` endpoints:
+
+  * ``session._execute`` registers one ``QueryProgress`` per query
+    (``PROGRESS.begin``) and closes it with the terminal state;
+  * the operator hot path (``exec/base.executed_partitions``) heartbeats
+    per pulled batch via ``ctx.progress`` (per-operator rows/batches/time
+    so far);
+  * the AQE driver (``sql/adaptive/executor.py``) reports stage counts
+    (total/materialized/running) and every runtime decision as it fires;
+  * the scan pipeline (``sql/scan_pipeline.py``) reports splits decoded
+    and the consumer-stalled state, the upload runner
+    (``exec/transitions.py``) batches/rows uploaded;
+  * the shuffle client/retry loop and the spill tiers report fetch and
+    spill counters.
+
+Overhead contract: everything is gated on ONE flag — ``PROGRESS.enabled``
+(set by ``obs/monitor.maybe_serve`` from ``spark.rapids.tpu.ui.enabled``).
+Disabled (the default), every hot-path call site is a single attribute
+check and ``ctx.progress`` stays ``None``, so no lock is ever taken and
+no object is allocated. Enabled, updates take a per-query lock at batch
+granularity (batches are ~1M rows; the lock is uncontended noise).
+
+Tenancy: ``session.set_job_group(tenant, desc)`` tags the progress
+record; ``/api/tenants`` aggregates these with the ``tenant.*`` counters
+the session writes into the process-wide metrics registry.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+DEFAULT_RECENT = 64
+
+
+class QueryProgress:
+    """Mutable live record of one executing query; ``snapshot()`` is the
+    JSON shape the monitor serves."""
+
+    def __init__(self, qid: str, tenant: Optional[str] = None,
+                 description: str = ""):
+        self._lock = threading.Lock()
+        self.id = qid
+        self.tenant = tenant or "default"
+        self.description = description or ""
+        self.status = "running"
+        self.error: Optional[str] = None
+        self.start_ts = time.time()
+        self.end_ts: Optional[float] = None
+        self.heartbeats = 0
+        self.updated_ts = self.start_ts
+        # static plan rows: [{"depth", "op", "id"}] — re-set by AQE as
+        # the runtime re-planned tree evolves. The plan objects
+        # themselves are pinned in _plans: plan rows join to _ops by
+        # id(node), and a freed node's recycled id could otherwise
+        # alias an unrelated operator's stats onto a live tree row.
+        self._plan_rows: List[Dict[str, Any]] = []
+        self._plans: List[Any] = []
+        # per-plan-node-identity operator stats (id(node) keyed, like
+        # ExecContext.node_stats); nodes from stage-converted AQE plans
+        # may not appear in the current plan rows — the snapshot's
+        # "operators" aggregate catches them by describe() string
+        self._ops: Dict[int, Dict[str, Any]] = {}
+        self.adaptive = False
+        self.stages_total = 0
+        self.stages_materialized = 0
+        self.stage_running: Optional[int] = None
+        self.stages: List[Dict[str, Any]] = []
+        self.decisions: List[Dict[str, Any]] = []
+        self.scan = {"splitsDecoded": 0, "bytesDecoded": 0,
+                     "batchesUploaded": 0, "rowsUploaded": 0,
+                     "stalls": 0, "stalled": False}
+        self.shuffle = {"fetches": 0, "bytes": 0, "retries": 0,
+                        "failures": 0, "mapPartitions": 0}
+        self.spill = {"events": 0, "bytes": 0}
+
+    # -- updates (all called with PROGRESS.enabled already checked) --------
+    def _beat_locked(self) -> None:
+        self.heartbeats += 1
+        self.updated_ts = time.time()
+
+    def set_plan(self, plan) -> None:
+        """(Re)attach the physical plan tree. AQE calls this twice: the
+        static shape at start, the runtime-re-planned tree at the end."""
+        rows: List[Dict[str, Any]] = []
+
+        def rec(node, depth: int) -> None:
+            rows.append({"depth": depth, "op": node.describe(),
+                         "id": id(node)})
+            for c in node.children:
+                rec(c, depth + 1)
+        rec(plan, 0)
+        with self._lock:
+            self._plan_rows = rows
+            self._plans.append(plan)  # pin: id-keyed joins stay valid
+            self._beat_locked()
+
+    def op_batch(self, node_id: int, op: str, rows,
+                 seconds: float) -> None:
+        """One pulled batch of one operator (the heartbeat)."""
+        with self._lock:
+            st = self._ops.get(node_id)
+            if st is None or st["op"] != op:
+                # an op-string mismatch on the same id means CPython
+                # recycled a freed stage-plan node's id (AQE conversion
+                # plans are transient): start fresh rather than merging
+                # two different operators' stats
+                st = self._ops[node_id] = {"op": op, "rows": 0,
+                                           "batches": 0, "time_s": 0.0}
+            st["batches"] += 1
+            if rows is not None:
+                st["rows"] += int(rows)
+            st["time_s"] = round(st["time_s"] + seconds, 6)
+            self._beat_locked()
+
+    def aqe_begin(self, total_stages: int) -> None:
+        with self._lock:
+            self.adaptive = True
+            self.stages_total = int(total_stages)
+            self._beat_locked()
+
+    def aqe_stage_running(self, sid: int) -> None:
+        with self._lock:
+            self.stage_running = sid
+            self._beat_locked()
+
+    def aqe_stage_done(self, sid: int, **stats) -> None:
+        with self._lock:
+            self.stages_materialized += 1
+            if self.stage_running == sid:
+                self.stage_running = None
+            self.stages.append(dict(stage=sid, ts=round(time.time(), 3),
+                                    **stats))
+            self._beat_locked()
+
+    def aqe_decision(self, decision: Dict[str, Any]) -> None:
+        with self._lock:
+            self.decisions.append(dict(decision))
+            self._beat_locked()
+
+    def note(self, group: str, **deltas) -> None:
+        """Add counter deltas to one of the scan/shuffle/spill groups."""
+        d = getattr(self, group)
+        with self._lock:
+            for k, v in deltas.items():
+                d[k] = d.get(k, 0) + v
+            self._beat_locked()
+
+    def set_scan_stalled(self, stalled: bool) -> None:
+        with self._lock:
+            if stalled and not self.scan["stalled"]:
+                self.scan["stalls"] += 1
+            self.scan["stalled"] = bool(stalled)
+            self._beat_locked()
+
+    def finish(self, status: str, error: Optional[str] = None) -> None:
+        with self._lock:
+            self.status = status
+            self.error = error
+            self.end_ts = time.time()
+            # a query that died mid-stall must not read as stalled
+            # forever in the recent ring; stage_running is deliberately
+            # preserved — "which stage was running" is the first
+            # hung/failed-query question
+            self.scan["stalled"] = False
+            # release the pinned plan trees: they can hold broadcast
+            # build tables (CpuBroadcastExchangeExec._cache) and other
+            # materialized data, and this record lives on in the recent
+            # ring. No heartbeat arrives after the terminal state, so
+            # the id-keyed joins are frozen and safe.
+            self._plans = []
+            self._beat_locked()
+
+    # -- snapshot -----------------------------------------------------------
+    def snapshot(self, full: bool = True) -> Dict[str, Any]:
+        with self._lock:
+            now = time.time()
+            out: Dict[str, Any] = {
+                "id": self.id, "tenant": self.tenant,
+                "description": self.description, "status": self.status,
+                "error": self.error,
+                "start_ts": round(self.start_ts, 3),
+                "end_ts": round(self.end_ts, 3) if self.end_ts else None,
+                "wall_s": round((self.end_ts or now) - self.start_ts, 3),
+                "updated_ts": round(self.updated_ts, 3),
+                "heartbeats": self.heartbeats,
+                "scan": dict(self.scan), "shuffle": dict(self.shuffle),
+                "spill": dict(self.spill),
+            }
+            if self.adaptive:
+                out["aqe"] = {
+                    "stagesTotal": self.stages_total,
+                    "stagesMaterialized": self.stages_materialized,
+                    "stageRunning": self.stage_running,
+                    "stages": list(self.stages),
+                    "decisions": list(self.decisions),
+                }
+            if not full:
+                return out
+            ops = {nid: dict(st) for nid, st in self._ops.items()}
+            plan = []
+            for row in self._plan_rows:
+                r = {"depth": row["depth"], "op": row["op"]}
+                st = ops.get(row["id"])
+                if st is not None:
+                    r.update(rows=st["rows"], batches=st["batches"],
+                             time_s=round(st["time_s"], 6))
+                plan.append(r)
+            out["plan"] = plan
+            # aggregate by operator describe() string: catches AQE
+            # stage-converted nodes absent from the current plan rows
+            agg: Dict[str, Dict[str, Any]] = {}
+            for st in ops.values():
+                a = agg.setdefault(st["op"], {"rows": 0, "batches": 0,
+                                              "time_s": 0.0})
+                a["rows"] += st["rows"]
+                a["batches"] += st["batches"]
+                a["time_s"] = round(a["time_s"] + st["time_s"], 6)
+            out["operators"] = [dict(op=k, **v) for k, v in
+                                sorted(agg.items(),
+                                       key=lambda kv: -kv[1]["time_s"])]
+            return out
+
+
+class ProgressTracker:
+    """Process-wide registry of in-flight + recently-finished queries.
+
+    ``enabled`` is THE hot-path gate: call sites check it (one attribute
+    load) before touching anything else. ``_current`` mirrors the event
+    journal's one-query-at-a-time window — subsystems without an
+    ExecContext (scan decode pool, shuffle client, spill tiers) attribute
+    to it; were two sessions ever to interleave queries the counters
+    would land on whichever window opened last, same documented
+    limitation as ``EventLog.query_start``.
+    """
+
+    def __init__(self, recent: int = DEFAULT_RECENT):
+        self._lock = threading.Lock()
+        self.enabled = False
+        self._inflight: Dict[str, QueryProgress] = {}
+        self._recent: collections.deque = collections.deque(
+            maxlen=max(1, recent))
+        self._current: Optional[QueryProgress] = None
+
+    def configure(self, enabled: bool,
+                  recent: Optional[int] = None) -> None:
+        with self._lock:
+            self.enabled = bool(enabled)
+            if recent is not None and \
+                    self._recent.maxlen != max(1, int(recent)):
+                self._recent = collections.deque(
+                    self._recent, maxlen=max(1, int(recent)))
+
+    # -- lifecycle ----------------------------------------------------------
+    def begin(self, qid: str, tenant: Optional[str] = None,
+              description: str = "") -> QueryProgress:
+        qp = QueryProgress(qid, tenant=tenant, description=description)
+        with self._lock:
+            self._inflight[qid] = qp
+            self._current = qp
+        return qp
+
+    def finish(self, qp: QueryProgress, status: str,
+               error: Optional[str] = None) -> None:
+        qp.finish(status, error)
+        with self._lock:
+            self._inflight.pop(qp.id, None)
+            self._recent.append(qp)
+            if self._current is qp:
+                self._current = None
+
+    @property
+    def current(self) -> Optional[QueryProgress]:
+        return self._current
+
+    # -- hot-path helpers (caller already checked ``enabled``) --------------
+    def scan_split(self, nbytes: int) -> None:
+        qp = self._current
+        if qp is not None:
+            qp.note("scan", splitsDecoded=1, bytesDecoded=int(nbytes))
+
+    def scan_stalled(self, stalled: bool) -> None:
+        qp = self._current
+        if qp is not None:
+            qp.set_scan_stalled(stalled)
+
+    def scan_upload(self, rows: int) -> None:
+        qp = self._current
+        if qp is not None:
+            qp.note("scan", batchesUploaded=1, rowsUploaded=int(rows))
+
+    def shuffle_fetch(self, nbytes: int) -> None:
+        qp = self._current
+        if qp is not None:
+            qp.note("shuffle", fetches=1, bytes=int(nbytes))
+
+    def shuffle_retry(self) -> None:
+        qp = self._current
+        if qp is not None:
+            qp.note("shuffle", retries=1)
+
+    def shuffle_failure(self) -> None:
+        qp = self._current
+        if qp is not None:
+            qp.note("shuffle", failures=1)
+
+    def shuffle_map_partition(self) -> None:
+        qp = self._current
+        if qp is not None:
+            qp.note("shuffle", mapPartitions=1)
+
+    def spill(self, nbytes: int) -> None:
+        qp = self._current
+        if qp is not None:
+            qp.note("spill", events=1, bytes=int(nbytes))
+
+    # -- introspection ------------------------------------------------------
+    def get(self, qid: str) -> Optional[QueryProgress]:
+        with self._lock:
+            qp = self._inflight.get(qid)
+            if qp is not None:
+                return qp
+            for r in self._recent:
+                if r.id == qid:
+                    return r
+        return None
+
+    def queries(self, full: bool = False) -> List[Dict[str, Any]]:
+        """Snapshots: in-flight first, then recently finished newest
+        first. ``full=False`` omits per-operator/plan detail (the list
+        endpoint and diagnostics dumps stay compact)."""
+        with self._lock:
+            inflight = list(self._inflight.values())
+            recent = list(self._recent)
+        return ([qp.snapshot(full=full) for qp in inflight]
+                + [qp.snapshot(full=full) for qp in reversed(recent)])
+
+    def inflight_by_tenant(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for qp in self._inflight.values():
+                out[qp.tenant] = out.get(qp.tenant, 0) + 1
+            return out
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self.enabled = False
+            self._inflight.clear()
+            self._recent.clear()
+            self._current = None
+
+
+PROGRESS = ProgressTracker()

@@ -155,6 +155,17 @@ class TpuSparkSession:
         # rule decisions, final plan tree (sql/adaptive/executor.py);
         # bench.py --aqe-sweep archives it per query
         self.last_aqe: Optional[dict] = None
+        # tenant/job-group tag (set_job_group): flows into every event,
+        # the tenant.* metric labels, and live progress records — the
+        # per-tenant accounting substrate the serving layer reads
+        self._job_group: tuple = (None, "")
+        # SIGUSR1 -> flight-recorder + thread-stack + progress dump into
+        # the event log (obs/monitor.py; main-thread sessions only)
+        if conf.get_bool("spark.rapids.tpu.ui.signalDiagnostics", True):
+            from spark_rapids_tpu.obs.monitor import (
+                install_signal_diagnostics,
+            )
+            install_signal_diagnostics()
 
     def clear_device_cache(self) -> None:
         for _source, parts in self.device_scan_cache.values():
@@ -307,6 +318,40 @@ class TpuSparkSession:
             if TpuSparkSession._active is self:
                 TpuSparkSession._active = None
 
+    # --- tenancy -----------------------------------------------------------
+    def set_job_group(self, tenant, description: str = "") -> None:
+        """Tag subsequent queries with a tenant/job-group id (the
+        SparkContext.setJobGroup analogue). The tag flows into every
+        event the journal records for those queries, the ``tenant.*``
+        counters in the process-wide metrics registry (rendered live at
+        ``/metrics`` and aggregated at ``/api/tenants``), and the live
+        query-progress records. ``set_job_group(None)`` clears it."""
+        self._job_group = (str(tenant) if tenant else None,
+                           str(description or ""))
+
+    def clear_job_group(self) -> None:
+        self._job_group = (None, "")
+
+    @staticmethod
+    def _count_rows(outs) -> int:
+        try:
+            return sum(len(df) for df in outs) if outs else 0
+        except TypeError:
+            return 0
+
+    def _note_tenant(self, tenant, status: str, wall_s: float,
+                     rows: int = 0) -> None:
+        """Per-tenant accounting, once per query end (success or
+        failure): the counters /api/tenants aggregates and a Prometheus
+        scrape sees as srt_tenant_* series."""
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        t = tenant or "default"
+        REGISTRY.counter("tenant.queries", tenant=t, status=status).add(1)
+        REGISTRY.counter("tenant.wallSeconds", tenant=t).add(
+            round(wall_s, 6))
+        if rows:
+            REGISTRY.counter("tenant.rowsReturned", tenant=t).add(rows)
+
     # --- conf --------------------------------------------------------------
     def set_conf(self, key: str, value) -> None:
         self.conf.set(key, value)
@@ -385,22 +430,43 @@ class TpuSparkSession:
         # HERE so planning failures are on record too; the failure path
         # below dumps the always-on flight recorder into the log
         obs_events.EVENTS.configure_from_conf(conf)
-        obs_events.EVENTS.query_start(
+        # live monitoring service (obs/monitor.py): starts/stops the
+        # embedded HTTP server on conf change and keeps the progress
+        # tracker's single hot-path flag in lockstep. Off (the default)
+        # this is two conf reads and ctx.progress stays None.
+        from spark_rapids_tpu.obs import monitor as obs_monitor
+        from spark_rapids_tpu.obs.progress import PROGRESS
+        obs_monitor.maybe_serve(conf)
+        tenant, job_desc = self._job_group
+        qid = obs_events.EVENTS.query_start(
+            tenant=tenant,
             confFingerprint=obs_events.conf_fingerprint(conf._settings))
+        qp = None
+        if PROGRESS.enabled:
+            qp = PROGRESS.begin(qid, tenant=tenant, description=job_desc)
+            ctx.progress = qp
         try:
             plan, outs, ctx = self._plan_and_run(
                 logical, ctx, conf, obs_metrics, global_before, t_query0,
                 trace_on, trace_path, obs_before)
         except BaseException as e:
+            wall_s = round(time.perf_counter() - t_query0, 6)
+            err = f"{type(e).__name__}: {e}"[:300]
             obs_events.EVENTS.query_end(
-                status="failed", flight_dump=True,
-                error=f"{type(e).__name__}: {e}"[:300],
-                wall_s=round(time.perf_counter() - t_query0, 6))
+                status="failed", flight_dump=True, error=err,
+                wall_s=wall_s)
+            self._note_tenant(tenant, "failed", wall_s)
+            if qp is not None:
+                PROGRESS.finish(qp, "failed", error=err)
             raise
+        wall_s = round(time.perf_counter() - t_query0, 6)
+        rows_out = self._count_rows(outs)
         obs_events.EVENTS.query_end(
-            status="success",
-            wall_s=round(time.perf_counter() - t_query0, 6),
+            status="success", wall_s=wall_s, rowsReturned=rows_out,
             **self._coverage_fields(plan, ctx))
+        self._note_tenant(tenant, "success", wall_s, rows_out)
+        if qp is not None:
+            PROGRESS.finish(qp, "success")
         self._sweep_adaptive_caches()
         return plan, outs
 
@@ -477,13 +543,17 @@ class TpuSparkSession:
             assert_is_on_tpu(plan, conf)
         if self.capture_plans:
             self.captured_plans.append(plan)
-        # durable plan facts: structural digest + operator coverage, and
-        # one cpuFallback event per tagged-off operator with the tag
-        # pass's will-not-work reasons (the explain-why-not record the
-        # qualification tool ranks by time impact)
+        # durable plan facts: structural digest + operator coverage + the
+        # tree itself (tools/history_server.py renders plan pages from
+        # the log alone), and one cpuFallback event per tagged-off
+        # operator with the tag pass's will-not-work reasons (the
+        # explain-why-not record the qualification tool ranks by impact)
         obs_events.EVENTS.emit(
             "queryPlan", planDigest=obs_events.plan_digest(plan),
+            planTree=plan.tree_string()[:20000],
             **self._coverage_fields(plan))
+        if ctx.progress is not None:
+            ctx.progress.set_plan(plan)
         if overrides is not None:
             for meta in overrides.fallback_metas():
                 obs_events.EVENTS.emit(
@@ -516,7 +586,9 @@ class TpuSparkSession:
                     self.agg_ratio_cache.pop(sig, None)
                 self.release_active_shuffles()
                 self.release_transient_buffers()
+                prev_progress = ctx.progress
                 ctx = ExecContext(conf, self, speculate=False)
+                ctx.progress = prev_progress  # same query, same record
                 with TRACER.span("Query", speculative=False,
                                  rerun=True):
                     outs = self._drain(plan, ctx, conf)
@@ -549,6 +621,10 @@ class TpuSparkSession:
         obs_events.EVENTS.emit(
             "queryPlan", planDigest=obs_events.plan_digest(cpu_plan),
             adaptive=True, phase="static")
+        if ctx.progress is not None:
+            # the static shape now; the executor re-sets the tree as
+            # runtime re-planning evolves it and reports stage progress
+            ctx.progress.set_plan(cpu_plan)
         try:
             with TRACER.span("Query", adaptive=True):
                 plan, outs = adaptive.execute(cpu_plan)
@@ -561,9 +637,12 @@ class TpuSparkSession:
         # from the static shape exactly when an AQE rule fired
         obs_events.EVENTS.emit(
             "queryPlan", planDigest=obs_events.plan_digest(plan),
+            planTree=plan.tree_string()[:20000],
             adaptive=True, phase="final", aqeStages=len(adaptive.stages),
             aqeDecisions=len(adaptive.decisions),
             **self._coverage_fields(plan))
+        if ctx.progress is not None:
+            ctx.progress.set_plan(plan)
         self._finish_query(plan, ctx, conf, obs_metrics, global_before,
                            t_query0, trace_on, trace_path, obs_before)
         return plan, outs, ctx

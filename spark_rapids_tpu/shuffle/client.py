@@ -22,6 +22,7 @@ from typing import List, Tuple
 
 from spark_rapids_tpu.obs.events import EVENTS
 from spark_rapids_tpu.obs.metrics import REGISTRY
+from spark_rapids_tpu.obs.progress import PROGRESS
 from spark_rapids_tpu.obs.trace import TRACER
 from spark_rapids_tpu.shuffle import wire
 from spark_rapids_tpu.shuffle.catalogs import ReceivedBufferCatalog
@@ -100,6 +101,8 @@ class ShuffleClient:
                     out.append(self.received.add_batch(batch))
             except BaseException as e:
                 REGISTRY.counter("shuffle.fetch.failures").add(1)
+                if PROGRESS.enabled:
+                    PROGRESS.shuffle_failure()
                 # durable record of the failure (timeouts included — they
                 # surface as ShuffleFetchFailedError messages): the
                 # qualification tool's fetch-hotspot input
@@ -117,6 +120,8 @@ class ShuffleClient:
             .observe(time.perf_counter() - t0)
         REGISTRY.counter("shuffle.fetch.count").add(1)
         REGISTRY.counter("shuffle.fetch.bytes").add(total)
+        if PROGRESS.enabled:  # live fetch progress (/api/query/<id>)
+            PROGRESS.shuffle_fetch(total)
         return out
 
     def _fetch_metadata(self, blocks) -> List[Tuple[int, int, int]]:

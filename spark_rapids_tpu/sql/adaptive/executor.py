@@ -105,6 +105,9 @@ class AdaptiveExecutor:
         from spark_rapids_tpu.obs.trace import TRACER
         self._stage_counter += 1
         sid = self._stage_counter
+        prog = self.ctx.progress  # live stage view (obs/progress.py)
+        if prog is not None:
+            prog.aqe_stage_running(sid)
         prepared = self._finalize_reads(exchange)
         converted = self._convert(prepared)
         assert hasattr(converted, "materialize_stage"), (
@@ -115,6 +118,10 @@ class AdaptiveExecutor:
         stage = ShuffleStage(sid, exchange.output_schema(),
                              exchange.partitioning, map_outputs, stats)
         self.stages.append(stage)
+        if prog is not None:
+            prog.aqe_stage_done(sid, partitions=stats.num_partitions,
+                                maps=stats.num_maps,
+                                totalBytes=stats.total_bytes)
         REGISTRY.counter("aqe.stages").add(1)
         EVENTS.emit("aqeStageStats", stage=sid,
                     partitions=stats.num_partitions, maps=stats.num_maps,
@@ -201,6 +208,7 @@ class AdaptiveExecutor:
     def _flush_decisions(self, start: int) -> None:
         from spark_rapids_tpu.obs.events import EVENTS
         from spark_rapids_tpu.obs.metrics import REGISTRY
+        prog = self.ctx.progress
         for d in self.decisions[start:]:
             kind = {"coalesce": "aqeCoalesce",
                     "skewSplit": "aqeSkewSplit"}.get(d["rule"])
@@ -209,6 +217,8 @@ class AdaptiveExecutor:
                 REGISTRY.counter(
                     "aqe.coalescedReads" if d["rule"] == "coalesce"
                     else "aqe.skewSplits").add(1)
+                if prog is not None:
+                    prog.aqe_decision(d)
 
     def _note(self, decision: dict, kind: str, counter: str) -> None:
         from spark_rapids_tpu.obs.events import EVENTS
@@ -216,6 +226,9 @@ class AdaptiveExecutor:
         self.decisions.append(decision)
         EVENTS.emit(kind, **decision)
         REGISTRY.counter(counter).add(1)
+        prog = self.ctx.progress
+        if prog is not None:
+            prog.aqe_decision(decision)
 
     # -- conversion / drain -------------------------------------------------
     def _convert(self, plan: PhysicalPlan) -> PhysicalPlan:
@@ -252,6 +265,10 @@ class AdaptiveExecutor:
         — its digest in the queryPlan event differs from the static shape
         exactly when a rule fired."""
         plan = cpu_plan
+        prog = self.ctx.progress
+        if prog is not None:
+            prog.aqe_begin(sum(1 for n in cpu_plan.walk()
+                               if _is_stage_boundary(n)))
         try:
             while True:
                 exchange = self._next_ready_exchange(plan)

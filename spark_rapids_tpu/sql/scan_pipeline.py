@@ -47,6 +47,7 @@ from typing import Callable, List, Optional, Tuple
 
 from spark_rapids_tpu.obs.events import EVENTS
 from spark_rapids_tpu.obs.metrics import REGISTRY
+from spark_rapids_tpu.obs.progress import PROGRESS
 from spark_rapids_tpu.obs.trace import TRACER
 
 # one decode task per split: () -> pd.DataFrame
@@ -168,6 +169,8 @@ class ScanPrefetcher:
                     return None
                 self._pending_bytes += nbytes
             _BYTES.add(nbytes)
+            if PROGRESS.enabled:  # live scan progress (/api/query/<id>)
+                PROGRESS.scan_split(nbytes)
             return df
         finally:
             with self._lock:
@@ -258,8 +261,12 @@ class ScanPrefetcher:
         if not fut.done():
             import time
             t0 = time.perf_counter()
+            if PROGRESS.enabled:  # live stall state, cleared below
+                PROGRESS.scan_stalled(True)
             with TRACER.span("scan.prefetch.stall", split=i):
                 wait([fut], return_when=FIRST_COMPLETED)
+            if PROGRESS.enabled:
+                PROGRESS.scan_stalled(False)
             stall_s = time.perf_counter() - t0
             _STALL_TIME.record(stall_s)
             with self._lock:

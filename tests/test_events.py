@@ -139,6 +139,106 @@ class TestEventLog:
         log.emit("a")
         assert log.dropped == 1
 
+    def test_gzip_rotation(self, tmp_path):
+        """eventLog.compress: rotated segments land as <path>.N.gz and
+        read_events folds them back transparently, oldest first."""
+        import gzip
+        path = str(tmp_path / "gz.jsonl")
+        log = EventLog()
+        log.configure(True, path, max_bytes=2000, rotations=2,
+                      compress=True)
+        for i in range(100):
+            log.emit("tick", i=i, pad="x" * 40)
+        log.close()
+        assert log.rotations >= 3 and log.rotate_failures == 0
+        assert os.path.exists(path)                  # active: plaintext
+        assert os.path.exists(path + ".1.gz")
+        assert os.path.exists(path + ".2.gz")
+        assert not os.path.exists(path + ".1")       # never plaintext
+        assert not os.path.exists(path + ".3.gz")
+        # compressed segments hold MORE events than a plaintext rotation
+        # would (the bound applies pre-compression) and parse as gzip
+        with gzip.open(path + ".1.gz", "rt") as f:
+            assert all(json.loads(ln)["kind"] == "tick" for ln in f)
+        events = read_events(path)
+        seqs = [ev["seq"] for ev in events]
+        assert seqs == sorted(seqs)
+        assert events[-1]["i"] == 99
+
+    def test_gzip_toggle_leaves_readable_mixed_chain(self, tmp_path):
+        """Turning compress on mid-run shifts existing plaintext
+        rotations alongside new gzip ones; read_events folds both."""
+        path = str(tmp_path / "mix.jsonl")
+        log = EventLog()
+        log.configure(True, path, max_bytes=1500, rotations=3)
+        for i in range(40):
+            log.emit("tick", i=i, pad="x" * 40)
+        log.configure(True, path, max_bytes=1500, rotations=3,
+                      compress=True)
+        # few enough post-toggle events for ONE gzip rotation, so the
+        # earlier plaintext rotations survive in the shifted chain
+        for i in range(40, 60):
+            log.emit("tick", i=i, pad="x" * 40)
+        log.close()
+        exts = [e for n in (1, 2, 3) for e in ("", ".gz")
+                if os.path.exists(f"{path}.{n}{e}")]
+        assert ".gz" in exts and "" in exts  # genuinely mixed
+        events = read_events(path)
+        seqs = [ev["seq"] for ev in events]
+        assert seqs == sorted(seqs)
+        assert events[-1]["i"] == 59
+
+    def test_read_events_tolerates_rotation_holes(self, tmp_path):
+        """A failed compress can leave a hole in the chain (e.g. '.1'
+        and '.3' with no '.2'); the reader must not silently drop every
+        segment older than the gap."""
+        import gzip
+        path = str(tmp_path / "holes.jsonl")
+
+        def write(p, seqs, gz=False):
+            opener = gzip.open if gz else open
+            with opener(p, "wt") as f:
+                for s in seqs:
+                    f.write(json.dumps({"kind": "tick", "ts": float(s),
+                                        "seq": s}) + "\n")
+        write(path + ".3.gz", [1, 2], gz=True)   # oldest
+        write(path + ".1", [5, 6])               # hole at .2
+        write(path, [7, 8])                      # active
+        events = read_events(path)
+        assert [ev["seq"] for ev in events] == [1, 2, 5, 6, 7, 8]
+
+    def test_tools_read_gzipped_logs(self, tmp_path):
+        """qualification and trace_summary consume a fully-gzipped log
+        (open_event_file magic-byte sniff) like a plaintext one."""
+        import gzip
+        import importlib.util
+        import os as _os
+        path = str(tmp_path / "whole.jsonl.gz")
+        with gzip.open(path, "wt") as f:
+            for ev in (
+                {"kind": "queryStart", "ts": 1.0, "seq": 1,
+                 "query": "q-1", "confFingerprint": "abc"},
+                {"kind": "queryPlan", "ts": 1.1, "seq": 2,
+                 "query": "q-1", "planDigest": "d", "tpuOps": 3,
+                 "cpuOps": 0, "coveragePct": 100.0},
+                {"kind": "queryEnd", "ts": 2.0, "seq": 3,
+                 "query": "q-1", "status": "success", "wall_s": 1.0,
+                 "coveragePct": 100.0},
+            ):
+                f.write(json.dumps(ev) + "\n")
+        tools = _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "srt_qual_gz", _os.path.join(tools, "qualification.py"))
+        qual = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(qual)
+        kind, events = qual._load_any(path)
+        assert kind == "events" and len(events) == 3
+        recs = qual.records_from_events(events, source=path)
+        assert len(recs) == 1
+        assert recs[0]["status"] == "success"
+        assert recs[0]["coverage_pct"] == 100.0
+
     def test_rotation_failure_keeps_appending_honestly(self, tmp_path):
         """A breached size bound whose rename fails must keep the
         journal appending (no lost events), count rotate_failures, and

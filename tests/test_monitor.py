@@ -1,0 +1,616 @@
+"""Live monitoring service (obs/monitor.py + obs/progress.py) and the
+event-log history server (tools/history_server.py).
+
+Covers the ISSUE 9 tentpole contract: endpoint responses against a real
+in-process HTTP server on an ephemeral port, Prometheus text-format
+validity, the progress lifecycle (start -> heartbeats -> terminal state,
+including a query failing mid-run), tenant-label propagation into
+events/metrics/progress, AQE stage-level progress, the
+disabled-by-default zero-overhead contract, SIGUSR1 diagnostics, and
+history-server parity with ``qualification --json`` over one event log."""
+
+import importlib.util
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.obs import monitor
+from spark_rapids_tpu.obs.events import EVENTS, read_events
+from spark_rapids_tpu.obs.progress import PROGRESS
+from spark_rapids_tpu.sql import functions as F
+
+pytestmark = pytest.mark.smoke  # fast cross-section (see pyproject)
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"srt_{name}", os.path.join(_TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _monitor_reset_after():
+    yield
+    monitor.stop()
+    PROGRESS.reset_for_tests()
+    EVENTS.reset_for_tests()
+
+
+@pytest.fixture
+def ui_session(session):
+    session.set_conf("spark.rapids.tpu.ui.enabled", True)
+    session.set_conf("spark.rapids.tpu.ui.port", 0)  # ephemeral
+    yield session
+    session.clear_job_group()
+
+
+def _get(path, code=200):
+    srv = monitor.server()
+    assert srv is not None, "monitor server not running"
+    try:
+        with urllib.request.urlopen(srv.url + path, timeout=10) as r:
+            assert r.status == code
+            return r.read().decode()
+    except urllib.error.HTTPError as e:
+        assert e.code == code, (e.code, path)
+        return e.read().decode()
+
+
+def _df(session, n=200):
+    pdf = pd.DataFrame({"k": np.arange(n, dtype=np.int64) % 8,
+                        "v": np.linspace(0.0, 1.0, n)})
+    return session.create_dataframe(pdf, 2)
+
+
+def _join_agg_query(s, n_left=120, n_right=8):
+    left = pd.DataFrame({"k": np.arange(n_left) % n_right,
+                         "v": np.arange(n_left, dtype=np.float64)})
+    right = pd.DataFrame({"k2": np.arange(n_right),
+                          "w": np.arange(n_right, dtype=np.float64) * 3})
+    l = s.create_dataframe(left, 3)
+    r = s.create_dataframe(right, 2)
+    return (l.join(r, left_on=["k"], right_on=["k2"])
+            .group_by("k").agg(F.sum(F.col("v") * F.col("w")).alias("sv")))
+
+
+# ---------------------------------------------------------------------------
+# Live endpoints
+# ---------------------------------------------------------------------------
+
+class TestLiveEndpoints:
+    def test_healthz_and_status(self, ui_session):
+        _df(ui_session).group_by("k").count().collect()
+        health = json.loads(_get("/healthz"))
+        assert health["status"] == "ok"
+        assert health["uptime_s"] >= 0
+        status = json.loads(_get("/api/status"))
+        assert status["status"] == "ok"
+        assert "eventLog" in status
+        mem = status["memory"]
+        assert mem["hbmBudgetBytes"] <= mem["hbmTotalBytes"]
+        for key in ("deviceStoreBytes", "hostStoreBytes",
+                    "diskStoreBytes"):
+            assert key in mem
+        assert status["semaphore"]["permits"] >= 1
+        assert status["device"]["localDevices"] >= 1
+
+    def test_query_progress_success(self, ui_session):
+        _df(ui_session).group_by("k").agg(
+            F.sum("v").alias("sv")).collect()
+        queries = json.loads(_get("/api/queries"))["queries"]
+        assert queries, "query missing from /api/queries"
+        q = queries[0]
+        assert q["status"] == "success"
+        assert q["heartbeats"] > 0
+        assert q["end_ts"] is not None and q["wall_s"] > 0
+        full = json.loads(_get("/api/query/" + q["id"]))
+        # plan tree rows annotated with per-operator progress
+        assert full["plan"], full
+        annotated = [r for r in full["plan"] if "rows" in r]
+        assert annotated, full["plan"]
+        assert any(r["batches"] >= 1 for r in annotated)
+        assert full["operators"]
+        assert all(op["time_s"] >= 0 for op in full["operators"])
+
+    def test_unknown_query_404(self, ui_session):
+        _df(ui_session).filter(F.col("v") > 0.5).collect()
+        body = json.loads(_get("/api/query/q-does-not-exist", code=404))
+        assert "error" in body
+
+    def test_index_html(self, ui_session):
+        _df(ui_session).filter(F.col("v") > 0.5).collect()
+        page = _get("/")
+        assert "<html" in page and "/api/queries" in page
+
+    def test_failed_query_terminal_state(self, ui_session, monkeypatch):
+        from spark_rapids_tpu.session import TpuSparkSession
+
+        def boom(self, plan, ctx, conf):
+            raise RuntimeError("synthetic monitor failure")
+        monkeypatch.setattr(TpuSparkSession, "_drain", boom)
+        with pytest.raises(RuntimeError, match="synthetic"):
+            _df(ui_session).collect()
+        queries = json.loads(_get("/api/queries"))["queries"]
+        failed = [q for q in queries if q["status"] == "failed"]
+        assert failed, queries
+        assert "synthetic monitor failure" in failed[0]["error"]
+        # terminal: moved out of in-flight into the recent ring
+        assert json.loads(_get("/api/status"))["inflightQueries"] == 0
+
+    def test_live_view_mid_query(self, ui_session, monkeypatch):
+        """While a query is draining, /api/queries reports it running
+        with advancing heartbeats — the 'live' in live monitoring."""
+        from spark_rapids_tpu.session import TpuSparkSession
+        orig = TpuSparkSession._drain
+        seen = {}
+
+        def snooping(self, plan, ctx, conf):
+            out = orig(self, plan, ctx, conf)
+            mid = json.loads(_get("/api/queries"))["queries"]
+            seen["mid"] = [q for q in mid if q["status"] == "running"]
+            return out
+        monkeypatch.setattr(TpuSparkSession, "_drain", snooping)
+        _df(ui_session).group_by("k").count().collect()
+        assert seen["mid"], "no running query visible mid-drain"
+        assert seen["mid"][0]["heartbeats"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:\\.|[^"\\])*"'
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"                      # metric name
+    rf"(\{{{_LABEL}(,{_LABEL})*\}})?"                 # optional labels
+    r" -?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?$")       # sample value
+
+
+class TestPrometheus:
+    def test_text_format_validity(self, ui_session):
+        _df(ui_session).group_by("k").count().collect()
+        body = _get("/metrics")
+        assert body.endswith("\n")
+        seen_types = {}
+        current_family = None
+        samples = set()
+        for line in body.splitlines():
+            if not line:
+                continue
+            if line.startswith("#"):
+                m = re.match(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                             r"(counter|gauge|summary|histogram)$", line)
+                assert m, f"bad comment line: {line!r}"
+                fam = m.group(1)
+                assert fam not in seen_types, f"duplicate TYPE {fam}"
+                seen_types[fam] = m.group(2)
+                current_family = fam
+                continue
+            assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+            name = line.split("{")[0].split(" ")[0]
+            # samples sit under their family's TYPE line (summaries add
+            # _sum/_count suffixes to the family name)
+            assert current_family is not None
+            assert name == current_family or \
+                name in (current_family + "_sum",
+                         current_family + "_count"), line
+            key = line.rsplit(" ", 1)[0]
+            assert key not in samples, f"duplicate sample {key!r}"
+            samples.add(key)
+        # counters follow the _total convention
+        for fam, t in seen_types.items():
+            if t == "counter":
+                assert fam.endswith("_total"), fam
+
+    def test_known_families_present(self, ui_session):
+        _df(ui_session).group_by("k").count().collect()
+        body = _get("/metrics")
+        assert "# TYPE srt_tenant_queries_total counter" in body
+        assert re.search(r"^srt_tenant_queries_total\{.*status=\""
+                         r"success\".*\} [0-9]+", body, re.M)
+
+    def test_label_escaping(self, ui_session):
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        REGISTRY.counter("test.escape", why='quote"back\\slash').add(1)
+        _df(ui_session).filter(F.col("v") > 0.5).collect()
+        body = _get("/metrics")
+        assert 'why="quote\\"back\\\\slash"' in body
+
+
+# ---------------------------------------------------------------------------
+# Tenant propagation
+# ---------------------------------------------------------------------------
+
+class TestTenants:
+    def test_tenant_flows_everywhere(self, ui_session, tmp_path):
+        log = str(tmp_path / "tenants.jsonl")
+        ui_session.set_conf("spark.rapids.tpu.eventLog.path", log)
+        ui_session.set_job_group("team-red", "red dashboards")
+        try:
+            _df(ui_session).group_by("k").count().collect()
+            ui_session.set_job_group("team-blue", "blue etl")
+            _df(ui_session).filter(F.col("v") > 0.25).collect()
+        finally:
+            ui_session.set_conf("spark.rapids.tpu.eventLog.path", "")
+            ui_session.clear_job_group()
+        # 1) every event inside each query window carries the tag
+        events = read_events(log)
+        tagged = [ev for ev in events if "tenant" in ev]
+        assert {ev["tenant"] for ev in tagged} == {"team-red",
+                                                  "team-blue"}
+        for kind in ("queryStart", "queryPlan", "queryEnd"):
+            assert all("tenant" in ev for ev in events
+                       if ev["kind"] == kind)
+        # 2) metric label set -> /metrics
+        body = _get("/metrics")
+        assert 'tenant="team-red"' in body
+        assert 'tenant="team-blue"' in body
+        # 3) progress records + /api/tenants aggregation
+        queries = json.loads(_get("/api/queries"))["queries"]
+        assert {q["tenant"] for q in queries} >= {"team-red",
+                                                  "team-blue"}
+        tenants = json.loads(_get("/api/tenants"))["tenants"]
+        assert tenants["team-red"]["queries"] >= 1
+        assert tenants["team-blue"]["queries"] >= 1
+        assert tenants["team-red"]["wall_s"] > 0
+
+    def test_untagged_queries_account_to_default(self, ui_session):
+        _df(ui_session).filter(F.col("v") > 0.5).collect()
+        tenants = json.loads(_get("/api/tenants"))["tenants"]
+        assert tenants["default"]["queries"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# AQE stage-level progress
+# ---------------------------------------------------------------------------
+
+class TestAqeProgress:
+    def test_stage_progress_recorded(self, ui_session, monkeypatch):
+        # the converted stage root runs materialize_stage — TPU or CPU
+        # flavor depending on conversion; snoop both
+        from spark_rapids_tpu.exec import cpu as cpu_mod
+        from spark_rapids_tpu.exec import tpu as tpu_mod
+        advancing = []
+
+        def snoop(orig):
+            def wrapped(self, ctx):
+                # stage-level progress ADVANCES while the query runs:
+                # each materialization sees its predecessors' count
+                qs = json.loads(_get("/api/queries"))["queries"]
+                running = [q for q in qs if q["status"] == "running"]
+                assert running, "AQE query not visible while running"
+                advancing.append(
+                    running[0]["aqe"]["stagesMaterialized"])
+                assert running[0]["aqe"]["stageRunning"] is not None
+                return orig(self, ctx)
+            return wrapped
+        for mod in (cpu_mod, tpu_mod):
+            for cls_name in dir(mod):
+                cls = getattr(mod, cls_name)
+                if isinstance(cls, type) and \
+                        "materialize_stage" in vars(cls):
+                    monkeypatch.setattr(
+                        cls, "materialize_stage",
+                        snoop(vars(cls)["materialize_stage"]))
+        ui_session.set_conf("spark.rapids.sql.adaptive.enabled", True)
+        ui_session.set_conf(
+            "spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+        try:
+            _join_agg_query(ui_session).collect()
+        finally:
+            ui_session.set_conf("spark.rapids.sql.adaptive.enabled",
+                                False)
+        assert advancing == [0, 1, 2]
+        queries = json.loads(_get("/api/queries"))["queries"]
+        aqe_qs = [q for q in queries if "aqe" in q]
+        assert aqe_qs, queries
+        full = json.loads(_get("/api/query/" + aqe_qs[0]["id"]))
+        aqe = full["aqe"]
+        # the join+agg shape cuts 3 stages; all materialized by the end
+        assert aqe["stagesTotal"] == 3
+        assert aqe["stagesMaterialized"] == 3
+        assert aqe["stageRunning"] is None
+        assert len(aqe["stages"]) == 3
+        assert all("totalBytes" in st for st in aqe["stages"])
+        # coalesce decisions fire on these tiny shuffles
+        assert aqe["decisions"], aqe
+        # the plan served is the runtime-re-planned tree
+        assert any("AqeShuffleRead" in r["op"] for r in full["plan"])
+
+
+# ---------------------------------------------------------------------------
+# Zero-overhead default
+# ---------------------------------------------------------------------------
+
+class TestDisabledDefault:
+    def test_no_thread_no_progress_by_default(self, session):
+        assert not session.conf.get("spark.rapids.tpu.ui.enabled")
+        _df(session).group_by("k").count().collect()
+        assert monitor.server() is None
+        assert not PROGRESS.enabled
+        assert PROGRESS.queries() == []
+        assert not any(t.name == "tpu-ui"
+                       for t in threading.enumerate())
+
+    def test_toggle_off_stops_server(self, ui_session):
+        _df(ui_session).filter(F.col("v") > 0.5).collect()
+        assert monitor.server() is not None
+        ui_session.set_conf("spark.rapids.tpu.ui.enabled", False)
+        _df(ui_session).filter(F.col("v") > 0.5).collect()
+        assert monitor.server() is None
+        assert not PROGRESS.enabled
+
+    def test_port_change_rebinds_while_enabled(self, ui_session):
+        import socket
+        _df(ui_session).filter(F.col("v") > 0.5).collect()
+        first = monitor.server()
+        assert first is not None
+        # same requested address -> same server instance (no churn)
+        _df(ui_session).filter(F.col("v") > 0.5).collect()
+        assert monitor.server() is first
+        # a changed ui.port while enabled must rebind, not silently
+        # keep serving the old address
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        new_port = probe.getsockname()[1]
+        probe.close()
+        ui_session.set_conf("spark.rapids.tpu.ui.port", new_port)
+        _df(ui_session).filter(F.col("v") > 0.5).collect()
+        assert monitor.server() is not first
+        assert monitor.server().port == new_port
+
+    def test_bind_failure_warns_once_and_stays_off(self, session,
+                                                   caplog):
+        """An occupied port must not warn per query or leave progress
+        tracking on with no server; toggling the conf retries."""
+        import logging
+        import socket
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        sock.listen(1)
+        busy_port = sock.getsockname()[1]
+        session.set_conf("spark.rapids.tpu.ui.enabled", True)
+        session.set_conf("spark.rapids.tpu.ui.port", busy_port)
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="spark_rapids_tpu.obs.monitor"):
+                _df(session).filter(F.col("v") > 0.5).collect()
+                _df(session).filter(F.col("v") > 0.5).collect()
+            warns = [r for r in caplog.records
+                     if "could not bind" in r.getMessage()]
+            assert len(warns) == 1  # sticky, not one per query
+            assert monitor.server() is None
+            assert not PROGRESS.enabled  # no tracking without a reader
+            # toggling off resets the sticky flag; on retries the bind
+            session.set_conf("spark.rapids.tpu.ui.enabled", False)
+            _df(session).filter(F.col("v") > 0.5).collect()
+            session.set_conf("spark.rapids.tpu.ui.enabled", True)
+            session.set_conf("spark.rapids.tpu.ui.port", 0)
+            _df(session).filter(F.col("v") > 0.5).collect()
+            assert monitor.server() is not None
+        finally:
+            sock.close()
+            session.set_conf("spark.rapids.tpu.ui.enabled", False)
+
+
+# ---------------------------------------------------------------------------
+# SIGUSR1 diagnostics
+# ---------------------------------------------------------------------------
+
+class TestSignalDiagnostics:
+    def test_dump_diagnostics_contents(self, session):
+        ev = monitor.dump_diagnostics(reason="unit")
+        assert ev["kind"] == "diagnostics"
+        assert ev["reason"] == "unit"
+        # every live thread's stack, this one included
+        assert any("MainThread" in k for k in ev["threads"])
+        assert all(isinstance(v, list) for v in ev["threads"].values())
+        kinds = [e["kind"] for e in EVENTS.flight_events()]
+        assert "diagnostics" in kinds
+
+    def test_sigusr1_triggers_dump(self, session):
+        import signal
+        assert monitor.install_signal_diagnostics()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            kinds = [e["kind"] for e in EVENTS.flight_events()]
+            if "diagnostics" in kinds:
+                break
+            time.sleep(0.05)
+        ev = next(e for e in EVENTS.flight_events()
+                  if e["kind"] == "diagnostics")
+        assert ev["reason"] == "SIGUSR1"
+
+    def test_sigusr1_no_deadlock_while_event_lock_held(self, session):
+        """The handler interrupts the main thread between bytecodes; if
+        that thread holds EventLog._lock (emit runs file I/O and gzip
+        rotation under it) an INLINE dump would self-deadlock. The
+        dump must run off-thread and complete once the lock frees."""
+        import signal
+        assert monitor.install_signal_diagnostics()
+        EVENTS._lock.acquire()
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            time.sleep(0.3)  # handler fires; dump thread blocks on lock
+            # peek at the raw ring: flight_events() takes the very lock
+            # this test is holding
+            assert "diagnostics" not in [
+                e["kind"] for e in list(EVENTS._ring)]
+        finally:
+            EVENTS._lock.release()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if any(e["kind"] == "diagnostics"
+                   for e in EVENTS.flight_events()):
+                break
+            time.sleep(0.05)
+        assert any(e["kind"] == "diagnostics"
+                   for e in EVENTS.flight_events())
+
+    def test_never_replaces_app_owned_handler(self, monkeypatch):
+        """An embedding application's own SIGUSR1 handler must survive
+        session creation — the engine is a library."""
+        import signal
+        app_handler = lambda s, f: None  # noqa: E731
+        old = signal.signal(signal.SIGUSR1, app_handler)
+        try:
+            monkeypatch.setattr(monitor, "_SIGNAL_INSTALLED", False)
+            assert monitor.install_signal_diagnostics() is False
+            assert signal.getsignal(signal.SIGUSR1) is app_handler
+        finally:
+            signal.signal(signal.SIGUSR1, old)
+
+
+# ---------------------------------------------------------------------------
+# History server parity with qualification --json
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def history_log(session, tmp_path):
+    """One event log holding a plain query, a tagged query and an AQE
+    query (the satellite acceptance artifact shape)."""
+    log = str(tmp_path / "history.jsonl")
+    session.set_conf("spark.rapids.tpu.eventLog.path", log)
+    try:
+        _df(session).group_by("k").agg(F.sum("v").alias("sv")).collect()
+        session.set_job_group("team-hist", "tagged")
+        _df(session).filter(F.col("v") > 0.5).collect()
+        session.clear_job_group()
+        session.set_conf("spark.rapids.sql.adaptive.enabled", True)
+        session.set_conf(
+            "spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+        _join_agg_query(session).collect()
+    finally:
+        session.set_conf("spark.rapids.sql.adaptive.enabled", False)
+        session.set_conf("spark.rapids.tpu.eventLog.path", "")
+        EVENTS.reset_for_tests()
+    return log
+
+
+class TestHistoryServer:
+    def _serve(self, log):
+        hs = _load_tool("history_server")
+        return hs.HistoryServer([log], port=0).start()
+
+    def _get(self, srv, path, code=200):
+        try:
+            with urllib.request.urlopen(srv.url + path, timeout=10) as r:
+                assert r.status == code
+                return r.read().decode()
+        except urllib.error.HTTPError as e:
+            assert e.code == code
+            return e.read().decode()
+
+    def test_report_parity_with_qualification_json(self, history_log,
+                                                   tmp_path, capsys):
+        qual = _load_tool("qualification")
+        out_json = str(tmp_path / "qual.json")
+        assert qual.main([history_log, "--json", out_json]) == 0
+        capsys.readouterr()
+        with open(out_json) as f:
+            expected = json.load(f)
+        srv = self._serve(history_log)
+        try:
+            served = json.loads(self._get(srv, "/api/report"))
+        finally:
+            srv.stop()
+        # EXACT parity: same folding functions, same JSON round trip
+        assert served == expected
+
+    def test_api_queries_and_query_page(self, history_log):
+        srv = self._serve(history_log)
+        try:
+            queries = json.loads(
+                self._get(srv, "/api/queries"))["queries"]
+            assert len(queries) == 3
+            assert all(q["status"] == "success" for q in queries)
+            aqe = [q for q in queries if q["aqe"]["adaptive"]]
+            assert len(aqe) == 1
+            assert aqe[0]["aqe"]["stages"] == 3
+            name = aqe[0]["query"]
+            detail = json.loads(
+                self._get(srv, "/api/query/" + name))["detail"]
+            assert detail["plan_tree"]  # from queryPlan.planTree
+            assert len(detail["stages"]) == 3
+            assert all(st["offset_s"] is not None
+                       for st in detail["stages"])
+            # HTML pages: index + per-query, self-contained
+            index = self._get(srv, "/")
+            assert "<html" in index and name in index
+            page = self._get(srv, "/query/" + name)
+            assert "Adaptive execution" in page
+            assert "Stage timeline" in page
+            assert "Plan" in page
+            assert json.loads(self._get(
+                srv, "/api/query/nope", code=404))["error"]
+            tenants = json.loads(
+                self._get(srv, "/api/tenants"))["tenants"]
+            assert tenants["team-hist"]["queries"] == 1
+            assert tenants["default"]["queries"] == 2
+            # record shape matches the live monitor's /api/tenants
+            assert set(tenants["default"]) == {
+                "queries", "failed", "wall_s", "rows", "inflight"}
+            assert tenants["default"]["rows"] > 0  # from rowsReturned
+        finally:
+            srv.stop()
+
+    def test_duplicate_run_names_link_correctly(self, tmp_path):
+        """A journal appended across runs reuses query ids; the '#2'
+        disambiguated record must be reachable — its index link needs
+        percent-encoding or the browser truncates at the fragment."""
+        log = str(tmp_path / "dups.jsonl")
+        with open(log, "w") as f:
+            for run in (1, 2):
+                f.write(json.dumps(
+                    {"kind": "queryStart", "ts": float(run), "seq": run,
+                     "query": "q-1"}) + "\n")
+                f.write(json.dumps(
+                    {"kind": "queryEnd", "ts": run + 0.5,
+                     "seq": run + 10, "query": "q-1",
+                     "status": "success", "wall_s": 0.5}) + "\n")
+        srv = self._serve(log)
+        try:
+            names = [q["query"] for q in json.loads(
+                self._get(srv, "/api/queries"))["queries"]]
+            assert names == ["q-1", "q-1#2"]
+            index = self._get(srv, "/")
+            assert "/query/q-1%232" in index
+            page = self._get(srv, "/query/q-1%232")
+            assert "q-1#2" in page
+        finally:
+            srv.stop()
+
+    def test_reload_on_log_growth(self, history_log):
+        srv = self._serve(history_log)
+        try:
+            n0 = len(json.loads(self._get(srv, "/api/queries"))["queries"])
+            with open(history_log, "a") as f:
+                f.write(json.dumps(
+                    {"kind": "queryStart", "ts": time.time(), "seq": 1,
+                     "query": "q-999"}) + "\n")
+                f.write(json.dumps(
+                    {"kind": "queryEnd", "ts": time.time(), "seq": 2,
+                     "query": "q-999", "status": "failed",
+                     "error": "appended"}) + "\n")
+            # mtime granularity: ensure the stat stamp moves
+            os.utime(history_log,
+                     (time.time() + 2, time.time() + 2))
+            n1 = len(json.loads(self._get(srv, "/api/queries"))["queries"])
+            assert n1 == n0 + 1
+            health = json.loads(self._get(srv, "/healthz"))
+            assert health["queries"] == n1
+        finally:
+            srv.stop()

@@ -1,0 +1,170 @@
+"""tools/perfdiff.py — the bench regression gate.
+
+Per-query speedup deltas with a noise threshold, geomean drift, exit
+codes (0 ok / 1 regression / 2 unusable input), and all three accepted
+artifact shapes (BENCH_DETAIL queries dict, BENCH_r* wrapper with tail
+lines, bare summary line)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+spec = importlib.util.spec_from_file_location(
+    "srt_perfdiff", os.path.join(_TOOLS, "perfdiff.py"))
+perfdiff = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(perfdiff)
+
+
+def _detail(tmp_path, name, speedups, extra=None):
+    doc = {"sf": 0.5, "iters": 3,
+           "queries": {q: {"speedup": s, "tpu_s": 1.0, "cpu_s": s}
+                       for q, s in speedups.items()}}
+    if extra:
+        doc["queries"].update(extra)
+    p = str(tmp_path / name)
+    with open(p, "w") as f:
+        json.dump(doc, f)
+    return p
+
+
+class TestLoadSweep:
+    def test_detail_shape(self, tmp_path):
+        p = _detail(tmp_path, "d.json", {"q1": 2.0, "q2": 1.5},
+                    extra={"q3": {"skipped": "timed out"}})
+        per, geo = perfdiff.load_sweep(p)
+        assert per == {"q1": 2.0, "q2": 1.5}  # skipped entries dropped
+        assert geo is None
+
+    def test_wrapper_shape_parses_tail(self, tmp_path):
+        doc = {"n": 5, "rc": 0,
+               "parsed": {"metric": "x", "value": 1.5613},
+               "tail": ("bench: q1 tpu=0.15s cpu=0.35s speedup=2.33x "
+                        "(timed_compiles=0 warm=6.0s/36c)\n"
+                        "bench: tpcxbb.q9 tpu=0.24s cpu=0.39s "
+                        "speedup=1.64x (timed_compiles=0)\n")}
+        p = str(tmp_path / "r.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        per, geo = perfdiff.load_sweep(p)
+        assert per == {"q1": 2.33, "tpcxbb.q9": 1.64}
+        assert geo == 1.5613
+
+    def test_summary_line_shape(self, tmp_path):
+        p = str(tmp_path / "s.json")
+        with open(p, "w") as f:
+            json.dump({"metric": "geomean", "value": 2.0, "unit": "x"},
+                      f)
+        per, geo = perfdiff.load_sweep(p)
+        assert per == {} and geo == 2.0
+
+    def test_unrecognized_raises(self, tmp_path):
+        p = str(tmp_path / "bad.json")
+        with open(p, "w") as f:
+            json.dump({"hello": 1}, f)
+        with pytest.raises(ValueError):
+            perfdiff.load_sweep(p)
+
+
+class TestCompare:
+    def test_no_regression_within_noise(self):
+        rep = perfdiff.compare({"q1": 2.0, "q2": 1.0}, None,
+                               {"q1": 1.9, "q2": 1.05}, None,
+                               threshold=0.10, geo_threshold=0.05)
+        assert not rep["regressed"]
+        assert rep["regressions"] == []
+        assert rep["common_queries"] == 2
+
+    def test_per_query_regression_flags(self):
+        rep = perfdiff.compare({"q1": 2.0, "q2": 2.0}, None,
+                               {"q1": 1.0, "q2": 2.0}, None,
+                               threshold=0.10, geo_threshold=0.05)
+        assert rep["regressed"]
+        assert rep["regressions"] == ["q1"]
+        q1 = next(r for r in rep["deltas"] if r["query"] == "q1")
+        assert q1["delta_pct"] == -50.0
+
+    def test_geomean_drift_regression(self):
+        # every query down 8%: below the 10% per-query noise bar but the
+        # geomean drifts -8% past the 5% bound
+        base = {f"q{i}": 2.0 for i in range(10)}
+        new = {f"q{i}": 2.0 * 0.92 for i in range(10)}
+        rep = perfdiff.compare(base, None, new, None,
+                               threshold=0.10, geo_threshold=0.05)
+        assert rep["geomean_regressed"] and rep["regressed"]
+        assert rep["regressions"] == []  # no single query over the bar
+
+    def test_improvements_reported(self):
+        rep = perfdiff.compare({"q1": 1.0}, None, {"q1": 2.0}, None,
+                               threshold=0.10, geo_threshold=0.05)
+        assert rep["improvements"] == ["q1"]
+        assert not rep["regressed"]
+
+    def test_disjoint_sets_listed(self):
+        rep = perfdiff.compare({"q1": 1.0, "q2": 1.0}, None,
+                               {"q2": 1.0, "q3": 1.0}, None,
+                               threshold=0.10, geo_threshold=0.05)
+        assert rep["only_in_base"] == ["q1"]
+        assert rep["only_in_new"] == ["q3"]
+
+    def test_geomean_only_comparison(self):
+        rep = perfdiff.compare({}, 2.0, {}, 1.5,
+                               threshold=0.10, geo_threshold=0.05)
+        assert rep["geomean_drift_pct"] == -25.0
+        assert rep["regressed"]
+
+
+class TestCli:
+    def test_exit_zero_on_ok(self, tmp_path, capsys):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0, "q2": 1.5})
+        new = _detail(tmp_path, "new.json", {"q1": 2.05, "q2": 1.5})
+        assert perfdiff.main([base, new]) == 0
+        out = capsys.readouterr().out
+        assert "RESULT: ok" in out
+
+    def test_exit_one_on_regression(self, tmp_path, capsys):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0, "q2": 1.5})
+        new = _detail(tmp_path, "new.json", {"q1": 0.9, "q2": 1.5})
+        assert perfdiff.main([base, new]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSED" in out
+
+    def test_exit_two_on_bad_input(self, tmp_path, capsys):
+        p = str(tmp_path / "junk.json")
+        with open(p, "w") as f:
+            f.write("{\"nope\": 1}")
+        good = _detail(tmp_path, "good.json", {"q1": 1.0})
+        assert perfdiff.main([p, good]) == 2
+
+    def test_exit_two_on_empty_either_side(self, tmp_path, capsys):
+        """A crashed sweep (no per-query data, no geomean) must not
+        pass the gate — on EITHER side."""
+        good = _detail(tmp_path, "good.json", {"q1": 1.0})
+        empty = str(tmp_path / "empty.json")
+        with open(empty, "w") as f:
+            json.dump({"parsed": {}, "tail": "", "rc": 1}, f)
+        assert perfdiff.main([empty, good]) == 2
+        assert perfdiff.main([good, empty]) == 2
+
+    def test_json_output(self, tmp_path, capsys):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0})
+        new = _detail(tmp_path, "new.json", {"q1": 1.0})
+        out_p = str(tmp_path / "diff.json")
+        assert perfdiff.main([base, new, "--json", out_p]) == 1
+        with open(out_p) as f:
+            rep = json.load(f)
+        assert rep["regressions"] == ["q1"]
+        assert rep["geomean_drift_pct"] == -50.0
+
+    def test_threshold_flag(self, tmp_path):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0})
+        new = _detail(tmp_path, "new.json", {"q1": 1.7})  # -15%
+        assert perfdiff.main([base, new, "--threshold", "0.2",
+                              "--geomean-threshold", "0.2"]) == 0
+        assert perfdiff.main([base, new, "--threshold", "0.1",
+                              "--geomean-threshold", "0.2"]) == 1

@@ -1,0 +1,429 @@
+"""Event-log history server: the after-the-fact half of the live UI.
+
+The reference's history server replays Spark event logs into the same UI
+pages the live driver served; this is that role over the journal
+``spark_rapids_tpu/obs/events.py`` writes (``spark.rapids.tpu.eventLog.*``,
+``bench.py --event-log``). It serves the SAME ``/api/*`` shapes as the
+embedded live monitor (``obs/monitor.py``) plus minimal self-contained
+HTML query pages, from one or more event logs — rotations (``<path>.1``,
+``<path>.1.gz``) fold in automatically, gzip segments decompress
+transparently, and the logs are re-read when their mtimes change, so a
+running ``bench.py --event-log`` sweep can be watched mid-flight.
+
+The per-query numbers (coverage %, fallback reasons, AQE decisions) come
+from ``tools/qualification.py``'s own folding functions — not a
+re-implementation — so ``/api/report`` is byte-equal to
+``qualification.py --json`` over the same logs.
+
+Endpoints:
+
+  GET /                  HTML index (one row per query)
+  GET /query/<name>      HTML query page: plan tree, coverage %,
+                         fallback reasons, AQE decisions, stage timeline
+  GET /api/queries       {"queries": [qualification records]}
+  GET /api/query/<name>  one record + detail (plan tree, stages, events)
+  GET /api/tenants       per-tenant aggregate over the records
+  GET /api/report        the full qualification report (== --json)
+  GET /healthz           liveness
+
+Usage:
+    python tools/history_server.py LOG [LOG...] [--host H] [--port P]
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+from urllib.parse import quote, unquote, urlparse
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# shared HTTP plumbing with the live monitor: one place for handler and
+# server-thread behavior, two UIs that cannot drift
+from spark_rapids_tpu.obs.monitor import (  # noqa: E402
+    BackgroundHttpServer, JsonHandler,
+)
+
+
+def _load_qualification():
+    """Load tools/qualification.py by path (tools/ is not a package);
+    the folding logic is REUSED, never duplicated — that is what keeps
+    this server's numbers equal to ``qualification --json``."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "srt_qualification", os.path.join(_TOOLS, "qualification.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+qualification = _load_qualification()
+
+
+# ---------------------------------------------------------------------------
+# Per-query detail beyond the qualification record (plan tree, timeline)
+# ---------------------------------------------------------------------------
+
+def details_from_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-query rendering detail the qualification record does not
+    carry: the plan tree string (``queryPlan.planTree``), the AQE stage
+    timeline (``aqeStageStats`` timestamps relative to query start) and
+    the raw decision events. Duplicate-id naming comes from
+    ``qualification.QueryWindows`` — the SAME windowing the records use
+    — so names line up record-for-record by construction."""
+    details: Dict[str, Dict[str, Any]] = {}
+    windows = qualification.QueryWindows()
+
+    for ev in events:
+        name = windows.name_for(ev)
+        if name is None:
+            continue
+        kind = ev.get("kind")
+        d = details.get(name)
+        if d is None:
+            d = details[name] = {
+                "name": name, "start_ts": None, "end_ts": None,
+                "plan_tree": None, "plan_digest": None,
+                "stages": [], "decisions": []}
+        if kind == "queryStart":
+            d["start_ts"] = ev.get("ts")
+        elif kind == "queryPlan":
+            if ev.get("planTree"):
+                d["plan_tree"] = ev["planTree"]
+            d["plan_digest"] = ev.get("planDigest")
+        elif kind == "aqeStageStats":
+            d["stages"].append({
+                "stage": ev.get("stage"), "ts": ev.get("ts"),
+                "offset_s": round(ev.get("ts", 0) - d["start_ts"], 3)
+                if d.get("start_ts") else None,
+                "partitions": ev.get("partitions"),
+                "maps": ev.get("maps"),
+                "totalBytes": ev.get("totalBytes"),
+                "maxBytes": ev.get("maxBytes"),
+                "medianBytes": ev.get("medianBytes")})
+        elif kind in ("aqeCoalesce", "aqeBroadcastDemote",
+                      "aqeSkewSplit"):
+            d["decisions"].append(
+                {k: v for k, v in ev.items() if k != "seq"})
+        elif kind == "queryEnd":
+            d["end_ts"] = ev.get("ts")
+    return details
+
+
+class HistoryStore:
+    """Loaded view over one or more event logs, reloaded when any base
+    file's (mtime, size) changes — a live sweep appends and the next
+    request sees it."""
+
+    def __init__(self, paths: List[str]):
+        self.paths = list(paths)
+        self._lock = threading.Lock()
+        self._stamp = None
+        self.records: List[Dict[str, Any]] = []
+        self.report: Dict[str, Any] = {}
+        self.details: Dict[str, Any] = {}
+        self.reload()
+
+    def _stat(self):
+        out = []
+        for p in self.paths:
+            try:
+                st = os.stat(p)
+                out.append((p, st.st_mtime_ns, st.st_size))
+            except OSError:
+                out.append((p, None, None))
+        return tuple(out)
+
+    def reload(self) -> None:
+        from spark_rapids_tpu.obs.events import read_events
+        # stamp BEFORE reading: events appended DURING the read must
+        # leave the stamp stale so the next request re-reads them — a
+        # post-read stamp would mark them loaded forever
+        stamp = self._stat()
+        records: List[Dict[str, Any]] = []
+        details: Dict[str, Any] = {}
+        for p in self.paths:
+            events = read_events(p)
+            recs = qualification.records_from_events(events, source=p)
+            det = details_from_events(events)
+            # names are per-log; a multi-log server disambiguates by
+            # prefixing the log basename on collision
+            existing = {r["query"] for r in records}
+            rename = {}
+            for r in recs:
+                name = r["query"]
+                if name in existing:
+                    name = f"{os.path.basename(p)}:{r['query']}"
+                    rename[r["query"]] = name
+                    r["query"] = name
+                existing.add(name)
+            for old, new in rename.items():
+                if old in det:
+                    det[new] = det.pop(old)
+            records.extend(recs)
+            details.update(det)
+        with self._lock:
+            self.records = records
+            self.details = details
+            self.report = qualification.build_report(records)
+            self._stamp = stamp
+
+    def maybe_reload(self) -> None:
+        if self._stat() != self._stamp:
+            self.reload()
+
+    def record(self, name: str) -> Optional[Dict[str, Any]]:
+        for r in self.records:
+            if r["query"] == name:
+                return r
+        return None
+
+    def tenants(self) -> Dict[str, Any]:
+        """Same record shape as the live monitor's /api/tenants
+        (queries/failed/wall_s/rows/inflight — inflight is always 0
+        here: history has no in-flight queries)."""
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for r in self.records:
+            t = r.get("tenant") or "default"
+            d = tenants.setdefault(t, {"queries": 0, "failed": 0,
+                                       "wall_s": 0.0, "rows": 0,
+                                       "inflight": 0})
+            d["queries"] += 1
+            if r["status"] == "failed":
+                d["failed"] += 1
+            if r.get("wall_s"):
+                d["wall_s"] = round(d["wall_s"] + r["wall_s"], 6)
+            d["rows"] += int(r.get("rows_returned") or 0)
+        return {"tenants": tenants}
+
+
+# ---------------------------------------------------------------------------
+# HTML rendering (self-contained, inline CSS, zero dependencies)
+# ---------------------------------------------------------------------------
+
+_CSS = """
+ body{font-family:monospace;margin:1.5em;background:#fafafa;color:#222}
+ table{border-collapse:collapse;margin:0.6em 0}
+ td,th{border:1px solid #ccc;padding:3px 8px;text-align:left}
+ pre{background:#f0f0f0;padding:0.8em;overflow-x:auto}
+ .failed{color:#c00}.success{color:#080}.unknown{color:#888}
+ .bar{background:#9bd;display:inline-block;height:0.8em}
+ a{color:inherit}
+"""
+
+
+def _esc(v: Any) -> str:
+    return html.escape("" if v is None else str(v))
+
+
+def _href(name: str) -> str:
+    """Percent-encode a query name for a URL path segment: duplicate-run
+    ids carry '#' (``q-1#2``), which a bare href would truncate to a
+    fragment and land on the WRONG query's page."""
+    return quote(str(name), safe="")
+
+
+def render_index(store: HistoryStore) -> str:
+    t = store.report.get("totals", {})
+    rows = []
+    for r in store.records:
+        cov = (f"{r['coverage_pct']:.0f}%"
+               if r.get("coverage_pct") is not None else "-")
+        wall = f"{r['wall_s']:.3f}" if r.get("wall_s") is not None else "-"
+        aqe = r.get("aqe") or {}
+        rows.append(
+            f"<tr><td><a href='/query/{_href(r['query'])}'>"
+            f"{_esc(r['query'])}</a></td>"
+            f"<td>{_esc(r.get('tenant') or 'default')}</td>"
+            f"<td class='{_esc(r['status'])}'>{_esc(r['status'])}</td>"
+            f"<td>{wall}</td><td>{cov}</td>"
+            f"<td>{len(r['fallbacks'])}</td>"
+            f"<td>{aqe.get('stages', 0) if aqe.get('adaptive') else '-'}"
+            f"</td></tr>")
+    return (
+        f"<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>tpu history server</title><style>{_CSS}</style></head>"
+        f"<body><h3>spark-rapids-tpu history server</h3>"
+        f"<p>{t.get('queries', 0)} queries "
+        f"({t.get('succeeded', 0)} succeeded, {t.get('failed', 0)} "
+        f"failed), mean coverage {t.get('mean_coverage_pct')}% &middot; "
+        f"<a href='/api/report'>/api/report</a> &middot; "
+        f"<a href='/api/tenants'>/api/tenants</a></p>"
+        f"<table><tr><th>query</th><th>tenant</th><th>status</th>"
+        f"<th>wall_s</th><th>coverage</th><th>fallbacks</th>"
+        f"<th>aqe stages</th></tr>{''.join(rows)}</table>"
+        f"</body></html>")
+
+
+def render_query_page(r: Dict[str, Any], detail: Dict[str, Any]) -> str:
+    out = [f"<!doctype html><html><head><meta charset='utf-8'>"
+           f"<title>{_esc(r['query'])}</title><style>{_CSS}</style>"
+           f"</head><body>"
+           f"<p><a href='/'>&larr; index</a></p>"
+           f"<h3>{_esc(r['query'])} "
+           f"<span class='{_esc(r['status'])}'>{_esc(r['status'])}"
+           f"</span></h3>"]
+    wall = f"{r['wall_s']:.3f}s" if r.get("wall_s") is not None else "?"
+    cov = (f"{r['coverage_pct']:.1f}%"
+           if r.get("coverage_pct") is not None else "?")
+    tcov = (f"{r['time_coverage_pct']:.1f}%"
+            if r.get("time_coverage_pct") is not None else "?")
+    out.append(
+        f"<p>tenant <b>{_esc(r.get('tenant') or 'default')}</b> &middot; "
+        f"wall {wall} &middot; op coverage <b>{cov}</b> &middot; "
+        f"time coverage {tcov} &middot; "
+        f"spill {r['spill']['bytes']}B &middot; "
+        f"fetch retries {r['fetch']['retries']} &middot; "
+        f"compile {r['compile']['seconds']:.2f}s</p>")
+    if r.get("error"):
+        out.append(f"<p class='failed'>error: {_esc(r['error'])}</p>")
+    if r["fallbacks"]:
+        out.append("<h4>CPU fallbacks (ranked by time impact)</h4>"
+                   "<table><tr><th>operator</th><th>impact_s</th>"
+                   "<th>reasons</th></tr>")
+        for fb in r["fallbacks"]:
+            out.append(
+                f"<tr><td>{_esc(fb.get('op'))}</td>"
+                f"<td>{fb.get('impact_s', 0.0):.4f}</td>"
+                f"<td>{_esc('; '.join(fb.get('reasons') or []))}"
+                f"</td></tr>")
+        out.append("</table>")
+    aqe = r.get("aqe") or {}
+    if aqe.get("adaptive"):
+        out.append(
+            f"<h4>Adaptive execution</h4><p>{aqe.get('stages', 0)} "
+            f"stages, {aqe.get('coalesced_reads', 0)} coalesced reads, "
+            f"{aqe.get('broadcast_demotions', 0)} broadcast demotions, "
+            f"{aqe.get('skew_splits', 0)} skew splits</p>")
+        stages = (detail or {}).get("stages") or []
+        if stages:
+            end = (detail.get("end_ts") or 0)
+            start = (detail.get("start_ts") or 0)
+            span = max((end - start), 1e-6) if end and start else None
+            out.append("<h4>Stage timeline</h4><table><tr><th>stage</th>"
+                       "<th>t+ (s)</th><th>partitions</th><th>maps</th>"
+                       "<th>bytes</th><th></th></tr>")
+            for st in stages:
+                off = st.get("offset_s")
+                width = int(200 * off / span) if (span and off) else 0
+                out.append(
+                    f"<tr><td>{_esc(st['stage'])}</td>"
+                    f"<td>{off if off is not None else '-'}</td>"
+                    f"<td>{_esc(st.get('partitions'))}</td>"
+                    f"<td>{_esc(st.get('maps'))}</td>"
+                    f"<td>{_esc(st.get('totalBytes'))}</td>"
+                    f"<td><span class='bar' style='width:{width}px'>"
+                    f"</span></td></tr>")
+            out.append("</table>")
+        decs = (detail or {}).get("decisions") or []
+        if decs:
+            out.append("<h4>Decisions</h4><pre>"
+                       + _esc(json.dumps(decs, indent=1)) + "</pre>")
+    tree = (detail or {}).get("plan_tree")
+    if tree:
+        out.append("<h4>Plan</h4><pre>" + _esc(tree) + "</pre>")
+    out.append("</body></html>")
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# HTTP server
+# ---------------------------------------------------------------------------
+
+class _Handler(JsonHandler):
+    server_version = "spark-rapids-tpu-history"
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        store: HistoryStore = self.server.store
+        path = urlparse(self.path).path
+        try:
+            store.maybe_reload()
+            if path == "/healthz":
+                self._send_json({"status": "ok", "logs": store.paths,
+                                 "queries": len(store.records)})
+            elif path == "/api/queries":
+                self._send_json({"queries": store.records})
+            elif path == "/api/report":
+                self._send_json(store.report)
+            elif path == "/api/tenants":
+                self._send_json(store.tenants())
+            elif path.startswith("/api/query/"):
+                name = unquote(path[len("/api/query/"):])
+                r = store.record(name)
+                if r is None:
+                    self._send_json(
+                        {"error": f"unknown query {name!r}"}, 404)
+                else:
+                    self._send_json(
+                        dict(r, detail=store.details.get(name)))
+            elif path.startswith("/query/"):
+                name = unquote(path[len("/query/"):])
+                r = store.record(name)
+                if r is None:
+                    self._send(404, f"unknown query {_esc(name)}",
+                               "text/html; charset=utf-8")
+                else:
+                    self._send(200, render_query_page(
+                        r, store.details.get(name)),
+                        "text/html; charset=utf-8")
+            elif path in ("/", "/index.html"):
+                self._send(200, render_index(store),
+                           "text/html; charset=utf-8")
+            else:
+                self._send_json({"error": f"no route {path}"}, 404)
+        except Exception as e:  # noqa: BLE001 — a broken page, not a query
+            self._send_json(
+                {"error": f"{type(e).__name__}: {e}"[:300]}, 500)
+
+
+class HistoryServer(BackgroundHttpServer):
+    """The shared background HTTP server over a HistoryStore;
+    ``port=0`` binds an ephemeral port (tests)."""
+
+    def __init__(self, paths: List[str], host: str = "127.0.0.1",
+                 port: int = 0):
+        self.store = HistoryStore(paths)
+        super().__init__(_Handler, host, port,
+                         thread_name="tpu-history")
+        self._httpd.store = self.store
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="History server over structured event logs "
+                    "(obs/events.py JSONL; rotations + gzip folded in)")
+    ap.add_argument("logs", nargs="+",
+                    help="event-log base paths (rotations fold in)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=18080,
+                    help="TCP port (default 18080; 0 = ephemeral)")
+    args = ap.parse_args(argv)
+    for p in args.logs:
+        if not os.path.exists(p):
+            print(f"history_server: {p}: no such file", file=sys.stderr)
+            return 2
+    srv = HistoryServer(args.logs, host=args.host, port=args.port).start()
+    print(f"history server on {srv.url} "
+          f"({len(srv.store.records)} queries from "
+          f"{len(args.logs)} log(s)); endpoints: / /query/<id> "
+          f"/api/queries /api/query/<id> /api/report /api/tenants",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,195 @@
+"""Compare two bench sweep artifacts; nonzero exit on regression.
+
+The CI gate the bench trajectory lacked: given a BASE and a NEW sweep,
+report per-query speedup deltas above a noise threshold and the geomean
+drift, and exit 1 when NEW regresses. Accepts any of the three artifact
+shapes the harness produces:
+
+  * ``BENCH_DETAIL.json`` — ``{"queries": {name: {"speedup": ...}}}``
+    (the per-query sidecar ``bench.py`` writes);
+  * ``BENCH_r*.json`` — the driver wrapper ``{"parsed": summary,
+    "tail": stderr}``; per-query speedups are recovered from the tail's
+    ``bench: <q> tpu=..s cpu=..s speedup=..x`` lines, the geomean from
+    ``parsed.value``;
+  * a bare summary line — ``{"metric": ..., "value": geomean}``
+    (geomean-only comparison).
+
+Exit codes: 0 = no regression, 1 = regression (any common query slower
+than ``--threshold``, default 10%, or geomean drift below
+``--geomean-threshold``, default 5%), 2 = unusable input.
+
+Usage:
+    python tools/perfdiff.py BASE.json NEW.json [--threshold 0.10]
+           [--geomean-threshold 0.05] [--json OUT]
+
+Workflow (docs/observability.md): archive each round's detail file and
+gate merges with
+``python tools/perfdiff.py BENCH_prev.json BENCH_DETAIL.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import re
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+_TAIL_RE = re.compile(
+    r"bench: (\S+) tpu=([\d.]+)s cpu=([\d.]+)s speedup=([\d.]+)x")
+
+
+def load_sweep(path: str) -> Tuple[Dict[str, float], Optional[float]]:
+    """-> (per-query speedups, recorded geomean or None)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    if isinstance(doc.get("queries"), dict):
+        per = {name: float(rec["speedup"])
+               for name, rec in doc["queries"].items()
+               if isinstance(rec, dict) and "speedup" in rec}
+        return per, None
+    if "parsed" in doc or "tail" in doc:
+        per = {m.group(1): float(m.group(4))
+               for m in _TAIL_RE.finditer(str(doc.get("tail", "")))}
+        parsed = doc.get("parsed") or {}
+        geo = float(parsed["value"]) if "value" in parsed else None
+        return per, geo
+    if "value" in doc and "metric" in doc:
+        return {}, float(doc["value"])
+    raise ValueError(
+        f"{path}: unrecognized sweep shape (expected BENCH_DETAIL "
+        "'queries' dict, BENCH_r* 'parsed'/'tail' wrapper, or a summary "
+        "line with 'metric'/'value')")
+
+
+def _geomean(values) -> Optional[float]:
+    vals = [v for v in values if v and v > 0]
+    if not vals:
+        return None
+    return math.exp(sum(math.log(v) for v in vals) / len(vals))
+
+
+def compare(base: Dict[str, float], base_geo: Optional[float],
+            new: Dict[str, float], new_geo: Optional[float],
+            threshold: float, geo_threshold: float) -> Dict[str, Any]:
+    common = sorted(set(base) & set(new))
+    deltas = []
+    for q in common:
+        d = new[q] / base[q] - 1.0 if base[q] > 0 else 0.0
+        deltas.append({"query": q, "base": base[q], "new": new[q],
+                       "delta_pct": round(100.0 * d, 2),
+                       "regressed": d < -threshold,
+                       "improved": d > threshold})
+    deltas.sort(key=lambda r: r["delta_pct"])
+    # geomean drift over the COMMON set when both sides have per-query
+    # data (apples to apples); without overlap fall back to whole-sweep
+    # geomeans — recorded, or derived from whichever per-query data
+    # exists (the dropped/new listings flag the set mismatch)
+    if common:
+        gb = _geomean(base[q] for q in common)
+        gn = _geomean(new[q] for q in common)
+    else:
+        gb = base_geo if base_geo is not None else \
+            _geomean(base.values())
+        gn = new_geo if new_geo is not None else _geomean(new.values())
+    drift = (gn / gb - 1.0) if (gb and gn) else None
+    regressions = [r for r in deltas if r["regressed"]]
+    geo_regressed = drift is not None and drift < -geo_threshold
+    return {
+        "common_queries": len(common),
+        "only_in_base": sorted(set(base) - set(new)),
+        "only_in_new": sorted(set(new) - set(base)),
+        "threshold_pct": round(100.0 * threshold, 2),
+        "geomean_threshold_pct": round(100.0 * geo_threshold, 2),
+        "geomean_base": round(gb, 4) if gb else None,
+        "geomean_new": round(gn, 4) if gn else None,
+        "geomean_drift_pct": round(100.0 * drift, 2)
+        if drift is not None else None,
+        "geomean_regressed": geo_regressed,
+        "regressions": [r["query"] for r in regressions],
+        "improvements": [r["query"] for r in deltas if r["improved"]],
+        "deltas": deltas,
+        "regressed": bool(regressions) or geo_regressed,
+    }
+
+
+def render_text(rep: Dict[str, Any]) -> str:
+    lines = []
+    gb, gn = rep["geomean_base"], rep["geomean_new"]
+    drift = rep["geomean_drift_pct"]
+    lines.append(
+        f"perfdiff: {rep['common_queries']} common queries, geomean "
+        f"{gb if gb is not None else '?'} -> "
+        f"{gn if gn is not None else '?'}"
+        + (f" ({drift:+.2f}%)" if drift is not None else ""))
+    shown = [r for r in rep["deltas"]
+             if r["regressed"] or r["improved"]]
+    if shown:
+        lines.append(f"{'query':<18} {'base':>8} {'new':>8} {'delta':>8}")
+        for r in shown:
+            mark = " REGRESSED" if r["regressed"] else ""
+            lines.append(f"{r['query']:<18} {r['base']:>8.3f} "
+                         f"{r['new']:>8.3f} {r['delta_pct']:>+7.1f}%"
+                         f"{mark}")
+    else:
+        lines.append(f"no per-query deltas beyond the "
+                     f"{rep['threshold_pct']:.0f}% noise threshold")
+    for key, label in (("only_in_base", "dropped from new"),
+                       ("only_in_new", "new queries")):
+        if rep[key]:
+            lines.append(f"-- {label}: {', '.join(rep[key][:10])}"
+                         + (" ..." if len(rep[key]) > 10 else ""))
+    if rep["geomean_regressed"]:
+        lines.append(f"-- GEOMEAN REGRESSION: drift {drift:+.2f}% "
+                     f"exceeds -{rep['geomean_threshold_pct']:.0f}%")
+    lines.append("RESULT: " + ("REGRESSED" if rep["regressed"] else "ok"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-query speedup deltas + geomean drift between "
+                    "two bench sweeps; exit 1 on regression")
+    ap.add_argument("base", help="baseline sweep artifact")
+    ap.add_argument("new", help="candidate sweep artifact")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="per-query noise threshold as a fraction "
+                         "(default 0.10 = 10%%)")
+    ap.add_argument("--geomean-threshold", type=float, default=0.05,
+                    help="geomean drift regression bound (default 0.05)")
+    ap.add_argument("--json", metavar="OUT", default="",
+                    help="also write the machine-shape diff ('-' = "
+                         "stdout)")
+    args = ap.parse_args(argv)
+    try:
+        base, base_geo = load_sweep(args.base)
+        new, new_geo = load_sweep(args.new)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"perfdiff: {e}", file=sys.stderr)
+        return 2
+    # BOTH sides must carry data: an empty NEW (crashed/truncated
+    # sweep) sailing through with exit 0 is exactly what a gate must
+    # reject
+    for path, per, geo in ((args.base, base, base_geo),
+                           (args.new, new, new_geo)):
+        if not per and geo is None:
+            print(f"perfdiff: {path}: no speedups found",
+                  file=sys.stderr)
+            return 2
+    rep = compare(base, base_geo, new, new_geo,
+                  args.threshold, args.geomean_threshold)
+    if args.json == "-":
+        print(json.dumps(rep, indent=1))
+    else:
+        print(render_text(rep))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(rep, f, indent=1)
+    return 1 if rep["regressed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

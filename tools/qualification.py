@@ -53,8 +53,11 @@ if _REPO not in sys.path:
 # ---------------------------------------------------------------------------
 
 def _load_any(path: str):
-    """('events', [...]) | ('profile', doc) by sniffing the file."""
-    with open(path) as f:
+    """('events', [...]) | ('profile', doc) by sniffing the file.
+    Gzip-compressed inputs (``eventLog.compress`` rotations, or a
+    hand-gzipped archive) decompress transparently."""
+    from spark_rapids_tpu.obs.events import open_event_file, read_events
+    with open_event_file(path) as f:
         # full first non-blank line, however long (a post-rotation file
         # can open with a flightRecorder dump far past any fixed window)
         head_line = ""
@@ -65,12 +68,11 @@ def _load_any(path: str):
     try:
         first = json.loads(head_line) if head_line else None
         if isinstance(first, dict) and "kind" in first:
-            from spark_rapids_tpu.obs.events import read_events
             return "events", read_events(path)
     except json.JSONDecodeError:
         pass
     try:
-        with open(path) as f:
+        with open_event_file(path) as f:
             doc = json.load(f)
     except json.JSONDecodeError:
         doc = None
@@ -88,6 +90,7 @@ def _load_any(path: str):
 def _new_record(name: str, source: str) -> Dict[str, Any]:
     return {
         "query": name, "source": source, "status": "unknown",
+        "tenant": None, "rows_returned": 0,
         "wall_s": None, "tpu_ops": 0, "cpu_ops": 0, "coverage_pct": None,
         "time_coverage_pct": None, "fallbacks": [],
         "spill": {"bytes": 0, "events": 0, "pressure_events": 0},
@@ -103,40 +106,51 @@ def _new_record(name: str, source: str) -> Dict[str, Any]:
     }
 
 
-def records_from_events(events: List[Dict[str, Any]],
-                        source: str) -> List[Dict[str, Any]]:
-    # query ids are process-local counters (q-1, q-2, ...): a journal
-    # appended across runs (bench worker respawns) reuses them, so a
-    # queryStart for an already-seen id opens a FRESH record ("q-1#2")
-    # instead of merging two different queries into one
-    live: Dict[str, Dict[str, Any]] = {}
-    seen_count: Dict[str, int] = {}
-    out: List[Dict[str, Any]] = []
+class QueryWindows:
+    """Event-stream query-id windowing, shared by this report and the
+    history server's detail pass (tools/history_server.py) so the two
+    can never drift on naming. Query ids are process-local counters
+    (q-1, q-2, ...): a journal appended across runs (bench worker
+    respawns) reuses them, so a ``queryStart`` for an already-seen id
+    opens a FRESH window named ``q-1#2`` instead of merging two
+    different queries into one."""
 
-    def new_rec(qid: str) -> Dict[str, Any]:
-        n = seen_count.get(qid, 0) + 1
-        seen_count[qid] = n
-        r = _new_record(qid if n == 1 else f"{qid}#{n}", source)
-        live[qid] = r
-        out.append(r)
-        return r
+    def __init__(self):
+        self._live: Dict[str, str] = {}   # raw id -> current name
+        self._seen: Dict[str, int] = {}
 
-    def rec_for(ev) -> Optional[Dict[str, Any]]:
+    def name_for(self, ev: Dict[str, Any]) -> Optional[str]:
+        """Disambiguated record name of the event's query window (None
+        for query-less events). A queryStart — or any event for a
+        never-seen id — opens a new window."""
         qid = ev.get("query")
         if qid is None:
             return None
-        if ev.get("kind") == "queryStart":
-            return new_rec(qid)
-        r = live.get(qid)
-        return r if r is not None else new_rec(qid)
+        if ev.get("kind") == "queryStart" or qid not in self._live:
+            n = self._seen.get(qid, 0) + 1
+            self._seen[qid] = n
+            self._live[qid] = qid if n == 1 else f"{qid}#{n}"
+        return self._live[qid]
+
+
+def records_from_events(events: List[Dict[str, Any]],
+                        source: str) -> List[Dict[str, Any]]:
+    windows = QueryWindows()
+    recs: Dict[str, Dict[str, Any]] = {}
+    out: List[Dict[str, Any]] = []
 
     for ev in events:
         kind = ev.get("kind")
-        r = rec_for(ev)
-        if r is None:
+        name = windows.name_for(ev)
+        if name is None:
             continue
+        r = recs.get(name)
+        if r is None:
+            r = recs[name] = _new_record(name, source)
+            out.append(r)
         if kind == "queryStart":
             r["conf_fingerprint"] = ev.get("confFingerprint")
+            r["tenant"] = ev.get("tenant")
         elif kind == "queryPlan":
             r["plan_digest"] = ev.get("planDigest")
             r["tpu_ops"] = ev.get("tpuOps", 0)
@@ -153,6 +167,7 @@ def records_from_events(events: List[Dict[str, Any]],
             r["status"] = ev.get("status", "unknown")
             r["wall_s"] = ev.get("wall_s")
             r["error"] = ev.get("error")
+            r["rows_returned"] = int(ev.get("rowsReturned", 0) or 0)
             if "coveragePct" in ev:
                 r["coverage_pct"] = ev["coveragePct"]
                 r["tpu_ops"] = ev.get("tpuOps", r["tpu_ops"])

@@ -154,7 +154,13 @@ def main(argv=None) -> int:
     ap.add_argument("-n", "--top", type=int, default=15,
                     help="rows to print (default 15)")
     args = ap.parse_args(argv)
-    with open(args.file) as f:
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    # gzip-transparent open (eventLog.compress rotations and hand-gzipped
+    # archives summarize like plaintext)
+    from spark_rapids_tpu.obs.events import open_event_file
+    with open_event_file(args.file) as f:
         try:
             doc = json.load(f)
         except json.JSONDecodeError:
