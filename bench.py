@@ -287,7 +287,7 @@ def _worker():
         rec["warm_compiles"] = compile_counts["n"] - c0
         rec["warm_compile_s"] = round(compile_counts["secs"] - s0, 3)
 
-        c0 = compile_counts["n"]
+        c0, s0 = compile_counts["n"], compile_counts["secs"]
         k0 = kernelcache.cache_stats()["misses"]
         tpu_iters = []
         for _ in range(iters):
@@ -295,6 +295,12 @@ def _worker():
             tpu_out = run_query(fn, True)
             tpu_iters.append(round(time.perf_counter() - t0, 4))
         rec["timed_compiles"] = compile_counts["n"] - c0
+        rec["timed_compile_s"] = round(compile_counts["secs"] - s0, 3)
+        # the ROADMAP item 2 trajectory number: total compiler seconds
+        # this query paid, warm-up + (pathological) steady state
+        rec["compile_s"] = round(rec["warm_compile_s"]
+                                 + rec["timed_compile_s"], 3)
+        rec["compiles"] = rec["warm_compiles"] + rec["timed_compiles"]
         rec["timed_kc_misses"] = kernelcache.cache_stats()["misses"] - k0
         rec["tpu_iters"] = tpu_iters
         # per-query profile artifact (obs/profile.py): captured NOW, off
@@ -866,8 +872,16 @@ def main():
         "n_below_1x": sum(1 for v in scored.values() if v["speedup"] < 1.0),
         "timed_compiles_total": sum(v.get("timed_compiles", 0)
                                     for v in scored.values()),
+        "warm_compiles_total": sum(v.get("warm_compiles", 0)
+                                   for v in scored.values()),
         "warm_compile_s_total": round(sum(v.get("warm_compile_s", 0.0)
                                           for v in scored.values()), 1),
+        # compile count + seconds per sweep (warm + timed): the
+        # run-over-run trajectory of ROADMAP item 2's success metric
+        "compiles_total": sum(v.get("compiles", 0)
+                              for v in scored.values()),
+        "compile_s_total": round(sum(v.get("compile_s", 0.0)
+                                     for v in scored.values()), 1),
         "loadavg_before": round(load_before[0], 2),
         "loadavg_after": round(load_after[0], 2),
         "detail_file": detail_file,

@@ -645,6 +645,36 @@ UI_RECENT_QUERIES = register(
     "in-flight set (a bounded ring; oldest evicted first).",
     validator=_positive)
 
+# --- compile & dispatch ledger (obs/compileledger.py: per-operator XLA
+# compile attribution, recompile-cause analysis — the instrument behind
+# tools/compile_report.py and the fusion work's timed_compiles->0 goal) ----
+COMPILE_LEDGER_ENABLED = register(
+    "spark.rapids.tpu.compileLedger.enabled", _to_bool, True,
+    "Record every XLA backend compile in the process-wide compile ledger "
+    "(obs/compileledger.py): triggering plan operator, query, kernel "
+    "identity, input shape/dtype signature, persistent-cache outcome and "
+    "compile seconds, in a bounded in-memory ring. Feeds the profile "
+    "report's 'compiles' section, enriched backendCompile journal "
+    "events, the live monitor's srt_compile_* series and /api/query "
+    "compile stats, flight-recorder failure dumps, and "
+    "tools/compile_report.py's recompile-cause analysis. On by default: "
+    "compiles are rare and the steady-state dispatch overhead is one "
+    "flag check plus two thread-local stores per kernel call.")
+
+COMPILE_LEDGER_MAX_ENTRIES = register(
+    "spark.rapids.tpu.compileLedger.maxEntries", int, 2048,
+    "Entries kept in the compile ledger's bounded ring (oldest evicted "
+    "first). 2048 covers ~50 fully-cold warm-up queries at the observed "
+    "19-36 compiles per query.", validator=_positive)
+
+COMPILE_LEDGER_COST_ANALYSIS = register(
+    "spark.rapids.tpu.compileLedger.costAnalysis", _to_bool, False,
+    "After each backend compile, re-lower the kernel and attach XLA "
+    "cost_analysis() FLOPs and bytes-accessed to its ledger entry. Off "
+    "by default: the re-trace measurably slows warm-up (it re-runs "
+    "tracing for every freshly compiled kernel); enable it for roofline "
+    "attribution passes.")
+
 UI_SIGNAL_DIAGNOSTICS = register(
     "spark.rapids.tpu.ui.signalDiagnostics", _to_bool, True,
     "Install a SIGUSR1 handler at session creation that dumps the "

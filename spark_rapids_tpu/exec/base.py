@@ -61,10 +61,11 @@ class PhysicalPlan:
         disabled the partitions pass through untouched — no timers on the
         hot path."""
         parts = self.partitions(ctx)
+        from spark_rapids_tpu.obs import compileledger
         from spark_rapids_tpu.obs.trace import TRACER
         prog = ctx.progress  # live monitoring (obs/progress.py)
         if not ctx.metrics_enabled and not TRACER.enabled \
-                and prog is None:
+                and prog is None and not compileledger.LEDGER.enabled:
             return parts
         import time
         op = self.describe()
@@ -91,17 +92,33 @@ class PhysicalPlan:
                     t0 = time.perf_counter()
                     with TRACER.span(self.name, op=op,
                                      partition=pidx) as sp:
+                        # operator scope: a backend compile fired by a
+                        # kernel call inside this pull attributes to
+                        # THIS operator (obs/compileledger.py), and
+                        # transfer sites report their seconds against it
+                        prev_op = compileledger.push_op(op, node_id, ctx)
                         try:
                             batch = next(it)
                         except StopIteration:
                             return
+                        finally:
+                            compileledger.pop_op(prev_op)
                         rows = (batch._host_rows
                                 if hasattr(batch, "_host_rows")
                                 else len(batch))
                         if sp is not None:
                             sp.set(batch_rows=rows)
                     if sync_each:
+                        t1 = time.perf_counter()
                         _force_sync(batch)
+                        t2 = time.perf_counter()
+                        # pull vs sync split: the pull is python dispatch
+                        # (+ children + transfers), the sync is the
+                        # device draining THIS operator's queued kernels
+                        # (children already synced before yielding) —
+                        # the profile's device/transfer/dispatch rows
+                        compileledger.note_breakdown(
+                            ctx, node_id, pull_s=t1 - t0, sync_s=t2 - t1)
                         # per-node-identity inclusive time: the profiler
                         # subtracts children to get exclusive per-kernel
                         # attribution (describe() keys merge same-shaped
@@ -194,6 +211,11 @@ class ExecContext:
         self.profile_sync = conf.get_bool(
             "spark.rapids.sql.profile.syncEachOp", False)
         self.node_times: dict = {}
+        # per-plan-node wall-time components (obs/compileledger.py
+        # note_breakdown): pull_s/sync_s under profile_sync, transfer_s
+        # from the host<->device transfer sites — the profile report
+        # renders these as device/transfer/dispatch rows (obs/profile.py)
+        self.node_breakdown: dict = {}
         # adaptive capacity speculation (spark.rapids.sql.adaptiveCapacity.
         # enabled): operators that speculated a device->host size fetch
         # from the session cache append (key, totals_device, caps_used,

@@ -1016,9 +1016,18 @@ class TpuShuffleExchangeExec(TpuExec):
                 if sess is not None and sess.semaphore is not None:
                     sess.semaphore.release()
         flat = [b for bs in per_map for b in bs]
-        frames = DeviceBatch.to_pandas_many(
-            flat, fused_fetch_bytes=int(ctx.conf.get(
-                "spark.rapids.sql.collect.fusedFetchBytes", 4 << 20)))
+        # stage-barrier fetch under this exchange's operator scope: the
+        # fused-fetch slice/pack kernels it compiles attribute HERE, and
+        # the device->host seconds land in this node's transfer component
+        import time as _time
+
+        from spark_rapids_tpu.obs import compileledger
+        with compileledger.op_context(self.describe(), id(self), ctx):
+            t0 = _time.perf_counter()
+            frames = DeviceBatch.to_pandas_many(
+                flat, fused_fetch_bytes=int(ctx.conf.get(
+                    "spark.rapids.sql.collect.fusedFetchBytes", 4 << 20)))
+            compileledger.note_transfer(_time.perf_counter() - t0, "d2h")
         map_outputs = []
         pos = 0
         for bs in per_map:

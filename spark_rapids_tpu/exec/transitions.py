@@ -146,11 +146,21 @@ def upload_partition(ctx: ExecContext, part: Partition, schema: Schema,
                             for nm, (codes, u) in hints.items()}
                 with TRACER.span("scan.upload", partition=i,
                                  rows=len(chunk)):
+                    import time as _time
+
+                    from spark_rapids_tpu.obs import compileledger
+                    _t0 = _time.perf_counter()
                     batch = DeviceBatch.from_pandas(
                         chunk, schema=schema, dict_state=dict_state,
                         dict_numerics=dict_numerics,
                         device=(mesh_devs[i % len(mesh_devs)]
                                 if mesh_devs else None))
+                    # host->device transfer attribution (host buffer
+                    # build + device_put dispatch) against the upload
+                    # operator — the "transfer" component of its profile
+                    # breakdown row (obs/profile.py)
+                    compileledger.note_transfer(
+                        _time.perf_counter() - _t0, "h2d")
                 if PROGRESS.enabled:  # live upload progress
                     PROGRESS.scan_upload(len(chunk))
                 yield fname, batch
@@ -272,10 +282,19 @@ class DeviceToHostExec(PhysicalPlan):
 
         def make(part: Partition) -> Partition:
             def run() -> Iterator[pd.DataFrame]:
+                import time as _time
+
+                from spark_rapids_tpu.obs import compileledger
                 sem = ctx.session.semaphore if ctx.session else None
                 try:
                     for batch in part():
-                        yield batch.to_pandas()
+                        t0 = _time.perf_counter()
+                        df = batch.to_pandas()
+                        # device->host fetch seconds against this
+                        # transition operator (profile breakdown)
+                        compileledger.note_transfer(
+                            _time.perf_counter() - t0, "d2h")
+                        yield df
                 finally:
                     if sem is not None:
                         sem.release()

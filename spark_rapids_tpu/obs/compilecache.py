@@ -19,9 +19,21 @@ warmup shows up per query in ``session.profile_report()`` (the
     compileCache.retrievalTime                          — time spent
         deserializing cached executables
 
-Listeners are process-wide and registered once (jax keeps them for the
-interpreter's lifetime); ``install()`` is idempotent and called at session
-construction.
+Each backend compile additionally lands in the compile LEDGER
+(obs/compileledger.py) carrying the triggering plan operator, kernel
+identity and shape signature — the per-cause attribution this module's
+bare counters cannot give.
+
+Double-install guard: listener registration is once per PROCESS, not per
+module instance. jax's monitoring registry keeps listeners for the
+interpreter's lifetime with no dedup, so a re-registration (repeated
+session creation after a module reload, a second interpreter-level
+import under a different name) would double-count every compile. The
+installed marker therefore lives on the ``jax.monitoring`` module itself
+— the one object all importers share — and the registered callbacks
+resolve their counters at event time, so a test-time
+``REGISTRY.clear()`` can never leave them feeding orphaned counter
+objects.
 """
 
 from __future__ import annotations
@@ -29,54 +41,63 @@ from __future__ import annotations
 import threading
 
 _LOCK = threading.Lock()
-_installed = False
+# the marker attribute set on jax's monitoring module: survives a reload
+# of THIS module, which a module-local flag would not
+_MARKER = "_srt_compile_listeners_installed"
 
 
 def install() -> bool:
-    """Register the jax monitoring listeners once. Returns True when the
-    listeners are active (already-installed counts)."""
-    global _installed
+    """Register the jax monitoring listeners once per process. Returns
+    True when the listeners are active (already-installed counts)."""
     with _LOCK:
-        if _installed:
-            return True
         try:
             from jax import monitoring
         except ImportError:  # pragma: no cover - jax is a hard dep
             return False
-        from spark_rapids_tpu.obs.metrics import REGISTRY
-
-        hits = REGISTRY.counter("compileCache.persistentHits")
-        misses = REGISTRY.counter("compileCache.persistentMisses")
-        requests = REGISTRY.counter("compileCache.requests")
-        compiles = REGISTRY.counter("compileCache.backendCompiles")
-        compile_time = REGISTRY.timer("compileCache.backendCompileTime")
-        saved = REGISTRY.timer("compileCache.timeSaved")
-        retrieval = REGISTRY.timer("compileCache.retrievalTime")
-
-        from spark_rapids_tpu.obs.events import EVENTS
+        if getattr(monitoring, _MARKER, False):
+            return True
 
         def on_event(name: str, **kw) -> None:
+            from spark_rapids_tpu.obs.compileledger import LEDGER
+            from spark_rapids_tpu.obs.events import EVENTS
+            from spark_rapids_tpu.obs.metrics import REGISTRY
             if name == "/jax/compilation_cache/cache_hits":
-                hits.add(1)
+                REGISTRY.counter("compileCache.persistentHits").add(1)
+                LEDGER.note_cache_event("hit")
             elif name == "/jax/compilation_cache/cache_misses":
-                misses.add(1)
+                REGISTRY.counter("compileCache.persistentMisses").add(1)
+                LEDGER.note_cache_event("miss")
                 # a miss means a real XLA compile is coming: the durable
                 # warmup fact the qualification report attributes
                 EVENTS.emit("compileCacheMiss")
             elif name == "/jax/compilation_cache/compile_requests_use_cache":
-                requests.add(1)
+                REGISTRY.counter("compileCache.requests").add(1)
 
         def on_duration(name: str, secs: float, **kw) -> None:
+            from spark_rapids_tpu.obs import compileledger
+            from spark_rapids_tpu.obs.compileledger import LEDGER
+            from spark_rapids_tpu.obs.metrics import REGISTRY
+            if compileledger.recording_suppressed():
+                # instrument-internal compile (attach_cost's AOT
+                # re-lower): not a warm-up fact, skip all accounting
+                return
             if "backend_compile" in name:
-                compiles.add(1)
-                compile_time.record(secs)
-                EVENTS.emit("backendCompile", seconds=round(secs, 4))
+                REGISTRY.counter("compileCache.backendCompiles").add(1)
+                REGISTRY.timer("compileCache.backendCompileTime") \
+                    .record(secs)
+                # the ledger assembles the attributed entry AND emits the
+                # enriched backendCompile journal event; disabled, it
+                # falls back to the bare event so the journal never goes
+                # dark
+                if LEDGER.record_compile(secs) is None:
+                    from spark_rapids_tpu.obs.events import EVENTS
+                    EVENTS.emit("backendCompile", seconds=round(secs, 4))
             elif "compile_time_saved" in name:
-                saved.record(secs)
+                REGISTRY.timer("compileCache.timeSaved").record(secs)
             elif "cache_retrieval_time" in name:
-                retrieval.record(secs)
+                REGISTRY.timer("compileCache.retrievalTime").record(secs)
 
         monitoring.register_event_listener(on_event)
         monitoring.register_event_duration_secs_listener(on_duration)
-        _installed = True
+        setattr(monitoring, _MARKER, True)
         return True

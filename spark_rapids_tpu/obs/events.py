@@ -23,7 +23,14 @@ Event taxonomy (one JSON object per line; every event carries ``kind``,
   fetchRetry        exec         peer, attempt (exec/tpu.py retry loop)
   fetchFailure      shuffle      peer, error (shuffle/client.py)
   compileCacheMiss  compile      persistent-cache miss (obs/compilecache.py)
-  backendCompile    compile      seconds (an XLA compile that actually ran)
+  backendCompile    compile      seconds, op (triggering plan operator),
+                                 kernel (cached_jit identity), avals
+                                 (input shape/dtype signature), outcome
+                                 (persistent-cache hit/miss) — an XLA
+                                 compile that actually ran, enriched by
+                                 the compile ledger
+                                 (obs/compileledger.py); the record
+                                 tools/compile_report.py mines
   scanStall         scan         split, stall_s (sql/scan_pipeline.py)
   scanBudgetStall   scan         split (prefetch submission backpressure)
   shuffleSkew       shuffle      source, partitions, totalBytes, maxBytes,
@@ -43,10 +50,12 @@ Event taxonomy (one JSON object per line; every event carries ``kind``,
                                  queryPlan event additionally carries
                                  adaptive=true + aqeStages/aqeDecisions)
   diagnostics       monitor      reason, threads{name: stack[]},
-                                 queries[] — SIGUSR1 / manual dump of
-                                 all-thread stacks + live query progress
+                                 queries[], compiles[] — SIGUSR1 /
+                                 manual dump of all-thread stacks + live
+                                 query progress + compile-ledger tail
                                  (obs/monitor.dump_diagnostics)
-  flightRecorder    session      reason, events[] (ring dump, see below)
+  flightRecorder    session      reason, events[], compiles[] (ring dump
+                                 + compile-ledger tail, see below)
 
 Every event between queryStart and queryEnd additionally carries the
 ``tenant`` tag when the session has a job group set
@@ -337,10 +346,18 @@ class EventLog:
 
     def dump_flight(self, reason: str = "manual") -> Dict[str, Any]:
         """Write the ring into the journal as ONE ``flightRecorder``
-        event (the dump excludes itself). Returns the dump event."""
+        event (the dump excludes itself), together with the compile
+        ledger's tail — a hang or failure during warm-up shows WHAT was
+        compiling, not just that compiles happened. Returns the dump
+        event."""
         snap = self.flight_events()
+        try:
+            from spark_rapids_tpu.obs.compileledger import LEDGER
+            compiles = LEDGER.tail()
+        except Exception:  # noqa: BLE001 — a dump must never fail
+            compiles = []
         return self.emit("flightRecorder", reason=reason, count=len(snap),
-                         events=snap)
+                         events=snap, compiles=compiles)
 
     def _note_span(self, ev: Dict[str, Any]) -> None:
         """Tracer hook (TRACER.flight_hook): mirror finished spans into

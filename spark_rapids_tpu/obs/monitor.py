@@ -352,7 +352,15 @@ class _Handler(JsonHandler):
                     self._send_json({"error": f"unknown query {qid!r}"},
                                     404)
                 else:
-                    self._send_json(qp.snapshot(full=True))
+                    doc = qp.snapshot(full=True)
+                    # per-cause compile attribution from the ledger
+                    # (obs/compileledger.py): which (operator, kernel)
+                    # this query's warm-up seconds went to
+                    from spark_rapids_tpu.obs.compileledger import LEDGER
+                    stats = LEDGER.query_stats(qid)
+                    if stats["compiles"]:
+                        doc["compileCauses"] = stats["causes"]
+                    self._send_json(doc)
             elif path == "/api/tenants":
                 self._send_json(tenants_snapshot())
             elif path in ("/", "/index.html"):
@@ -451,6 +459,7 @@ def dump_diagnostics(reason: str = "manual") -> Dict[str, Any]:
     import sys
     import traceback
 
+    from spark_rapids_tpu.obs.compileledger import LEDGER
     from spark_rapids_tpu.obs.events import EVENTS
     names = {t.ident: t.name for t in threading.enumerate()}
     stacks: Dict[str, List[str]] = {}
@@ -458,8 +467,11 @@ def dump_diagnostics(reason: str = "manual") -> Dict[str, Any]:
         entries = traceback.format_stack(frame)
         stacks[f"{names.get(tid, 'thread')}-{tid}"] = [
             ln.rstrip("\n") for ln in entries[-40:]]
+    # the compile-ledger tail answers the first hung-warmup question —
+    # "what was compiling?" — next to where each thread is stuck
     ev = EVENTS.emit("diagnostics", reason=reason, threads=stacks,
-                     queries=PROGRESS.queries(full=False))
+                     queries=PROGRESS.queries(full=False),
+                     compiles=LEDGER.tail())
     EVENTS.dump_flight(reason=f"diagnostics:{reason}")
     return ev
 

@@ -35,10 +35,54 @@ def _node_profile(node, ctx, op_metrics: Dict[str, Any]) -> Dict[str, Any]:
         "batches": st["batches"] if st else 0,
         "children": children,
     }
+    bd = _node_breakdown(node, ctx)
+    if bd is not None:
+        out["breakdown"] = bd
     metrics = op_metrics.get(node.describe())
     if metrics:
         out["metrics"] = dict(metrics)
     return out
+
+
+def _node_breakdown(node, ctx) -> Optional[Dict[str, float]]:
+    """Split one operator's EXCLUSIVE wall time into device compute,
+    host<->device transfer and python-dispatch gap, from the components
+    the exec hot path records (obs/compileledger.note_breakdown):
+
+      * ``device_s``   — sync_s: time the device spent draining THIS
+        operator's queued kernels (profile.syncEachOp mode syncs after
+        every batch, and every child synced before yielding, so the
+        queue holds only this operator's work);
+      * ``transfer_s`` — seconds the transfer sites (scan/exchange
+        uploads, collect/exchange fetches) reported against this node;
+      * ``dispatch_s`` — the remainder of the exclusive pull time:
+        python-side tracing/dispatch/orchestration gap.
+
+    The three sum to the node's exclusive time (clamped at zero), which
+    is exactly what distinguishes "kernel is slow" from "we're
+    dispatch-bound". None when nothing was recorded for this node
+    (profile sync off and no transfers)."""
+    bd = getattr(ctx, "node_breakdown", None)
+    st = bd.get(id(node)) if bd else None
+    if not st:
+        return None
+    device = st.get("sync_s", 0.0)
+    transfer = st.get("transfer_s", 0.0)
+    pull = st.get("pull_s")
+    if pull is not None:
+        # children's pull+sync happened inside this node's pull: remove
+        # their inclusive share to get this operator's own python time
+        child_s = 0.0
+        for c in node.children:
+            cst = bd.get(id(c)) or {}
+            child_s += cst.get("pull_s", 0.0) + cst.get("sync_s", 0.0)
+        dispatch = max(pull - child_s - transfer, 0.0)
+    else:
+        dispatch = 0.0
+    return {"device_s": round(device, 6),
+            "transfer_s": round(transfer, 6),
+            "dispatch_s": round(dispatch, 6),
+            "total_s": round(device + transfer + dispatch, 6)}
 
 
 def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
@@ -48,8 +92,10 @@ def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
     ``global_delta`` is the per-query diff of the process-wide registry
     (obs.metrics.registry_delta) carrying spill/fetch/compile activity;
     ``obs_before`` is the query-start snapshot of (tracer dropped,
-    event-log dropped, event-log rotations, event-log rotate failures)
-    so truncation reports as a per-query delta like everything else."""
+    event-log dropped, event-log rotations, event-log rotate failures,
+    compile-ledger seq) so truncation reports as a per-query delta like
+    everything else — and the ``compiles`` section covers exactly this
+    query's ledger entries."""
     op_metrics = ctx.op_metrics()
     tree = _node_profile(plan, ctx, op_metrics)
     summary: Dict[str, Any] = {}
@@ -95,7 +141,25 @@ def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
     # hiding a clipped record
     from spark_rapids_tpu.obs.events import EVENTS
     from spark_rapids_tpu.obs.trace import TRACER
-    t0, e0, r0, f0 = obs_before or (0, 0, 0, 0)
+    t0, e0, r0, f0, ledger0 = (tuple(obs_before) + (0,) * 5)[:5] \
+        if obs_before else (0, 0, 0, 0, 0)
+    # compile attribution (obs/compileledger.py): this query's ledger
+    # entries summarized by (operator, kernel) cause — who compiled,
+    # which shapes, how many seconds of the wall went to the compiler
+    from spark_rapids_tpu.obs.compileledger import LEDGER, analyze
+    ledger_entries = LEDGER.entries(since_seq=ledger0)
+    if ledger_entries:
+        rep = analyze(ledger_entries, top_n=8)
+        summary["compiles"] = {
+            "count": rep["total_compiles"],
+            "seconds": rep["total_seconds"],
+            "attributedPct": rep["attributed_pct"],
+            "causes": [
+                {"op": g["op"], "kernel": (g["kernel"] or "")[:120],
+                 "compiles": g["compiles"], "seconds": g["seconds"],
+                 "signatures": g["signatures"]}
+                for g in rep["groups"]],
+        }
     obs = {}
     if TRACER.dropped - t0 > 0:
         obs["trace.droppedEvents"] = TRACER.dropped - t0
@@ -139,12 +203,17 @@ class ProfileReport:
             lines.append(f"query wall: {self.wall_s:.3f}s")
 
         def rec(node: Dict[str, Any], indent: int) -> None:
-            lines.append(
-                "  " * indent
-                + f"{node['op']}  "
-                + f"[incl {node['inclusive_s']:.3f}s "
-                + f"excl {node['exclusive_s']:.3f}s "
-                + f"rows {node['rows']} batches {node['batches']}]")
+            line = ("  " * indent
+                    + f"{node['op']}  "
+                    + f"[incl {node['inclusive_s']:.3f}s "
+                    + f"excl {node['exclusive_s']:.3f}s "
+                    + f"rows {node['rows']} batches {node['batches']}]")
+            bd = node.get("breakdown")
+            if bd:
+                line += (f" [device {bd['device_s']:.3f}s "
+                         f"transfer {bd['transfer_s']:.3f}s "
+                         f"dispatch {bd['dispatch_s']:.3f}s]")
+            lines.append(line)
             for c in node["children"]:
                 rec(c, indent + 1)
         rec(self.tree, 0)
@@ -153,6 +222,17 @@ class ProfileReport:
                 continue
             lines.append(f"-- {section}")
             for k, v in sorted(vals.items()):
+                if isinstance(v, list):
+                    # ranked sub-records (the compiles section's causes)
+                    lines.append(f"   {k}:")
+                    for item in v:
+                        if isinstance(item, dict):
+                            body = " ".join(f"{ik}={iv}" for ik, iv
+                                            in item.items())
+                            lines.append(f"     - {body}")
+                        else:
+                            lines.append(f"     - {item}")
+                    continue
                 if isinstance(v, float):
                     v = round(v, 6)
                 lines.append(f"   {k}: {v}")

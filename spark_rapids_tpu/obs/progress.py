@@ -81,6 +81,10 @@ class QueryProgress:
         self.shuffle = {"fetches": 0, "bytes": 0, "retries": 0,
                         "failures": 0, "mapPartitions": 0}
         self.spill = {"events": 0, "bytes": 0}
+        # backend compiles during this query (obs/compileledger.py):
+        # a query sitting in warm-up shows WHAT is compiling right now
+        self.compile = {"compiles": 0, "seconds": 0.0,
+                        "lastKernel": None}
 
     # -- updates (all called with PROGRESS.enabled already checked) --------
     def _beat_locked(self) -> None:
@@ -154,6 +158,18 @@ class QueryProgress:
                 d[k] = d.get(k, 0) + v
             self._beat_locked()
 
+    def note_compile(self, seconds: float,
+                     kernel: Optional[str] = None) -> None:
+        """One backend compile attributed to this query (called by the
+        compile ledger, obs/compileledger.py)."""
+        with self._lock:
+            self.compile["compiles"] += 1
+            self.compile["seconds"] = round(
+                self.compile["seconds"] + seconds, 4)
+            if kernel:
+                self.compile["lastKernel"] = kernel[:120]
+            self._beat_locked()
+
     def set_scan_stalled(self, stalled: bool) -> None:
         with self._lock:
             if stalled and not self.scan["stalled"]:
@@ -194,6 +210,7 @@ class QueryProgress:
                 "heartbeats": self.heartbeats,
                 "scan": dict(self.scan), "shuffle": dict(self.shuffle),
                 "spill": dict(self.spill),
+                "compile": dict(self.compile),
             }
             if self.adaptive:
                 out["aqe"] = {

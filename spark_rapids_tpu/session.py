@@ -414,10 +414,14 @@ class TpuSparkSession:
         global_before = (obs_metrics.REGISTRY.values()
                          if ctx.metrics_enabled else None)
         # truncation counters snapshot: the profile's observability
-        # section reports this query's DELTA, not the process totals
+        # section reports this query's DELTA, not the process totals.
+        # The 5th element is the compile-ledger seq watermark: the
+        # profile's ``compiles`` section covers entries recorded after it
+        from spark_rapids_tpu.obs.compileledger import LEDGER as _LEDGER
         obs_before = (TRACER.dropped, obs_events.EVENTS.dropped,
                       obs_events.EVENTS.rotations,
-                      obs_events.EVENTS.rotate_failures) \
+                      obs_events.EVENTS.rotate_failures,
+                      _LEDGER.seq) \
             if ctx.metrics_enabled else None
         if ctx.metrics_enabled:
             # the scan pipeline's peak gauge is state, not flow: reset it
@@ -430,6 +434,10 @@ class TpuSparkSession:
         # HERE so planning failures are on record too; the failure path
         # below dumps the always-on flight recorder into the log
         obs_events.EVENTS.configure_from_conf(conf)
+        # compile ledger (obs/compileledger.py): per-cause attribution of
+        # every backend compile this query triggers
+        from spark_rapids_tpu.obs.compileledger import LEDGER
+        LEDGER.configure_from_conf(conf)
         # live monitoring service (obs/monitor.py): starts/stops the
         # embedded HTTP server on conf change and keeps the progress
         # tracker's single hot-path flag in lockstep. Off (the default)
@@ -850,9 +858,26 @@ class TpuSparkSession:
                 finally:
                     if self.semaphore is not None:
                         self.semaphore.release()
-            outs = DeviceBatch.to_pandas_many(
-                batches, fused_fetch_bytes=int(conf.get(
-                    "spark.rapids.sql.collect.fusedFetchBytes", 4 << 20)))
+            # result fetch under a "Collect" scope: the fused-fetch
+            # pack/slice kernels it compiles attribute to "Collect" in
+            # the ledger, and the device->host seconds land as a
+            # Collect/fetchTime SQL metric. Deliberately NOT charged to
+            # the root node's breakdown (node_id=None): the fetch runs
+            # AFTER the root's pull window, and folding it in would
+            # break the device+transfer+dispatch == exclusive invariant
+            # of the per-operator rows (obs/profile.py)
+            import time as _time
+
+            from spark_rapids_tpu.obs import compileledger
+            with compileledger.op_context("Collect", None, None):
+                _t0 = _time.perf_counter()
+                outs = DeviceBatch.to_pandas_many(
+                    batches, fused_fetch_bytes=int(conf.get(
+                        "spark.rapids.sql.collect.fusedFetchBytes",
+                        4 << 20)))
+                if ctx.metrics_enabled:
+                    ctx.metric_add("Collect", "fetchTime",
+                                   _time.perf_counter() - _t0)
         else:
             for part in plan.executed_partitions(ctx):
                 for df in part():

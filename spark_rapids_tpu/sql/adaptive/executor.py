@@ -113,22 +113,33 @@ class AdaptiveExecutor:
         assert hasattr(converted, "materialize_stage"), (
             "stage root must stay the exchange after conversion, got "
             f"{converted.describe()}")
+        # compile-ledger watermark: stage-split uploads and per-stage
+        # kernel shapes are a known warm-up cause under AQE — attribute
+        # the compiles each stage triggers to it (obs/compileledger.py)
+        from spark_rapids_tpu.obs.compileledger import LEDGER
+        ledger0 = LEDGER.seq
         with TRACER.span("AqeStage", stage=sid):
             map_outputs, stats = converted.materialize_stage(self.ctx)
+        stage_compiles = LEDGER.entries(since_seq=ledger0)
+        compile_s = round(sum(e["seconds"] for e in stage_compiles), 4)
         stage = ShuffleStage(sid, exchange.output_schema(),
                              exchange.partitioning, map_outputs, stats)
         self.stages.append(stage)
         if prog is not None:
             prog.aqe_stage_done(sid, partitions=stats.num_partitions,
                                 maps=stats.num_maps,
-                                totalBytes=stats.total_bytes)
+                                totalBytes=stats.total_bytes,
+                                compiles=len(stage_compiles),
+                                compileSeconds=compile_s)
         REGISTRY.counter("aqe.stages").add(1)
         EVENTS.emit("aqeStageStats", stage=sid,
                     partitions=stats.num_partitions, maps=stats.num_maps,
                     totalBytes=stats.total_bytes,
                     maxBytes=stats.max_bytes(),
                     medianBytes=stats.median_bytes(),
-                    rows=sum(stats.rows_by_partition or []))
+                    rows=sum(stats.rows_by_partition or []),
+                    compiles=len(stage_compiles),
+                    compileSeconds=compile_s)
         record_shuffle_skew(stats.bytes_by_partition,
                             source=f"aqe:stage-{sid}")
         return stage

@@ -92,6 +92,33 @@ _MISSES = _REGISTRY.counter("kernelCache.misses")
 _BUILD_TIME = _REGISTRY.timer("kernelCache.buildTime")
 
 
+def _wrap_ledgered(signature: str, fn):
+    """Compile-ledger dispatch context (obs/compileledger.py): every call
+    of a cached kernel publishes its signature + argument references to a
+    thread-local for the call's duration, so a backend compile fired
+    inside it knows its kernel identity and input shape signature. The
+    steady-state (no-compile) overhead is one flag check, two
+    thread-local stores and a try/finally; with the ledger disabled it is
+    the flag check alone."""
+    from spark_rapids_tpu.obs import compileledger as _cl
+
+    def wrapped(*a, **kw):
+        if not _cl.LEDGER.enabled:
+            return fn(*a, **kw)
+        d = _cl.dispatch_begin(signature, a, kw)
+        try:
+            out = fn(*a, **kw)
+        finally:
+            entries = _cl.dispatch_end(d)
+        if entries and _cl.LEDGER.capture_cost:
+            # a compile just happened (warm-up path): opt-in FLOPs/bytes
+            # attribution via a re-lower of the now-cached executable
+            for e in entries:
+                _cl.LEDGER.attach_cost(e, fn, a, kw)
+        return out
+    return wrapped
+
+
 def cached_jit(signature: str, builder: Callable[[], Any]):
     """Return the cached kernel for ``signature``, building it once.
 
@@ -100,7 +127,10 @@ def cached_jit(signature: str, builder: Callable[[], Any]):
     tracer is on, hits emit instant events and builds emit spans (the
     XLA executable compile itself happens lazily at first call — the
     build span covers kernel CONSTRUCTION, backend_compile listeners
-    cover compilation, see bench.py)."""
+    cover compilation, see bench.py). Every cached kernel is wrapped
+    with the compile-ledger dispatch context so the backend compiles it
+    eventually triggers attribute to this signature + the calling plan
+    operator (obs/compileledger.py)."""
     with _LOCK:
         fn = _CACHE.get(signature)
         if fn is not None:
@@ -118,6 +148,7 @@ def cached_jit(signature: str, builder: Callable[[], Any]):
     with _TRACER.span("kernelcache.build", signature=signature[:160]):
         fn = builder()
     _BUILD_TIME.record(time.perf_counter() - t0)
+    fn = _wrap_ledgered(signature, fn)
     if _PROFILE:
         fn = _wrap_profiled(signature, fn)
     with _LOCK:

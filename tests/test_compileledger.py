@@ -1,0 +1,463 @@
+"""Compile & dispatch ledger (obs/compileledger.py).
+
+The attribution instrument behind ROADMAP item 2 (timed_compiles -> 0):
+every backend compile carries its triggering plan operator, kernel
+identity and shape signature; the analyzer names varying dimensions and
+recommends padding buckets; the per-batch execute path decomposes
+operator wall time into device/transfer/dispatch. Tier-1 invariant: the
+second run of tpch q6 triggers ZERO backend recompiles — the contract
+the whole-stage-fusion work must preserve.
+"""
+
+import json
+
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.obs import compileledger as cl
+from spark_rapids_tpu.obs.compileledger import LEDGER, analyze, parse_aval
+from spark_rapids_tpu.sql import functions as F
+
+
+def _entry(op="TpuProjectExec", kernel="proj|k1", avals=(),
+           seconds=1.0, query="q-1", outcome=None):
+    return {"op": op, "kernel": kernel, "avals": list(avals),
+            "seconds": seconds, "query": query, "outcome": outcome}
+
+
+# ---------------------------------------------------------------------------
+# Analyzer unit tests (synthetic ledgers with known varying dims)
+# ---------------------------------------------------------------------------
+
+class TestAnalyzer:
+    def test_groups_by_kernel_and_names_varying_axis(self):
+        entries = [
+            _entry(avals=["int32[1024,4]", "float64[1024]"]),
+            _entry(avals=["int32[2048,4]", "float64[2048]"],
+                   query="q-2"),
+            _entry(avals=["int32[4096,4]", "float64[4096]"],
+                   query="q-2"),
+            _entry(kernel="other|k2", avals=["int32[64]"]),
+        ]
+        rep = analyze(entries)
+        assert rep["total_compiles"] == 4
+        assert rep["attributed_pct"] == 100.0
+        g = next(gr for gr in rep["groups"] if gr["kernel"] == "proj|k1")
+        assert g["compiles"] == 3 and g["signatures"] == 3
+        assert g["queries"] == ["q-1", "q-2"]
+        # arg0 axis0 and arg1 axis0 vary; arg0 axis1 (the 4) does not
+        varying = {(v["arg"], v["axis"]) for v in g["varying"]}
+        assert (0, 0) in varying and (1, 0) in varying
+        assert (0, 1) not in varying
+        v0 = next(v for v in g["varying"] if (v["arg"], v["axis"]) == (0, 0))
+        assert v0["values"] == [1024, 2048, 4096]
+        assert v0["dtype"] == "int32"
+
+    def test_padding_buckets_and_projected_savings(self):
+        # 1000/1100/1200 rows: power-of-two padding collapses them to
+        # TWO buckets (1024, 2048) -> one of three compiles was waste
+        entries = [
+            _entry(avals=[f"int32[{n}]"], seconds=2.0)
+            for n in (1000, 1100, 1200)]
+        rep = analyze(entries)
+        g = rep["groups"][0]
+        v = g["varying"][0]
+        assert v["buckets"] == [1024, 2048]
+        assert g["projected_savings_s"] == pytest.approx(2.0)
+        assert rep["projected_savings_s"] == pytest.approx(2.0)
+
+    def test_static_scalar_variation(self):
+        # capacity buckets ride as static jit args: "=N" avals
+        entries = [_entry(avals=["float64[64]", "=1000"]),
+                   _entry(avals=["float64[64]", "=3000"])]
+        rep = analyze(entries)
+        v = rep["groups"][0]["varying"]
+        assert len(v) == 1 and v[0]["dtype"] == "static"
+        assert v[0]["buckets"] == [1024, 4096]
+
+    def test_unattributed_share(self):
+        entries = [_entry(seconds=9.0),
+                   {"op": None, "kernel": None, "avals": None,
+                    "seconds": 1.0, "query": None}]
+        rep = analyze(entries)
+        assert rep["attributed_seconds"] == 9.0
+        assert rep["attributed_pct"] == pytest.approx(90.0)
+
+    def test_stable_shape_groups_report_no_variation(self):
+        entries = [_entry(avals=["int32[64]"]),
+                   _entry(avals=["int32[64]"], query="q-2")]
+        rep = analyze(entries)
+        g = rep["groups"][0]
+        assert g["signatures"] == 1 and g["varying"] == []
+        assert g["projected_savings_s"] == 0.0
+
+    def test_rank_mismatch_reported(self):
+        entries = [_entry(avals=["int32[8]"]),
+                   _entry(avals=["int32[8,2]"])]
+        rep = analyze(entries)
+        v = rep["groups"][0]["varying"]
+        assert v and v[0]["axis"] == "rank"
+
+    def test_aggregated_count_entries(self):
+        # profile-sourced causes are pre-aggregated: one entry standing
+        # for N compiles must count as N (qualification/compile_report
+        # feed these from the profile's compiles section)
+        entries = [dict(_entry(seconds=6.0), count=12),
+                   _entry(kernel="k2", seconds=0.5)]
+        rep = analyze(entries)
+        assert rep["total_compiles"] == 13
+        g = next(g for g in rep["groups"] if g["kernel"] == "proj|k1")
+        assert g["compiles"] == 12
+
+    def test_suppressed_recording(self):
+        LEDGER.configure(True)
+        seq0 = LEDGER.seq
+        with cl._suppress_recording():
+            assert LEDGER.record_compile(1.0) is None
+        assert LEDGER.entries(since_seq=seq0) == []
+
+    def test_parse_aval(self):
+        assert parse_aval("int32[8,128]") == ("int32", (8, 128))
+        assert parse_aval("float64[]") == ("float64", ())
+        assert parse_aval("=1024") == ("=", "1024")
+        assert parse_aval("<DeviceBatch>") is None
+
+
+# ---------------------------------------------------------------------------
+# End-to-end attribution
+# ---------------------------------------------------------------------------
+
+def _fresh_df(session, n=100, parts=2):
+    return session.create_dataframe(
+        pd.DataFrame({"a": list(range(n)), "b": [1.5] * n}), parts)
+
+
+class TestLedgerAttribution:
+    def test_entries_carry_op_kernel_avals_and_query(self, session):
+        from spark_rapids_tpu.utils import kernelcache
+        import jax
+        kernelcache.clear()
+        jax.clear_caches()
+        seq0 = LEDGER.seq
+        out = (_fresh_df(session)
+               .filter(F.col("a") > 10)
+               .group_by().agg(F.sum("b").alias("s")).collect())
+        assert len(out) == 1
+        entries = LEDGER.entries(since_seq=seq0)
+        assert entries, "cold kernels must have compiled"
+        total = sum(e["seconds"] for e in entries)
+        attributed = sum(e["seconds"] for e in entries
+                         if e["op"] and e["kernel"])
+        # the acceptance bar: >=90% of backend-compile time attributed
+        # to an (operator, shape-signature) cause
+        assert attributed >= 0.9 * total
+        ops = {e["op"] for e in entries if e["op"]}
+        assert any("Agg" in op for op in ops)
+        e = next(e for e in entries if e["op"] and e["avals"])
+        assert e["query"] is not None
+        assert any("[" in a or a.startswith("=") for a in e["avals"])
+
+    def test_profile_compiles_section(self, session):
+        from spark_rapids_tpu.utils import kernelcache
+        import jax
+        kernelcache.clear()
+        jax.clear_caches()
+        _fresh_df(session, 64, 1).group_by().agg(
+            F.max("a").alias("m")).collect()
+        prof = session.profile_json()
+        comp = prof["summary"].get("compiles")
+        assert comp and comp["count"] > 0
+        assert comp["attributedPct"] >= 90.0
+        assert comp["causes"][0]["kernel"]
+
+    def test_second_run_of_tpch_q6_recompiles_nothing(self, session):
+        """ROADMAP item 2's steady-state invariant, pinned: warm-up may
+        compile, the second run of the same query MUST NOT — this is
+        the regression test the whole-stage-fusion work must keep
+        green (and what bench.py's timed_compiles measures)."""
+        from spark_rapids_tpu.models import tpch_data
+        from spark_rapids_tpu.models.tpch import QUERIES
+        lineitem = tpch_data.gen_lineitem(0.002)
+
+        def run():
+            tables = {"lineitem": session.create_dataframe(lineitem, 3)}
+            return QUERIES["q6"](session, tables).collect()
+
+        first = run()
+        seq0 = LEDGER.seq
+        second = run()
+        recompiles = LEDGER.entries(since_seq=seq0)
+        assert recompiles == [], (
+            "steady-state recompile regression: second q6 run compiled "
+            + ", ".join(f"{e['op']}/{(e['kernel'] or '')[:60]}"
+                        for e in recompiles))
+        pd.testing.assert_frame_equal(first, second)
+
+    def test_ledger_disabled_records_nothing(self, session):
+        from spark_rapids_tpu.utils import kernelcache
+        import jax
+        session.set_conf("spark.rapids.tpu.compileLedger.enabled", False)
+        try:
+            kernelcache.clear()
+            jax.clear_caches()
+            seq0 = LEDGER.seq
+            _fresh_df(session, 32, 1).group_by().agg(
+                F.count("a").alias("c")).collect()
+            assert LEDGER.entries(since_seq=seq0) == []
+        finally:
+            session.set_conf("spark.rapids.tpu.compileLedger.enabled",
+                             True)
+            LEDGER.configure(True)
+
+    def test_query_stats_groups_causes(self):
+        LEDGER.configure(True)
+        seq0 = LEDGER.seq
+        tok = cl.push_op("TpuTestExec", None, None)
+        try:
+            d = cl.dispatch_begin("testkern|x", (), {})
+            try:
+                LEDGER.record_compile(0.5)
+                LEDGER.record_compile(0.25)
+            finally:
+                cl.dispatch_end(d)
+        finally:
+            cl.pop_op(tok)
+        ents = LEDGER.entries(since_seq=seq0)
+        assert len(ents) == 2
+        q = ents[0]["query"]  # may be None outside a query window
+        stats = LEDGER.query_stats(q) if q else None
+        if stats:
+            assert stats["compiles"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# Dispatch/device/transfer breakdown
+# ---------------------------------------------------------------------------
+
+class TestBreakdown:
+    def test_components_sum_to_exclusive_time(self, session):
+        session.set_conf("spark.rapids.sql.profile.syncEachOp", True)
+        try:
+            (_fresh_df(session, 5000, 2)
+             .filter(F.col("a") % 3 == 0)
+             .group_by().agg(F.sum("b").alias("s")).collect())
+        finally:
+            session.set_conf("spark.rapids.sql.profile.syncEachOp",
+                             False)
+        prof = session.profile_json()
+
+        rows = []
+
+        def walk(node, is_root):
+            if node.get("breakdown") and not is_root:
+                rows.append(node)
+            for c in node.get("children", []):
+                walk(c, False)
+
+        walk(prof["plan"], True)
+        assert rows, "syncEachOp must produce breakdown rows"
+        for node in rows:
+            bd = node["breakdown"]
+            total = bd["device_s"] + bd["transfer_s"] + bd["dispatch_s"]
+            # components are rounded to 6dp independently of total_s
+            assert total == pytest.approx(bd["total_s"], abs=5e-6)
+            excl = node["exclusive_s"]
+            # the acceptance bar: components sum to within 10% of the
+            # operator's exclusive wall time (plus a tiny absolute
+            # epsilon for sub-millisecond operators)
+            assert abs(total - excl) <= max(0.10 * excl, 0.005), (
+                node["op"], bd, excl)
+
+    def test_transfer_attributed_to_upload_operator(self, session):
+        session.set_conf("spark.rapids.sql.profile.syncEachOp", True)
+        try:
+            _fresh_df(session, 20000, 2).group_by().agg(
+                F.sum("b").alias("s")).collect()
+        finally:
+            session.set_conf("spark.rapids.sql.profile.syncEachOp",
+                             False)
+        prof = session.profile_json()
+        found = []
+
+        def walk(node):
+            bd = node.get("breakdown")
+            if bd and ("Scan" in node["op"]
+                       or "HostToDevice" in node["op"]):
+                found.append(bd)
+            for c in node.get("children", []):
+                walk(c)
+
+        walk(prof["plan"])
+        assert found and any(bd["transfer_s"] > 0 for bd in found), found
+
+
+# ---------------------------------------------------------------------------
+# Listener double-install guard (satellite)
+# ---------------------------------------------------------------------------
+
+class TestListenerGuard:
+    def test_repeated_install_never_double_counts(self, session):
+        from jax import monitoring
+
+        from spark_rapids_tpu.obs import compilecache
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        assert compilecache.install() is True
+        assert compilecache.install() is True  # idempotent
+        before = REGISTRY.value("compileCache.backendCompiles")
+        monitoring.record_event_duration_secs(
+            "/jax/core/compile/backend_compile_duration", 0.123)
+        after = REGISTRY.value("compileCache.backendCompiles")
+        assert after - before == 1, \
+            "double-registered listeners would double-count"
+
+    def test_two_sessions_one_registration(self):
+        """Repeated session creation (stop + rebuild) re-runs install();
+        the process-wide marker keeps exactly one listener pair."""
+        from jax import monitoring
+
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        from spark_rapids_tpu.session import TpuSparkSession
+        s1 = TpuSparkSession.builder().get_or_create()
+        s1.stop()
+        s2 = TpuSparkSession.builder().get_or_create()
+        try:
+            before = REGISTRY.value("compileCache.persistentMisses")
+            monitoring.record_event(
+                "/jax/compilation_cache/cache_misses")
+            after = REGISTRY.value("compileCache.persistentMisses")
+            assert after - before == 1
+        finally:
+            s2.stop()
+
+    def test_counters_survive_registry_clear(self):
+        """The listeners resolve counters at event time: a test-time
+        REGISTRY.clear() must not leave them feeding orphaned counter
+        objects (counts silently lost)."""
+        from jax import monitoring
+
+        from spark_rapids_tpu.obs import compilecache
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        compilecache.install()
+        monitoring.record_event("/jax/compilation_cache/cache_misses")
+        base = REGISTRY.value("compileCache.persistentMisses")
+        assert base >= 1
+        monitoring.record_event("/jax/compilation_cache/cache_misses")
+        assert REGISTRY.value("compileCache.persistentMisses") == base + 1
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder / diagnostics carry the ledger tail (satellite)
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorderIntegration:
+    def test_flight_dump_includes_compiles(self, session, tmp_path):
+        from spark_rapids_tpu.obs.events import EVENTS
+        tok = cl.push_op("TpuDumpExec", None, None)
+        try:
+            d = cl.dispatch_begin("dumpkern", (), {})
+            try:
+                LEDGER.record_compile(0.2)
+            finally:
+                cl.dispatch_end(d)
+        finally:
+            cl.pop_op(tok)
+        ev = EVENTS.dump_flight(reason="test")
+        assert "compiles" in ev
+        assert any(e.get("kernel") == "dumpkern" for e in ev["compiles"])
+
+    def test_diagnostics_includes_compiles(self, session):
+        from spark_rapids_tpu.obs.monitor import dump_diagnostics
+        ev = dump_diagnostics(reason="test")
+        assert "compiles" in ev and isinstance(ev["compiles"], list)
+
+
+# ---------------------------------------------------------------------------
+# tools/compile_report.py over a synthetic enriched event log
+# ---------------------------------------------------------------------------
+
+def _load_tool(name):
+    import importlib.util
+    import os
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    spec = importlib.util.spec_from_file_location(
+        f"srt_{name}", os.path.join(tools, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_event_log(path, events):
+    with open(path, "w") as f:
+        for i, ev in enumerate(events):
+            ev = dict(ev)
+            ev.setdefault("ts", 1000.0 + i)
+            ev.setdefault("seq", i + 1)
+            f.write(json.dumps(ev) + "\n")
+    return str(path)
+
+
+_SYNTH_EVENTS = [
+    {"kind": "queryStart", "query": "q-1"},
+    {"kind": "backendCompile", "query": "q-1", "seconds": 2.0,
+     "op": "TpuHashJoinExec(inner)", "kernel": "join|probe",
+     "avals": ["int64[1000]", "=1000"], "outcome": "miss"},
+    {"kind": "backendCompile", "query": "q-1", "seconds": 2.0,
+     "op": "TpuHashJoinExec(inner)", "kernel": "join|probe",
+     "avals": ["int64[1500]", "=1500"], "outcome": "miss"},
+    {"kind": "queryEnd", "query": "q-1", "status": "success",
+     "wall_s": 10.0},
+    {"kind": "queryStart", "query": "q-2"},
+    {"kind": "backendCompile", "query": "q-2", "seconds": 2.0,
+     "op": "TpuHashJoinExec(inner)", "kernel": "join|probe",
+     "avals": ["int64[3000]", "=3000"], "outcome": "miss"},
+    {"kind": "backendCompile", "query": "q-2", "seconds": 0.1,
+     "op": None, "kernel": None, "avals": None, "outcome": None},
+    {"kind": "queryEnd", "query": "q-2", "status": "success",
+     "wall_s": 5.0},
+]
+
+
+class TestCompileReportTool:
+    def test_report_attributes_and_recommends_buckets(self, tmp_path):
+        cr = _load_tool("compile_report")
+        log = _write_event_log(tmp_path / "ev.jsonl", _SYNTH_EVENTS)
+        entries = cr._load_entries(log)
+        assert len(entries) == 4
+        rep = cr.build_report(entries)
+        # 6.0 of 6.1 seconds carry an (operator, shape) cause
+        assert rep["attributed_pct"] >= 90.0
+        g = rep["groups"][0]
+        assert g["kernel"] == "join|probe" and g["compiles"] == 3
+        axis = next(v for v in g["varying"] if v["axis"] == 0)
+        assert axis["values"] == [1000, 1500, 3000]
+        assert axis["buckets"] == [1024, 2048, 4096]
+        assert rep["per_query"]["q-1"]["compiles"] == 2
+        text = cr.render_text(rep, per_query=True)
+        assert "join|probe" in text and "recommend padding" in text
+
+    def test_cli_json_and_exit_codes(self, tmp_path, capsys):
+        cr = _load_tool("compile_report")
+        log = _write_event_log(tmp_path / "ev.jsonl", _SYNTH_EVENTS)
+        out = str(tmp_path / "rep.json")
+        assert cr.main([log, "--json", out]) == 0
+        with open(out) as f:
+            rep = json.load(f)
+        assert rep["total_compiles"] == 4
+        empty = _write_event_log(tmp_path / "empty.jsonl",
+                                 [{"kind": "queryStart", "query": "q-9"}])
+        assert cr.main([empty]) == 2
+
+    def test_qualification_warmup_section(self, tmp_path, capsys):
+        qual = _load_tool("qualification")
+        log = _write_event_log(tmp_path / "ev.jsonl", _SYNTH_EVENTS)
+        recs = qual.records_from_events(
+            __import__("spark_rapids_tpu.obs.events",
+                       fromlist=["read_events"]).read_events(log),
+            source=log)
+        report = qual.build_report(recs)
+        warm = report["warmup"]
+        assert warm["attributed_pct"] >= 90.0
+        assert warm["groups"][0]["kernel"] == "join|probe"
+        text = qual.render_text(report)
+        assert "warm-up compile causes" in text

@@ -21,10 +21,12 @@ perfdiff = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(perfdiff)
 
 
-def _detail(tmp_path, name, speedups, extra=None):
+def _detail(tmp_path, name, speedups, extra=None, compiles=None):
     doc = {"sf": 0.5, "iters": 3,
            "queries": {q: {"speedup": s, "tpu_s": 1.0, "cpu_s": s}
                        for q, s in speedups.items()}}
+    for q, n in (compiles or {}).items():
+        doc["queries"].setdefault(q, {})["timed_compiles"] = n
     if extra:
         doc["queries"].update(extra)
     p = str(tmp_path / name)
@@ -168,3 +170,78 @@ class TestCli:
                               "--geomean-threshold", "0.2"]) == 0
         assert perfdiff.main([base, new, "--threshold", "0.1",
                               "--geomean-threshold", "0.2"]) == 1
+
+
+class TestCompileGate:
+    """Steady-state recompile drift between sweeps gates exactly like a
+    speedup regression (ROADMAP item 2: timed_compiles -> 0)."""
+
+    def test_load_compiles_detail_shape(self, tmp_path):
+        p = _detail(tmp_path, "d.json", {"q1": 2.0, "q2": 1.5},
+                    compiles={"q1": 0, "q2": 3})
+        assert perfdiff.load_compiles(p) == {"q1": 0, "q2": 3}
+
+    def test_load_compiles_wrapper_tail(self, tmp_path):
+        doc = {"parsed": {"metric": "x", "value": 1.5},
+               "tail": ("bench: q1 tpu=0.15s cpu=0.35s speedup=2.33x "
+                        "(timed_compiles=2 warm=6.0s/36c)\n"
+                        "bench: q2 tpu=0.2s cpu=0.3s speedup=1.50x "
+                        "(timed_compiles=0 warm=1.0s/3c)\n")}
+        p = str(tmp_path / "r.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        assert perfdiff.load_compiles(p) == {"q1": 2, "q2": 0}
+
+    def test_compile_increase_regresses(self, tmp_path, capsys):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0},
+                       compiles={"q1": 0})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0},
+                      compiles={"q1": 4})
+        assert perfdiff.main([base, new]) == 1
+        out = capsys.readouterr().out
+        assert "STEADY-STATE RECOMPILE REGRESSION" in out
+        assert "RESULT: REGRESSED" in out
+
+    def test_compile_decrease_is_not_a_regression(self, tmp_path,
+                                                  capsys):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0},
+                       compiles={"q1": 4})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0},
+                      compiles={"q1": 0})
+        assert perfdiff.main([base, new]) == 0
+        assert "RESULT: ok" in capsys.readouterr().out
+
+    def test_equal_compiles_pass(self, tmp_path):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0},
+                       compiles={"q1": 1})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0},
+                      compiles={"q1": 1})
+        assert perfdiff.main([base, new]) == 0
+
+    def test_ignore_compiles_flag(self, tmp_path):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0},
+                       compiles={"q1": 0})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0},
+                      compiles={"q1": 4})
+        assert perfdiff.main([base, new, "--ignore-compiles"]) == 0
+
+    def test_missing_compile_data_does_not_gate(self, tmp_path):
+        # artifacts without timed_compiles (old sweeps, summary lines)
+        # keep the gate on speedups only
+        base = _detail(tmp_path, "base.json", {"q1": 2.0})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0},
+                      compiles={"q1": 4})
+        assert perfdiff.main([base, new]) == 0
+
+    def test_compile_deltas_in_json(self, tmp_path, capsys):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0},
+                       compiles={"q1": 0})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0},
+                      compiles={"q1": 2})
+        out_p = str(tmp_path / "diff.json")
+        assert perfdiff.main([base, new, "--json", out_p]) == 1
+        with open(out_p) as f:
+            rep = json.load(f)
+        assert rep["compile_regressions"] == ["q1"]
+        assert rep["compile_deltas"] == [
+            {"query": "q1", "base": 0, "new": 2, "regressed": True}]

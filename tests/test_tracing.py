@@ -226,9 +226,13 @@ def test_trace_summary_tool(tmp_path, capsys, session, rng):
 
 
 def test_disabled_metrics_no_wrapping(session):
-    """Overhead contract: metrics + tracing off -> executed_partitions
-    returns the operator's raw partitions untouched."""
+    """Overhead contract: metrics + tracing + compile ledger off ->
+    executed_partitions returns the operator's raw partitions
+    untouched. With the ledger ON (its default) the wrapper stays — it
+    maintains the operator scope compile attribution rides on
+    (obs/compileledger.py)."""
     from spark_rapids_tpu.exec.base import ExecContext, PhysicalPlan
+    from spark_rapids_tpu.obs.compileledger import LEDGER
 
     sentinel = [lambda: iter(())]
 
@@ -240,6 +244,12 @@ def test_disabled_metrics_no_wrapping(session):
     try:
         ctx = ExecContext(session.conf, None)
         assert not TRACER.enabled
-        assert P().executed_partitions(ctx) is sentinel
+        assert LEDGER.enabled  # default on -> still wrapped
+        assert P().executed_partitions(ctx) is not sentinel
+        LEDGER.configure(False)
+        try:
+            assert P().executed_partitions(ctx) is sentinel
+        finally:
+            LEDGER.configure(True)
     finally:
         session.set_conf("spark.rapids.sql.metrics.enabled", True)

@@ -1,0 +1,212 @@
+"""Recompile-cause report over the compile ledger's durable record.
+
+ROADMAP item 2's success metric is ``timed_compiles -> 0`` and the
+compile count per query halved; this tool is the instrument that says
+where to aim. It mines the enriched ``backendCompile`` events the
+compile ledger writes into the structured event journal
+(obs/compileledger.py -> obs/events.py, every compile carrying its
+triggering plan operator, kernel identity and input shape signature) —
+and/or archived per-query profile JSONs (the ``compiles`` section) —
+and reports:
+
+  * **top recompile causes**: kernels grouped by identity across shape
+    signatures, ranked by projected savings then compile seconds;
+  * **varying dimensions**: for each group that compiled more than
+    once, the argument axes (or static scalars — capacity buckets)
+    whose values differ across signatures, by positionally diffing the
+    aval lists;
+  * **bucket recommendations**: power-of-two padding buckets covering
+    the observed values of each varying dimension;
+  * **projected warm-up savings**: compile seconds beyond one compile
+    per recommended bucket — what stable/padded shapes would save;
+  * **attribution**: the share of total backend-compile seconds carrying
+    an (operator, shape-signature) cause (the ledger's coverage).
+
+Usage:
+    python tools/compile_report.py LOG_OR_PROFILE [...] [--json OUT]
+           [-n N] [--per-query]
+
+Event-log rotations fold in automatically; gzip segments decompress
+transparently. ``tools/qualification.py``'s warm-up section is the
+same analysis folded into the full workload report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _load_entries(path: str) -> List[Dict[str, Any]]:
+    """Compile entries from one input: a JSONL event log (enriched
+    backendCompile events, rotations folded) or a profile JSON (the
+    ``compiles`` section's causes — no avals, attribution only)."""
+    from spark_rapids_tpu.obs.events import open_event_file, read_events
+    with open_event_file(path) as f:
+        head = ""
+        for line in f:
+            if line.strip():
+                head = line
+                break
+    is_events = False
+    try:
+        first = json.loads(head) if head else None
+        is_events = isinstance(first, dict) and "kind" in first
+    except json.JSONDecodeError:
+        pass
+    out: List[Dict[str, Any]] = []
+    if is_events:
+        # reuse qualification's query-window naming so q-1 reused across
+        # bench worker respawns splits into q-1 / q-1#2 here too
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "srt_qualification", os.path.join(_TOOLS, "qualification.py"))
+        qual = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(qual)
+        windows = qual.QueryWindows()
+        for ev in read_events(path):
+            name = windows.name_for(ev)
+            if ev.get("kind") != "backendCompile":
+                continue
+            out.append({
+                "op": ev.get("op"), "kernel": ev.get("kernel"),
+                "avals": ev.get("avals"), "query": name,
+                "outcome": ev.get("outcome"),
+                "seconds": float(ev.get("seconds", 0.0))})
+        return out
+    with open_event_file(path) as f:
+        doc = json.load(f)
+    if not (isinstance(doc, dict) and "plan" in doc):
+        raise ValueError(
+            f"{path}: neither a JSONL event log nor a profile JSON")
+    name = os.path.basename(path).replace(".profile.json", "")
+    comp = (doc.get("summary") or {}).get("compiles") or {}
+    for cause in comp.get("causes", []):
+        out.append({"op": cause.get("op"), "kernel": cause.get("kernel"),
+                    "avals": None, "query": name, "outcome": None,
+                    "count": int(cause.get("compiles", 1) or 1),
+                    "seconds": float(cause.get("seconds", 0.0))})
+    return out
+
+
+def build_report(entries: List[Dict[str, Any]],
+                 top_n: int = 15) -> Dict[str, Any]:
+    from spark_rapids_tpu.obs.compileledger import analyze
+    rep = analyze(entries, top_n=top_n)
+    # per-query rollup next to the cross-query cause groups
+    per_query: Dict[str, Dict[str, Any]] = {}
+    for e in entries:
+        q = e.get("query") or "?"
+        d = per_query.setdefault(q, {"compiles": 0, "seconds": 0.0})
+        d["compiles"] += max(int(e.get("count", 1) or 1), 1)
+        d["seconds"] = round(d["seconds"] + e["seconds"], 4)
+    rep["per_query"] = dict(sorted(
+        per_query.items(), key=lambda kv: -kv[1]["seconds"]))
+    return rep
+
+
+def render_text(rep: Dict[str, Any], top_n: int = 15,
+                per_query: bool = False) -> str:
+    lines: List[str] = []
+    lines.append(
+        f"compile report: {rep['total_compiles']} backend compiles, "
+        f"{rep['total_seconds']:.2f}s total, "
+        f"{rep['attributed_pct']:.0f}% attributed to (operator, "
+        f"shape-signature) causes across {rep['n_groups']} kernels; "
+        f"projected warm-up savings with stable shapes "
+        f"{rep['projected_savings_s']:.2f}s")
+    if rep["groups"]:
+        lines.append("")
+        lines.append("-- top recompile causes (ranked by projected "
+                     "savings, then seconds)")
+        lines.append(f"{'seconds':>8} {'n':>4} {'sigs':>4} "
+                     f"{'save_s':>7}  kernel / operator")
+        for g in rep["groups"]:
+            label = (g["kernel"] or "?")[:64]
+            lines.append(
+                f"{g['seconds']:>8.2f} {g['compiles']:>4} "
+                f"{g['signatures']:>4} "
+                f"{g['projected_savings_s']:>7.2f}  {label}")
+            if g["op"]:
+                ops = ", ".join(o[:60] for o in g["ops"][:2])
+                lines.append(f"{'':>28}  op: {ops}")
+            if g["queries"]:
+                lines.append(
+                    f"{'':>28}  queries: "
+                    + ", ".join(g["queries"][:8])
+                    + (" ..." if len(g["queries"]) > 8 else ""))
+            for v in g["varying"][:4]:
+                where = (f"arg{v['arg']} {v['dtype']}"
+                         + (f" axis{v['axis']}"
+                            if v["axis"] is not None else ""))
+                vals = ",".join(str(x) for x in v["values"][:8])
+                bucks = ",".join(str(b) for b in v["buckets"][:8])
+                lines.append(
+                    f"{'':>28}  varies: {where} in [{vals}]"
+                    + (f" -> recommend padding buckets [{bucks}]"
+                       if bucks else ""))
+    if per_query and rep.get("per_query"):
+        lines.append("")
+        lines.append("-- per-query compile totals")
+        lines.append(f"{'query':<18} {'compiles':>8} {'seconds':>9}")
+        for q, d in rep["per_query"].items():
+            lines.append(f"{q[:18]:<18} {d['compiles']:>8} "
+                         f"{d['seconds']:>9.2f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Recompile-cause report: top causes, varying "
+                    "dimensions, padding-bucket recommendations and "
+                    "projected warm-up savings from enriched "
+                    "backendCompile events (obs/compileledger.py)")
+    ap.add_argument("inputs", nargs="+",
+                    help="event-log files (rotations folded in) and/or "
+                         "*.profile.json files")
+    ap.add_argument("--json", metavar="OUT", default="",
+                    help="also write the machine-shape report ('-' for "
+                         "stdout)")
+    ap.add_argument("-n", "--top", type=int, default=15,
+                    help="cause groups shown (default 15)")
+    ap.add_argument("--per-query", action="store_true",
+                    help="append the per-query compile totals table")
+    args = ap.parse_args(argv)
+
+    entries: List[Dict[str, Any]] = []
+    for path in args.inputs:
+        try:
+            entries.extend(_load_entries(path))
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            print(f"compile_report: {e}", file=sys.stderr)
+            return 2
+    if not entries:
+        print("compile_report: no backendCompile records found "
+              "(run with the event log enabled: "
+              "spark.rapids.tpu.eventLog.path / bench.py --event-log)",
+              file=sys.stderr)
+        return 2
+    rep = build_report(entries, args.top)
+    if args.json == "-":
+        print(json.dumps(rep, indent=1))
+    else:
+        print(render_text(rep, args.top, per_query=args.per_query))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(rep, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)

@@ -109,7 +109,9 @@ def details_from_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "maps": ev.get("maps"),
                 "totalBytes": ev.get("totalBytes"),
                 "maxBytes": ev.get("maxBytes"),
-                "medianBytes": ev.get("medianBytes")})
+                "medianBytes": ev.get("medianBytes"),
+                "compiles": ev.get("compiles"),
+                "compileSeconds": ev.get("compileSeconds")})
         elif kind in ("aqeCoalesce", "aqeBroadcastDemote",
                       "aqeSkewSplit"):
             d["decisions"].append(
@@ -297,6 +299,35 @@ def render_query_page(r: Dict[str, Any], detail: Dict[str, Any]) -> str:
                 f"<td>{_esc('; '.join(fb.get('reasons') or []))}"
                 f"</td></tr>")
         out.append("</table>")
+    comp = r.get("compile") or {}
+    if comp.get("entries"):
+        # per-cause compile attribution from the enriched backendCompile
+        # events (obs/compileledger.py) — the same grouping the live
+        # monitor serves at /api/query/<id>
+        from spark_rapids_tpu.obs.compileledger import analyze
+        crep = analyze(comp["entries"], top_n=8)
+        out.append(
+            f"<h4>Backend compiles</h4><p>{crep['total_compiles']} "
+            f"compiles, {crep['total_seconds']:.2f}s, "
+            f"{crep['attributed_pct']:.0f}% attributed; projected "
+            f"savings with stable shapes "
+            f"{crep['projected_savings_s']:.2f}s</p>"
+            "<table><tr><th>operator</th><th>kernel</th>"
+            "<th>compiles</th><th>sigs</th><th>seconds</th>"
+            "<th>varying dims</th></tr>")
+        for g in crep["groups"]:
+            vary = "; ".join(
+                f"arg{v['arg']}"
+                + (f".ax{v['axis']}" if v["axis"] is not None else "")
+                + f" in {v['values'][:5]}"
+                for v in g["varying"][:3])
+            out.append(
+                f"<tr><td>{_esc((g['op'] or '?')[:60])}</td>"
+                f"<td>{_esc((g['kernel'] or '?')[:60])}</td>"
+                f"<td>{g['compiles']}</td><td>{g['signatures']}</td>"
+                f"<td>{g['seconds']:.3f}</td>"
+                f"<td>{_esc(vary)}</td></tr>")
+        out.append("</table>")
     aqe = r.get("aqe") or {}
     if aqe.get("adaptive"):
         out.append(
@@ -311,16 +342,20 @@ def render_query_page(r: Dict[str, Any], detail: Dict[str, Any]) -> str:
             span = max((end - start), 1e-6) if end and start else None
             out.append("<h4>Stage timeline</h4><table><tr><th>stage</th>"
                        "<th>t+ (s)</th><th>partitions</th><th>maps</th>"
-                       "<th>bytes</th><th></th></tr>")
+                       "<th>bytes</th><th>compiles</th><th></th></tr>")
             for st in stages:
                 off = st.get("offset_s")
                 width = int(200 * off / span) if (span and off) else 0
+                ncomp = st.get("compiles")
+                comp_cell = "-" if ncomp is None else (
+                    f"{ncomp} ({st.get('compileSeconds', 0) or 0:.2f}s)")
                 out.append(
                     f"<tr><td>{_esc(st['stage'])}</td>"
                     f"<td>{off if off is not None else '-'}</td>"
                     f"<td>{_esc(st.get('partitions'))}</td>"
                     f"<td>{_esc(st.get('maps'))}</td>"
                     f"<td>{_esc(st.get('totalBytes'))}</td>"
+                    f"<td>{_esc(comp_cell)}</td>"
                     f"<td><span class='bar' style='width:{width}px'>"
                     f"</span></td></tr>")
             out.append("</table>")

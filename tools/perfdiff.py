@@ -14,13 +14,22 @@ shapes the harness produces:
   * a bare summary line — ``{"metric": ..., "value": geomean}``
     (geomean-only comparison).
 
+Besides speedups, the gate also compares **steady-state compile counts**
+(``timed_compiles`` — XLA backend compiles during the timed iterations,
+which a healthy query keeps at ZERO): a query whose warm-run compile
+count grew between BASE and NEW re-traces in steady state, a compile
+pathology that inflates wall time no speedup threshold reliably
+catches. Any increase on a common query exits 1, same as a speedup
+regression (``--ignore-compiles`` disables).
+
 Exit codes: 0 = no regression, 1 = regression (any common query slower
-than ``--threshold``, default 10%, or geomean drift below
-``--geomean-threshold``, default 5%), 2 = unusable input.
+than ``--threshold``, default 10%, geomean drift below
+``--geomean-threshold``, default 5%, or a steady-state compile-count
+increase), 2 = unusable input.
 
 Usage:
     python tools/perfdiff.py BASE.json NEW.json [--threshold 0.10]
-           [--geomean-threshold 0.05] [--json OUT]
+           [--geomean-threshold 0.05] [--ignore-compiles] [--json OUT]
 
 Workflow (docs/observability.md): archive each round's detail file and
 gate merges with
@@ -38,14 +47,26 @@ from typing import Any, Dict, Optional, Tuple
 
 _TAIL_RE = re.compile(
     r"bench: (\S+) tpu=([\d.]+)s cpu=([\d.]+)s speedup=([\d.]+)x")
+_TAIL_COMPILES_RE = re.compile(
+    r"bench: (\S+) tpu=[\d.]+s cpu=[\d.]+s speedup=[\d.]+x "
+    r"\(timed_compiles=(\d+)")
 
 
-def load_sweep(path: str) -> Tuple[Dict[str, float], Optional[float]]:
-    """-> (per-query speedups, recorded geomean or None)."""
+def _read_doc(path: str) -> Dict[str, Any]:
     with open(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict):
         raise ValueError(f"{path}: not a JSON object")
+    return doc
+
+
+def load_sweep(path: str) -> Tuple[Dict[str, float], Optional[float]]:
+    """-> (per-query speedups, recorded geomean or None)."""
+    return sweep_from_doc(_read_doc(path), path)
+
+
+def sweep_from_doc(doc: Dict[str, Any],
+                   path: str) -> Tuple[Dict[str, float], Optional[float]]:
     if isinstance(doc.get("queries"), dict):
         per = {name: float(rec["speedup"])
                for name, rec in doc["queries"].items()
@@ -65,6 +86,25 @@ def load_sweep(path: str) -> Tuple[Dict[str, float], Optional[float]]:
         "line with 'metric'/'value')")
 
 
+def load_compiles(path: str) -> Dict[str, int]:
+    """Per-query steady-state compile counts (``timed_compiles``) from a
+    sweep artifact; empty when the shape does not carry them (bare
+    summary lines)."""
+    return compiles_from_doc(_read_doc(path))
+
+
+def compiles_from_doc(doc: Dict[str, Any]) -> Dict[str, int]:
+    if isinstance(doc.get("queries"), dict):
+        return {name: int(rec["timed_compiles"])
+                for name, rec in doc["queries"].items()
+                if isinstance(rec, dict) and "timed_compiles" in rec}
+    if "parsed" in doc or "tail" in doc:
+        return {m.group(1): int(m.group(2))
+                for m in _TAIL_COMPILES_RE.finditer(
+                    str(doc.get("tail", "")))}
+    return {}
+
+
 def _geomean(values) -> Optional[float]:
     vals = [v for v in values if v and v > 0]
     if not vals:
@@ -74,7 +114,9 @@ def _geomean(values) -> Optional[float]:
 
 def compare(base: Dict[str, float], base_geo: Optional[float],
             new: Dict[str, float], new_geo: Optional[float],
-            threshold: float, geo_threshold: float) -> Dict[str, Any]:
+            threshold: float, geo_threshold: float,
+            base_compiles: Optional[Dict[str, int]] = None,
+            new_compiles: Optional[Dict[str, int]] = None) -> Dict[str, Any]:
     common = sorted(set(base) & set(new))
     deltas = []
     for q in common:
@@ -98,7 +140,21 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
     drift = (gn / gb - 1.0) if (gb and gn) else None
     regressions = [r for r in deltas if r["regressed"]]
     geo_regressed = drift is not None and drift < -geo_threshold
+    # steady-state recompile gate: timed_compiles growing between sweeps
+    # means the engine re-traces during timed iterations now — a compile
+    # pathology, gated exactly like a speedup regression (ROADMAP item
+    # 2's success metric is timed_compiles -> 0 everywhere)
+    compile_deltas = []
+    for q in sorted(set(base_compiles or {}) & set(new_compiles or {})):
+        b, n = base_compiles[q], new_compiles[q]
+        if b != n:
+            compile_deltas.append({"query": q, "base": b, "new": n,
+                                   "regressed": n > b})
+    compile_regressions = [d["query"] for d in compile_deltas
+                           if d["regressed"]]
     return {
+        "compile_deltas": compile_deltas,
+        "compile_regressions": compile_regressions,
         "common_queries": len(common),
         "only_in_base": sorted(set(base) - set(new)),
         "only_in_new": sorted(set(new) - set(base)),
@@ -112,7 +168,8 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
         "regressions": [r["query"] for r in regressions],
         "improvements": [r["query"] for r in deltas if r["improved"]],
         "deltas": deltas,
-        "regressed": bool(regressions) or geo_regressed,
+        "regressed": bool(regressions) or geo_regressed
+        or bool(compile_regressions),
     }
 
 
@@ -145,6 +202,11 @@ def render_text(rep: Dict[str, Any]) -> str:
     if rep["geomean_regressed"]:
         lines.append(f"-- GEOMEAN REGRESSION: drift {drift:+.2f}% "
                      f"exceeds -{rep['geomean_threshold_pct']:.0f}%")
+    for d in rep.get("compile_deltas", []):
+        mark = " STEADY-STATE RECOMPILE REGRESSION" if d["regressed"] \
+            else " (improved)"
+        lines.append(f"-- timed_compiles {d['query']}: "
+                     f"{d['base']} -> {d['new']}{mark}")
     lines.append("RESULT: " + ("REGRESSED" if rep["regressed"] else "ok"))
     return "\n".join(lines)
 
@@ -160,13 +222,22 @@ def main(argv=None) -> int:
                          "(default 0.10 = 10%%)")
     ap.add_argument("--geomean-threshold", type=float, default=0.05,
                     help="geomean drift regression bound (default 0.05)")
+    ap.add_argument("--ignore-compiles", action="store_true",
+                    help="do not gate on steady-state (timed_compiles) "
+                         "compile-count increases")
     ap.add_argument("--json", metavar="OUT", default="",
                     help="also write the machine-shape diff ('-' = "
                          "stdout)")
     args = ap.parse_args(argv)
     try:
-        base, base_geo = load_sweep(args.base)
-        new, new_geo = load_sweep(args.new)
+        base_doc = _read_doc(args.base)
+        new_doc = _read_doc(args.new)
+        base, base_geo = sweep_from_doc(base_doc, args.base)
+        new, new_geo = sweep_from_doc(new_doc, args.new)
+        base_c = {} if args.ignore_compiles \
+            else compiles_from_doc(base_doc)
+        new_c = {} if args.ignore_compiles \
+            else compiles_from_doc(new_doc)
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
         print(f"perfdiff: {e}", file=sys.stderr)
         return 2
@@ -180,7 +251,8 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
     rep = compare(base, base_geo, new, new_geo,
-                  args.threshold, args.geomean_threshold)
+                  args.threshold, args.geomean_threshold,
+                  base_compiles=base_c, new_compiles=new_c)
     if args.json == "-":
         print(json.dumps(rep, indent=1))
     else:

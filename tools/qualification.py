@@ -19,7 +19,12 @@ Per query it computes:
   * **spill pressure** — bytes/events through the tiers, memory-pressure
     backoffs;
   * **fetch-retry hotspots** — shuffle retries/failures per peer;
-  * **compile-warmup share** — backend-compile seconds vs query wall;
+  * **compile-warmup share** — backend-compile seconds vs query wall,
+    plus a workload-wide **warm-up cause ranking**: enriched
+    ``backendCompile`` events grouped by (operator, kernel identity),
+    varying shape dimensions named and padding buckets recommended
+    (obs/compileledger.analyze; ``tools/compile_report.py`` is the
+    standalone deep-dive);
   * **shuffle skew** — per-query max/median partition-size ratio from
     ``shuffleSkew`` events (obs/shuffleobs.py), AQE on or off — the
     "this workload would benefit from adaptive execution" signal;
@@ -96,7 +101,7 @@ def _new_record(name: str, source: str) -> Dict[str, Any]:
         "spill": {"bytes": 0, "events": 0, "pressure_events": 0},
         "fetch": {"retries": 0, "failures": 0, "by_peer": {}},
         "compile": {"compiles": 0, "seconds": 0.0, "cache_misses": 0,
-                    "warmup_share_pct": None},
+                    "warmup_share_pct": None, "entries": []},
         "scan": {"stalls": 0, "stall_s": 0.0, "budget_stalls": 0},
         "shuffle_skew": {"shuffles": 0, "max_ratio": None,
                          "max_bytes": 0},
@@ -200,6 +205,15 @@ def records_from_events(events: List[Dict[str, Any]],
             r["compile"]["compiles"] += 1
             r["compile"]["seconds"] = round(
                 r["compile"]["seconds"] + float(ev.get("seconds", 0.0)), 4)
+            # enriched (compile-ledger) events carry the cause: keep the
+            # per-compile records so the report's warm-up section can
+            # group by (operator, kernel) and diff shape signatures
+            if len(r["compile"]["entries"]) < 512:
+                r["compile"]["entries"].append({
+                    "op": ev.get("op"), "kernel": ev.get("kernel"),
+                    "avals": ev.get("avals"), "query": name,
+                    "outcome": ev.get("outcome"),
+                    "seconds": float(ev.get("seconds", 0.0))})
         elif kind == "compileCacheMiss":
             r["compile"]["cache_misses"] += 1
         elif kind == "scanStall":
@@ -289,6 +303,16 @@ def record_from_profile(doc: Dict[str, Any], name: str) -> Dict[str, Any]:
         "compileCache.backendCompiles", 0))
     r["compile"]["seconds"] = round(float(cc.get(
         "compileCache.backendCompileTime", 0.0)), 4)
+    # archived profiles carry the ledger's per-cause summary (the
+    # ``compiles`` section): feed the causes into the warm-up ranking
+    # (no avals in the aggregate — varying-dim analysis needs the event
+    # log, but the (operator, kernel) attribution survives)
+    for cause in (summary.get("compiles") or {}).get("causes", []):
+        r["compile"]["entries"].append({
+            "op": cause.get("op"), "kernel": cause.get("kernel"),
+            "avals": None, "query": name, "outcome": None,
+            "count": int(cause.get("compiles", 1) or 1),
+            "seconds": float(cause.get("seconds", 0.0))})
     if r["wall_s"] and r["compile"]["seconds"]:
         r["compile"]["warmup_share_pct"] = round(min(
             100.0 * r["compile"]["seconds"] / r["wall_s"], 100.0), 2)
@@ -356,8 +380,17 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "compile_seconds": round(sum(r["compile"]["seconds"]
                                      for r in records), 2),
     }
+    # warm-up compile causes across the whole workload: the enriched
+    # backendCompile records grouped by kernel identity, varying
+    # dimensions named, padding buckets recommended
+    # (obs/compileledger.analyze — the same analyzer
+    # tools/compile_report.py runs standalone)
+    from spark_rapids_tpu.obs.compileledger import analyze
+    compile_entries = [e for r in records
+                       for e in r["compile"].get("entries", [])]
+    warmup = analyze(compile_entries) if compile_entries else None
     return {"version": 1, "totals": totals, "queries": records,
-            "fallback_reasons": ranked}
+            "fallback_reasons": ranked, "warmup": warmup}
 
 
 def _fmt_bytes(n: int) -> str:
@@ -406,6 +439,29 @@ def render_text(report: Dict[str, Any], top_n: int = 15) -> str:
         for a in ranked[:top_n]:
             lines.append(f"{a['impact_s']:>9.4f} {len(a['queries']):>7}  "
                          f"{a['reason'][:100]}")
+    warm = report.get("warmup")
+    if warm and warm["groups"]:
+        lines.append("")
+        lines.append(
+            f"-- warm-up compile causes ({warm['total_compiles']} "
+            f"compiles, {warm['total_seconds']:.2f}s, "
+            f"{warm['attributed_pct']:.0f}% attributed to "
+            f"(operator, shape-signature); projected savings with "
+            f"stable shapes {warm['projected_savings_s']:.2f}s)")
+        lines.append(f"{'seconds':>8} {'n':>4} {'sigs':>4}  cause")
+        for g in warm["groups"][:top_n]:
+            cause = (g["op"] or g["kernel"] or "?")[:70]
+            lines.append(f"{g['seconds']:>8.2f} {g['compiles']:>4} "
+                         f"{g['signatures']:>4}  {cause}")
+            for v in g["varying"][:3]:
+                where = (f"arg{v['arg']} {v['dtype']}"
+                         + (f" axis{v['axis']}"
+                            if v["axis"] is not None else ""))
+                vals = ",".join(str(x) for x in v["values"][:6])
+                bucks = ",".join(str(b) for b in v["buckets"][:6])
+                lines.append(f"{'':>19}  varies: {where} in [{vals}]"
+                             + (f" -> pad to [{bucks}]" if bucks
+                                else ""))
     hot = {}
     for r in report["queries"]:
         for peer, n in r["fetch"]["by_peer"].items():
