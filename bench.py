@@ -70,6 +70,17 @@ its suite's job group). Pairs with --event-log: afterwards
 pages from the record, and `python tools/perfdiff.py OLD.json
 BENCH_DETAIL.json` gates the round against the previous one.
 
+Serve mode (`--concurrency N` or BENCH_CONCURRENCY=N): after the sweep,
+the scored queries re-submit through the admission scheduler
+(spark_rapids_tpu/serving/) on an N-worker pool — one tenant per suite,
+BENCH_SERVE_REPEATS (default 2) rounds so repeat submissions exercise
+the cross-query plan cache (BENCH_SERVE_RESULT_CACHE=1 additionally
+enables the result cache) — and BENCH_SERVE.json records throughput
+(qps), p50/p95/p99 job latency, steady-state compile count, and
+per-tenant plan/result-cache hit rates, every job verified against the
+CPU oracle. `tools/perfdiff.py OLD_SERVE.json BENCH_SERVE.json` gates
+serve-mode throughput regressions.
+
 Scan-inclusive mode (`--include-scan` or BENCH_INCLUDE_SCAN=1): for the
 tpch queries in BENCH_SCAN_QUERIES (default q1,q6,q14), additionally time
 the TPU path over real multi-row-group Parquet files with the device scan
@@ -470,6 +481,102 @@ def _worker():
         finally:
             session.set_conf("spark.rapids.sql.cacheDeviceScans", True)
 
+    # --concurrency N: serve-mode phase — the sweep's queries submitted
+    # through the admission scheduler (serving/scheduler.py) on an
+    # N-worker pool, each suite as its own tenant, repeated so the
+    # second submission exercises the cross-query plan cache. Reports
+    # throughput (qps), latency quantiles and per-tenant cache hit
+    # rates; every job's result is verified against the CPU oracle.
+    def measure_serve(sweep, concurrency):
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        repeats = int(os.environ.get("BENCH_SERVE_REPEATS", "2"))
+        if os.environ.get("BENCH_SERVE_RESULT_CACHE", "") == "1":
+            session.set_conf(
+                "spark.rapids.tpu.serving.resultCache.enabled", True)
+        session.set_conf("spark.rapids.sql.enabled", True)
+
+        def cache_counters():
+            snap = {}
+            for m in REGISTRY.metrics():
+                if m.name.startswith(("plancache.", "resultcache.")):
+                    snap[(m.name, m.labels.get("tenant", "default"))] = \
+                        m.value
+            return snap
+
+        # serial warm pass: compiles and oracle results out of the
+        # measured window (steady-state serving throughput, the same
+        # contract as the main sweep's min-of-iters)
+        oracles = {}
+        for name, sn, q in sweep:
+            fn = suites[sn][q]
+            oracles[name] = run_query(fn, False)
+            run_query(fn, True)
+        before = cache_counters()
+        c0 = compile_counts["n"]
+        sched = session.serving_scheduler(workers=concurrency)
+        jobs = []
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            for name, sn, q in sweep:
+                jobs.append((name, sched.submit(
+                    suites[sn][q], tenant=sn, description=name)))
+        sched.drain()
+        wall = time.perf_counter() - t0
+        snap = sched.snapshot()
+        sched.close()
+        after = cache_counters()
+        lat, statuses, failed, verified = [], {}, [], True
+        per_query = {}
+        for name, job in jobs:
+            st = job.status
+            statuses[st] = statuses.get(st, 0) + 1
+            rec = per_query.setdefault(
+                name, {"latencies_s": [], "statuses": []})
+            rec["statuses"].append(st)
+            if job.wall_s is not None:
+                lat.append(job.wall_s)
+                rec["latencies_s"].append(job.wall_s)
+            if st != "succeeded":
+                failed.append(f"{name}: {st}: {job.error}"[:160])
+            elif not _results_match(job.result, oracles[name]):
+                verified = False
+                failed.append(f"{name}: result mismatch vs CPU oracle")
+        lat.sort()
+
+        def q_at(p):
+            return round(lat[min(len(lat) - 1,
+                                 int(p * (len(lat) - 1)))], 4) \
+                if lat else None
+        tenants = {}
+        for sn in sorted({s for _, s, _ in sweep}):
+            t = {"jobs": sum(1 for n, s, q in sweep
+                             if s == sn) * repeats}
+            for fam in ("plancache", "resultcache"):
+                h = after.get((f"{fam}.hits", sn), 0) \
+                    - before.get((f"{fam}.hits", sn), 0)
+                m = after.get((f"{fam}.misses", sn), 0) \
+                    - before.get((f"{fam}.misses", sn), 0)
+                t[f"{fam}_hits"] = h
+                t[f"{fam}_misses"] = m
+                t[f"{fam}_hit_rate"] = round(h / (h + m), 4) \
+                    if h + m else None
+            tenants[sn] = t
+        return {
+            "concurrency": concurrency, "repeats": repeats,
+            "jobs": len(jobs), "wall_s": round(wall, 4),
+            "qps": round(len(jobs) / wall, 4) if wall > 0 else None,
+            "latency_s": {"p50": q_at(0.50), "p95": q_at(0.95),
+                          "p99": q_at(0.99)},
+            "timed_compiles": compile_counts["n"] - c0,
+            "peak_running": snap["peakRunning"],
+            "shed": snap["shedTotal"],
+            "statuses": statuses,
+            "verified": verified and not failed,
+            "failures": failed[:20],
+            "tenants": tenants,
+            "queries": per_query,
+        }
+
     out = os.fdopen(os.dup(1), "w", buffering=1)
     os.dup2(2, 1)  # anything stray printed inside the engine -> stderr
     for line in sys.stdin:
@@ -483,6 +590,14 @@ def _worker():
                 if sn not in suites:
                     suites[sn] = _build_suite(sn)
                 out.write(json.dumps({"built": sn}) + "\n")
+                continue
+            if req.get("op") == "serve":
+                sweep = [tuple(e) for e in req["sweep"]]
+                for _name, sn, _q in sweep:
+                    if sn not in suites:
+                        suites[sn] = _build_suite(sn)
+                rec = measure_serve(sweep, int(req["concurrency"]))
+                out.write(json.dumps({"serve": rec}) + "\n")
                 continue
             sn, q = req["suite"], req["query"]
             if sn not in suites:
@@ -670,6 +785,14 @@ def main():
         # BENCH_UI_PORT (default 4040) for the sweep's duration
         os.environ["BENCH_UI"] = "1"
         os.environ.setdefault("BENCH_UI_PORT", "4040")
+    if "--concurrency" in sys.argv:
+        # serve-mode phase after the sweep: the same queries submitted
+        # through the admission scheduler on an N-worker pool, writing
+        # BENCH_SERVE.json (throughput qps, latency quantiles, per-
+        # tenant cache hit rates; tools/perfdiff.py gates qps drift)
+        idx = sys.argv.index("--concurrency")
+        os.environ["BENCH_CONCURRENCY"] = sys.argv[idx + 1] \
+            if idx + 1 < len(sys.argv) else "4"
 
     suite_names, sweep = _parse_sweep()
     sf = float(os.environ.get("BENCH_SF", "0.5"))
@@ -762,6 +885,47 @@ def main():
                   f"(timed_compiles={rec['timed_compiles']} "
                   f"warm={rec['warm_s']:.1f}s/{rec['warm_compiles']}c)",
                   file=sys.stderr, flush=True)
+        # serve-mode phase (--concurrency N): every successfully-built
+        # suite's scored queries re-submitted through the scheduler
+        serve_rec = None
+        concurrency = int(os.environ.get("BENCH_CONCURRENCY", "0") or 0)
+        if concurrency > 0:
+            serve_sweep = [[name, sn, q] for name, sn, q in sweep
+                           if isinstance(detail.get(name), dict)
+                           and "speedup" in detail[name]]
+            if serve_sweep:
+                deadline = per_query_timeout * max(4, len(serve_sweep))
+                reply = worker.ask({"op": "serve",
+                                    "concurrency": concurrency,
+                                    "sweep": serve_sweep}, deadline)
+                if reply is not None and "serve" in reply:
+                    serve_rec = reply["serve"]
+                    serve_file = os.environ.get("BENCH_SERVE_FILE",
+                                                "BENCH_SERVE.json")
+                    serve_doc = dict(
+                        serve_rec, sf=sf,
+                        mode="serve: admission scheduler "
+                             "(serving/scheduler.py), one tenant per "
+                             "suite, repeats x sweep submitted on an "
+                             "N-worker pool after a serial warm pass; "
+                             "every job verified against the CPU "
+                             "oracle")
+                    try:
+                        with open(serve_file, "w") as f:
+                            json.dump(serve_doc, f, indent=1)
+                    except OSError as e:
+                        print(f"bench: could not write {serve_file}: "
+                              f"{e}", file=sys.stderr, flush=True)
+                    print(f"bench: serve concurrency={concurrency} "
+                          f"qps={serve_rec['qps']} "
+                          f"p50={serve_rec['latency_s']['p50']}s "
+                          f"p99={serve_rec['latency_s']['p99']}s "
+                          f"verified={serve_rec['verified']}",
+                          file=sys.stderr, flush=True)
+                else:
+                    print(f"bench: serve phase failed: "
+                          f"{str(reply)[:200]}", file=sys.stderr,
+                          flush=True)
     finally:
         worker.close()
 
@@ -886,6 +1050,10 @@ def main():
         "loadavg_after": round(load_after[0], 2),
         "detail_file": detail_file,
     }
+    if serve_rec is not None:
+        summary["serve_qps"] = serve_rec["qps"]
+        summary["serve_p99_s"] = serve_rec["latency_s"]["p99"]
+        summary["serve_verified"] = serve_rec["verified"]
     if load_warning:
         summary["load_warning"] = load_warning
     if not speedups:

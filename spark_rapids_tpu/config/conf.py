@@ -675,6 +675,112 @@ COMPILE_LEDGER_COST_ANALYSIS = register(
     "tracing for every freshly compiled kernel); enable it for roofline "
     "attribution passes.")
 
+# --- concurrent query serving (serving/: admission scheduler, per-tenant
+# HBM quotas, cross-query plan/result caches — the reference's long-lived
+# driver-plugin service role grown into a multi-tenant front-end) ----------
+SERVING_WORKERS = register(
+    "spark.rapids.tpu.serving.workers", int, 4,
+    "Worker threads in the admission scheduler's pool "
+    "(serving/scheduler.py): how many queries execute concurrently. "
+    "Device admission is still bounded separately by "
+    "spark.rapids.sql.concurrentTpuTasks and the per-tenant permit "
+    "budgets.", validator=_positive)
+
+SERVING_MAX_QUEUED = register(
+    "spark.rapids.tpu.serving.maxQueuedQueries", int, 128,
+    "Bound on TOTAL queued (admitted but not yet running) jobs across "
+    "all tenant lanes; a submission past it is load-shed immediately "
+    "(job status 'shed', a queryShed journal event, serving.shed "
+    "counters) instead of building an unbounded backlog.",
+    validator=_positive)
+
+SERVING_DEFAULT_DEADLINE = register(
+    "spark.rapids.tpu.serving.defaultDeadlineSeconds", float, 0.0,
+    "Default per-query deadline for scheduler-submitted jobs, counted "
+    "from submission; 0 disables. A job still queued past its deadline "
+    "never starts; a running one cancels cooperatively at its next "
+    "batch-pull boundary (queryTimeout journal event with the "
+    "flight-recorder tail attached). Per-job deadline_s overrides.",
+    validator=_non_negative)
+
+SERVING_TENANT_DEFAULT_PERMITS = register(
+    "spark.rapids.tpu.serving.tenant.defaultPermits", int, 0,
+    "Default per-tenant device-admission budget: the maximum task "
+    "semaphore permits one tenant's tasks may hold concurrently, so a "
+    "single tenant cannot occupy every concurrentTpuTasks slot and "
+    "starve the device for the rest. 0 = no tenant bound (global limit "
+    "only). Override per tenant with "
+    "spark.rapids.tpu.serving.tenant.<name>.permits; per-tenant "
+    "holder/waiter gauges surface at /api/scheduler and /metrics.",
+    validator=_non_negative)
+
+SERVING_TENANT_DEFAULT_WEIGHT = register(
+    "spark.rapids.tpu.serving.tenant.defaultWeight", float, 1.0,
+    "Default weighted-fair share of a tenant's lane in the admission "
+    "scheduler: the dispatcher serves the non-empty lane with the "
+    "least virtual time and serving advances it by 1/weight, so a "
+    "weight-3 tenant is dispatched 3x as often under contention. "
+    "Override per tenant with "
+    "spark.rapids.tpu.serving.tenant.<name>.weight.",
+    validator=_positive)
+
+SERVING_PLAN_CACHE = register(
+    "spark.rapids.tpu.serving.planCache.enabled", _to_bool, True,
+    "Cross-query plan cache (serving/caches.py): repeat submissions of "
+    "the same query shape under the same explicit conf and the same "
+    "source data versions (file mtimes / in-memory content digests) "
+    "skip the tag+convert planning pass entirely and execute a clone "
+    "of the cached physical plan — zero re-planning, and identical "
+    "operator signatures keep every compiled kernel warm "
+    "(timed_compiles stays 0). Keyed by (plan digest, conf "
+    "fingerprint, source versions); a conf change or a rewritten "
+    "table misses. AQE queries are excluded (their plans are runtime-"
+    "re-planned per execution; see exchangeReuse instead).")
+
+SERVING_PLAN_CACHE_MAX = register(
+    "spark.rapids.tpu.serving.planCache.maxEntries", int, 256,
+    "LRU entry bound of the cross-query plan cache.",
+    validator=_positive)
+
+SERVING_RESULT_CACHE = register(
+    "spark.rapids.tpu.serving.resultCache.enabled", _to_bool, False,
+    "Opt-in cross-query RESULT cache for identical dashboard-style "
+    "queries: a repeat submission under the same (plan digest, conf "
+    "fingerprint, source versions) key answers straight from the "
+    "cached host frames with zero execution (resultCacheHit journal "
+    "event, srt_resultcache_* series). Only deterministic, non-writing "
+    "plans are cached; hits return defensive copies. Off by default: "
+    "serving workloads opt in per session.")
+
+SERVING_RESULT_CACHE_MAX = register(
+    "spark.rapids.tpu.serving.resultCache.maxEntries", int, 64,
+    "LRU entry bound of the result cache.", validator=_positive)
+
+SERVING_RESULT_CACHE_MAX_BYTES = register(
+    "spark.rapids.tpu.serving.resultCache.maxBytes", _to_bytes,
+    256 << 20,
+    "Byte bound of the result cache (pandas deep memory usage of the "
+    "cached frames); a single result larger than this is never cached "
+    "and the LRU evicts oldest-first past it.", validator=_positive)
+
+SERVING_EXCHANGE_REUSE = register(
+    "spark.rapids.tpu.serving.exchangeReuse.enabled", _to_bool, False,
+    "Opt-in cross-query AQE exchange reuse (serving/caches.py): a new "
+    "adaptive query whose exchange subtree digest (structure + source "
+    "data versions + conf fingerprint) matches an already-materialized "
+    "shuffle stage ADOPTS that stage's map output and statistics "
+    "instead of recomputing it (aqeExchangeReuse journal event, "
+    "srt_exchangereuse_* series). Stages are refcounted, so eviction "
+    "never frees frames a running query still reads. Requires "
+    "spark.rapids.sql.adaptive.enabled.")
+
+SERVING_EXCHANGE_REUSE_MAX_BYTES = register(
+    "spark.rapids.tpu.serving.exchangeReuse.maxBytes", _to_bytes,
+    256 << 20,
+    "Byte bound on materialized stage output retained for cross-query "
+    "exchange reuse (measured shuffle bytes; oldest evicted first).",
+    validator=_positive)
+
 UI_SIGNAL_DIAGNOSTICS = register(
     "spark.rapids.tpu.ui.signalDiagnostics", _to_bool, True,
     "Install a SIGUSR1 handler at session creation that dumps the "

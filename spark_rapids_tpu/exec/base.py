@@ -64,8 +64,10 @@ class PhysicalPlan:
         from spark_rapids_tpu.obs import compileledger
         from spark_rapids_tpu.obs.trace import TRACER
         prog = ctx.progress  # live monitoring (obs/progress.py)
+        cancel = ctx.cancel  # cooperative cancellation (serving/)
         if not ctx.metrics_enabled and not TRACER.enabled \
-                and prog is None and not compileledger.LEDGER.enabled:
+                and prog is None and not compileledger.LEDGER.enabled \
+                and cancel is None:
             return parts
         import time
         op = self.describe()
@@ -89,6 +91,12 @@ class PhysicalPlan:
             def run():
                 it = part()
                 while True:
+                    if cancel is not None:
+                        # batch-pull boundary: a cancelled or past-
+                        # deadline query raises here instead of being
+                        # killed mid-kernel, so the session's normal
+                        # failure path releases its buffers/shuffles
+                        cancel.check()
                     t0 = time.perf_counter()
                     with TRACER.span(self.name, op=op,
                                      partition=pidx) as sp:
@@ -241,6 +249,19 @@ class ExecContext:
         # only when the monitoring UI is enabled; None (the default)
         # keeps every heartbeat site a single is-None check
         self.progress = None
+        # cooperative cancellation scope (serving/cancellation.py): the
+        # scheduler installs it thread-locally before running a job;
+        # executed_partitions checks it at every batch-pull boundary.
+        # None (the default) keeps the hot path untouched.
+        from spark_rapids_tpu.serving.cancellation import current_scope
+        self.cancel = current_scope()
+        # per-QUERY resource tracking (shuffle ids registered, transient
+        # spillable buffer ids): concurrent queries must each release
+        # exactly their own at query end — a shared session-level list
+        # would free a neighbor's live buffers (session.py routes its
+        # register/release calls through the executing query's context)
+        self.active_shuffles: list = []
+        self.transient_bids: set = set()
 
     def metric_add(self, op: str, name: str, value):
         self.registry.counter(name, op=op).add(value)

@@ -2,13 +2,35 @@
 
 Bounds how many host task threads may hold device batches concurrently
 (spark.rapids.sql.concurrentTpuTasks). Acquire-on-first-use per task,
-release on task completion, exactly the reference's protocol.
+release on task completion, exactly the reference's protocol — plus the
+serving layer's two generalizations:
+
+  * **drain-safe reconfiguration**: ``get(permits)`` with a different
+    permit count RESIZES the live singleton instead of replacing it. The
+    old replace-on-change lost every existing holder's accounting — a
+    task releasing into the fresh instance was a no-op while the fresh
+    instance admitted a full new complement, silently over-admitting the
+    device. A shrink takes effect as holders drain (no new admission
+    until the census fits the new bound); a grow admits waiters
+    immediately.
+  * **per-tenant permit budgets** (``spark.rapids.tpu.serving.tenant.*``):
+    a tenant's tasks are additionally bounded by that tenant's budget, so
+    one tenant cannot occupy every device slot and starve the rest. The
+    tenant is resolved from the thread-local serving context
+    (serving/cancellation.py) — the scheduler's workers set it per job —
+    and budget 0/unset means "global limit only". Per-tenant holder and
+    waiter gauges (``semaphore.tenant.holders/waiters{tenant=}``) feed
+    the monitor's /api/scheduler quota scoreboard.
+
+Implementation is a single condition variable over a holder census
+rather than a raw ``threading.Semaphore``: resize and tenant bounds are
+then plain predicate changes, impossible to over-admit by construction.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 
 class TpuSemaphore:
@@ -16,65 +38,165 @@ class TpuSemaphore:
     _lock = threading.Lock()
 
     def __init__(self, permits: int):
-        self.permits = permits
-        self._sem = threading.Semaphore(permits)
-        self._holders: Dict[int, int] = {}  # task id -> acquire count
-        self._state_lock = threading.Lock()
+        self.permits = max(1, int(permits))
+        self._cond = threading.Condition()
+        # task id -> (acquire count, tenant)
+        self._holders: Dict[int, Tuple[int, Optional[str]]] = {}
+        # tenant -> tasks currently holding / waiting
+        self._tenant_held: Dict[str, int] = {}
+        self._tenant_waiting: Dict[str, int] = {}
+        # tenant -> max concurrent holders (0/absent = unbounded)
+        self._tenant_budgets: Dict[str, int] = {}
+        self._default_budget = 0
         self._holders_gauge = None  # resolved lazily, once
 
-    def _publish_locked(self) -> None:
+    # -- metrics -------------------------------------------------------------
+    def _publish_locked(self, tenant: Optional[str] = None) -> None:
         """Mirror the holder count into the process-wide registry
         (semaphore.holders gauge) so the scan pipeline's queue-depth view
         and profile reports see device-admission pressure without polling.
-        Caller holds self._state_lock."""
+        Caller holds self._cond."""
+        from spark_rapids_tpu.obs.metrics import REGISTRY
         if self._holders_gauge is None:
-            from spark_rapids_tpu.obs.metrics import REGISTRY
             self._holders_gauge = REGISTRY.gauge("semaphore.holders")
         self._holders_gauge.set(len(self._holders))
+        if tenant is not None:
+            REGISTRY.gauge("semaphore.tenant.holders", tenant=tenant) \
+                .set(self._tenant_held.get(tenant, 0))
+            REGISTRY.gauge("semaphore.tenant.waiters", tenant=tenant) \
+                .set(self._tenant_waiting.get(tenant, 0))
 
     def available_permits(self) -> int:
         """Permits not currently held by any task thread (introspection
         for tests and backpressure diagnostics)."""
-        with self._state_lock:
+        with self._cond:
             return max(self.permits - len(self._holders), 0)
 
+    # -- configuration -------------------------------------------------------
     @classmethod
     def get(cls, permits: int) -> "TpuSemaphore":
         with cls._lock:
-            if cls._instance is None or cls._instance.permits != permits:
+            if cls._instance is None:
                 cls._instance = cls(permits)
+            elif cls._instance.permits != permits:
+                # resize the LIVE instance: replacing it while holders
+                # exist on the old one loses their accounting and
+                # over-admits (the pre-serving singleton race)
+                cls._instance.resize(permits)
             return cls._instance
 
-    def acquire_if_necessary(self, task_id: Optional[int] = None) -> None:
-        tid = task_id if task_id is not None else threading.get_ident()
-        with self._state_lock:
-            held = self._holders.get(tid, 0)
-            if held:
-                self._holders[tid] = held + 1
-                return
-        # contended acquires are the interesting signal (tasks stalled
-        # behind concurrentTpuTasks); the uncontended path stays timer-free
-        if not self._sem.acquire(blocking=False):
-            import time
+    def resize(self, permits: int) -> None:
+        """Drain-safe permit change: growth wakes waiters immediately; a
+        shrink stops new admission until enough holders release that the
+        census fits the new bound. Holders are never revoked."""
+        with self._cond:
+            self.permits = max(1, int(permits))
+            self._cond.notify_all()
 
-            from spark_rapids_tpu.obs.metrics import REGISTRY
-            from spark_rapids_tpu.obs.trace import TRACER
-            t0 = time.perf_counter()
-            with TRACER.span("semaphore.wait", permits=self.permits):
-                self._sem.acquire()
+    def configure_tenants(self, budgets: Dict[str, int],
+                          default: int = 0) -> None:
+        """Install per-tenant max-holder budgets (0 = unbounded). The
+        scheduler calls this from the ``spark.rapids.tpu.serving.tenant.*``
+        confs; loosened budgets wake waiters."""
+        with self._cond:
+            self._tenant_budgets = {str(t): max(0, int(b))
+                                    for t, b in budgets.items()}
+            self._default_budget = max(0, int(default))
+            self._cond.notify_all()
+
+    def tenant_budget(self, tenant: Optional[str]) -> int:
+        if tenant is None:
+            return 0
+        return self._tenant_budgets.get(str(tenant), self._default_budget)
+
+    def tenant_usage(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant quota scoreboard for /api/scheduler."""
+        with self._cond:
+            tenants = (set(self._tenant_held) | set(self._tenant_waiting)
+                       | set(self._tenant_budgets))
+            return {t: {"held": self._tenant_held.get(t, 0),
+                        "waiting": self._tenant_waiting.get(t, 0),
+                        "budget": self.tenant_budget(t)}
+                    for t in sorted(tenants)}
+
+    # -- admission -----------------------------------------------------------
+    def _admissible_locked(self, tenant: Optional[str]) -> bool:
+        if len(self._holders) >= self.permits:
+            return False
+        budget = self.tenant_budget(tenant)
+        return not (budget and tenant is not None
+                    and self._tenant_held.get(str(tenant), 0) >= budget)
+
+    def acquire_if_necessary(self, task_id: Optional[int] = None,
+                             tenant: Optional[str] = None) -> None:
+        tid = task_id if task_id is not None else threading.get_ident()
+        if tenant is None:
+            from spark_rapids_tpu.serving.cancellation import current_tenant
+            tenant = current_tenant()
+        tkey = str(tenant) if tenant is not None else None
+        with self._cond:
+            held = self._holders.get(tid)
+            if held is not None:
+                self._holders[tid] = (held[0] + 1, held[1])
+                return
+            if self._admissible_locked(tkey):
+                self._grant_locked(tid, tkey)
+                return
+            # contended acquires are the interesting signal (tasks
+            # stalled behind concurrentTpuTasks or a tenant budget); the
+            # uncontended path above stays timer-free
+            if tkey is not None:
+                self._tenant_waiting[tkey] = \
+                    self._tenant_waiting.get(tkey, 0) + 1
+        import time
+
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        from spark_rapids_tpu.obs.trace import TRACER
+        from spark_rapids_tpu.serving.cancellation import current_scope
+        # a blocked admission wait must stay cancellable: tenant budgets
+        # create exactly the contention where a deadline/cancel fires
+        # while the thread is parked here, well before the next
+        # batch-pull boundary could notice
+        scope = current_scope()
+        t0 = time.perf_counter()
+        try:
+            with TRACER.span("semaphore.wait", permits=self.permits,
+                             tenant=tkey or ""):
+                with self._cond:
+                    try:
+                        while not self._admissible_locked(tkey):
+                            self._cond.wait(
+                                0.05 if scope is not None else None)
+                            if scope is not None:
+                                scope.check()  # QueryCancelled/Timeout
+                    finally:
+                        if tkey is not None:
+                            self._tenant_waiting[tkey] -= 1
+                    self._grant_locked(tid, tkey)
+        finally:
             REGISTRY.timer("semaphore.waitTime") \
                 .record(time.perf_counter() - t0)
-        with self._state_lock:
-            self._holders[tid] = 1
-            self._publish_locked()
+
+    def _grant_locked(self, tid: int, tenant: Optional[str]) -> None:
+        self._holders[tid] = (1, tenant)
+        if tenant is not None:
+            self._tenant_held[tenant] = self._tenant_held.get(tenant, 0) + 1
+        self._publish_locked(tenant)
 
     def release(self, task_id: Optional[int] = None) -> None:
         tid = task_id if task_id is not None else threading.get_ident()
-        with self._state_lock:
-            held = self._holders.pop(tid, 0)
-            self._publish_locked()
-        if held:
-            self._sem.release()
+        with self._cond:
+            held = self._holders.pop(tid, None)
+            if held is not None:
+                tenant = held[1]
+                if tenant is not None:
+                    n = self._tenant_held.get(tenant, 1) - 1
+                    if n > 0:
+                        self._tenant_held[tenant] = n
+                    else:
+                        self._tenant_held.pop(tenant, None)
+                self._publish_locked(tenant)
+                self._cond.notify_all()
 
     def __enter__(self):
         self.acquire_if_necessary()

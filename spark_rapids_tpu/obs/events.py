@@ -16,8 +16,24 @@ Event taxonomy (one JSON object per line; every event carries ``kind``,
   queryStart        session      confFingerprint
   queryPlan         session      planDigest, tpuOps, cpuOps, coveragePct
   cpuFallback       tag pass     op, describe, reasons[] (sql/overrides.py)
-  queryEnd          session      status success|failed, wall_s, error,
-                                 coveragePct, cpuOpTime {op: seconds}
+  queryEnd          session      status success|failed|cancelled|timeout,
+                                 wall_s, error, coveragePct,
+                                 cpuOpTime {op: seconds}
+  queryCancelled    serving      reason, events[] (flight-recorder
+                                 tail), compiles[] — a job cancel
+                                 honored at a batch-pull boundary
+  queryTimeout      serving      deadlineSeconds, reason, events[],
+                                 compiles[] — the per-query deadline
+                                 fired (serving/cancellation.py)
+  planCacheHit      serving      planDigest — tag+convert planning
+                                 skipped for a repeat submission
+  resultCacheHit    serving      planDigest, rows — the opt-in result
+                                 cache answered without executing
+  aqeExchangeReuse  serving      stage, reusedFrom, totalBytes — a new
+                                 query adopted an already-materialized
+                                 AQE stage (serving/caches.py)
+  queryShed         serving      tenant, queueDepth — admission queue
+                                 full, job load-shed (serving/scheduler)
   spill             memory       direction, bytes, buffer (memory/spill.py)
   memoryPressure    memory       neededBytes, freedBytes (alloc backoff)
   fetchRetry        exec         peer, attempt (exec/tpu.py retry loop)
@@ -137,6 +153,17 @@ class EventLog:
         # tenant/job-group window (session.set_job_group): like the query
         # window, every event between queryStart/queryEnd carries it
         self._current_tenant: Optional[str] = None
+        # concurrent serving: one open window PER EXECUTING THREAD
+        # (thread ident -> (query id, tenant)). Events emitted on a query
+        # thread attribute to that thread's window; subsystem threads
+        # without one (decode pool, shuffle server) fall back to the
+        # most-recently-opened window — the pre-serving limitation,
+        # now scoped to cross-thread emitters only.
+        self._windows: Dict[int, tuple] = {}
+        # last query id OPENED on each thread, surviving query_end: the
+        # serving scheduler joins its job records to journal query ids
+        # with this (bounded implicitly by live thread count)
+        self._last_by_thread: Dict[int, str] = {}
         # gzip rotated segments (spark.rapids.tpu.eventLog.compress)
         self.compress = False
         # truncation visibility (profile "observability" section)
@@ -210,15 +237,18 @@ class EventLog:
         """Record one durable fact. Always lands in the flight-recorder
         ring; additionally appended to the JSONL journal when enabled.
         Never raises — a broken sink must not fail the query."""
+        tid = threading.get_ident()
         with self._lock:
             self._seq += 1
             ev = {"kind": kind, "ts": round(time.time(), 6),
                   "seq": self._seq}
-            if self._current_query is not None and "query" not in fields:
-                ev["query"] = self._current_query
-            if self._current_tenant is not None and \
-                    "tenant" not in fields:
-                ev["tenant"] = self._current_tenant
+            win = self._windows.get(tid)
+            qid = win[0] if win is not None else self._current_query
+            tenant = win[1] if win is not None else self._current_tenant
+            if qid is not None and "query" not in fields:
+                ev["query"] = qid
+            if tenant is not None and "tenant" not in fields:
+                ev["tenant"] = tenant
             ev.update(fields)
             if kind != "flightRecorder":
                 # a dump must never re-enter the ring: the next dump
@@ -311,15 +341,19 @@ class EventLog:
         id — and the tenant/job-group tag, when one is set — until
         query_end. Returns the id (``q-<n>``, process-wide).
 
-        One window at a time: the engine executes queries serially (one
-        driver thread per process; subsystem threads WITHIN a query are
-        what the lock covers). Were two sessions ever to interleave
-        queries, events would attribute to whichever window opened last
-        — acceptable for a post-hoc mining record, noted here so the
-        limitation is deliberate rather than discovered."""
+        One window PER THREAD: the serving layer runs queries
+        concurrently, each on its own worker thread, and events emitted
+        on that thread attribute to its window. Subsystem threads
+        without a window of their own (decode pool, shuffle server)
+        fall back to the most-recently-opened one — acceptable for a
+        post-hoc mining record, noted here so the limitation is
+        deliberate rather than discovered."""
+        tid = threading.get_ident()
         with self._lock:
             self._query_counter += 1
             qid = f"q-{self._query_counter}"
+            self._windows[tid] = (qid, tenant or None)
+            self._last_by_thread[tid] = qid
             self._current_query = qid
             self._current_tenant = tenant or None
         self.emit("queryStart", query=qid, **fields)
@@ -330,13 +364,27 @@ class EventLog:
         if flight_dump:
             self.dump_flight(reason=f"query {status}")
         self.emit("queryEnd", status=status, **fields)
+        tid = threading.get_ident()
         with self._lock:
-            self._current_query = None
-            self._current_tenant = None
+            self._windows.pop(tid, None)
+            if self._windows:
+                # another query is still in flight: cross-thread
+                # emitters fall back to one of the remaining windows
+                self._current_query, self._current_tenant = \
+                    next(reversed(self._windows.values()))
+            else:
+                self._current_query = None
+                self._current_tenant = None
 
     @property
     def current_query(self) -> Optional[str]:
-        return self._current_query
+        win = self._windows.get(threading.get_ident())
+        return win[0] if win is not None else self._current_query
+
+    def last_query_on_thread(self) -> Optional[str]:
+        """Most recent query id OPENED on this thread (survives
+        query_end — the serving scheduler's job/journal join key)."""
+        return self._last_by_thread.get(threading.get_ident())
 
     # -- flight recorder ----------------------------------------------------
     def flight_events(self) -> List[Dict[str, Any]]:
@@ -384,6 +432,8 @@ class EventLog:
             self.compress = False
             self._current_query = None
             self._current_tenant = None
+            self._windows.clear()
+            self._last_by_thread.clear()
             self._ring.clear()
 
 

@@ -23,6 +23,9 @@ Endpoints:
   ``GET /api/tenants``    per-tenant accounting (``session.set_job_group``
                           tags + the ``tenant.*`` registry counters) —
                           the substrate a multi-tenant scheduler reads
+  ``GET /api/scheduler``  live admission-scheduler state (serving/):
+                          queue depth, running jobs, per-tenant lanes,
+                          HBM quota usage, load-shed counts
   ``GET /``               minimal self-contained HTML live view (polls
                           ``/api/queries``)
 
@@ -363,6 +366,14 @@ class _Handler(JsonHandler):
                     self._send_json(doc)
             elif path == "/api/tenants":
                 self._send_json(tenants_snapshot())
+            elif path == "/api/scheduler":
+                # live admission-scheduler state (serving/scheduler.py):
+                # queue depth, running set, per-tenant quota usage, shed
+                # counts; an empty list when no scheduler is running
+                from spark_rapids_tpu.serving.scheduler import (
+                    snapshot_all,
+                )
+                self._send_json(snapshot_all())
             elif path in ("/", "/index.html"):
                 self._send(200, _INDEX_HTML, "text/html; charset=utf-8")
             else:

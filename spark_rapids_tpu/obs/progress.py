@@ -251,12 +251,13 @@ class ProgressTracker:
     """Process-wide registry of in-flight + recently-finished queries.
 
     ``enabled`` is THE hot-path gate: call sites check it (one attribute
-    load) before touching anything else. ``_current`` mirrors the event
-    journal's one-query-at-a-time window — subsystems without an
-    ExecContext (scan decode pool, shuffle client, spill tiers) attribute
-    to it; were two sessions ever to interleave queries the counters
-    would land on whichever window opened last, same documented
-    limitation as ``EventLog.query_start``.
+    load) before touching anything else. ``current`` resolves the
+    EXECUTING THREAD's in-flight record first (the serving layer runs
+    queries concurrently, one worker thread each), then falls back to
+    the most-recently-begun query — subsystems without an ExecContext
+    (scan decode pool, shuffle client, spill tiers) attribute to that
+    fallback, the same documented limitation as ``EventLog.query_start``,
+    now scoped to cross-thread emitters only.
     """
 
     def __init__(self, recent: int = DEFAULT_RECENT):
@@ -266,6 +267,7 @@ class ProgressTracker:
         self._recent: collections.deque = collections.deque(
             maxlen=max(1, recent))
         self._current: Optional[QueryProgress] = None
+        self._by_thread: Dict[int, QueryProgress] = {}
 
     def configure(self, enabled: bool,
                   recent: Optional[int] = None) -> None:
@@ -280,62 +282,70 @@ class ProgressTracker:
     def begin(self, qid: str, tenant: Optional[str] = None,
               description: str = "") -> QueryProgress:
         qp = QueryProgress(qid, tenant=tenant, description=description)
+        tid = threading.get_ident()
         with self._lock:
             self._inflight[qid] = qp
+            self._by_thread[tid] = qp
             self._current = qp
         return qp
 
     def finish(self, qp: QueryProgress, status: str,
                error: Optional[str] = None) -> None:
         qp.finish(status, error)
+        tid = threading.get_ident()
         with self._lock:
             self._inflight.pop(qp.id, None)
             self._recent.append(qp)
+            if self._by_thread.get(tid) is qp:
+                del self._by_thread[tid]
             if self._current is qp:
-                self._current = None
+                # another thread's query may still be in flight: keep a
+                # live fallback for cross-thread attributions
+                self._current = next(iter(self._inflight.values()), None)
 
     @property
     def current(self) -> Optional[QueryProgress]:
-        return self._current
+        qp = self._by_thread.get(threading.get_ident())
+        return qp if qp is not None else self._current
 
     # -- hot-path helpers (caller already checked ``enabled``) --------------
     def scan_split(self, nbytes: int) -> None:
-        qp = self._current
+        qp = self.current
         if qp is not None:
             qp.note("scan", splitsDecoded=1, bytesDecoded=int(nbytes))
 
     def scan_stalled(self, stalled: bool) -> None:
-        qp = self._current
+        qp = self.current
         if qp is not None:
             qp.set_scan_stalled(stalled)
 
     def scan_upload(self, rows: int) -> None:
-        qp = self._current
+        qp = self.current
         if qp is not None:
             qp.note("scan", batchesUploaded=1, rowsUploaded=int(rows))
 
     def shuffle_fetch(self, nbytes: int) -> None:
-        qp = self._current
+        qp = self.current
         if qp is not None:
             qp.note("shuffle", fetches=1, bytes=int(nbytes))
 
     def shuffle_retry(self) -> None:
-        qp = self._current
+        qp = self.current
         if qp is not None:
             qp.note("shuffle", retries=1)
 
     def shuffle_failure(self) -> None:
-        qp = self._current
+        qp = self.current
         if qp is not None:
             qp.note("shuffle", failures=1)
 
     def shuffle_map_partition(self) -> None:
-        qp = self._current
+        qp = self.current
         if qp is not None:
             qp.note("shuffle", mapPartitions=1)
 
     def spill(self, nbytes: int) -> None:
-        qp = self._current
+        qp = self.current
         if qp is not None:
             qp.note("spill", events=1, bytes=int(nbytes))
 

@@ -1,0 +1,38 @@
+"""Concurrent query serving (ROADMAP item 1).
+
+The reference's driver plugin is a long-lived service many Spark jobs
+share; this package is that serving layer for the port:
+
+  * ``scheduler.py``    — admission controller + weighted-fair scheduler:
+    queries submit as jobs (submit/status/cancel), per-tenant FIFO lanes,
+    bounded queue with load-shed, a worker pool running queries
+    concurrently with per-query deadlines and cooperative cancellation;
+  * ``cancellation.py`` — the cancel/deadline scope the execution hot
+    path checks at batch-pull boundaries (exec/base.py), plus the
+    thread-local serving context (current tenant) the tenant-scoped HBM
+    quotas read (memory/semaphore.py);
+  * ``caches.py``       — cross-query plan cache (skips tag+convert
+    planning on repeat submissions), opt-in result cache for repeated
+    dashboard-style queries, and the AQE exchange-reuse cache that lets a
+    new query adopt an already-materialized shuffle stage.
+
+See docs/serving.md for the scheduler model, quota semantics and cache
+invalidation rules.
+"""
+
+from spark_rapids_tpu.serving.cancellation import (  # noqa: F401
+    CancelScope, QueryCancelled, QueryTimeout, SchedulerOverloaded,
+    current_scope, current_tenant, serving_context,
+)
+
+
+def __getattr__(name):
+    # scheduler/caches import the session module; resolve lazily so
+    # `import spark_rapids_tpu.serving` never cycles through session.py
+    if name in ("QueryScheduler", "QueryJob"):
+        from spark_rapids_tpu.serving import scheduler
+        return getattr(scheduler, name)
+    if name in ("PlanCache", "ResultCache", "ExchangeReuseCache"):
+        from spark_rapids_tpu.serving import caches
+        return getattr(caches, name)
+    raise AttributeError(name)

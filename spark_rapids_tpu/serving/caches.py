@@ -1,0 +1,449 @@
+"""Cross-query serving caches: plan cache, result cache, exchange reuse.
+
+All three key on the same identity triple —
+
+    (plan digest, conf fingerprint, source data versions)
+
+— where the *plan digest* is ``obs/events.plan_digest`` over the CPU
+physical plan (structure + expressions), the *conf fingerprint* is the
+explicit-settings hash every query already journals, and the *source
+versions* pin the data behind every scan: file sources version by
+``(path, mtime)`` per file (a rewritten table MUST miss), in-memory
+sources by ``DataSource.data_uid()`` (a content digest for small frames,
+a process-unique counter otherwise).
+
+  * **PlanCache** (``spark.rapids.tpu.serving.planCache.enabled``, on by
+    default): repeat submissions skip the tag+convert rewrite
+    (TpuOverrides + TransitionOverrides + fusions) entirely. A hit
+    returns a **clone** of the cached tree — node-for-node copies with
+    DAG sharing preserved — so two concurrent queries never execute the
+    same plan objects; the clones carry identical operator signatures,
+    so every kernel-cache key stays warm and ``timed_compiles`` stays 0.
+  * **ResultCache** (``...resultCache.enabled``, opt-in): identical
+    dashboard-style queries answer straight from the cached host frames
+    with zero execution. Only deterministic, non-writing plans are
+    cacheable; hits return defensive copies.
+  * **ExchangeReuseCache** (``...exchangeReuse.enabled``, opt-in): a new
+    adaptive query whose exchange subtree digest matches an
+    already-materialized ``ShuffleStage`` adopts its map output instead
+    of recomputing the stage (sql/adaptive/executor.py). Stages are
+    refcounted — eviction mid-adoption never frees frames a running
+    query still reads.
+
+Hit/miss counters land in the process registry as ``plancache.*`` /
+``resultcache.*`` / ``exchangereuse.*`` (Prometheus ``srt_plancache_*``,
+``srt_resultcache_*``, ``srt_exchangereuse_*``) labeled by tenant.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+PLAN_CACHE_ENABLED = "spark.rapids.tpu.serving.planCache.enabled"
+PLAN_CACHE_MAX = "spark.rapids.tpu.serving.planCache.maxEntries"
+RESULT_CACHE_ENABLED = "spark.rapids.tpu.serving.resultCache.enabled"
+RESULT_CACHE_MAX = "spark.rapids.tpu.serving.resultCache.maxEntries"
+RESULT_CACHE_MAX_BYTES = "spark.rapids.tpu.serving.resultCache.maxBytes"
+EXCHANGE_REUSE_ENABLED = "spark.rapids.tpu.serving.exchangeReuse.enabled"
+EXCHANGE_REUSE_MAX_BYTES = \
+    "spark.rapids.tpu.serving.exchangeReuse.maxBytes"
+
+
+# ---------------------------------------------------------------------------
+# Source data versions
+# ---------------------------------------------------------------------------
+
+def source_version(source) -> Tuple:
+    """Identity of the DATA behind one scan source. File-backed sources
+    version per (path, mtime) so a rewritten table invalidates every
+    cache keyed over it; in-memory sources ride ``data_uid`` (content
+    digest for small frames, else a process-unique per-object counter)."""
+    base = getattr(source, "_base", source)
+    paths = getattr(base, "paths", None)
+    if paths:
+        def mtime(p):
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return None
+        return tuple((str(p), mtime(p)) for p in paths)
+    try:
+        return (source.data_uid(),)
+    except Exception:  # noqa: BLE001 — unversionable -> never cacheable
+        return (object(),)  # unique, unequal to everything
+
+
+def source_versions(logical) -> Tuple:
+    """Versions of every scanned source in a logical plan, in walk
+    order (position matters: two scans of different tables must not
+    commute)."""
+    out: List[Tuple] = []
+    for node in logical.walk():
+        src = getattr(node, "source", None)
+        if src is not None:
+            out.append(source_version(src))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Full-fidelity plan identity
+# ---------------------------------------------------------------------------
+#
+# The journal's ``plan_digest`` (describe() of every node) is a SHAPE key:
+# it deliberately collapses queries that differ only in literals so
+# cross-run mining can group "the same query shape". A cache key must be
+# exact — two filters differing only in a pattern literal, or two writes
+# differing only in their save mode, are different queries — so the
+# serving caches hash every semantic attribute of every node, recursing
+# through engine-owned value objects (expressions, sort orders, agg
+# plans, schemas) where literals actually live.
+
+_IDENT_MAX_DEPTH = 64
+
+
+def _value_identity(v, depth: int = 0) -> str:
+    """Deterministic identity string of one attribute value. Scalars and
+    engine-owned value objects contribute full fidelity; foreign objects
+    (pandas frames, numpy arrays) contribute their class only — their
+    data identity is the source-version component's job."""
+    if depth > _IDENT_MAX_DEPTH:
+        return "<deep>"
+    if v is None or isinstance(v, (str, int, float, bool, bytes)):
+        return repr(v)
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(_value_identity(x, depth + 1)
+                              for x in v) + "]"
+    if isinstance(v, (set, frozenset)):
+        return "{" + ",".join(sorted(_value_identity(x, depth + 1)
+                                     for x in v)) + "}"
+    if isinstance(v, dict):
+        items = sorted(
+            (repr(k), _value_identity(x, depth + 1))
+            for k, x in v.items()
+            if isinstance(k, (str, int, float, bool, bytes)) or k is None)
+        return "{" + ",".join(f"{k}:{x}" for k, x in items) + "}"
+    from spark_rapids_tpu.columnar.dtype import DType
+    if isinstance(v, DType):
+        return f"dtype:{v.name}"
+    from spark_rapids_tpu.exec.base import PhysicalPlan
+    from spark_rapids_tpu.sql.sources import DataSource
+    if isinstance(v, DataSource):
+        # structure only — the DATA behind it is pinned separately by
+        # source_version (content digest / mtime), so a rebuilt source
+        # with identical content still hits
+        return f"source:{v.describe()}"
+    if isinstance(v, PhysicalPlan):
+        return f"plan:{type(v).__name__}"  # children are walked, not attrs
+    mod = type(v).__module__ or ""
+    if mod.startswith("spark_rapids_tpu") and hasattr(v, "__dict__"):
+        # engine-owned value object (Expression, SortOrder, AggPlan,
+        # Schema, ...): class + every attribute, recursively — literals
+        # (filter patterns, substring offsets, cast targets) live here
+        parts = [f"{k}={_value_identity(a, depth + 1)}"
+                 for k, a in sorted(vars(v).items())]
+        return f"{type(v).__name__}({','.join(parts)})"
+    return f"<{type(v).__name__}>"
+
+
+def node_identity(node) -> str:
+    """Full-fidelity identity of ONE plan node: class, describe(),
+    fingerprint, and every public attribute (expressions recursed with
+    their literals). Children are NOT included — tree walkers append
+    them positionally."""
+    parts = [type(node).__name__, node.describe(),
+             node.fingerprint_extra()]
+    for k, v in sorted(vars(node).items()):
+        # underscore attrs are node-private state (memoized schemas,
+        # broadcast materialization caches), not query semantics
+        if k == "children" or k.startswith("_"):
+            continue
+        parts.append(f"{k}={_value_identity(v)}")
+    return "|".join(parts)
+
+
+def plan_identity(plan) -> str:
+    """Exact structural hash of a physical plan tree — the serving
+    caches' plan-key component. Unlike the journal's ``plan_digest``
+    (shape key), two plans differing in ANY literal digest differently."""
+    import hashlib
+    parts: List[str] = []
+
+    def rec(n) -> None:
+        parts.append(node_identity(n))
+        parts.append("(")
+        for c in n.children:
+            rec(c)
+        parts.append(")")
+    rec(plan)
+    return hashlib.sha1(
+        "\n".join(parts).encode("utf-8", "replace")).hexdigest()
+
+
+def clone_plan(plan):
+    """Node-for-node copy of a physical plan tree with DAG sharing
+    preserved (reuse_common_subtrees creates shared subtrees; cloning
+    them once keeps the within-query dedup). Per-node materialization
+    caches (``_cache`` dicts: broadcast bids/frames) get a FRESH dict
+    per clone — sharing them with the master races concurrent queries:
+    query A registers the broadcast batch as ITS transient and query-end
+    release frees it while an identical query B still holds the cached
+    buffer id (the catalog ``contains`` re-materialization guard is
+    check-then-act, so B can acquire a buffer A is about to close)."""
+    memo: Dict[int, Any] = {}
+
+    def rec(node):
+        got = memo.get(id(node))
+        if got is not None:
+            return got
+        c = copy.copy(node)
+        memo[id(node)] = c
+        if "_cache" in vars(c):
+            c._cache = {}
+        c.children = [rec(ch) for ch in node.children]
+        return c
+    return rec(plan)
+
+
+# ---------------------------------------------------------------------------
+# Caches
+# ---------------------------------------------------------------------------
+
+def _count(name: str, tenant: Optional[str]) -> None:
+    from spark_rapids_tpu.obs.metrics import REGISTRY
+    REGISTRY.counter(name, tenant=tenant or "default").add(1)
+
+
+class PlanCache:
+    """LRU of converted physical plans keyed by the identity triple."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+
+    def get(self, key, conf, tenant: Optional[str] = None):
+        if not conf.get_bool(PLAN_CACHE_ENABLED, True):
+            return None
+        with self._lock:
+            plan = self._entries.get(key)
+            if plan is not None:
+                self._entries.move_to_end(key)
+        if plan is None:
+            _count("plancache.misses", tenant)
+            return None
+        _count("plancache.hits", tenant)
+        return clone_plan(plan)
+
+    def put(self, key, plan, conf) -> None:
+        if not conf.get_bool(PLAN_CACHE_ENABLED, True):
+            return
+        cap = max(1, conf.get_int(PLAN_CACHE_MAX, 256))
+        with self._lock:
+            self._entries[key] = plan
+            self._entries.move_to_end(key)
+            while len(self._entries) > cap:
+                self._entries.popitem(last=False)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+class ResultCache:
+    """Opt-in LRU of (plan, output frames) for repeated dashboard-style
+    queries; byte-bounded (pandas ``memory_usage(deep=True)``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()  # key -> (plan, outs, bytes)
+        self._bytes = 0
+
+    @staticmethod
+    def _outs_bytes(outs) -> int:
+        total = 0
+        for df in outs:
+            try:
+                total += int(df.memory_usage(deep=True).sum())
+            except (TypeError, ValueError, AttributeError):
+                return -1
+        return total
+
+    @staticmethod
+    def cacheable(cpu_plan) -> bool:
+        """Deterministic, non-writing plans only: a write commits files
+        (replaying it from cache would skip the side effect), and a
+        rand() branch must re-execute by definition."""
+        if any(n.name in ("CpuWriteExec", "TpuWriteExec")
+               for n in cpu_plan.walk()):
+            return False
+        from spark_rapids_tpu.exec.reuse import subtree_deterministic
+        return subtree_deterministic(cpu_plan)
+
+    def get(self, key, conf, tenant: Optional[str] = None):
+        if not conf.get_bool(RESULT_CACHE_ENABLED, False):
+            return None
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+        if ent is None:
+            _count("resultcache.misses", tenant)
+            return None
+        _count("resultcache.hits", tenant)
+        plan, outs, _nbytes = ent
+        return plan, [df.copy() for df in outs]
+
+    def maybe_put(self, key, cpu_plan, plan, outs, conf,
+                  tenant: Optional[str] = None) -> bool:
+        if not conf.get_bool(RESULT_CACHE_ENABLED, False) \
+                or not self.cacheable(cpu_plan):
+            return False
+        nbytes = self._outs_bytes(outs)
+        max_bytes = int(conf.get(RESULT_CACHE_MAX_BYTES, 256 << 20))
+        if nbytes < 0 or nbytes > max_bytes:
+            return False
+        cap = max(1, conf.get_int(RESULT_CACHE_MAX, 64))
+        # defensive copies IN: the caller may mutate the returned frames
+        outs = [df.copy() for df in outs]
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[2]
+            self._entries[key] = (plan, outs, nbytes)
+            self._bytes += nbytes
+            while self._entries and (len(self._entries) > cap
+                                     or self._bytes > max_bytes):
+                _k, (_p, _o, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
+        return True
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+
+class ExchangeReuseCache:
+    """Opt-in cross-query registry of materialized AQE shuffle stages
+    (sql/adaptive/stages.ShuffleStage), keyed by the exchange subtree's
+    digest + conf fingerprint + source versions. Stages are refcounted:
+    the cache holds one reference, every adopting query another —
+    eviction never frees frames a running query still reads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()  # key -> ShuffleStage
+        self._bytes = 0
+
+    def get(self, key, tenant: Optional[str] = None):
+        with self._lock:
+            stage = self._entries.get(key)
+            if stage is not None:
+                self._entries.move_to_end(key)
+                stage.retain()  # the adopting query's reference
+        _count("exchangereuse.hits" if stage is not None
+               else "exchangereuse.misses", tenant)
+        return stage
+
+    def put(self, key, stage, max_bytes: int) -> bool:
+        """Offer a freshly-materialized stage. Returns whether the cache
+        took a reference (callers release their own either way)."""
+        if stage.map_outputs is None or stage.total_bytes > max_bytes:
+            return False
+        evicted = []
+        with self._lock:
+            if key in self._entries:
+                return False  # an equivalent stage is already cached
+            stage.retain()
+            self._entries[key] = stage
+            self._bytes += stage.total_bytes
+            while self._entries and self._bytes > max_bytes:
+                _k, old = self._entries.popitem(last=False)
+                self._bytes -= old.total_bytes
+                evicted.append(old)
+        for old in evicted:
+            old.release()
+        return True
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes}
+
+    def clear(self) -> None:
+        with self._lock:
+            entries, self._entries = list(self._entries.values()), \
+                OrderedDict()
+            self._bytes = 0
+        for st in entries:
+            st.release()
+
+
+class ServingCaches:
+    """The session's serving-cache bundle (session._serving())."""
+
+    def __init__(self):
+        self.plan_cache = PlanCache()
+        self.result_cache = ResultCache()
+        self.exchange_cache = ExchangeReuseCache()
+
+    def key_for(self, cpu_plan, conf, logical) -> Tuple:
+        from spark_rapids_tpu.obs.events import conf_fingerprint
+        # plan_identity, NOT the journal's plan_digest: the digest is a
+        # shape key that collapses literal-only differences (two filters
+        # differing only in a pattern literal), which a cache key must
+        # distinguish
+        return (plan_identity(cpu_plan),
+                conf_fingerprint(conf._settings),
+                source_versions(logical))
+
+    def clear(self) -> None:
+        self.plan_cache.clear()
+        self.result_cache.clear()
+        self.exchange_cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# Exchange subtree digests (adaptive executor)
+# ---------------------------------------------------------------------------
+
+def exchange_reuse_key(exchange, conf) -> Tuple:
+    """Cross-query identity of one exchange subtree about to
+    materialize. ShuffleStageRef leaves substitute the referenced
+    stage's OWN reuse key (compositional: stage 2 over a reused stage 1
+    digests the same in both queries); a referenced stage without one
+    contributes its process-unique uid, poisoning the key so it can
+    never collide across queries."""
+    import hashlib
+
+    from spark_rapids_tpu.obs.events import conf_fingerprint
+    from spark_rapids_tpu.sql.adaptive.stages import ShuffleStageRef
+    parts: List[str] = []
+
+    def rec(n) -> None:
+        if isinstance(n, ShuffleStageRef):
+            rk = getattr(n.stage, "reuse_key", None)
+            parts.append(f"stageref:{rk if rk is not None else 'vol%d' % n.stage.uid}")
+            return
+        parts.append(node_identity(n))
+        src = getattr(n, "source", None)
+        if src is not None:
+            parts.append(repr(source_version(src)))
+        parts.append("(")
+        for c in n.children:
+            rec(c)
+        parts.append(")")
+    rec(exchange)
+    digest = hashlib.sha1("|".join(parts).encode("utf-8",
+                                                 "replace")).hexdigest()
+    return (digest[:16], conf_fingerprint(conf._settings))

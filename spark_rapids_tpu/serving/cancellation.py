@@ -1,0 +1,99 @@
+"""Cooperative cancellation + the thread-local serving context.
+
+A ``CancelScope`` is one query's cancellation state: an explicit cancel
+flag (``scope.cancel()``) and an optional wall-clock deadline. The
+execution hot path checks the scope at **batch-pull boundaries**
+(``exec/base.executed_partitions``): a cancelled or expired query raises
+``QueryCancelled``/``QueryTimeout`` out of the next batch pull instead of
+being killed mid-kernel — device state stays consistent and the session's
+normal failure path (transient-buffer release, shuffle unregistration,
+journal events) runs as usual.
+
+``serving_context`` is how the scope reaches the engine without threading
+a parameter through every operator: the scheduler's worker enters the
+context before running a job, ``ExecContext.__init__`` picks the scope up
+from the thread-local, and the tenant-scoped HBM quotas
+(``memory/semaphore.py``) read ``current_tenant()`` at acquire time.
+Imports here stay stdlib-only so the hot path (exec/base) can import this
+module without cycling through the session.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+
+class QueryCancelled(RuntimeError):
+    """The query's cancel scope was cancelled (job cancel / shutdown)."""
+
+
+class QueryTimeout(QueryCancelled):
+    """The query ran past its deadline (checked at batch-pull
+    boundaries — cooperative, never mid-kernel)."""
+
+
+class SchedulerOverloaded(RuntimeError):
+    """The admission queue was full and the job was load-shed."""
+
+
+class CancelScope:
+    """One query's cancellation state. Thread-safe; ``check()`` is the
+    hot-path call (two attribute loads when neither flag is set)."""
+
+    __slots__ = ("deadline_ts", "deadline_s", "_cancelled", "reason")
+
+    def __init__(self, deadline_s: Optional[float] = None):
+        self.deadline_s = deadline_s if deadline_s and deadline_s > 0 \
+            else None
+        self.deadline_ts = (time.monotonic() + self.deadline_s
+                            if self.deadline_s else None)
+        self._cancelled = False
+        self.reason: Optional[str] = None
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        self.reason = reason or "cancelled"
+        self._cancelled = True  # GIL-atomic; no lock on the check path
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def expired(self) -> bool:
+        return (self.deadline_ts is not None
+                and time.monotonic() > self.deadline_ts)
+
+    def check(self) -> None:
+        """Raise if the query must stop. Called once per pulled batch."""
+        if self._cancelled:
+            raise QueryCancelled(self.reason or "cancelled")
+        if self.deadline_ts is not None \
+                and time.monotonic() > self.deadline_ts:
+            raise QueryTimeout(
+                f"query exceeded its {self.deadline_s:.3f}s deadline")
+
+
+_TLS = threading.local()
+
+
+def current_scope() -> Optional[CancelScope]:
+    return getattr(_TLS, "scope", None)
+
+
+def current_tenant() -> Optional[str]:
+    return getattr(_TLS, "tenant", None)
+
+
+@contextmanager
+def serving_context(tenant: Optional[str] = None,
+                    scope: Optional[CancelScope] = None):
+    """Install (tenant, scope) as this thread's serving context for the
+    duration; the engine's hot paths read them thread-locally."""
+    prev = (getattr(_TLS, "tenant", None), getattr(_TLS, "scope", None))
+    _TLS.tenant, _TLS.scope = tenant, scope
+    try:
+        yield
+    finally:
+        _TLS.tenant, _TLS.scope = prev

@@ -157,8 +157,24 @@ class TpuSparkSession:
         self.last_aqe: Optional[dict] = None
         # tenant/job-group tag (set_job_group): flows into every event,
         # the tenant.* metric labels, and live progress records — the
-        # per-tenant accounting substrate the serving layer reads
-        self._job_group: tuple = (None, "")
+        # per-tenant accounting substrate the serving layer reads.
+        # Thread-scoped: the scheduler's workers each run a different
+        # tenant's job concurrently (_set_thread_job_group); a plain
+        # set_job_group also updates the session-wide default so the
+        # single-threaded API keeps its exact pre-serving behavior.
+        self._job_group_default: tuple = (None, "")
+        self._job_group_tls = threading.local()
+        # serving-layer state: cross-query plan/result caches and the
+        # AQE exchange-reuse cache (serving/caches.py), created lazily on
+        # first use so sessions that never serve pay nothing
+        self._serving_caches = None
+        self._serving_lock = threading.Lock()
+        # per-executing-thread ExecContext scope: register/release of
+        # per-query resources (transient spillables, shuffle ids) routes
+        # to the OWNING query's context so concurrent queries cannot
+        # free each other's buffers
+        self._exec_scope = threading.local()
+        self._shuffle_lock = threading.Lock()
         # SIGUSR1 -> flight-recorder + thread-stack + progress dump into
         # the event log (obs/monitor.py; main-thread sessions only)
         if conf.get_bool("spark.rapids.tpu.ui.signalDiagnostics", True):
@@ -218,23 +234,50 @@ class TpuSparkSession:
     def shuffle_env(self):
         return self.shuffle_envs[0]
 
-    def next_shuffle_id(self) -> int:
-        self._shuffle_id_counter += 1
-        self._active_shuffles.append(self._shuffle_id_counter)
-        return self._shuffle_id_counter
+    def _current_ctx(self):
+        """The ExecContext of the query executing on THIS thread (set by
+        ``_execute``); None outside a query. Per-query resource tracking
+        (transients, shuffle ids) routes here so concurrent queries each
+        release exactly their own."""
+        return getattr(self._exec_scope, "ctx", None)
 
-    def release_active_shuffles(self) -> None:
-        """Unregister every shuffle the last query registered (the
-        reference's unregisterShuffle path)."""
+    def next_shuffle_id(self) -> int:
+        with self._shuffle_lock:
+            self._shuffle_id_counter += 1
+            sid = self._shuffle_id_counter
+            self._active_shuffles.append(sid)
+        ctx = self._current_ctx()
+        if ctx is not None:
+            ctx.active_shuffles.append(sid)
+        return sid
+
+    def release_active_shuffles(self, ctx=None) -> None:
+        """Unregister every shuffle a query registered (the reference's
+        unregisterShuffle path). With a context, only that query's
+        shuffles; without one (session.stop), everything outstanding."""
+        if ctx is None:
+            ctx = self._current_ctx()
         if self._shuffle_env is None:
+            if ctx is not None:
+                ctx.active_shuffles.clear()
             return
+        with self._shuffle_lock:
+            if ctx is not None:
+                sids, ctx.active_shuffles = list(ctx.active_shuffles), []
+                self._active_shuffles = [
+                    s for s in self._active_shuffles if s not in set(sids)]
+            else:
+                sids, self._active_shuffles = self._active_shuffles, []
         for env in self._shuffle_env:
-            for sid in self._active_shuffles:
+            for sid in sids:
                 env.shuffle_catalog.remove_shuffle(sid)
-        self._active_shuffles.clear()
 
     def register_transient(self, bid: int) -> int:
-        self._transient_bids.add(bid)
+        ctx = self._current_ctx()
+        if ctx is not None:
+            ctx.transient_bids.add(bid)
+        else:
+            self._transient_bids.add(bid)
         return bid
 
     def add_transient_batch(self, batch, priority: int) -> int:
@@ -245,15 +288,25 @@ class TpuSparkSession:
             self.buffer_catalog.add_batch(batch, priority))
 
     def consume_transient(self, bid: int) -> None:
+        ctx = self._current_ctx()
+        if ctx is not None:
+            ctx.transient_bids.discard(bid)
         self._transient_bids.discard(bid)
         self.buffer_catalog.remove(bid)
 
-    def release_transient_buffers(self) -> None:
+    def release_transient_buffers(self, ctx=None) -> None:
         """Free per-query spillables a short-circuited (or failed) query
-        never consumed."""
-        for bid in self._transient_bids:
+        never consumed. With a context, only that query's; the session-
+        level set (registrations outside any query) drains too when no
+        other query is executing them."""
+        if ctx is None:
+            ctx = self._current_ctx()
+        if ctx is not None:
+            bids, ctx.transient_bids = set(ctx.transient_bids), set()
+        else:
+            bids, self._transient_bids = set(self._transient_bids), set()
+        for bid in bids:
             self.buffer_catalog.remove(bid)
-        self._transient_bids.clear()
 
     def set_mesh(self, n_devices: Optional[int]) -> None:
         """Configure an n-device data-parallel mesh for distributed
@@ -307,6 +360,7 @@ class TpuSparkSession:
         process-wide device manager (a later session registers its own),
         and clear the singleton."""
         self.clear_device_cache()
+        self.clear_serving_caches()
         self.release_active_shuffles()
         if self._shuffle_env is not None:
             for env in self._shuffle_env:
@@ -326,11 +380,60 @@ class TpuSparkSession:
         counters in the process-wide metrics registry (rendered live at
         ``/metrics`` and aggregated at ``/api/tenants``), and the live
         query-progress records. ``set_job_group(None)`` clears it."""
-        self._job_group = (str(tenant) if tenant else None,
-                           str(description or ""))
+        group = (str(tenant) if tenant else None,
+                 str(description or ""))
+        self._job_group_default = group
+        self._job_group_tls.value = group
 
     def clear_job_group(self) -> None:
-        self._job_group = (None, "")
+        self.set_job_group(None)
+
+    def _set_thread_job_group(self, tenant, description: str = "") -> None:
+        """Tag THIS THREAD's queries only (the serving workers' form:
+        each worker runs a different tenant's job concurrently, and a
+        session-wide tag would cross-attribute them)."""
+        self._job_group_tls.value = (str(tenant) if tenant else None,
+                                     str(description or ""))
+
+    @property
+    def _job_group(self) -> tuple:
+        return getattr(self._job_group_tls, "value",
+                       self._job_group_default)
+
+    # --- serving ------------------------------------------------------------
+    def _serving(self):
+        """The session's serving-cache bundle (serving/caches.py), or
+        None when every serving cache is disabled — the legacy planning
+        path then runs with zero extra work per query."""
+        conf = self.conf
+        from spark_rapids_tpu.serving import caches as sc
+        if not (conf.get_bool(sc.PLAN_CACHE_ENABLED, True)
+                or conf.get_bool(sc.RESULT_CACHE_ENABLED, False)):
+            return None
+        return self._serving_bundle()
+
+    def _serving_bundle(self):
+        if self._serving_caches is None:
+            with self._serving_lock:
+                if self._serving_caches is None:
+                    from spark_rapids_tpu.serving.caches import (
+                        ServingCaches,
+                    )
+                    self._serving_caches = ServingCaches()
+        return self._serving_caches
+
+    def serving_scheduler(self, **kwargs):
+        """Build an admission scheduler over this session
+        (serving/scheduler.py): submit/status/cancel with per-tenant
+        weighted-fair lanes, bounded-queue load-shed, per-query
+        deadlines and tenant HBM quotas. The caller owns its lifecycle
+        (``close()``)."""
+        from spark_rapids_tpu.serving.scheduler import QueryScheduler
+        return QueryScheduler(self, **kwargs)
+
+    def clear_serving_caches(self) -> None:
+        if self._serving_caches is not None:
+            self._serving_caches.clear()
 
     @staticmethod
     def _count_rows(outs) -> int:
@@ -453,6 +556,10 @@ class TpuSparkSession:
         if PROGRESS.enabled:
             qp = PROGRESS.begin(qid, tenant=tenant, description=job_desc)
             ctx.progress = qp
+        # per-thread execution scope: register/release of per-query
+        # resources (transients, shuffle ids) resolves to THIS context
+        # while the query runs on this thread
+        self._exec_scope.ctx = ctx
         try:
             plan, outs, ctx = self._plan_and_run(
                 logical, ctx, conf, obs_metrics, global_before, t_query0,
@@ -460,13 +567,36 @@ class TpuSparkSession:
         except BaseException as e:
             wall_s = round(time.perf_counter() - t_query0, 6)
             err = f"{type(e).__name__}: {e}"[:300]
+            # cooperative cancellation / deadline: a first-class terminal
+            # state, not a failure — the dedicated journal event carries
+            # the flight-recorder tail + compile-ledger tail so a killed
+            # query still leaves its last moments on record
+            from spark_rapids_tpu.serving.cancellation import (
+                QueryCancelled, QueryTimeout,
+            )
+            if isinstance(e, QueryTimeout):
+                status, kind = "timeout", "queryTimeout"
+            elif isinstance(e, QueryCancelled):
+                status, kind = "cancelled", "queryCancelled"
+            else:
+                status, kind = "failed", None
+            if kind is not None:
+                extra = {}
+                if status == "timeout" and ctx.cancel is not None:
+                    extra["deadlineSeconds"] = ctx.cancel.deadline_s
+                obs_events.EVENTS.emit(
+                    kind, reason=err, wall_s=wall_s,
+                    events=obs_events.EVENTS.flight_events(),
+                    compiles=_LEDGER.tail(), **extra)
             obs_events.EVENTS.query_end(
-                status="failed", flight_dump=True, error=err,
+                status=status, flight_dump=kind is None, error=err,
                 wall_s=wall_s)
-            self._note_tenant(tenant, "failed", wall_s)
+            self._note_tenant(tenant, status, wall_s)
             if qp is not None:
-                PROGRESS.finish(qp, "failed", error=err)
+                PROGRESS.finish(qp, status, error=err)
             raise
+        finally:
+            self._exec_scope.ctx = None
         wall_s = round(time.perf_counter() - t_query0, 6)
         rows_out = self._count_rows(outs)
         obs_events.EVENTS.query_end(
@@ -528,8 +658,38 @@ class TpuSparkSession:
                                           obs_metrics, global_before,
                                           t_query0, trace_on, trace_path,
                                           obs_before)
+        # cross-query serving caches (serving/caches.py), keyed by
+        # (plan digest, conf fingerprint, source data versions):
+        #   * result cache (opt-in): identical dashboard-style query ->
+        #     answer straight from the cached host frames, zero execution;
+        #   * plan cache (on by default): repeat submission skips the
+        #     tag+convert rewrite entirely — zero re-planning, and the
+        #     identical operator signatures keep every kernel-cache key
+        #     warm (timed_compiles stays 0).
+        caches = self._serving()
+        cache_key = caches.key_for(cpu_plan, conf, logical) \
+            if caches is not None else None
+        tenant = self._job_group[0]
+        if cache_key is not None:
+            hit = caches.result_cache.get(cache_key, conf, tenant)
+            if hit is not None:
+                plan, outs = hit
+                obs_events.EVENTS.emit(
+                    "resultCacheHit", planDigest=cache_key[0],
+                    rows=self._count_rows(outs))
+                if self.capture_plans:
+                    self.captured_plans.append(plan)
+                self._finish_query(plan, ctx, conf, obs_metrics,
+                                   global_before, t_query0, trace_on,
+                                   trace_path, obs_before)
+                return plan, outs, ctx
+        plan = caches.plan_cache.get(cache_key, conf, tenant) \
+            if cache_key is not None else None
+        plan_cache_hit = plan is not None
         overrides = None
-        if conf.sql_enabled:
+        if plan_cache_hit:
+            pass  # tag+convert skipped: the rewrite was cached
+        elif conf.sql_enabled:
             overrides = TpuOverrides(conf)
             plan = overrides.apply(cpu_plan)
             plan = TransitionOverrides(conf).apply(plan)
@@ -547,8 +707,10 @@ class TpuSparkSession:
                 plan = reuse_common_subtrees(plan)
         else:
             plan = cpu_plan
-        if conf.test_enabled:
+        if conf.test_enabled and not plan_cache_hit:
             assert_is_on_tpu(plan, conf)
+        if cache_key is not None and not plan_cache_hit:
+            caches.plan_cache.put(cache_key, plan, conf)
         if self.capture_plans:
             self.captured_plans.append(plan)
         # durable plan facts: structural digest + operator coverage + the
@@ -556,8 +718,12 @@ class TpuSparkSession:
         # the log alone), and one cpuFallback event per tagged-off
         # operator with the tag pass's will-not-work reasons (the
         # explain-why-not record the qualification tool ranks by impact)
+        if plan_cache_hit:
+            obs_events.EVENTS.emit(
+                "planCacheHit", planDigest=obs_events.plan_digest(plan))
         obs_events.EVENTS.emit(
             "queryPlan", planDigest=obs_events.plan_digest(plan),
+            planCacheHit=plan_cache_hit,
             planTree=plan.tree_string()[:20000],
             **self._coverage_fields(plan))
         if ctx.progress is not None:
@@ -592,17 +758,25 @@ class TpuSparkSession:
                 # (a dense-group miss collapses group counts)
                 for sig in ctx.ratio_writes:
                     self.agg_ratio_cache.pop(sig, None)
-                self.release_active_shuffles()
-                self.release_transient_buffers()
+                self.release_active_shuffles(ctx)
+                self.release_transient_buffers(ctx)
                 prev_progress = ctx.progress
                 ctx = ExecContext(conf, self, speculate=False)
                 ctx.progress = prev_progress  # same query, same record
+                # re-point this thread's execution scope at the fresh
+                # context so the re-run's registrations release with IT
+                self._exec_scope.ctx = ctx
                 with TRACER.span("Query", speculative=False,
                                  rerun=True):
                     outs = self._drain(plan, ctx, conf)
         finally:
-            self.release_active_shuffles()
-            self.release_transient_buffers()
+            self.release_active_shuffles(ctx)
+            self.release_transient_buffers(ctx)
+        if cache_key is not None:
+            # opt-in result cache: remember (plan, outputs) for identical
+            # dashboard-style re-submissions (deterministic reads only)
+            caches.result_cache.maybe_put(cache_key, cpu_plan, plan,
+                                          outs, conf, tenant)
         self._finish_query(plan, ctx, conf, obs_metrics, global_before,
                            t_query0, trace_on, trace_path, obs_before)
         return plan, outs, ctx

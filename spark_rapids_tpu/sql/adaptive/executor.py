@@ -72,6 +72,14 @@ class AdaptiveExecutor:
         self.stages: List[ShuffleStage] = []
         self.decisions: List[dict] = []
         self._stage_counter = 0
+        # cross-query exchange reuse (serving/caches.py, opt-in): adopt
+        # an already-materialized stage whose subtree digest matches
+        # instead of recomputing it, and offer fresh stages back
+        self._exchange_cache = None
+        from spark_rapids_tpu.serving import caches as sc
+        if conf.get_bool(sc.EXCHANGE_REUSE_ENABLED, False):
+            self._exchange_cache = \
+                session._serving_bundle().exchange_cache
 
     # -- stage discovery ----------------------------------------------------
     def _next_ready_exchange(self, plan: PhysicalPlan) -> Optional[PhysicalPlan]:
@@ -106,6 +114,31 @@ class AdaptiveExecutor:
         self._stage_counter += 1
         sid = self._stage_counter
         prog = self.ctx.progress  # live stage view (obs/progress.py)
+        # cross-query exchange reuse: a cached stage whose subtree digest
+        # (stage-ref substituted, source-versioned, conf-fingerprinted)
+        # matches is adopted outright — map output and statistics — and
+        # the whole materialization below is skipped
+        reuse_key = None
+        if self._exchange_cache is not None:
+            from spark_rapids_tpu.serving.caches import exchange_reuse_key
+            reuse_key = exchange_reuse_key(exchange, self.conf)
+            adopted = self._exchange_cache.get(
+                reuse_key, tenant=self.session._job_group[0])
+            if adopted is not None:
+                self.stages.append(adopted)  # retained by the cache.get
+                decision = {"rule": "exchangeReuse", "stage": sid,
+                            "reusedFrom": adopted.uid,
+                            "totalBytes": int(adopted.total_bytes),
+                            "partitions": adopted.stats.num_partitions}
+                self._note(decision, "aqeExchangeReuse",
+                           counter="aqe.exchangeReuses")
+                if prog is not None:
+                    prog.aqe_stage_done(
+                        sid, partitions=adopted.stats.num_partitions,
+                        maps=adopted.stats.num_maps,
+                        totalBytes=adopted.stats.total_bytes,
+                        reused=True, compiles=0, compileSeconds=0.0)
+                return adopted
         if prog is not None:
             prog.aqe_stage_running(sid)
         prepared = self._finalize_reads(exchange)
@@ -124,6 +157,7 @@ class AdaptiveExecutor:
         compile_s = round(sum(e["seconds"] for e in stage_compiles), 4)
         stage = ShuffleStage(sid, exchange.output_schema(),
                              exchange.partitioning, map_outputs, stats)
+        stage.reuse_key = reuse_key
         self.stages.append(stage)
         if prog is not None:
             prog.aqe_stage_done(sid, partitions=stats.num_partitions,
@@ -294,7 +328,20 @@ class AdaptiveExecutor:
             outs = self.session._drain(final, self.ctx, self.conf)
         finally:
             # stage outputs are per-query host materializations; a failed
-            # query must not pin them until the next execution
+            # query must not pin them until the next execution. With
+            # exchange reuse on, fresh keyed stages are offered to the
+            # cross-query cache FIRST (it takes its own reference), then
+            # this query's reference drops either way.
+            if self._exchange_cache is not None:
+                from spark_rapids_tpu.serving.caches import (
+                    EXCHANGE_REUSE_MAX_BYTES,
+                )
+                max_bytes = int(self.conf.get(EXCHANGE_REUSE_MAX_BYTES,
+                                              256 << 20))
+                for st in self.stages:
+                    if st.reuse_key is not None:
+                        self._exchange_cache.put(st.reuse_key, st,
+                                                 max_bytes)
             for st in self.stages:
                 st.release()
         self.session.last_aqe = {

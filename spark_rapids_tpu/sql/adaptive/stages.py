@@ -22,6 +22,8 @@ the reference registering map output in the shuffle catalog.
 
 from __future__ import annotations
 
+import itertools
+import threading
 from typing import Iterator, List, Optional, Sequence
 
 import pandas as pd
@@ -60,7 +62,16 @@ class PartialSpec:
 
 
 class ShuffleStage:
-    """One materialized shuffle stage's output + statistics."""
+    """One materialized shuffle stage's output + statistics.
+
+    Reference-counted for cross-query exchange reuse
+    (serving/caches.ExchangeReuseCache): the creating query holds the
+    initial reference, the cache and every adopting query take one each
+    (``retain``), and ``release`` frees the host frames only when the
+    last reference drops — eviction mid-adoption can never free frames
+    a running query still reads."""
+
+    _uids = itertools.count(1)
 
     def __init__(self, stage_id: int, schema: Schema,
                  partitioning, map_outputs: List[List[pd.DataFrame]],
@@ -70,6 +81,13 @@ class ShuffleStage:
         self.partitioning = partitioning
         self.map_outputs = map_outputs
         self.stats = stats
+        # process-unique identity (ids recycle; uids never do) + the
+        # cross-query reuse key the serving cache filed this stage under
+        # (None = not offered / reuse disabled)
+        self.uid = next(ShuffleStage._uids)
+        self.reuse_key = None
+        self._refs = 1
+        self._ref_lock = threading.Lock()
 
     @property
     def n_partitions(self) -> int:
@@ -102,11 +120,23 @@ class ShuffleStage:
                     out.append(f)
         return out
 
+    def retain(self) -> None:
+        """Take one reference (cross-query reuse: the cache and every
+        adopting query hold one)."""
+        with self._ref_lock:
+            self._refs += 1
+
     def release(self) -> None:
-        """Free the materialized host frames (the executed plan object
-        outlives the query in session.last_plan; only the statistics are
-        needed post-hoc)."""
-        self.map_outputs = None
+        """Drop one reference; the materialized host frames free when
+        the LAST reference drops (the executed plan object outlives the
+        query in session.last_plan; only the statistics are needed
+        post-hoc). The pre-serving single-owner behavior is unchanged:
+        one creation reference, one release, frames freed."""
+        with self._ref_lock:
+            self._refs -= 1
+            if self._refs > 0:
+                return
+            self.map_outputs = None
 
 
 class ShuffleStageRef(PhysicalPlan):

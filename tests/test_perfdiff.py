@@ -245,3 +245,73 @@ class TestCompileGate:
         assert rep["compile_regressions"] == ["q1"]
         assert rep["compile_deltas"] == [
             {"query": "q1", "base": 0, "new": 2, "regressed": True}]
+
+
+def _serve(tmp_path, name, qps, verified=True, p50=0.5, p99=1.2,
+           concurrency=8):
+    """A BENCH_SERVE.json-shaped artifact (bench.py --concurrency N)."""
+    doc = {"concurrency": concurrency, "repeats": 2, "jobs": 16,
+           "wall_s": round(16 / qps, 4) if qps else None, "qps": qps,
+           "latency_s": {"p50": p50, "p95": p99 * 0.9, "p99": p99},
+           "timed_compiles": 0, "verified": verified,
+           "tenants": {"tpch": {"plancache_hit_rate": 0.5}}}
+    p = str(tmp_path / name)
+    with open(p, "w") as f:
+        json.dump(doc, f)
+    return p
+
+
+class TestServeGate:
+    def test_detects_serve_artifact(self, tmp_path):
+        p = _serve(tmp_path, "s.json", 4.0)
+        with open(p) as f:
+            doc = json.load(f)
+        s = perfdiff.serve_from_doc(doc)
+        assert s == {"qps": 4.0, "p50": 0.5, "p99": 1.2,
+                     "concurrency": 8, "verified": True}
+        assert perfdiff.serve_from_doc({"queries": {}}) is None
+
+    def test_throughput_ok(self, tmp_path, capsys):
+        base = _serve(tmp_path, "base.json", 4.0)
+        new = _serve(tmp_path, "new.json", 4.2)
+        assert perfdiff.main([base, new]) == 0
+        assert "RESULT: ok" in capsys.readouterr().out
+
+    def test_throughput_within_threshold_ok(self, tmp_path):
+        base = _serve(tmp_path, "base.json", 4.0)
+        new = _serve(tmp_path, "new.json", 3.8)  # -5% < default 10%
+        assert perfdiff.main([base, new]) == 0
+
+    def test_throughput_regression_exits_1(self, tmp_path, capsys):
+        base = _serve(tmp_path, "base.json", 4.0)
+        new = _serve(tmp_path, "new.json", 3.0)  # -25%
+        assert perfdiff.main([base, new]) == 1
+        assert "REGRESSION" in capsys.readouterr().out
+
+    def test_threshold_flag(self, tmp_path):
+        base = _serve(tmp_path, "base.json", 4.0)
+        new = _serve(tmp_path, "new.json", 3.8)
+        assert perfdiff.main([base, new, "--threshold", "0.02"]) == 1
+
+    def test_unverified_new_exits_1(self, tmp_path):
+        # an oracle-verification failure regresses even at higher qps
+        base = _serve(tmp_path, "base.json", 4.0)
+        new = _serve(tmp_path, "new.json", 9.0, verified=False)
+        assert perfdiff.main([base, new]) == 1
+
+    def test_serve_vs_sweep_mismatch_exits_2(self, tmp_path, capsys):
+        serve = _serve(tmp_path, "s.json", 4.0)
+        sweep = _detail(tmp_path, "d.json", {"q1": 2.0})
+        assert perfdiff.main([serve, sweep]) == 2
+        assert "cannot compare" in capsys.readouterr().err
+
+    def test_json_report(self, tmp_path):
+        base = _serve(tmp_path, "base.json", 4.0)
+        new = _serve(tmp_path, "new.json", 3.0)
+        out_p = str(tmp_path / "diff.json")
+        assert perfdiff.main([base, new, "--json", out_p]) == 1
+        with open(out_p) as f:
+            rep = json.load(f)
+        assert rep["mode"] == "serve"
+        assert rep["regressed"] is True
+        assert rep["qps_drift_pct"] == -25.0

@@ -1,0 +1,709 @@
+"""Concurrent query serving: semaphore reconfiguration + tenant quotas,
+the admission scheduler (fair pick, shed, deadlines, cancellation), and
+the cross-query plan/result/exchange caches (hit + invalidation rules).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.serving.cancellation import (
+    CancelScope, QueryCancelled, QueryTimeout,
+)
+from spark_rapids_tpu.sql import functions as F
+
+
+def _fresh_semaphore(permits):
+    with TpuSemaphore._lock:
+        TpuSemaphore._instance = None
+    return TpuSemaphore.get(permits)
+
+
+# ---------------------------------------------------------------------------
+# TpuSemaphore: drain-safe reconfiguration (the singleton race) + quotas
+# ---------------------------------------------------------------------------
+
+class TestSemaphoreReconfigure:
+    def test_get_resizes_live_instance(self):
+        """The pre-serving bug: get() with a new permit count REPLACED
+        the instance while holders existed on the old one, silently
+        over-admitting. get() must now return the same (resized)
+        instance."""
+        sem = _fresh_semaphore(2)
+        sem.acquire_if_necessary(task_id=1)
+        sem2 = TpuSemaphore.get(3)
+        assert sem2 is sem
+        assert sem2.permits == 3
+        # the holder's accounting survived the resize
+        assert sem2.available_permits() == 2
+        sem.release(task_id=1)
+        assert sem2.available_permits() == 3
+
+    def test_shrink_is_drain_safe(self):
+        """Shrinking below the current holder census admits nothing new
+        until holders drain — never revokes, never over-admits."""
+        sem = _fresh_semaphore(2)
+        sem.acquire_if_necessary(task_id=1)
+        sem.acquire_if_necessary(task_id=2)
+        TpuSemaphore.get(1)  # shrink mid-flight
+        acquired = threading.Event()
+
+        def third():
+            sem.acquire_if_necessary(task_id=3)
+            acquired.set()
+        t = threading.Thread(target=third, daemon=True)
+        t.start()
+        time.sleep(0.15)
+        assert not acquired.is_set(), \
+            "shrink admitted a task past the new bound"
+        sem.release(task_id=1)
+        time.sleep(0.15)
+        assert not acquired.is_set(), \
+            "1 holder remains against permits=1; nothing may admit"
+        sem.release(task_id=2)
+        assert acquired.wait(2.0), "freed permit never admitted waiter"
+        sem.release(task_id=3)
+        t.join(2.0)
+
+    def test_grow_wakes_waiters(self):
+        sem = _fresh_semaphore(1)
+        sem.acquire_if_necessary(task_id=1)
+        acquired = threading.Event()
+
+        def second():
+            sem.acquire_if_necessary(task_id=2)
+            acquired.set()
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert not acquired.is_set()
+        TpuSemaphore.get(2)  # grow: waiter admits without any release
+        assert acquired.wait(2.0)
+        sem.release(task_id=1)
+        sem.release(task_id=2)
+        t.join(2.0)
+
+    def test_concurrent_get_single_instance(self):
+        """Hammer get() with varying permits from many threads while
+        holders churn: exactly one instance, never more holders than the
+        final bound allows."""
+        sem = _fresh_semaphore(2)
+        instances = set()
+        stop = threading.Event()
+
+        def churn(tid):
+            while not stop.is_set():
+                s = TpuSemaphore.get(2 + (tid % 2))
+                instances.add(id(s))
+                s.acquire_if_necessary(task_id=tid)
+                s.release(task_id=tid)
+        threads = [threading.Thread(target=churn, args=(i,), daemon=True)
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join(2.0)
+        assert instances == {id(sem)}
+
+    def test_recursive_acquire_still_one_permit(self):
+        sem = _fresh_semaphore(2)
+        sem.acquire_if_necessary(task_id=7)
+        sem.acquire_if_necessary(task_id=7)
+        assert sem.available_permits() == 1
+        sem.release(task_id=7)
+        assert sem.available_permits() == 2
+
+
+class TestTenantQuotas:
+    def test_budget_bounds_tenant_not_device(self):
+        """Tenant A at budget 1 queues its second task while tenant B
+        still admits — one tenant cannot starve the device."""
+        sem = _fresh_semaphore(4)
+        sem.configure_tenants({"a": 1, "b": 2})
+        sem.acquire_if_necessary(task_id=1, tenant="a")
+        blocked = threading.Event()
+        admitted = threading.Event()
+
+        def second_a():
+            blocked.set()
+            sem.acquire_if_necessary(task_id=2, tenant="a")
+            admitted.set()
+        t = threading.Thread(target=second_a, daemon=True)
+        t.start()
+        blocked.wait(2.0)
+        time.sleep(0.1)
+        assert not admitted.is_set(), "tenant budget 1 admitted 2 tasks"
+        # an unrelated tenant is untouched by a's saturation
+        sem.acquire_if_necessary(task_id=3, tenant="b")
+        assert sem.tenant_usage()["a"]["waiting"] == 1
+        sem.release(task_id=1)
+        assert admitted.wait(2.0)
+        sem.release(task_id=2)
+        sem.release(task_id=3)
+        t.join(2.0)
+
+    def test_unbudgeted_tenant_rides_global_limit(self):
+        sem = _fresh_semaphore(2)
+        sem.configure_tenants({"a": 1})
+        sem.acquire_if_necessary(task_id=1, tenant="zzz")
+        sem.acquire_if_necessary(task_id=2, tenant="zzz")
+        assert sem.available_permits() == 0
+        sem.release(task_id=1)
+        sem.release(task_id=2)
+
+    def test_usage_scoreboard(self):
+        sem = _fresh_semaphore(4)
+        sem.configure_tenants({"a": 2}, default=3)
+        sem.acquire_if_necessary(task_id=1, tenant="a")
+        u = sem.tenant_usage()
+        assert u["a"] == {"held": 1, "waiting": 0, "budget": 2}
+        assert sem.tenant_budget("other") == 3
+        sem.release(task_id=1)
+
+
+# ---------------------------------------------------------------------------
+# CancelScope
+# ---------------------------------------------------------------------------
+
+class TestCancelScope:
+    def test_cancel_raises_at_check(self):
+        scope = CancelScope()
+        scope.check()  # no-op
+        scope.cancel("user asked")
+        with pytest.raises(QueryCancelled, match="user asked"):
+            scope.check()
+
+    def test_deadline_raises_timeout(self):
+        scope = CancelScope(deadline_s=0.01)
+        time.sleep(0.03)
+        assert scope.expired()
+        with pytest.raises(QueryTimeout):
+            scope.check()
+        # QueryTimeout is a QueryCancelled (one except clause catches both)
+        assert issubclass(QueryTimeout, QueryCancelled)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler
+# ---------------------------------------------------------------------------
+
+def _dataset(n=64, parts=2):
+    return pd.DataFrame({
+        "k": np.arange(n, dtype=np.int64) % 5,
+        "v": np.arange(n, dtype=np.float64)})
+
+
+def _query(session, df=None):
+    d = session.create_dataframe(df if df is not None else _dataset(), 2)
+    return d.group_by("k").agg(F.sum("v").alias("s"))
+
+
+class SlowSource:
+    """An InMemorySource whose every partition sleeps before yielding:
+    gives cancellation/deadline tests a multi-batch-pull window."""
+
+    def __new__(cls, df, num_partitions, delay_s):
+        from spark_rapids_tpu.sql.sources import InMemorySource
+        src = InMemorySource(df, num_partitions)
+        orig = src.cpu_partitions
+
+        def slow_partitions(ctx):
+            parts = orig(ctx)
+
+            def wrap(p):
+                def run():
+                    time.sleep(delay_s)
+                    return p()
+                return run
+            return [wrap(p) for p in parts]
+        src.cpu_partitions = slow_partitions
+        return src
+
+
+def _slow_df(session, delay_s=0.1, parts=6):
+    from spark_rapids_tpu.session import DataFrame
+    from spark_rapids_tpu.sql import plan as lp
+    src = SlowSource(_dataset(240, parts), parts, delay_s)
+    return DataFrame(session, lp.LogicalScan(src))
+
+
+class TestScheduler:
+    def test_submit_runs_and_matches_oracle(self, session):
+        sched = session.serving_scheduler(workers=2)
+        try:
+            df = _dataset()
+            job = sched.submit(_query(session, df), tenant="t1",
+                               description="smoke")
+            assert job.wait(30) == "succeeded"
+            out = job.get()
+            oracle = df.groupby("k", as_index=False)["v"].sum() \
+                .rename(columns={"v": "s"})
+            got = out.sort_values("k").reset_index(drop=True)
+            exp = oracle.sort_values("k").reset_index(drop=True)
+            assert np.allclose(got["s"].to_numpy(dtype=float),
+                               exp["s"].to_numpy(dtype=float))
+            assert job.query_id is not None
+        finally:
+            sched.close()
+
+    def test_callable_work_and_status(self, session):
+        sched = session.serving_scheduler(workers=1)
+        try:
+            job = sched.submit(lambda s: _query(s), tenant="lazy")
+            assert job.wait(30) == "succeeded"
+            snap = sched.status(job.id)
+            assert snap["status"] == "succeeded"
+            assert snap["tenant"] == "lazy"
+            assert sched.status("job-does-not-exist") is None
+        finally:
+            sched.close()
+
+    def test_load_shed_past_queue_bound(self, session):
+        from spark_rapids_tpu.obs.events import EVENTS
+        sched = session.serving_scheduler(workers=1, max_queue=1)
+        try:
+            blocker = sched.submit(_slow_df(session, delay_s=0.2),
+                                   tenant="a")
+            time.sleep(0.05)  # let the worker pick the blocker up
+            queued = sched.submit(_query(session), tenant="a")
+            shed = sched.submit(_query(session), tenant="b")
+            assert shed.status == "shed"
+            with pytest.raises(Exception, match="queue full"):
+                shed.get(1)
+            kinds = [e["kind"] for e in EVENTS.flight_events()]
+            assert "queryShed" in kinds
+            assert blocker.wait(30) == "succeeded"
+            assert queued.wait(30) == "succeeded"
+            assert sched.snapshot()["shedTotal"] == 1
+        finally:
+            sched.close()
+
+    def test_cancel_queued_job(self, session):
+        sched = session.serving_scheduler(workers=1)
+        try:
+            blocker = sched.submit(_slow_df(session, delay_s=0.3),
+                                   tenant="a")
+            time.sleep(0.05)
+            victim = sched.submit(_query(session), tenant="a")
+            assert sched.cancel(victim.id, "changed my mind")
+            assert victim.wait(5) == "cancelled"
+            with pytest.raises(QueryCancelled):
+                victim.get(1)
+            assert blocker.wait(30) == "succeeded"
+        finally:
+            sched.close()
+
+    def test_cancel_running_job_mid_drain(self, session):
+        """Cooperative cancellation at a batch-pull boundary: the
+        running query stops between partitions and lands 'cancelled'
+        with a queryCancelled journal event carrying the flight tail."""
+        from spark_rapids_tpu.obs.events import EVENTS
+        sched = session.serving_scheduler(workers=1)
+        try:
+            job = sched.submit(_slow_df(session, delay_s=0.15, parts=8),
+                               tenant="a", description="to-cancel")
+            deadline = time.monotonic() + 10
+            while job.status == "queued" and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.1)  # inside the drain now
+            assert sched.cancel(job.id)
+            assert job.wait(20) == "cancelled"
+            evs = [e for e in EVENTS.flight_events()
+                   if e["kind"] == "queryCancelled"]
+            assert evs, "no queryCancelled event journaled"
+            assert "events" in evs[-1]  # flight-recorder tail attached
+        finally:
+            sched.close()
+
+    def test_deadline_timeout_running(self, session):
+        from spark_rapids_tpu.obs.events import EVENTS
+        sched = session.serving_scheduler(workers=1)
+        try:
+            job = sched.submit(_slow_df(session, delay_s=0.15, parts=8),
+                               tenant="a", deadline_s=0.3)
+            assert job.wait(30) == "timeout"
+            with pytest.raises(QueryTimeout):
+                job.get(1)
+            evs = [e for e in EVENTS.flight_events()
+                   if e["kind"] == "queryTimeout"]
+            assert evs
+            assert evs[-1].get("deadlineSeconds") == pytest.approx(0.3)
+        finally:
+            sched.close()
+
+    def test_deadline_burned_in_queue_never_starts(self, session):
+        sched = session.serving_scheduler(workers=1)
+        try:
+            blocker = sched.submit(_slow_df(session, delay_s=0.3),
+                                   tenant="a")
+            time.sleep(0.05)
+            job = sched.submit(_query(session), tenant="a",
+                               deadline_s=0.01)
+            assert job.wait(30) == "timeout"
+            assert "queued" in (job.error or "")
+            assert blocker.wait(30) == "succeeded"
+        finally:
+            sched.close()
+
+    def test_weighted_fair_pick_order(self, session):
+        """With every lane backed up behind one worker, a weight-2
+        tenant is dispatched twice as often as a weight-1 tenant."""
+        session.set_conf("spark.rapids.tpu.serving.tenant.heavy.weight",
+                         2.0)
+        order = []
+        lock = threading.Lock()
+
+        def tracer(name):
+            def fn(s):
+                with lock:
+                    order.append(name)
+                return _query(s)
+            return fn
+        sched = session.serving_scheduler(workers=1)
+        try:
+            blocker = sched.submit(_slow_df(session, delay_s=0.15),
+                                   tenant="light")
+            time.sleep(0.05)
+            jobs = []
+            for i in range(4):
+                jobs.append(sched.submit(tracer(f"h{i}"), tenant="heavy"))
+                jobs.append(sched.submit(tracer(f"l{i}"), tenant="light"))
+            for j in jobs:
+                assert j.wait(60) == "succeeded"
+            assert blocker.wait(30) == "succeeded"
+            # first three dispatches after the blocker: heavy twice per
+            # light once (vtime advances 0.5 vs 1.0)
+            heavy_first = [o for o in order[:3] if o.startswith("h")]
+            assert len(heavy_first) == 2, order
+        finally:
+            sched.close()
+
+    def test_snapshot_shape_and_monitor_route(self, session):
+        from spark_rapids_tpu.serving.scheduler import snapshot_all
+        session.set_conf(
+            "spark.rapids.tpu.serving.tenant.defaultPermits", 1)
+        sched = session.serving_scheduler(workers=2)
+        try:
+            job = sched.submit(_query(session), tenant="snap")
+            job.wait(30)
+            snap = sched.snapshot()
+            assert snap["workers"] == 2
+            assert "snap" in snap["tenants"]
+            assert snap["tenants"]["snap"]["quota"]["budget"] == 1
+            allsnap = snapshot_all()
+            assert any(s["workers"] == 2
+                       for s in allsnap["schedulers"])
+        finally:
+            sched.close()
+            session.set_conf(
+                "spark.rapids.tpu.serving.tenant.defaultPermits", 0)
+
+    def test_close_cancels_pending(self, session):
+        sched = session.serving_scheduler(workers=1)
+        blocker = sched.submit(_slow_df(session, delay_s=0.2),
+                               tenant="a")
+        time.sleep(0.05)
+        pending = sched.submit(_query(session), tenant="a")
+        sched.close(cancel_pending=True)
+        assert pending.status == "cancelled"
+        assert blocker.status in ("succeeded", "cancelled")
+        with pytest.raises(RuntimeError):
+            sched.submit(_query(session))
+
+
+# ---------------------------------------------------------------------------
+# Plan cache
+# ---------------------------------------------------------------------------
+
+def _cache_counters():
+    from spark_rapids_tpu.obs.metrics import REGISTRY
+    out = {}
+    for m in REGISTRY.metrics():
+        if m.name.startswith(("plancache.", "resultcache.",
+                              "exchangereuse.")):
+            out[m.name] = out.get(m.name, 0) + m.value
+    return out
+
+
+class TestPlanCache:
+    def test_repeat_submission_hits(self, session):
+        df = _query(session)
+        before = _cache_counters()
+        out1 = df.collect()
+        out2 = df.collect()
+        delta = {k: v - before.get(k, 0)
+                 for k, v in _cache_counters().items()}
+        assert delta.get("plancache.hits", 0) >= 1
+        pd.testing.assert_frame_equal(
+            out1.sort_values("k").reset_index(drop=True),
+            out2.sort_values("k").reset_index(drop=True))
+
+    def test_hit_executes_clone_not_master(self, session):
+        """Two executions of a cached plan run DIFFERENT plan objects
+        (clones) — concurrent queries must never share per-node state."""
+        df = _query(session)
+        session.capture_plans = True
+        session.captured_plans.clear()
+        try:
+            df.collect()
+            df.collect()
+            p1, p2 = session.captured_plans[-2:]
+            assert p1 is not p2
+            assert p1.tree_string() == p2.tree_string()
+        finally:
+            session.capture_plans = False
+            session.captured_plans.clear()
+
+    def test_conf_change_misses(self, session):
+        df = _query(session)
+        df.collect()
+        before = _cache_counters()
+        session.set_conf("spark.rapids.sql.batchSizeRows", 1 << 19)
+        try:
+            df.collect()
+        finally:
+            session.set_conf("spark.rapids.sql.batchSizeRows", 1 << 20)
+        delta = {k: v - before.get(k, 0)
+                 for k, v in _cache_counters().items()}
+        assert delta.get("plancache.misses", 0) >= 1
+        assert delta.get("plancache.hits", 0) == 0
+
+    def test_table_mtime_change_misses(self, session, tmp_path):
+        path = str(tmp_path / "t.parquet")
+        pd.DataFrame({"a": [1, 2, 3], "b": [1.0, 2.0, 3.0]}) \
+            .to_parquet(path, index=False)
+        q1 = session.read.parquet(path).group_by("a") \
+            .agg(F.sum("b").alias("s"))
+        out1 = q1.collect()
+        assert len(out1) == 3
+        before = _cache_counters()
+        q1.collect()
+        mid = _cache_counters()
+        assert mid.get("plancache.hits", 0) \
+            - before.get("plancache.hits", 0) >= 1
+        # rewrite the table with DIFFERENT data; force a new mtime (the
+        # filesystem's clock granularity can swallow a fast rewrite)
+        pd.DataFrame({"a": [7, 8], "b": [9.0, 9.0]}) \
+            .to_parquet(path, index=False)
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        q2 = session.read.parquet(path).group_by("a") \
+            .agg(F.sum("b").alias("s"))
+        out2 = q2.collect()
+        assert sorted(out2["a"].tolist()) == [7, 8], \
+            "stale plan served old data after table rewrite"
+
+    def test_literal_only_difference_misses(self, session):
+        """Two queries differing ONLY in an expression literal must key
+        differently (regression: the journal's shape-level plan_digest
+        collapsed literal-only differences, so the second query was
+        served the FIRST query's cached plan — startswith('ea') answered
+        startswith('we'))."""
+        df = pd.DataFrame({"region": ["east", "west", "west"],
+                           "x": [1, 2, 3]})
+        a = session.create_dataframe(df, 1).filter(
+            F.col("region").startswith("ea"))
+        b = session.create_dataframe(df, 1).filter(
+            F.col("region").startswith("we"))
+        a.collect()
+        out_b = b.collect()
+        assert set(out_b["region"]) == {"west"}, \
+            "plan cache served a different query's plan"
+        # and the exact-identity layer itself distinguishes them
+        from spark_rapids_tpu.serving.caches import plan_identity
+        session.capture_plans = True
+        session.captured_plans.clear()
+        try:
+            a.collect()
+            b.collect()
+            pa_, pb = session.captured_plans[-2:]
+            assert plan_identity(pa_) != plan_identity(pb)
+        finally:
+            session.capture_plans = False
+            session.captured_plans.clear()
+
+    def test_disabled_never_caches(self, session):
+        session.set_conf("spark.rapids.tpu.serving.planCache.enabled",
+                         False)
+        try:
+            df = _query(session)
+            before = _cache_counters()
+            df.collect()
+            df.collect()
+            delta = {k: v - before.get(k, 0)
+                     for k, v in _cache_counters().items()}
+            assert delta.get("plancache.hits", 0) == 0
+            assert delta.get("plancache.misses", 0) == 0
+        finally:
+            session.set_conf(
+                "spark.rapids.tpu.serving.planCache.enabled", True)
+
+
+# ---------------------------------------------------------------------------
+# Result cache
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def result_cache_session(session):
+    session.set_conf("spark.rapids.tpu.serving.resultCache.enabled", True)
+    yield session
+    session.set_conf("spark.rapids.tpu.serving.resultCache.enabled",
+                     False)
+    session.clear_serving_caches()
+
+
+class TestResultCache:
+    def test_hit_skips_execution(self, result_cache_session):
+        session = result_cache_session
+        df = _query(session)
+        out1 = df.collect()
+        before = _cache_counters()
+        out2 = df.collect()
+        delta = {k: v - before.get(k, 0)
+                 for k, v in _cache_counters().items()}
+        assert delta.get("resultcache.hits", 0) == 1
+        pd.testing.assert_frame_equal(
+            out1.sort_values("k").reset_index(drop=True),
+            out2.sort_values("k").reset_index(drop=True))
+
+    def test_hit_returns_defensive_copy(self, result_cache_session):
+        session = result_cache_session
+        df = _query(session)
+        out1 = df.collect()
+        out1.iloc[:, :] = 0  # vandalize the returned frame
+        out2 = df.collect()
+        assert not (out2["s"] == 0).all(), \
+            "result cache served the caller-mutated frame"
+
+    def test_conf_change_misses(self, result_cache_session):
+        session = result_cache_session
+        df = _query(session)
+        df.collect()
+        session.set_conf("spark.rapids.sql.shuffle.partitions", 3)
+        try:
+            before = _cache_counters()
+            df.collect()
+            delta = {k: v - before.get(k, 0)
+                     for k, v in _cache_counters().items()}
+            assert delta.get("resultcache.hits", 0) == 0
+        finally:
+            session.set_conf("spark.rapids.sql.shuffle.partitions", 8)
+
+    def test_mtime_change_misses(self, result_cache_session, tmp_path):
+        session = result_cache_session
+        path = str(tmp_path / "rc.parquet")
+        pd.DataFrame({"a": [1, 2], "b": [3.0, 4.0]}) \
+            .to_parquet(path, index=False)
+        q = session.read.parquet(path).group_by("a") \
+            .agg(F.sum("b").alias("s"))
+        q.collect()
+        q.collect()  # hit
+        pd.DataFrame({"a": [5], "b": [6.0]}).to_parquet(path, index=False)
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        out = session.read.parquet(path).group_by("a") \
+            .agg(F.sum("b").alias("s")).collect()
+        assert out["a"].tolist() == [5], \
+            "result cache served stale data after table rewrite"
+
+    def test_nondeterministic_never_cached(self, result_cache_session):
+        session = result_cache_session
+        base = session.create_dataframe(_dataset(32), 2)
+        q = base.with_column("r", F.rand(3))
+        before = _cache_counters()
+        q.collect()
+        q.collect()
+        delta = {k: v - before.get(k, 0)
+                 for k, v in _cache_counters().items()}
+        assert delta.get("resultcache.hits", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# Exchange reuse (AQE)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def aqe_reuse_session(session):
+    session.set_conf("spark.rapids.sql.adaptive.enabled", True)
+    session.set_conf("spark.rapids.tpu.serving.exchangeReuse.enabled",
+                     True)
+    yield session
+    session.set_conf("spark.rapids.sql.adaptive.enabled", False)
+    session.set_conf("spark.rapids.tpu.serving.exchangeReuse.enabled",
+                     False)
+    session.clear_serving_caches()
+
+
+def _join_query(session, left, right):
+    ldf = session.create_dataframe(left, 2)
+    rdf = session.create_dataframe(right, 2)
+    return ldf.join(rdf, left_on="k", right_on="j") \
+        .group_by("k").agg(F.sum("w").alias("sw"))
+
+
+class TestExchangeReuse:
+    def test_second_query_adopts_stage(self, aqe_reuse_session):
+        session = aqe_reuse_session
+        rng = np.random.default_rng(7)
+        left = pd.DataFrame({"k": rng.integers(0, 20, 400),
+                             "v": rng.normal(size=400)})
+        right = pd.DataFrame({"j": np.arange(20), "w": np.ones(20)})
+        q = _join_query(session, left, right)
+        out1 = q.collect()
+        aqe1 = session.last_aqe
+        assert aqe1 is not None and aqe1["stages"] >= 1
+        before = _cache_counters()
+        out2 = q.collect()
+        delta = {k: v - before.get(k, 0)
+                 for k, v in _cache_counters().items()}
+        assert delta.get("exchangereuse.hits", 0) >= 1, \
+            (delta, session.last_aqe)
+        assert any(d["rule"] == "exchangeReuse"
+                   for d in session.last_aqe["decisions"])
+        pd.testing.assert_frame_equal(
+            out1.sort_values("k").reset_index(drop=True),
+            out2.sort_values("k").reset_index(drop=True))
+
+    def test_reused_stage_survives_first_query_release(self,
+                                                       aqe_reuse_session):
+        """Refcounting: the first query's end-of-query release must not
+        free map output the cache still owns."""
+        session = aqe_reuse_session
+        rng = np.random.default_rng(8)
+        left = pd.DataFrame({"k": rng.integers(0, 10, 200),
+                             "v": rng.normal(size=200)})
+        right = pd.DataFrame({"j": np.arange(10), "w": np.ones(10)})
+        q = _join_query(session, left, right)
+        q.collect()
+        cache = session._serving_bundle().exchange_cache
+        stats = cache.stats()
+        assert stats["entries"] >= 1
+        with cache._lock:
+            for st in cache._entries.values():
+                assert st.map_outputs is not None, \
+                    "cached stage's frames were freed by query release"
+
+    def test_data_change_misses(self, aqe_reuse_session):
+        session = aqe_reuse_session
+        rng = np.random.default_rng(9)
+        right = pd.DataFrame({"j": np.arange(10), "w": np.ones(10)})
+
+        def fresh_left():
+            return pd.DataFrame({
+                "k": rng.integers(0, 10, 3000),
+                "v": rng.normal(size=3000),
+                "pad": rng.normal(size=3000)})
+        q1 = _join_query(session, fresh_left(), right)
+        q1.collect()
+        before = _cache_counters()
+        # same SHAPE, different data (big frames -> uid-versioned)
+        q2 = _join_query(session, fresh_left(), right)
+        q2.collect()
+        delta = {k: v - before.get(k, 0)
+                 for k, v in _cache_counters().items()}
+        assert delta.get("exchangereuse.hits", 0) == 0

@@ -1,0 +1,203 @@
+"""Concurrent-serving stress: the acceptance sweep of the serving layer.
+
+An 8-worker mixed-tenant sweep (tpch + tpcxbb lanes) through the
+admission scheduler, asserting the full contract at once:
+
+  * every concurrent result is byte-identical to the serial run of the
+    same query (which is itself verified against the CPU oracle);
+  * >1 query is provably in flight (overlapping progress-record windows
+    AND the scheduler's peak_running);
+  * no tenant ever exceeds its HBM permit budget (the semaphore's
+    tenant scoreboard sampled throughout the sweep);
+  * repeated submissions hit the cross-query plan cache — zero
+    re-planning — and the concurrent phase compiles NOTHING
+    (timed_compiles == 0, the PR 6 tier-1 invariant carried into
+    serving).
+"""
+
+import threading
+import time
+
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.models import tpch_data, tpcxbb_data
+from spark_rapids_tpu.models.tpch import QUERIES as TPCH_QUERIES
+from spark_rapids_tpu.models.tpcxbb import QUERIES as BB_QUERIES
+from tests.querytest import assert_frames_equal
+
+SF_TPCH = 0.002   # ~12K lineitem rows
+SF_BB = 0.05      # ~2K store_sales rows
+
+# two tenants, mixed suites: the sweep each tenant submits
+SWEEP = [
+    ("tpch", "q1"), ("tpch", "q6"), ("tpch", "q14"),
+    ("tpcxbb", "q9"), ("tpcxbb", "q7"),
+]
+
+_COMPILES = {"n": 0, "armed": False}
+
+
+def _on_event(name, dur, **kw):
+    if _COMPILES["armed"] and "backend_compile" in name:
+        _COMPILES["n"] += 1
+
+
+_LISTENER = {"installed": False}
+
+
+def _arm_compile_listener():
+    if not _LISTENER["installed"]:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_event)
+        _LISTENER["installed"] = True
+
+
+@pytest.fixture(scope="module")
+def stress_tables():
+    tpch = {name: gen(SF_TPCH)
+            for name, gen in tpch_data.ALL_TABLES.items()}
+    tpch["nation"] = tpch_data.gen_nation()
+    tpch["region"] = tpch_data.gen_region()
+    bb = {name: fn(SF_BB, None)
+          for name, fn in tpcxbb_data.ALL_TABLES.items()}
+    return {"tpch": tpch, "tpcxbb": bb}
+
+
+def _build_query(session, suite, qname, pandas_tables):
+    tables = {name: session.create_dataframe(
+        df, 3 if len(df) > 100 else 1)
+        for name, df in pandas_tables[suite].items()}
+    queries = TPCH_QUERIES if suite == "tpch" else BB_QUERIES
+    return queries[qname](session, tables)
+
+
+def _canon(df: pd.DataFrame) -> pd.DataFrame:
+    if not len(df):
+        return df.reset_index(drop=True)
+    return df.sort_values(list(df.columns), kind="mergesort") \
+        .reset_index(drop=True)
+
+
+def test_eight_way_concurrent_mixed_tenant_sweep(session, stress_tables):
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    from spark_rapids_tpu.obs import monitor as obs_monitor
+    from spark_rapids_tpu.obs.metrics import REGISTRY
+    from spark_rapids_tpu.obs.progress import PROGRESS
+
+    session.set_conf("spark.rapids.sql.enabled", True)
+    session.set_conf("spark.rapids.sql.shuffle.partitions", 2)
+    session.set_conf("spark.rapids.sql.exec.CartesianProductExec", True)
+    # tenant HBM quotas: 3 device slots, each tenant budgeted to 2 — a
+    # saturated tenant queues while the other still admits
+    session.set_conf("spark.rapids.sql.concurrentTpuTasks", 3)
+    session.set_conf("spark.rapids.tpu.serving.tenant.tpch.permits", 2)
+    session.set_conf("spark.rapids.tpu.serving.tenant.tpcxbb.permits", 2)
+    old_permits = session.semaphore.permits
+    session.semaphore = TpuSemaphore.get(3)
+    # progress records (the interleaving evidence) need the tracker on,
+    # and the conf must be FINAL before the serial pass: the plan cache
+    # keys on the conf fingerprint, and the repeat submissions below
+    # must hit entries the serial pass created
+    session.set_conf("spark.rapids.tpu.ui.enabled", True)
+    session.set_conf("spark.rapids.tpu.ui.port", 0)
+
+    # DataFrames are built once and submitted repeatedly: the repeat
+    # submissions are what must hit the plan cache
+    frames = {}
+    for suite, qname in SWEEP:
+        frames[(suite, qname)] = _build_query(session, suite, qname,
+                                              stress_tables)
+
+    # serial reference pass: CPU oracle + warmed serial TPU results
+    # (warm until a run compiles nothing — adaptive paths legitimately
+    # change the compiled program over the first few executions)
+    _arm_compile_listener()
+    serial, oracle = {}, {}
+    for key, df in frames.items():
+        session.set_conf("spark.rapids.sql.enabled", False)
+        oracle[key] = df.collect()
+        session.set_conf("spark.rapids.sql.enabled", True)
+        for _ in range(4):
+            c0 = _COMPILES["n"]
+            _COMPILES["armed"] = True
+            serial[key] = df.collect()
+            _COMPILES["armed"] = False
+            if _COMPILES["n"] == c0:
+                break
+        assert_frames_equal(serial[key], oracle[key],
+                            ignore_order=True, approx=True)
+
+    obs_monitor.maybe_serve(session.conf)
+    assert PROGRESS.enabled
+
+    plancache_hits0 = sum(
+        m.value for m in REGISTRY.metrics()
+        if m.name == "plancache.hits")
+
+    sched = session.serving_scheduler(workers=8)
+    quota_violations = []
+    stop_sampling = threading.Event()
+
+    def sample_quotas():
+        sem = session.semaphore
+        while not stop_sampling.is_set():
+            for t, u in sem.tenant_usage().items():
+                if u["budget"] and u["held"] > u["budget"]:
+                    quota_violations.append((t, dict(u)))
+            time.sleep(0.002)
+    sampler = threading.Thread(target=sample_quotas, daemon=True)
+    sampler.start()
+
+    repeats = 2
+    jobs = []
+    try:
+        _COMPILES["armed"] = True
+        c0 = _COMPILES["n"]
+        for _ in range(repeats):
+            for (suite, qname), df in frames.items():
+                jobs.append(((suite, qname), sched.submit(
+                    df, tenant=suite, description=f"{suite}.{qname}")))
+        assert sched.drain(timeout=480), "sweep did not drain"
+        _COMPILES["armed"] = False
+        timed_compiles = _COMPILES["n"] - c0
+        snap = sched.snapshot()
+    finally:
+        _COMPILES["armed"] = False
+        stop_sampling.set()
+        sampler.join(2.0)
+        sched.close()
+        obs_monitor.stop()
+        session.set_conf("spark.rapids.tpu.ui.enabled", False)
+        session.semaphore.configure_tenants({}, default=0)
+        session.semaphore = TpuSemaphore.get(old_permits)
+
+    # 1) every job succeeded, byte-identical to its serial run
+    for key, job in jobs:
+        assert job.status == "succeeded", (key, job.status, job.error)
+        pd.testing.assert_frame_equal(_canon(job.result),
+                                      _canon(serial[key]))
+
+    # 2) >1 query provably in flight: the scheduler saw it AND the
+    # progress records' execution windows overlap
+    assert snap["peakRunning"] > 1, snap
+    windows = [(q["start_ts"], q["end_ts"])
+               for q in PROGRESS.queries(full=False)
+               if q["end_ts"] is not None]
+    overlaps = sum(
+        1 for i, (s1, e1) in enumerate(windows)
+        for (s2, e2) in windows[i + 1:]
+        if s1 < e2 and s2 < e1)
+    assert overlaps >= 1, "no overlapping query windows recorded"
+
+    # 3) no tenant ever exceeded its HBM permit budget
+    assert not quota_violations, quota_violations[:5]
+
+    # 4) repeat submissions hit the plan cache (zero re-planning) and
+    # the concurrent phase compiled NOTHING (the PR 6 invariant)
+    plancache_hits = sum(
+        m.value for m in REGISTRY.metrics()
+        if m.name == "plancache.hits") - plancache_hits0
+    assert plancache_hits >= len(SWEEP) * repeats, plancache_hits
+    assert timed_compiles == 0, \
+        f"concurrent serving re-compiled {timed_compiles} kernels"

@@ -218,6 +218,7 @@ _CSS = """
  td,th{border:1px solid #ccc;padding:3px 8px;text-align:left}
  pre{background:#f0f0f0;padding:0.8em;overflow-x:auto}
  .failed{color:#c00}.success{color:#080}.unknown{color:#888}
+ .cancelled{color:#c80}.timeout{color:#c80}
  .bar{background:#9bd;display:inline-block;height:0.8em}
  a{color:inherit}
 """
@@ -257,7 +258,13 @@ def render_index(store: HistoryStore) -> str:
         f"<body><h3>spark-rapids-tpu history server</h3>"
         f"<p>{t.get('queries', 0)} queries "
         f"({t.get('succeeded', 0)} succeeded, {t.get('failed', 0)} "
-        f"failed), mean coverage {t.get('mean_coverage_pct')}% &middot; "
+        f"failed"
+        + (f", {t.get('cancelled', 0)} cancelled, "
+           f"{t.get('timed_out', 0)} timed out"
+           if t.get("cancelled") or t.get("timed_out") else "")
+        + (f", {t.get('plan_cache_hits', 0)} plan-cache hits"
+           if t.get("plan_cache_hits") else "")
+        + f"), mean coverage {t.get('mean_coverage_pct')}% &middot; "
         f"<a href='/api/report'>/api/report</a> &middot; "
         f"<a href='/api/tenants'>/api/tenants</a></p>"
         f"<table><tr><th>query</th><th>tenant</th><th>status</th>"
@@ -288,6 +295,21 @@ def render_query_page(r: Dict[str, Any], detail: Dict[str, Any]) -> str:
         f"compile {r['compile']['seconds']:.2f}s</p>")
     if r.get("error"):
         out.append(f"<p class='failed'>error: {_esc(r['error'])}</p>")
+    serving = r.get("serving") or {}
+    if serving.get("interrupted"):
+        d = serving.get("deadline_s")
+        out.append(
+            f"<p class='{_esc(r['status'])}'>serving: query "
+            f"{_esc(serving['interrupted'])}"
+            + (f" (deadline {_esc(d)}s)" if d else "")
+            + (", flight-recorder tail attached in the journal"
+               if r.get("flight_dumped") else "") + "</p>")
+    if serving.get("plan_cache_hit") or serving.get("result_cache_hit"):
+        hits = [k for k in ("plan_cache_hit", "result_cache_hit")
+                if serving.get(k)]
+        out.append(f"<p>serving caches: "
+                   f"{_esc(', '.join(h.replace('_', ' ') for h in hits))}"
+                   f"</p>")
     if r["fallbacks"]:
         out.append("<h4>CPU fallbacks (ranked by time impact)</h4>"
                    "<table><tr><th>operator</th><th>impact_s</th>"

@@ -12,7 +12,12 @@ shapes the harness produces:
     ``bench: <q> tpu=..s cpu=..s speedup=..x`` lines, the geomean from
     ``parsed.value``;
   * a bare summary line — ``{"metric": ..., "value": geomean}``
-    (geomean-only comparison).
+    (geomean-only comparison);
+  * ``BENCH_SERVE.json`` — the serve-mode artifact ``bench.py
+    --concurrency N`` writes; when BOTH sides are serve artifacts the
+    gate switches to **throughput**: NEW qps dropping more than
+    ``--threshold`` below BASE (or NEW failing oracle verification)
+    exits 1.
 
 Besides speedups, the gate also compares **steady-state compile counts**
 (``timed_compiles`` — XLA backend compiles during the timed iterations,
@@ -103,6 +108,61 @@ def compiles_from_doc(doc: Dict[str, Any]) -> Dict[str, int]:
                 for m in _TAIL_COMPILES_RE.finditer(
                     str(doc.get("tail", "")))}
     return {}
+
+
+def serve_from_doc(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Serve-mode artifact (``BENCH_SERVE.json`` from ``bench.py
+    --concurrency N``): throughput + latency quantiles. None when the
+    doc is not a serve artifact."""
+    if "qps" not in doc or "latency_s" not in doc:
+        return None
+    lat = doc.get("latency_s") or {}
+    return {"qps": float(doc["qps"]) if doc["qps"] else None,
+            "p50": lat.get("p50"), "p99": lat.get("p99"),
+            "concurrency": doc.get("concurrency"),
+            "verified": doc.get("verified")}
+
+
+def compare_serve(base: Dict[str, Any], new: Dict[str, Any],
+                  threshold: float) -> Dict[str, Any]:
+    """Serve-mode throughput gate: NEW qps dropping more than
+    ``threshold`` below BASE regresses (same bound as a per-query
+    speedup), as does a NEW sweep that failed verification."""
+    qb, qn = base.get("qps"), new.get("qps")
+    drift = (qn / qb - 1.0) if qb and qn else None
+    regressed = (drift is not None and drift < -threshold) \
+        or new.get("verified") is False
+    return {
+        "mode": "serve",
+        "concurrency_base": base.get("concurrency"),
+        "concurrency_new": new.get("concurrency"),
+        "qps_base": qb, "qps_new": qn,
+        "qps_drift_pct": round(100.0 * drift, 2)
+        if drift is not None else None,
+        "p99_base": base.get("p99"), "p99_new": new.get("p99"),
+        "threshold_pct": round(100.0 * threshold, 2),
+        "new_verified": new.get("verified"),
+        "regressed": regressed,
+    }
+
+
+def render_serve_text(rep: Dict[str, Any]) -> str:
+    lines = [
+        f"perfdiff (serve mode): qps {rep['qps_base']} -> "
+        f"{rep['qps_new']}"
+        + (f" ({rep['qps_drift_pct']:+.2f}%)"
+           if rep["qps_drift_pct"] is not None else "")
+        + f", p99 {rep['p99_base']}s -> {rep['p99_new']}s"]
+    if rep["new_verified"] is False:
+        lines.append("-- NEW serve sweep FAILED result verification")
+    if rep["regressed"] and rep["qps_drift_pct"] is not None \
+            and rep["qps_drift_pct"] < -rep["threshold_pct"]:
+        lines.append(f"-- THROUGHPUT REGRESSION: qps drift "
+                     f"{rep['qps_drift_pct']:+.2f}% exceeds "
+                     f"-{rep['threshold_pct']:.0f}%")
+    lines.append("RESULT: " + ("REGRESSED" if rep["regressed"]
+                               else "ok"))
+    return "\n".join(lines)
 
 
 def _geomean(values) -> Optional[float]:
@@ -232,6 +292,24 @@ def main(argv=None) -> int:
     try:
         base_doc = _read_doc(args.base)
         new_doc = _read_doc(args.new)
+        # serve-mode artifacts (bench.py --concurrency) gate on
+        # throughput instead of per-query speedups
+        base_serve = serve_from_doc(base_doc)
+        new_serve = serve_from_doc(new_doc)
+        if base_serve is not None and new_serve is not None:
+            rep = compare_serve(base_serve, new_serve, args.threshold)
+            if args.json == "-":
+                print(json.dumps(rep, indent=1))
+            else:
+                print(render_serve_text(rep))
+                if args.json:
+                    with open(args.json, "w") as f:
+                        json.dump(rep, f, indent=1)
+            return 1 if rep["regressed"] else 0
+        if (base_serve is None) != (new_serve is None):
+            raise ValueError(
+                "cannot compare a serve-mode artifact against a sweep "
+                "artifact (one side has 'qps', the other does not)")
         base, base_geo = sweep_from_doc(base_doc, args.base)
         new, new_geo = sweep_from_doc(new_doc, args.new)
         base_c = {} if args.ignore_compiles \

@@ -106,7 +106,10 @@ def _new_record(name: str, source: str) -> Dict[str, Any]:
         "shuffle_skew": {"shuffles": 0, "max_ratio": None,
                          "max_bytes": 0},
         "aqe": {"adaptive": False, "stages": 0, "coalesced_reads": 0,
-                "broadcast_demotions": 0, "skew_splits": 0},
+                "broadcast_demotions": 0, "skew_splits": 0,
+                "exchange_reuses": 0},
+        "serving": {"plan_cache_hit": False, "result_cache_hit": False,
+                    "interrupted": None, "deadline_s": None},
         "flight_dumped": False, "error": None,
     }
 
@@ -242,6 +245,22 @@ def records_from_events(events: List[Dict[str, Any]],
         elif kind == "aqeSkewSplit":
             r["aqe"]["adaptive"] = True
             r["aqe"]["skew_splits"] += 1
+        elif kind == "aqeExchangeReuse":
+            r["aqe"]["adaptive"] = True
+            r["aqe"]["exchange_reuses"] += 1
+        elif kind == "planCacheHit":
+            r["serving"]["plan_cache_hit"] = True
+        elif kind == "resultCacheHit":
+            r["serving"]["result_cache_hit"] = True
+        elif kind in ("queryCancelled", "queryTimeout"):
+            # serving-layer interruption: the event carries the
+            # flight-recorder tail; queryEnd lands the terminal status
+            r["serving"]["interrupted"] = \
+                "timeout" if kind == "queryTimeout" else "cancelled"
+            if ev.get("deadlineSeconds") is not None:
+                r["serving"]["deadline_s"] = ev["deadlineSeconds"]
+            if ev.get("events"):
+                r["flight_dumped"] = True
         elif kind == "flightRecorder":
             r["flight_dumped"] = True
     for r in out:
@@ -368,10 +387,19 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         a["ops"] = sorted(a["ops"])
     n_ok = sum(1 for r in records if r["status"] == "success")
     n_fail = sum(1 for r in records if r["status"] == "failed")
+    n_cancel = sum(1 for r in records if r["status"] == "cancelled")
+    n_timeout = sum(1 for r in records if r["status"] == "timeout")
     covs = [r["coverage_pct"] for r in records
             if r["coverage_pct"] is not None]
     totals = {
         "queries": len(records), "succeeded": n_ok, "failed": n_fail,
+        "cancelled": n_cancel, "timed_out": n_timeout,
+        "plan_cache_hits": sum(
+            1 for r in records
+            if r.get("serving", {}).get("plan_cache_hit")),
+        "result_cache_hits": sum(
+            1 for r in records
+            if r.get("serving", {}).get("result_cache_hit")),
         "mean_coverage_pct": round(sum(covs) / len(covs), 2)
         if covs else None,
         "fully_on_tpu": sum(1 for c in covs if c >= 100.0),
@@ -404,9 +432,17 @@ def _fmt_bytes(n: int) -> str:
 def render_text(report: Dict[str, Any], top_n: int = 15) -> str:
     t = report["totals"]
     lines: List[str] = []
+    interrupted = t.get("cancelled", 0) + t.get("timed_out", 0)
     lines.append(
         f"workload qualification: {t['queries']} queries "
-        f"({t['succeeded']} succeeded, {t['failed']} failed), "
+        f"({t['succeeded']} succeeded, {t['failed']} failed"
+        + (f", {t.get('cancelled', 0)} cancelled, "
+           f"{t.get('timed_out', 0)} timed out" if interrupted else "")
+        + (f", {t['plan_cache_hits']} plan-cache hits"
+           if t.get("plan_cache_hits") else "")
+        + (f", {t['result_cache_hits']} result-cache hits"
+           if t.get("result_cache_hits") else "")
+        + "), "
         f"mean TPU op coverage "
         f"{t['mean_coverage_pct'] if t['mean_coverage_pct'] is not None else '?'}%, "
         f"{t['fully_on_tpu']} fully on TPU")
@@ -513,6 +549,21 @@ def render_text(report: Dict[str, Any], top_n: int = 15) -> str:
             dump = " [flight recorder dumped]" if r["flight_dumped"] else ""
             lines.append(f"   {r['query']}: {r['error'] or '?'}"[:140]
                          + dump)
+    # serving-layer interruptions: cancels and deadline timeouts (the
+    # dedicated events carry the flight-recorder tail)
+    stopped = [r for r in report["queries"]
+               if r["status"] in ("cancelled", "timeout")]
+    if stopped:
+        lines.append("")
+        lines.append("-- cancelled / timed-out queries")
+        for r in stopped:
+            d = r.get("serving", {}).get("deadline_s")
+            extra = f" (deadline {d}s)" if d else ""
+            dump = " [flight recorder attached]" \
+                if r["flight_dumped"] else ""
+            lines.append(
+                f"   {r['query']}: {r['status']}{extra}: "
+                f"{r['error'] or '?'}"[:140] + dump)
     return "\n".join(lines)
 
 
