@@ -186,6 +186,33 @@ def _results_match(tpu_df, cpu_df) -> bool:
     return True
 
 
+def _breakdown_totals(profile_json):
+    """Sum the per-node device/transfer/dispatch breakdown rows of one
+    profile JSON (recorded under profile.syncEachOp) into whole-query
+    totals + the dispatch share tools/perfdiff.py gates on. None when
+    the profile carries no breakdown."""
+    tot = {"device_s": 0.0, "transfer_s": 0.0, "dispatch_s": 0.0}
+
+    def rec(node):
+        bd = node.get("breakdown")
+        if bd:
+            for k in tot:
+                tot[k] += float(bd.get(k, 0.0) or 0.0)
+        for c in node.get("children", ()):
+            rec(c)
+    tree = (profile_json or {}).get("plan")
+    if not tree:
+        return None
+    rec(tree)
+    total = sum(tot.values())
+    if total <= 0:
+        return None
+    return {"device_s": round(tot["device_s"], 4),
+            "transfer_s": round(tot["transfer_s"], 4),
+            "dispatch_s": round(tot["dispatch_s"], 4),
+            "dispatch_share": round(tot["dispatch_s"] / total, 4)}
+
+
 def _worker():
     sf = float(os.environ.get("BENCH_SF", "0.5"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
@@ -207,7 +234,12 @@ def _worker():
         "spark.rapids.sql.enabled", True).config(
         # symmetric residency: the CPU path holds its pandas tables in
         # RAM, the TPU path holds uploaded scan batches in HBM
-        "spark.rapids.sql.cacheDeviceScans", True).get_or_create()
+        "spark.rapids.sql.cacheDeviceScans", True).config(
+        # whole-stage fusion (exec/stagecompiler): bench default ON —
+        # the dispatch-bound laggards are the queries it exists for;
+        # BENCH_FUSION=0 reproduces the per-operator plans
+        "spark.rapids.sql.fusion.stageEnabled",
+        os.environ.get("BENCH_FUSION", "1") != "0").get_or_create()
 
     # --event-log: every query of the sweep journals durable facts
     # (query lifecycle, fallbacks, spills, retries, compiles) so the run
@@ -320,6 +352,25 @@ def _worker():
         prof = getattr(session, "last_profile", None)
         if prof is not None:
             rec["_profile"] = prof.to_json()
+
+        # device/transfer/dispatch shares: one extra UNTIMED run under
+        # profile.syncEachOp so BENCH_DETAIL carries the per-query
+        # breakdown the dispatch-share perfdiff gate compares between
+        # sweeps (ROADMAP item 2's "dispatch_s share collapses" is a
+        # gated number, not a one-off observation). BENCH_BREAKDOWN=0
+        # skips the extra run.
+        if os.environ.get("BENCH_BREAKDOWN", "1") != "0":
+            session.set_conf("spark.rapids.sql.profile.syncEachOp", True)
+            try:
+                run_query(fn, True)
+                prof_bd = getattr(session, "last_profile", None)
+                bd = _breakdown_totals(prof_bd.to_json()) \
+                    if prof_bd is not None else None
+            finally:
+                session.set_conf("spark.rapids.sql.profile.syncEachOp",
+                                 False)
+            if bd is not None:
+                rec.update(bd)
 
         run_query(fn, False)  # warm CPU caches too
         cpu_iters = []
@@ -880,10 +931,13 @@ def main():
             rec = reply["result"]
             detail[name] = rec
             speedups.append(rec["speedup"])
+            dshare = (f" dispatch_share={rec['dispatch_share']:.2f}"
+                      if "dispatch_share" in rec else "")
             print(f"bench: {name} tpu={rec['tpu_s']:.2f}s "
                   f"cpu={rec['cpu_s']:.2f}s speedup={rec['speedup']:.2f}x "
                   f"(timed_compiles={rec['timed_compiles']} "
-                  f"warm={rec['warm_s']:.1f}s/{rec['warm_compiles']}c)",
+                  f"warm={rec['warm_s']:.1f}s/{rec['warm_compiles']}c)"
+                  f"{dshare}",
                   file=sys.stderr, flush=True)
         # serve-mode phase (--concurrency N): every successfully-built
         # suite's scored queries re-submitted through the scheduler

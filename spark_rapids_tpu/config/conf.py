@@ -418,6 +418,49 @@ STAGE_FUSION = register(
     "single XLA executable so the compiler fuses them. TPU-first feature with "
     "no reference equivalent: cuDF dispatches one kernel per op.")
 
+FUSION_STAGE_ENABLED = register(
+    "spark.rapids.sql.fusion.stageEnabled", _to_bool, False,
+    "Whole-stage fusion (exec/stagecompiler/): cut the converted physical "
+    "plan into fusible pipelines at exchange/scan/fallback boundaries and "
+    "emit ONE jit-compiled program per pipeline (TpuFusedStageExec) "
+    "instead of one dispatch per operator — chains of deterministic "
+    "Project/Filter (with interleaved batch coalescing absorbed) run as a "
+    "single XLA executable with the intermediate buffers donated inside "
+    "the program. false (default) keeps today's per-operator plans "
+    "byte-identical; the bench harness turns it on. Fused stages report "
+    "their member-operator pipeline to the compile ledger, profile tree, "
+    "progress records and flight recorder.")
+
+FUSION_MIN_OPS = register(
+    "spark.rapids.sql.fusion.minOperators", int, 2,
+    "Minimum number of compute operators (projects/filters) a pipeline "
+    "must contain before whole-stage fusion replaces it with a fused "
+    "stage; shorter chains keep their standalone kernels (fusing one "
+    "operator only renames its dispatch).", validator=_positive)
+
+FUSION_DONATE = register(
+    "spark.rapids.sql.fusion.donateInputs", _to_bool, False,
+    "Donate the input batch's device buffers to the fused-stage program "
+    "(jax donate_argnums), letting XLA reuse them for the stage's "
+    "intermediates. Only applied when the stage input is a known "
+    "single-consumer producer (exchange/join/aggregate output) AND "
+    "spark.rapids.sql.reuseSubtrees.enabled is false — the reuse pass "
+    "rewrites the tree after stage cutting and replays the same batches "
+    "to every consumer of a shared subtree, which donation must never "
+    "touch. Off by default: within one fused program XLA already reuses "
+    "intermediate buffers, donation only adds the input itself.")
+
+FUSION_HASH_KERNELS = register(
+    "spark.rapids.sql.fusion.hashKernels", _to_bool, True,
+    "Allow the Pallas open-addressing hash-table kernels "
+    "(ops/pallas_kernels.py) to replace the sort-based fallbacks: the "
+    "union-lexsort join probe (exec/tpujoin.py) for equi joins whose "
+    "key columns are all fixed-width (single or multi-column; string "
+    "keys keep the sort probe), and the sorted count-distinct pass "
+    "(exec/aggfuse.py). Only effective when SPARK_RAPIDS_TPU_PALLAS "
+    "selects the pallas (or interpret) path — the default jnp mode keeps "
+    "the sort spellings byte-identical.")
+
 JOIN_EXACT_LONG_STRINGS = register(
     "spark.rapids.sql.join.exactLongStrings", _to_bool, True,
     "String join keys longer than the 64-byte sort prefix are verified "

@@ -103,8 +103,11 @@ class PhysicalPlan:
                         # operator scope: a backend compile fired by a
                         # kernel call inside this pull attributes to
                         # THIS operator (obs/compileledger.py), and
-                        # transfer sites report their seconds against it
-                        prev_op = compileledger.push_op(op, node_id, ctx)
+                        # transfer sites report their seconds against it.
+                        # Fused stages publish their member pipeline too.
+                        prev_op = compileledger.push_op(
+                            op, node_id, ctx,
+                            getattr(self, "member_ops", None))
                         try:
                             batch = next(it)
                         except StopIteration:

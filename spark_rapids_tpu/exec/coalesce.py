@@ -36,6 +36,34 @@ class RequireSingleBatch(CoalesceGoal):
         return "RequireSingleBatch"
 
 
+def coalesce_iter(batches, goal: CoalesceGoal, schema: Schema,
+                  growth: float) -> Iterator[DeviceBatch]:
+    """Accumulate a batch stream to ``goal`` and concatenate — the one
+    coalescing loop, shared by TpuCoalesceBatchesExec and the fused
+    stage's input re-batching (exec/stagecompiler/fusedexec.py).
+
+    Capacity-based accounting: an exact count would cost a device->host
+    scalar sync per batch (~hundreds of ms through remote attachments);
+    the bucketed capacity over-estimates by at most 2x, which only makes
+    coalesced outputs slightly smaller than the goal."""
+    from spark_rapids_tpu.exec.tpu import _concat_device
+    single = isinstance(goal, RequireSingleBatch)
+    target = 0 if single else goal.rows
+    pending: List[DeviceBatch] = []
+    pending_rows = 0
+    for batch in batches:
+        rows = batch.num_rows_hint()
+        if rows == 0 and pending:
+            continue  # drop known-empty fragments
+        pending.append(batch)
+        pending_rows += rows
+        if not single and pending_rows >= target:
+            yield _concat_device(pending, schema, growth)
+            pending, pending_rows = [], 0
+    if pending:
+        yield _concat_device(pending, schema, growth)
+
+
 class TpuCoalesceBatchesExec(PhysicalPlan):
     columnar_output = True
 
@@ -50,33 +78,14 @@ class TpuCoalesceBatchesExec(PhysicalPlan):
         return f"TpuCoalesceBatchesExec({self.goal!r})"
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        from spark_rapids_tpu.exec.tpu import _concat_device
         child_parts = self.children[0].executed_partitions(ctx)
         schema = self.output_schema()
         growth = ctx.conf.capacity_growth
-        single = isinstance(self.goal, RequireSingleBatch)
-        target = 0 if single else self.goal.rows
 
         def make(part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
-                pending: List[DeviceBatch] = []
-                pending_rows = 0
-                for batch in part():
-                    # capacity-based accounting: an exact count would cost
-                    # a device->host scalar sync per batch (~hundreds of ms
-                    # through remote attachments); the bucketed capacity
-                    # over-estimates by at most 2x, which only makes
-                    # coalesced outputs slightly smaller than the goal
-                    rows = batch.num_rows_hint()
-                    if rows == 0 and pending:
-                        continue  # drop known-empty fragments
-                    pending.append(batch)
-                    pending_rows += rows
-                    if not single and pending_rows >= target:
-                        yield _concat_device(pending, schema, growth)
-                        pending, pending_rows = [], 0
-                if pending:
-                    yield _concat_device(pending, schema, growth)
+                yield from coalesce_iter(part(), self.goal, schema,
+                                         growth)
             return run
         return [make(p) for p in child_parts]
 

@@ -39,6 +39,10 @@ _PRECISE = {
     "TpuBroadcastHashJoinExec", "TpuBroadcastExchangeExec",
     "TpuShuffleExchangeExec", "TpuSortExec", "TpuCoalesceBatchesExec",
     "TpuCoalescePartitionsExec",
+    # whole-stage fusion: fingerprint_extra carries every member's full
+    # identity (exec/stagecompiler/fusedexec.py), so a fused pipeline is
+    # as precise as the chain it replaced
+    "TpuFusedStageExec",
 }
 
 # a subtree is only worth materializing when it contains real compute

@@ -1,0 +1,215 @@
+"""TpuFusedStageExec: one jit'd program per fused operator pipeline.
+
+The fused stage holds its member operators (bottom-up execution order)
+and builds a single ``cached_jit`` kernel applying each member's device
+function in sequence — the whole-stage-codegen move (HyPer / Spark WSCG)
+in the XLA world: what used to be N python dispatches and N kernel
+launches per batch is one dispatch of one executable, and XLA reuses
+(donates) the buffers between member ops inside the program instead of
+materializing each operator's output to HBM.
+
+Members are restricted to deterministic operators whose per-batch work
+is a pure batch -> batch device function: TpuProjectExec, TpuFilterExec
+and TpuCoalesceBatchesExec (absorbed — the bottom-most coalesce's goal
+becomes the stage's INPUT re-batching so capacity buckets stay as
+stable as the unfused pipeline's; interior ones are identity inside one
+program). Anything else — exchanges, joins, aggregates, scans,
+transitions, CPU fallbacks, nondeterministic expressions — is a stage
+boundary (cutter.py).
+
+Observability: the fused node is first-class everywhere. ``describe()``
+names the member pipeline (profile tree, progress records, plan
+digests); ``member_ops`` rides the exec op-scope so a compile fired
+inside the stage lands in the ledger with the member list
+(obs/compileledger.py); a kernel failure emits a ``fusedStageFailure``
+event naming the member pipeline — captured by the always-on flight
+recorder, so a queryFailed dump says WHICH fused pipeline died, not
+just that a fused node did — and re-raises with the pipeline in the
+message.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
+from spark_rapids_tpu.utils.kernelcache import cached_jit, expr_signature
+
+
+def member_fn(node: PhysicalPlan):
+    """(batch -> batch device function, kernel signature) of one fusible
+    member, or (None, sig) for an absorbed coalesce. Raises TypeError on
+    a non-fusible node — the cutter must never hand one over."""
+    from spark_rapids_tpu.exec import tpu as tpuexec
+    from spark_rapids_tpu.exec.coalesce import TpuCoalesceBatchesExec
+    from spark_rapids_tpu.sql.exprs.evalbridge import eval_projection
+    if isinstance(node, TpuCoalesceBatchesExec):
+        return None, f"coalesce|{node.goal!r}"
+    if isinstance(node, tpuexec.TpuProjectExec) and not node._impure:
+        if node._pure_selection:
+            # pure column selection/rename: pytree restructuring only —
+            # inside the fused program this is free (no buffer copies;
+            # the jit boundary is the stage's, not this member's).
+            # The SOURCE INDICES are part of the cache key: the closure
+            # bakes them, and two selections outputting the same names
+            # from different ordinals must not share a compiled program
+            # (the TpuFilterExec out_sel sig guards the same hazard)
+            kern = node._kernel
+            from spark_rapids_tpu.sql.exprs.core import Alias, BoundRef
+
+            def as_ref(e):
+                while isinstance(e, Alias):
+                    e = e.children[0]
+                return e if isinstance(e, BoundRef) else None
+            names = [n for n, _ in node.exprs]
+            idx = [as_ref(e).index for _, e in node.exprs]
+            sig = f"sel|{tuple(idx)}:{','.join(names)}"
+            return (lambda b: kern(b)), sig
+        # computed/mixed projections deliberately use the PLAIN
+        # eval_projection spelling rather than the node's mixed kernel:
+        # that kernel splits computed vs passthrough outputs to avoid
+        # jit-BOUNDARY buffer copies (exec/tpu.py), a concern that does
+        # not exist inside one fused program
+        bound = [e for _, e in node.exprs]
+        names = [n for n, _ in node.exprs]
+        sig = "project|" + "|".join(
+            f"{n}={expr_signature(e)}" for n, e in node.exprs)
+        return (lambda b: eval_projection(b, bound, names)), sig
+    if isinstance(node, tpuexec.TpuFilterExec) and not node._impure:
+        out_sel = node.out_sel
+        sel_sig = ("" if out_sel is None
+                   else f"|sel={tuple(out_sel[1])}"
+                        f":{','.join(out_sel[0])}")
+        # the node's own un-jitted closure — one filter spelling for the
+        # standalone and fused paths
+        return node._raw_kernel, \
+            "filter|" + expr_signature(node.condition) + sel_sig
+    raise TypeError(f"not fusible: {node.describe()}")
+
+
+class TpuFusedStageExec(PhysicalPlan):
+    """One fused pipeline of member operators as a single plan node.
+
+    ``members`` is bottom-up (execution order): members[0] consumes the
+    stage input, members[-1] produces the stage output. ``donate`` adds
+    jax buffer donation of the stage INPUT (cutter decides it only for
+    known single-consumer producers)."""
+
+    columnar_output = True
+
+    def __init__(self, child: PhysicalPlan,
+                 members: List[PhysicalPlan], donate: bool = False):
+        super().__init__([child])
+        from spark_rapids_tpu.exec.coalesce import TpuCoalesceBatchesExec
+        self.members = list(members)
+        self.member_ops = [m.describe() for m in self.members]
+        self.donate = bool(donate)
+        self.input_goal = None
+        # an absorbed INTERIOR coalesce must not silently fragment the
+        # consumer: inside one program its re-batching is free to drop,
+        # but the consumer then sees one output per input batch instead
+        # of the coalesced stream — so the TOPMOST interior coalesce's
+        # goal re-batches the stage OUTPUT (filters/projects preserve
+        # capacity, so the grouping matches what the interior coalesce
+        # would have produced)
+        self.output_goal = None
+        fns, sigs = [], []
+        for i, m in enumerate(self.members):
+            fn, sig = member_fn(m)
+            if fn is None:
+                if isinstance(m, TpuCoalesceBatchesExec):
+                    if i == 0:
+                        # the bottom coalesce keeps its re-batching role
+                        # at the stage input (capacity-bucket stability)
+                        self.input_goal = m.goal
+                    else:
+                        self.output_goal = m.goal
+                continue
+            fns.append(fn)
+            sigs.append(sig)
+        self._fns = fns
+        sig = "fusedstage|" + "|".join(sigs) \
+            + (f"|donate" if self.donate else "")
+        self._sig = sig
+
+        def fused(batch: DeviceBatch) -> DeviceBatch:
+            for fn in fns:
+                batch = fn(batch)
+            return batch
+        if self.donate:
+            self._kernel = cached_jit(
+                sig, lambda: jax.jit(fused, donate_argnums=(0,)))
+        else:
+            self._kernel = cached_jit(sig, lambda: jax.jit(fused))
+
+    # -- plan-node surface ---------------------------------------------------
+    def output_schema(self) -> Schema:
+        return self.members[-1].output_schema()
+
+    def describe(self) -> str:
+        shorts = [m.describe().split("(", 1)[0] for m in self.members]
+        return f"TpuFusedStageExec([{' -> '.join(shorts)}])"
+
+    def fingerprint_extra(self) -> str:
+        # full member identity: the fused node must be as precise as its
+        # members were (reuse dedup, capacity speculation, plan caches
+        # all key on describe()+fingerprint_extra)
+        parts = [f"{m.describe()}#{m.fingerprint_extra()}"
+                 for m in self.members]
+        return (f"goal={self.input_goal!r}|out={self.output_goal!r}|"
+                + ";".join(parts))
+
+    # -- execution -----------------------------------------------------------
+    def _pipeline_label(self) -> str:
+        return " -> ".join(
+            m.describe().split("(", 1)[0] for m in self.members)
+
+    def _note_failure(self, e: BaseException) -> None:
+        """A failure inside the fused program must name the member
+        pipeline, not just this node: the event lands in the always-on
+        flight recorder, so the queryFailed dump carries it."""
+        from spark_rapids_tpu.obs.events import EVENTS
+        EVENTS.emit("fusedStageFailure", op=self.describe()[:200],
+                    members=[m[:200] for m in self.member_ops],
+                    error=f"{type(e).__name__}: {e}"[:300])
+
+    def partitions(self, ctx: ExecContext) -> List[Partition]:
+        child_parts = self.children[0].executed_partitions(ctx)
+        growth = ctx.conf.capacity_growth
+        in_schema = self.children[0].output_schema()
+        goal = self.input_goal
+
+        def input_batches(part: Partition) -> Iterator[DeviceBatch]:
+            if goal is None:
+                yield from part()
+                return
+            from spark_rapids_tpu.exec.coalesce import coalesce_iter
+            yield from coalesce_iter(part(), goal, in_schema, growth)
+
+        out_goal = self.output_goal
+        out_schema = self.output_schema()
+
+        def make(part: Partition) -> Partition:
+            def fused_outputs() -> Iterator[DeviceBatch]:
+                for batch in input_batches(part):
+                    try:
+                        out = self._kernel(batch)
+                    except Exception as e:  # noqa: BLE001
+                        self._note_failure(e)
+                        raise RuntimeError(
+                            f"fused stage [{self._pipeline_label()}] "
+                            f"failed: {e}") from e
+                    yield out
+
+            def run() -> Iterator[DeviceBatch]:
+                if out_goal is None:
+                    yield from fused_outputs()
+                    return
+                from spark_rapids_tpu.exec.coalesce import coalesce_iter
+                yield from coalesce_iter(fused_outputs(), out_goal,
+                                         out_schema, growth)
+            return run
+        return [make(p) for p in child_parts]

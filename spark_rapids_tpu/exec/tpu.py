@@ -90,7 +90,10 @@ def _fused_filter_source(node: PhysicalPlan, ctx: ExecContext):
     (exec/fusion.py). ``out_sel`` is the filter's fused output selection
     (fuse_selection_into_filter); the caller applies it as a zero-copy
     column view before the concat. Returns (node, None, None) when
-    nothing fuses."""
+    nothing fuses. NB the whole-stage cutter mirrors this claim
+    (exec/stagecompiler/cutter._parent_claims_filter) and leaves the
+    claimed filter out of fused pipelines — changes to the conditions
+    here must be reflected there."""
     from spark_rapids_tpu.exec.coalesce import TpuCoalesceBatchesExec
     if isinstance(node, TpuCoalesceBatchesExec):
         # the collapse concat coalesces everything anyway — a TargetSize
@@ -255,6 +258,10 @@ class TpuFilterExec(TpuExec):
             pred = to_device_column(ctx, condition.eval_device(ctx))
             keep = pred.data & pred.validity
             return rowops.filter_batch(_select_view(batch, out_sel), keep)
+        # the un-jitted closure: whole-stage fusion traces it INSIDE the
+        # fused program (exec/stagecompiler/fusedexec.member_fn), so the
+        # fused and standalone spellings can never diverge
+        self._raw_kernel = kernel
         from spark_rapids_tpu.sql.exprs.nondet import has_nondeterministic
         self._impure = has_nondeterministic(condition)
         if self._impure:

@@ -66,12 +66,15 @@ _tls = threading.local()
 # ---------------------------------------------------------------------------
 
 def push_op(op: str, node_id: Optional[int] = None,
-            ctx: Any = None) -> Any:
+            ctx: Any = None, members: Optional[List[str]] = None) -> Any:
     """Enter an operator scope on this thread; returns the previous scope
     token to pass to ``pop_op``. Called per batch pull on the exec hot
-    path — two attribute stores, no lock."""
+    path — two attribute stores, no lock. ``members``: the member
+    operator pipeline of a fused stage (exec/stagecompiler), so a
+    compile fired inside it records WHICH operators the fused program
+    contains."""
     prev = getattr(_tls, "op", None)
-    _tls.op = (op, node_id, ctx)
+    _tls.op = (op, node_id, ctx, members)
     return prev
 
 
@@ -79,9 +82,10 @@ def pop_op(prev: Any) -> None:
     _tls.op = prev
 
 
-def current_op() -> Optional[Tuple[str, Optional[int], Any]]:
-    """(describe, node_id, ExecContext) of the operator executing on this
-    thread, or None outside any operator scope."""
+def current_op() -> Optional[Tuple[str, Optional[int], Any,
+                                   Optional[List[str]]]]:
+    """(describe, node_id, ExecContext, member_ops) of the operator
+    executing on this thread, or None outside any operator scope."""
     return getattr(_tls, "op", None)
 
 
@@ -91,8 +95,8 @@ class op_context:
     fused result fetch, AQE stage materialization)."""
 
     def __init__(self, op: str, node_id: Optional[int] = None,
-                 ctx: Any = None):
-        self._args = (op, node_id, ctx)
+                 ctx: Any = None, members: Optional[List[str]] = None):
+        self._args = (op, node_id, ctx, members)
         self._prev = None
 
     def __enter__(self):
@@ -112,7 +116,7 @@ def note_transfer(seconds: float, direction: str = "h2d") -> None:
     cur = current_op()
     if cur is None:
         return
-    _op, node_id, ctx = cur
+    _op, node_id, ctx = cur[0], cur[1], cur[2]
     if ctx is None or node_id is None:
         return
     note_breakdown(ctx, node_id, transfer_s=seconds)
@@ -309,6 +313,7 @@ class CompileLedger:
         cur = current_op()
         d = current_dispatch()
         op = cur[0] if cur is not None else None
+        members = (cur[3] if cur is not None and len(cur) > 3 else None)
         entry: Dict[str, Any] = {
             "ts": round(time.time(), 6),
             "query": EVENTS.current_query,
@@ -319,6 +324,10 @@ class CompileLedger:
             "outcome": (d.cache_outcome if d is not None else None),
             "seconds": round(seconds, 4),
         }
+        if members:
+            # fused-stage attribution: the compile belongs to the fused
+            # kernel AND names the member-operator pipeline inside it
+            entry["members"] = [m[:200] for m in members]
         with self._lock:
             self._seq += 1
             entry["seq"] = self._seq
@@ -342,10 +351,12 @@ class CompileLedger:
                 qp.note_compile(seconds, entry["kernel"])
         # durable record: the enriched journal event compile_report and
         # qualification mine (tools/)
+        extra = {"members": entry["members"]} if "members" in entry \
+            else {}
         EVENTS.emit(
             "backendCompile", seconds=round(seconds, 4), op=op,
             kernel=entry["kernel"], avals=entry["avals"],
-            outcome=entry["outcome"])
+            outcome=entry["outcome"], **extra)
         return entry
 
     def attach_cost(self, entry: Dict[str, Any], fn, args, kwargs) -> None:
@@ -479,9 +490,13 @@ def analyze(entries: List[Dict[str, Any]],
         key = kernel or f"(op){op}"
         g = groups.setdefault(key, {
             "kernel": kernel, "ops": set(), "compiles": 0,
-            "seconds": 0.0, "sigs": {}, "queries": set()})
+            "seconds": 0.0, "sigs": {}, "queries": set(),
+            "members": None})
         if op:
             g["ops"].add(op)
+        if e.get("members") and not g["members"]:
+            # fused-stage member pipeline (exec/stagecompiler)
+            g["members"] = list(e["members"])
         if e.get("query"):
             g["queries"].add(e["query"])
         g["compiles"] += n
@@ -509,6 +524,7 @@ def analyze(entries: List[Dict[str, Any]],
             "kernel": g["kernel"],
             "op": sorted(g["ops"])[0] if g["ops"] else None,
             "ops": sorted(g["ops"]),
+            "members": g["members"],
             "queries": sorted(g["queries"]),
             "compiles": g["compiles"],
             "seconds": round(g["seconds"], 4),

@@ -46,7 +46,16 @@ Event taxonomy (one JSON object per line; every event carries ``kind``,
                                  compile that actually ran, enriched by
                                  the compile ledger
                                  (obs/compileledger.py); the record
-                                 tools/compile_report.py mines
+                                 tools/compile_report.py mines. Compiles
+                                 fired inside a fused stage additionally
+                                 carry members[] (the member-operator
+                                 pipeline, exec/stagecompiler)
+  fusedStageFailure exec         op, members[], error — a fused-stage
+                                 program failed; names the member
+                                 operator pipeline so the flight-
+                                 recorder dump of the ensuing
+                                 queryFailed says WHICH operators were
+                                 inside (exec/stagecompiler/fusedexec)
   scanStall         scan         split, stall_s (sql/scan_pipeline.py)
   scanBudgetStall   scan         split (prefetch submission backpressure)
   shuffleSkew       shuffle      source, partitions, totalBytes, maxBytes,

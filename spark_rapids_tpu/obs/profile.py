@@ -35,6 +35,11 @@ def _node_profile(node, ctx, op_metrics: Dict[str, Any]) -> Dict[str, Any]:
         "batches": st["batches"] if st else 0,
         "children": children,
     }
+    members = getattr(node, "member_ops", None)
+    if members:
+        # fused stage (exec/stagecompiler): the profile row stands for
+        # the whole member pipeline — name it
+        out["members"] = [m[:200] for m in members]
     bd = _node_breakdown(node, ctx)
     if bd is not None:
         out["breakdown"] = bd

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -187,3 +187,434 @@ def compact_permutation(keep: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     idx = jnp.arange(n, dtype=jnp.int32)
     perm = jnp.zeros((n,), jnp.int32).at[dest].set(idx)
     return perm, kept_total.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Open-addressing hash-table kernels (join build/probe, grouped-agg)
+# ---------------------------------------------------------------------------
+#
+# The engine's joins and grouped aggregations spell "hash table" as
+# sort + segment sweeps (ops/joins.py, ops/groupby.py) because XLA cannot
+# express data-dependent memory. Pallas CAN: these kernels are the real
+# thing — a power-of-two open-addressing table with linear probing, the
+# cuDF hash build/probe the reference calls (GpuHashJoin.scala:113-244)
+# re-founded on the TPU's sequential grid.
+#
+# Contract: every key column is reduced to an EXACT uint64 equality image
+# first (ops/sortops.u64_key_image — fixed-width values carry the full
+# value, dictionary codes are exact within a batch), so table equality is
+# exact, never probabilistic. The build kernel walks rows sequentially
+# with the table in scratch, emitting each row's slot and its arrival
+# rank within the slot; the probe kernel is read-only and data-parallel
+# per stream row. Both run under the same SPARK_RAPIDS_TPU_PALLAS switch
+# as the compaction kernel (=interpret covers them in CPU CI); the jnp
+# twins implement the identical table algorithm with vectorized
+# round-based claiming, so either mode yields the same groups.
+#
+# Load factor is bounded at <= 1/2 by hash_table_size, so linear probing
+# always terminates at an empty slot and the whole-table-in-scratch
+# single-step grid is adequate for the batch sizes the interpret/CI path
+# sees; an HBM-blocked variant is the TPU-at-scale follow-up.
+
+_HASH_SEED = 0x243F6A8885A308D3
+
+
+def hash_table_size(capacity: int) -> int:
+    """Static power-of-two table size at load factor <= 1/2."""
+    t = 16
+    while t < 2 * max(int(capacity), 1):
+        t <<= 1
+    return t
+
+
+def _mix_images(images) -> jnp.ndarray:
+    from spark_rapids_tpu.ops.hashing import splitmix64
+    h = jnp.asarray(_HASH_SEED, jnp.uint64)
+    for img in images:
+        h = splitmix64(h ^ img.astype(jnp.uint64))
+    return h
+
+
+def _hash_build_jnp(images, valid: jnp.ndarray, table_size: int):
+    """Vectorized twin of the build kernel: round-based claiming. Each
+    round every still-pending row tries slot (h + probe) % T; rows whose
+    slot holds their key join it, rows hitting an empty slot race a
+    scatter-min claim (one winner per slot per round), losers re-try the
+    same slot next round (the winner's key may BE theirs). Terminates
+    because every round either places >= 1 row or advances every
+    pending row's probe past a full slot."""
+    T = table_size
+    n = valid.shape[0]
+    k = len(images)
+    h = _mix_images(images)
+    rows = jnp.arange(n, dtype=jnp.int32)
+    # table arrays carry one spill slot at index T so masked scatters
+    # have a harmless destination
+    init = {
+        "tab": [jnp.zeros((T + 1,), jnp.uint64) for _ in range(k)],
+        "occ": jnp.zeros((T + 1,), jnp.bool_),
+        "slot": jnp.full((n,), T, jnp.int32),
+        "pending": valid,
+        "probe": jnp.zeros((n,), jnp.uint64),
+    }
+
+    def cond(st):
+        return jnp.any(st["pending"])
+
+    def body(st):
+        slot = ((h + st["probe"]) % jnp.uint64(T)).astype(jnp.int32)
+        occ = st["occ"][slot]
+        eq = jnp.ones((n,), jnp.bool_)
+        for j in range(k):
+            eq = eq & (st["tab"][j][slot] == images[j])
+        found = st["pending"] & occ & eq
+        empty = st["pending"] & ~occ
+        cand = jnp.where(empty, slot, T)
+        winner = jnp.full((T + 1,), n, jnp.int32).at[cand].min(rows)
+        placed = empty & (winner[jnp.clip(slot, 0, T - 1)] == rows)
+        wslot = jnp.where(placed, slot, T)
+        tab = [st["tab"][j].at[wslot].set(images[j]) for j in range(k)]
+        occ2 = st["occ"].at[wslot].set(True).at[T].set(False)
+        done = found | placed
+        return {
+            "tab": tab,
+            "occ": occ2,
+            "slot": jnp.where(done, slot, st["slot"]),
+            "pending": st["pending"] & ~done,
+            # a claim loser re-probes the SAME slot (its key may have
+            # just been placed there); only occupied-mismatch advances
+            "probe": st["probe"] + jnp.where(
+                st["pending"] & ~done & occ, 1, 0).astype(jnp.uint64),
+        }
+
+    st = jax.lax.while_loop(cond, body, init)
+    slot = st["slot"]
+    counts = jnp.zeros((T + 1,), jnp.int32).at[slot].add(
+        jnp.where(valid, 1, 0))[:T]
+    table = jnp.stack([t[:T] for t in st["tab"]])
+    return slot, None, table, counts
+
+
+def _hash_probe_jnp(table: jnp.ndarray, counts: jnp.ndarray, images,
+                    valid: jnp.ndarray, table_size: int) -> jnp.ndarray:
+    T = table_size
+    n = valid.shape[0]
+    k = table.shape[0]
+    h = _mix_images(images)
+    init = {
+        "slot": jnp.full((n,), T, jnp.int32),
+        "pending": valid,
+        "probe": jnp.zeros((n,), jnp.uint64),
+    }
+
+    def cond(st):
+        return jnp.any(st["pending"])
+
+    def body(st):
+        slot = ((h + st["probe"]) % jnp.uint64(T)).astype(jnp.int32)
+        occ = counts[slot] > 0
+        eq = jnp.ones((n,), jnp.bool_)
+        for j in range(k):
+            eq = eq & (table[j][slot] == images[j])
+        found = st["pending"] & occ & eq
+        absent = st["pending"] & ~occ  # empty slot ends the probe chain
+        return {
+            "slot": jnp.where(found, slot, st["slot"]),
+            "pending": st["pending"] & ~(found | absent),
+            "probe": st["probe"] + jnp.where(
+                st["pending"], 1, 0).astype(jnp.uint64),
+        }
+
+    return jax.lax.while_loop(cond, body, init)["slot"]
+
+
+def _hash_build_kernel(k: int, T: int, keys_ref, valid_ref, slot_ref,
+                       rank_ref, tab_ref, cnt_ref):
+    """Sequential build: rows insert one at a time with the table held in
+    the kernel's output refs (single-step grid). Per row: linear-probe to
+    the first slot that is empty (claim it, rank 0) or already holds the
+    key (rank = member count so far). The sequential walk is what gives
+    exact per-row arrival ranks with no sort anywhere."""
+    import jax.experimental.pallas as pl
+    n = slot_ref.shape[1]
+    cnt_ref[...] = jnp.zeros((1, T), jnp.int32)
+    tab_ref[...] = jnp.zeros((k, T), jnp.uint64)
+    slot_ref[...] = jnp.full((1, n), T, jnp.int32)
+    rank_ref[...] = jnp.zeros((1, n), jnp.int32)
+
+    def insert(e, _):
+        e = e.astype(jnp.int32)
+        v = pl.load(valid_ref, (jnp.int32(0), e)) != 0
+        row_keys = [pl.load(keys_ref, (jnp.int32(j), e)) for j in range(k)]
+        h = jnp.asarray(_HASH_SEED, jnp.uint64)
+        from spark_rapids_tpu.ops.hashing import splitmix64
+        for kk in row_keys:
+            h = splitmix64(h ^ kk)
+
+        def probe_cond(carry):
+            _p, _s, code = carry
+            return code == 0
+
+        def probe_body(carry):
+            p, _s, _code = carry
+            s = ((h + p.astype(jnp.uint64)) % jnp.uint64(T)).astype(
+                jnp.int32)
+            c = pl.load(cnt_ref, (jnp.int32(0), s))
+            eq = jnp.asarray(True)
+            for j in range(k):
+                eq = eq & (pl.load(tab_ref, (jnp.int32(j), s)) == row_keys[j])
+            code = jnp.where(c == 0, jnp.int32(1),
+                             jnp.where(eq, jnp.int32(2), jnp.int32(0)))
+            return p + jnp.int32(1), s, code
+
+        _p, s, code = jax.lax.while_loop(
+            probe_cond, probe_body, (jnp.int32(0), jnp.int32(0),
+                                     jnp.int32(0)))
+
+        @pl.when(v)
+        def _():
+            for j in range(k):
+                pl.store(tab_ref, (jnp.int32(j), s), row_keys[j])
+            rank = pl.load(cnt_ref, (jnp.int32(0), s))
+            pl.store(cnt_ref, (jnp.int32(0), s), rank + 1)
+            pl.store(slot_ref, (jnp.int32(0), e), s)
+            pl.store(rank_ref, (jnp.int32(0), e), rank)
+        return 0
+
+    jax.lax.fori_loop(0, n, insert, 0)
+
+
+def _hash_probe_kernel(k: int, T: int, tab_ref, cnt_ref, keys_ref,
+                       valid_ref, slot_ref):
+    """Read-only probe: per stream row, follow the chain to the row's key
+    slot or the first empty slot (absent -> T)."""
+    import jax.experimental.pallas as pl
+    n = slot_ref.shape[1]
+    slot_ref[...] = jnp.full((1, n), T, jnp.int32)
+
+    def probe(e, _):
+        e = e.astype(jnp.int32)
+        v = pl.load(valid_ref, (jnp.int32(0), e)) != 0
+        row_keys = [pl.load(keys_ref, (jnp.int32(j), e)) for j in range(k)]
+        h = jnp.asarray(_HASH_SEED, jnp.uint64)
+        from spark_rapids_tpu.ops.hashing import splitmix64
+        for kk in row_keys:
+            h = splitmix64(h ^ kk)
+
+        def probe_cond(carry):
+            _p, _s, code = carry
+            return code == 0
+
+        def probe_body(carry):
+            p, _s, _code = carry
+            s = ((h + p.astype(jnp.uint64)) % jnp.uint64(T)).astype(
+                jnp.int32)
+            c = pl.load(cnt_ref, (jnp.int32(0), s))
+            eq = jnp.asarray(True)
+            for j in range(k):
+                eq = eq & (pl.load(tab_ref, (jnp.int32(j), s)) == row_keys[j])
+            # 1 = absent (empty slot ends the chain), 2 = found
+            code = jnp.where(c == 0, jnp.int32(1),
+                             jnp.where(eq, jnp.int32(2), jnp.int32(0)))
+            return p + jnp.int32(1), s, code
+
+        _p, s, code = jax.lax.while_loop(
+            probe_cond, probe_body, (jnp.int32(0), jnp.int32(0),
+                                     jnp.int32(0)))
+
+        @pl.when(v & (code == 2))
+        def _():
+            pl.store(slot_ref, (jnp.int32(0), e), s)
+        return 0
+
+    jax.lax.fori_loop(0, n, probe, 0)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _hash_build_pallas(keys: jnp.ndarray, valid: jnp.ndarray,
+                       table_size: int, interpret: bool):
+    import jax.experimental.pallas as pl
+    k, n = keys.shape
+    T = table_size
+    slot, rank, tab, cnt = pl.pallas_call(
+        functools.partial(_hash_build_kernel, k, T),
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((k, T), jnp.uint64),
+            jax.ShapeDtypeStruct((1, T), jnp.int32),
+        ],
+        interpret=interpret,
+    )(keys, valid.astype(jnp.int32).reshape(1, n))
+    return slot[0], rank[0], tab, cnt[0]
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _hash_probe_pallas(tab: jnp.ndarray, cnt: jnp.ndarray,
+                       keys: jnp.ndarray, valid: jnp.ndarray,
+                       table_size: int, interpret: bool):
+    import jax.experimental.pallas as pl
+    k, n = keys.shape
+    slot = pl.pallas_call(
+        functools.partial(_hash_probe_kernel, k, table_size),
+        out_shape=[jax.ShapeDtypeStruct((1, n), jnp.int32)],
+        interpret=interpret,
+    )(tab, cnt.reshape(1, -1), keys,
+      valid.astype(jnp.int32).reshape(1, n))[0]
+    return slot[0]
+
+
+# whole-table-in-refs bound for the COMPILED pallas path: a (k, T)
+# uint64 table must stay VMEM-resident in the single-step grid, so
+# tables past this slot count route to the jnp twin instead (identical
+# contract — the decision is static per capacity bucket, made at trace
+# time). Interpret mode has no such bound.
+_PALLAS_MAX_TABLE = 1 << 17
+
+_hash_pallas_ok: Optional[bool] = None
+
+
+def _hash_pallas_available() -> bool:
+    """Eager one-shot probe of the HASH kernels specifically: uint64
+    tables, scalar while-loops and dynamic ref indexing are a different
+    Mosaic feature surface than the compaction kernel's matmul scan, so
+    _pallas_available() proving the latter says nothing about these —
+    and a deferred failure would surface inside a jitted join probe at
+    query time (the exact mode the compaction probe's docstring warns
+    about)."""
+    global _hash_pallas_ok
+    if _hash_pallas_ok is None:
+        try:
+            keys = jnp.asarray(np.arange(32) % 5, jnp.uint64)
+            valid = jnp.ones((32,), jnp.bool_)
+            slot, _r, tab, cnt = _hash_build_pallas(
+                keys.reshape(1, -1), valid, 64, False)
+            probe = _hash_probe_pallas(tab, cnt, keys.reshape(1, -1),
+                                       valid, 64, False)
+            jax.block_until_ready(probe)
+            _hash_pallas_ok = True
+        except Exception:  # noqa: BLE001 — any compile/runtime failure
+            _hash_pallas_ok = False
+            import logging
+            logging.getLogger(__name__).warning(
+                "pallas hash-table kernels unavailable on this backend; "
+                "keeping the sort-based join/agg paths")
+    return _hash_pallas_ok
+
+
+def hash_kernels_mode() -> str:
+    """'pallas' | 'interpret' | 'off' — whether the hash-table kernels
+    may replace the sort-based join/agg fallbacks. Rides the same
+    SPARK_RAPIDS_TPU_PALLAS switch as the compaction kernel: default
+    (auto/jnp) keeps the sort paths byte-identical."""
+    m = _mode()
+    if m == "pallas" and _hash_pallas_available():
+        return "pallas"
+    if m == "interpret":
+        return "interpret"
+    return "off"
+
+
+def hash_table_build(images, valid: jnp.ndarray, table_size: int,
+                     mode: Optional[str] = None):
+    """Build the open-addressing table over exact u64 key images.
+    Returns (slot[n] int32 (invalid -> T), rank[n] int32 or None,
+    table (k, T) uint64, counts (T,) int32). rank is per-row arrival
+    order within its slot (pallas/interpret only — the vectorized twin
+    derives placement by a one-operand sort instead)."""
+    mode = mode or hash_kernels_mode()
+    if mode == "pallas" and table_size > _PALLAS_MAX_TABLE:
+        mode = "jnp"  # table would not fit the single-step VMEM grid
+    if mode in ("pallas", "interpret"):
+        keys = jnp.stack([im.astype(jnp.uint64) for im in images])
+        return _hash_build_pallas(keys, valid, table_size,
+                                  mode == "interpret")
+    return _hash_build_jnp(images, valid, table_size)
+
+
+def hash_table_probe(table: jnp.ndarray, counts: jnp.ndarray, images,
+                     valid: jnp.ndarray, table_size: int,
+                     mode: Optional[str] = None) -> jnp.ndarray:
+    """Slot of each probe row's key, or table_size when absent/invalid."""
+    mode = mode or hash_kernels_mode()
+    if mode == "pallas" and table_size > _PALLAS_MAX_TABLE:
+        mode = "jnp"  # match hash_table_build's routing
+    if mode in ("pallas", "interpret"):
+        keys = jnp.stack([im.astype(jnp.uint64) for im in images])
+        return _hash_probe_pallas(table, counts, keys, valid, table_size,
+                                  mode == "interpret")
+    return _hash_probe_jnp(table, counts, images, valid, table_size)
+
+
+def hash_join_probe(build_images, build_valid: jnp.ndarray,
+                    stream_images, stream_valid: jnp.ndarray,
+                    table_size: int, mode: Optional[str] = None):
+    """Hash-table join probe with the (counts, bstart, bperm) contract of
+    ops/joins.join_probe: counts[i] build matches of stream row i,
+    bstart[i] the first slot of its match group in bperm, bperm grouping
+    build rows by key (dead rows last). Replaces the union lexsort over
+    both sides' key images with one table build + O(1) probes; the only
+    ordering work left is placing build rows contiguously per group —
+    the sequential kernel derives that from arrival ranks, the jnp twin
+    from a single int32 sort of the build side only."""
+    mode = mode or hash_kernels_mode()
+    nb = build_valid.shape[0]
+    T = table_size
+    slot_b, rank, table, counts_t = hash_table_build(
+        build_images, build_valid, T, mode=mode)
+    starts = jnp.cumsum(counts_t) - counts_t
+    if rank is not None:
+        live_total = counts_t.sum().astype(jnp.int32)
+        rows = jnp.arange(nb, dtype=jnp.int32)
+        dead = ~build_valid
+        dead_i = dead.astype(jnp.int32)
+        dead_ex = jnp.cumsum(dead_i) - dead_i
+        pos = jnp.where(
+            build_valid,
+            starts[jnp.clip(slot_b, 0, T - 1)] + rank,
+            live_total + dead_ex).astype(jnp.int32)
+        bperm = jnp.zeros((nb,), jnp.int32).at[pos].set(rows)
+    else:
+        off_key = jnp.where(build_valid, slot_b, T).astype(jnp.int32)
+        _off, bperm = jax.lax.sort(
+            (off_key, jnp.arange(nb, dtype=jnp.int32)), num_keys=1,
+            is_stable=True)
+    slot_s = hash_table_probe(table, counts_t, stream_images,
+                              stream_valid, T, mode=mode)
+    hit = slot_s < T
+    safe = jnp.clip(slot_s, 0, T - 1)
+    bstart = jnp.where(hit, starts[safe], 0).astype(jnp.int32)
+    counts = jnp.where(hit, counts_t[safe], 0).astype(jnp.int32)
+    return counts, bstart, bperm
+
+
+def hash_group_ids(images, valid: jnp.ndarray, table_size: int,
+                   mode: Optional[str] = None):
+    """Grouped-agg accumulate substrate: dense group id per row from the
+    hash table (no sort). Returns (gid[n] int32 (invalid -> -1),
+    num_groups int32, rep_rows[n] int32 — rep_rows[g] is the first
+    original row of group g for g < num_groups)."""
+    mode = mode or hash_kernels_mode()
+    n = valid.shape[0]
+    T = table_size
+    slot, rank, _table, counts_t = hash_table_build(images, valid, T,
+                                                    mode=mode)
+    used = counts_t > 0
+    gid_of_slot = (jnp.cumsum(used.astype(jnp.int32)) - 1).astype(
+        jnp.int32)
+    safe = jnp.clip(slot, 0, T - 1)
+    gid = jnp.where(valid & (slot < T), gid_of_slot[safe], -1)
+    num_groups = used.sum().astype(jnp.int32)
+    rows = jnp.arange(n, dtype=jnp.int32)
+    if rank is not None:
+        # the kernel's arrival ranks name each group's first row directly
+        first = valid & (rank == 0)
+        rep_rows = jnp.zeros((n,), jnp.int32).at[
+            jnp.where(first, gid, n)].set(rows, mode="drop")
+    else:
+        first_of_slot = jnp.full((T + 1,), n, jnp.int32).at[
+            jnp.where(valid, slot, T)].min(rows)[:T]
+        rep_rows = jnp.zeros((n,), jnp.int32).at[
+            jnp.where(used, gid_of_slot, n)].set(first_of_slot,
+                                                 mode="drop")
+    return gid, num_groups, rep_rows

@@ -645,11 +645,18 @@ class TransitionOverrides:
         from spark_rapids_tpu.exec.fusion import (
             fuse_filter_into_aggregate, fuse_selection_into_filter,
         )
+        from spark_rapids_tpu.exec.stagecompiler import compile_stages
         # fuse BEFORE coalesce insertion: a fused-away Filter is no longer
-        # a fragmenting producer, so no coalesce node appears above it
-        return insert_coalesce(
-            fuse_filter_into_aggregate(
-                fuse_selection_into_filter(self._apply(plan), self.conf),
+        # a fragmenting producer, so no coalesce node appears above it.
+        # Whole-stage fusion runs LAST, over the final operator layout
+        # (coalesce nodes included — the stage absorbs them), so the
+        # legacy, AQE per-stage and plan-cache paths all cut identically.
+        return compile_stages(
+            insert_coalesce(
+                fuse_filter_into_aggregate(
+                    fuse_selection_into_filter(self._apply(plan),
+                                               self.conf),
+                    self.conf),
                 self.conf),
             self.conf)
 

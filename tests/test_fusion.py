@@ -137,3 +137,343 @@ def test_fused_project_chain(session):
     assert tpu.n.tolist() == cpu.n.tolist()
     np.testing.assert_allclose(tpu.sc.values.astype(float),
                                cpu.sc.values.astype(float), rtol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# Whole-stage fusion (exec/stagecompiler): one jit'd program per pipeline
+# ---------------------------------------------------------------------------
+
+FUSION_ON = {"spark.rapids.sql.fusion.stageEnabled": True}
+
+
+def _fused_nodes(plan):
+    return [n for n in plan.walk()
+            if type(n).__name__ == "TpuFusedStageExec"]
+
+
+def _chain_query(session, parts=2):
+    rng = np.random.default_rng(5)
+    n = 4000
+    df = pd.DataFrame({"k": rng.choice(["a", "b", "c"], n),
+                       "v": rng.uniform(0, 100, n),
+                       "w": rng.integers(-50, 50, n).astype(np.int64)})
+    return (session.create_dataframe(df, parts)
+            .filter(F.col("v") > 10)
+            .with_column("x", F.col("v") * 2.0)
+            .with_column("y", F.col("x") + F.col("w"))
+            .filter(F.col("y") > 30)
+            .with_column("z", F.col("y") - 1.5))
+
+
+class TestWholeStageFusion:
+    def test_off_is_identity_and_on_fuses(self, session):
+        session.capture_plans = True
+        try:
+            session.set_conf("spark.rapids.sql.enabled", True)
+            session.set_conf("spark.rapids.sql.fusion.stageEnabled",
+                             False)
+            off = _chain_query(session).collect()
+            plan_off = session.captured_plans[-1]
+            assert not _fused_nodes(plan_off)
+            # the off path is the identity transform: compile_stages
+            # returns the SAME plan object untouched
+            from spark_rapids_tpu.exec.stagecompiler import compile_stages
+            assert compile_stages(plan_off, session.conf) is plan_off
+            session.set_conf("spark.rapids.sql.fusion.stageEnabled",
+                             True)
+            on = _chain_query(session).collect()
+            plan_on = session.captured_plans[-1]
+            fused = _fused_nodes(plan_on)
+            assert fused, "whole-stage fusion should engage"
+            # the whole project/filter pipeline collapsed into one node
+            assert len(fused[0].members) >= 4
+            assert any("TpuFilterExec" in m for m in fused[0].member_ops)
+            pd.testing.assert_frame_equal(
+                off.sort_values("v").reset_index(drop=True),
+                on.sort_values("v").reset_index(drop=True))
+        finally:
+            session.capture_plans = False
+            session.set_conf("spark.rapids.sql.fusion.stageEnabled",
+                             False)
+
+    def test_min_operators_gate(self, session):
+        session.capture_plans = True
+        try:
+            session.set_conf("spark.rapids.sql.enabled", True)
+            session.set_conf("spark.rapids.sql.fusion.stageEnabled",
+                             True)
+            session.set_conf("spark.rapids.sql.fusion.minOperators", 99)
+            _chain_query(session).collect()
+            assert not _fused_nodes(session.captured_plans[-1])
+        finally:
+            session.capture_plans = False
+            session.reset_conf()
+
+    def test_nondeterministic_breaks_the_chain(self, session):
+        session.capture_plans = True
+        try:
+            session.set_conf("spark.rapids.sql.enabled", True)
+            session.set_conf("spark.rapids.sql.fusion.stageEnabled",
+                             True)
+            q = (_chain_query(session)
+                 .with_column("r", F.rand(seed=7))
+                 .with_column("r2", F.col("r") + 1.0))
+            q.collect()
+            plan = session.captured_plans[-1]
+            for fused in _fused_nodes(plan):
+                assert not any("rand" in m.lower()
+                               for m in fused.member_ops)
+        finally:
+            session.capture_plans = False
+            session.reset_conf()
+
+    def test_plan_cache_identity_includes_fusion_conf(self, session):
+        """A plan cached with fusion ON must not be served once the conf
+        flips: the serving plan-cache key carries the conf fingerprint,
+        and the fusion conf is part of it."""
+        session.capture_plans = True
+        try:
+            session.set_conf("spark.rapids.sql.enabled", True)
+            session.set_conf("spark.rapids.sql.fusion.stageEnabled",
+                             True)
+            on1 = _chain_query(session).collect()
+            assert _fused_nodes(session.captured_plans[-1])
+            on2 = _chain_query(session).collect()  # plan-cache territory
+            assert _fused_nodes(session.captured_plans[-1])
+            session.set_conf("spark.rapids.sql.fusion.stageEnabled",
+                             False)
+            off = _chain_query(session).collect()
+            assert not _fused_nodes(session.captured_plans[-1]), \
+                "cached fused plan served after fusion was disabled"
+            pd.testing.assert_frame_equal(on1, on2)
+            pd.testing.assert_frame_equal(on1, off)
+        finally:
+            session.capture_plans = False
+            session.reset_conf()
+
+    def test_failure_names_member_pipeline(self, session, monkeypatch):
+        """A failure inside a fused program must name the member
+        operator pipeline — in the raised error AND in the flight
+        recorder (so the queryFailed dump carries it)."""
+        from spark_rapids_tpu.exec.stagecompiler.fusedexec import (
+            TpuFusedStageExec,
+        )
+        from spark_rapids_tpu.obs.events import EVENTS
+        orig_init = TpuFusedStageExec.__init__
+
+        def failing_init(self, *a, **kw):
+            orig_init(self, *a, **kw)
+
+            def boom(_batch):
+                raise ValueError("injected kernel failure")
+            self._kernel = boom
+        monkeypatch.setattr(TpuFusedStageExec, "__init__", failing_init)
+        session.set_conf("spark.rapids.sql.enabled", True)
+        session.set_conf("spark.rapids.sql.fusion.stageEnabled", True)
+        try:
+            with pytest.raises(RuntimeError) as exc:
+                _chain_query(session).collect()
+            msg = str(exc.value)
+            assert "fused stage [" in msg and "TpuFilterExec" in msg
+            assert "injected kernel failure" in msg
+            dumped = [e for e in EVENTS.flight_events()
+                      if e.get("kind") == "fusedStageFailure"]
+            assert dumped, "fusedStageFailure must reach the recorder"
+            assert any("TpuFilterExec" in m
+                       for m in dumped[-1]["members"])
+            assert "injected kernel failure" in dumped[-1]["error"]
+        finally:
+            session.reset_conf()
+
+    def test_fused_compile_records_members_in_ledger(self, session):
+        from spark_rapids_tpu.obs.compileledger import LEDGER
+        session.set_conf("spark.rapids.sql.enabled", True)
+        session.set_conf("spark.rapids.sql.fusion.stageEnabled", True)
+        try:
+            seq0 = LEDGER.seq
+            # a fresh literal mints a fresh fused-kernel signature, so
+            # this query COMPILES its fused program
+            rng = np.random.default_rng(11)
+            df = pd.DataFrame({"v": rng.uniform(0, 1, 500),
+                               "w": rng.uniform(0, 1, 500)})
+            (session.create_dataframe(df, 1)
+             .filter(F.col("v") > 0.123456789)
+             .with_column("x", F.col("v") * 7.654321)
+             .with_column("y", F.col("x") + F.col("w"))
+             .collect())
+            fused_entries = [
+                e for e in LEDGER.entries(since_seq=seq0)
+                if (e.get("op") or "").startswith("TpuFusedStageExec")]
+            assert fused_entries, "fused-stage compile not in ledger"
+            assert any(e.get("members") for e in fused_entries)
+            ms = next(e["members"] for e in fused_entries
+                      if e.get("members"))
+            assert any("TpuFilterExec" in m for m in ms)
+        finally:
+            session.reset_conf()
+
+
+class TestFusionOracleEquivalence:
+    """Fusion ON vs the CPU oracle (which also proves ON == OFF — the
+    per-suite differential tests run the OFF path). Tier-1 keeps the
+    cheapest representative queries (q6 + q3-under-AQE, which exercises
+    scan/filter/project chains, a join, and AQE stage conversion); the
+    tpch/tpcxbb full sweeps and the mortgage workload run fusion-on in
+    the slow tier — tier-1's 870s budget cannot absorb them."""
+
+    @pytest.fixture(scope="class")
+    def tpch_tables(self):
+        from spark_rapids_tpu.models import tpch_data
+        sf = 0.002
+        return {"lineitem": tpch_data.gen_lineitem(sf),
+                "orders": tpch_data.gen_orders(sf),
+                "customer": tpch_data.gen_customer(sf),
+                "part": tpch_data.gen_part(sf)}
+
+    def test_tpch_q6_fusion_on(self, session, tpch_tables):
+        from spark_rapids_tpu.models.tpch import QUERIES
+        from tests.querytest import assert_tpu_and_cpu_equal
+
+        def run(s):
+            tables = {n: s.create_dataframe(df, 3)
+                      for n, df in tpch_tables.items()}
+            return QUERIES["q6"](s, tables)
+        assert_tpu_and_cpu_equal(run, approx=True, conf=dict(
+            FUSION_ON, **{"spark.rapids.sql.shuffle.partitions": 2}))
+
+    def test_fusion_under_aqe_small(self, session):
+        """Fusion cutting inside AQE's per-stage conversion, on a small
+        synthetic join+agg (the tpch q3 variant runs in the slow tier —
+        tier-1's budget)."""
+        from tests.querytest import assert_tpu_and_cpu_equal
+        rng = np.random.default_rng(8)
+        n = 1500
+        fact = pd.DataFrame({
+            "k": rng.integers(0, 30, n).astype(np.int64),
+            "v": rng.uniform(0, 10, n)})
+        dim = pd.DataFrame({"k": np.arange(40, dtype=np.int64),
+                            "w": rng.integers(0, 5, 40).astype(np.int64)})
+
+        def run(s):
+            f = (s.create_dataframe(fact, 2).filter(F.col("v") > 1)
+                 .with_column("x", F.col("v") * 2.0)
+                 .with_column("y", F.col("x") + 1.0))
+            d = s.create_dataframe(dim, 2)
+            return (f.join(d, on="k", how="inner").group_by("w")
+                    .agg(F.sum("y").alias("sy"),
+                         F.count("*").alias("c")))
+        assert_tpu_and_cpu_equal(run, approx=True, conf=dict(
+            FUSION_ON, **{
+                "spark.rapids.sql.adaptive.enabled": True,
+                "spark.rapids.sql.autoBroadcastJoinThreshold": -1,
+                "spark.rapids.sql.shuffle.partitions": 2}))
+
+
+@pytest.mark.slow
+class TestFusionOracleEquivalenceExtended:
+    """Fusion-on oracle checks beyond the tier-1 representatives:
+    more tpch queries, a tpcxbb query, and the mortgage agg-join."""
+
+    @pytest.fixture(scope="class")
+    def tpch_tables(self):
+        from spark_rapids_tpu.models import tpch_data
+        sf = 0.002
+        return {"lineitem": tpch_data.gen_lineitem(sf),
+                "orders": tpch_data.gen_orders(sf),
+                "customer": tpch_data.gen_customer(sf),
+                "part": tpch_data.gen_part(sf)}
+
+    @pytest.mark.parametrize("qname", ["q1", "q3", "q14"])
+    def test_tpch_fusion_on(self, session, tpch_tables, qname):
+        from spark_rapids_tpu.models.tpch import QUERIES
+        from tests.querytest import assert_tpu_and_cpu_equal
+
+        def run(s):
+            tables = {n: s.create_dataframe(df, 3)
+                      for n, df in tpch_tables.items()}
+            return QUERIES[qname](s, tables)
+        assert_tpu_and_cpu_equal(run, approx=True, conf=dict(
+            FUSION_ON, **{"spark.rapids.sql.shuffle.partitions": 2}))
+
+    def test_tpch_q3_fusion_under_aqe(self, session, tpch_tables):
+        from spark_rapids_tpu.models.tpch import QUERIES
+        from tests.querytest import assert_tpu_and_cpu_equal
+
+        def run(s):
+            tables = {n: s.create_dataframe(df, 3)
+                      for n, df in tpch_tables.items()}
+            return QUERIES["q3"](s, tables)
+        assert_tpu_and_cpu_equal(run, approx=True, conf=dict(
+            FUSION_ON, **{"spark.rapids.sql.adaptive.enabled": True,
+                          "spark.rapids.sql.shuffle.partitions": 2}))
+
+    def test_tpcxbb_fusion_on(self, session):
+        from spark_rapids_tpu.models import tpcxbb_data
+        from spark_rapids_tpu.models.tpcxbb import QUERIES
+        from tests.querytest import assert_tpu_and_cpu_equal
+        tables_pd = {name: fn(0.02, None)
+                     for name, fn in tpcxbb_data.ALL_TABLES.items()}
+
+        def run(s):
+            tables = {n: s.create_dataframe(df, 2)
+                      for n, df in tables_pd.items()}
+            return QUERIES["q6"](s, tables)
+        assert_tpu_and_cpu_equal(run, approx=True, conf=dict(
+            FUSION_ON, **{"spark.rapids.sql.shuffle.partitions": 2}))
+
+    def test_mortgage_agg_join_fusion_on(self, session):
+        from spark_rapids_tpu.models import mortgage, mortgage_data
+        from tests.querytest import assert_tpu_and_cpu_equal
+        perf_pd = mortgage_data.gen_performance(0.02)
+        acq_pd = mortgage_data.gen_acquisition(0.02)
+
+        def run(s):
+            return mortgage.aggregates_with_join(
+                s, s.create_dataframe(perf_pd, 2),
+                s.create_dataframe(acq_pd, 2))
+        assert_tpu_and_cpu_equal(run, approx=True, conf=FUSION_ON)
+
+
+@pytest.mark.slow
+class TestFusionFullSweep:
+    """The full fusion-on oracle sweep over every tpch + tpcxbb query
+    (the tier-1 classes above cover the representative subset)."""
+
+    @pytest.fixture(scope="class")
+    def tpch_all(self):
+        from spark_rapids_tpu.models import tpch_data
+        tables = {name: gen(0.002)
+                  for name, gen in tpch_data.ALL_TABLES.items()}
+        tables["nation"] = tpch_data.gen_nation()
+        tables["region"] = tpch_data.gen_region()
+        return tables
+
+    def test_tpch_all_queries_fusion_on(self, session, tpch_all):
+        from spark_rapids_tpu.models.tpch import QUERIES
+        from tests.querytest import assert_tpu_and_cpu_equal
+        for qname in sorted(QUERIES, key=lambda q: int(q[1:])):
+            def run(s, qname=qname):
+                tables = {n: s.create_dataframe(
+                    df, 3 if len(df) > 50 else 1)
+                    for n, df in tpch_all.items()}
+                return QUERIES[qname](s, tables)
+            assert_tpu_and_cpu_equal(run, approx=True, conf=dict(
+                FUSION_ON, **{
+                    "spark.rapids.sql.exec.CartesianProductExec": True,
+                    "spark.rapids.sql.shuffle.partitions": 2}))
+
+    def test_tpcxbb_all_queries_fusion_on(self, session):
+        from spark_rapids_tpu.models import tpcxbb_data
+        from spark_rapids_tpu.models.tpcxbb import QUERIES
+        from tests.querytest import assert_tpu_and_cpu_equal
+        tables_pd = {name: fn(0.05, None)
+                     for name, fn in tpcxbb_data.ALL_TABLES.items()}
+        for qname in sorted(QUERIES, key=lambda q: int(q[1:])):
+            def run(s, qname=qname):
+                tables = {n: s.create_dataframe(
+                    df, 3 if len(df) > 100 else 1)
+                    for n, df in tables_pd.items()}
+                return QUERIES[qname](s, tables)
+            assert_tpu_and_cpu_equal(run, approx=True, conf=dict(
+                FUSION_ON,
+                **{"spark.rapids.sql.shuffle.partitions": 2}))

@@ -21,12 +21,15 @@ perfdiff = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(perfdiff)
 
 
-def _detail(tmp_path, name, speedups, extra=None, compiles=None):
+def _detail(tmp_path, name, speedups, extra=None, compiles=None,
+            dispatch=None):
     doc = {"sf": 0.5, "iters": 3,
            "queries": {q: {"speedup": s, "tpu_s": 1.0, "cpu_s": s}
                        for q, s in speedups.items()}}
     for q, n in (compiles or {}).items():
         doc["queries"].setdefault(q, {})["timed_compiles"] = n
+    for q, d in (dispatch or {}).items():
+        doc["queries"].setdefault(q, {})["dispatch_share"] = d
     if extra:
         doc["queries"].update(extra)
     p = str(tmp_path / name)
@@ -245,6 +248,70 @@ class TestCompileGate:
         assert rep["compile_regressions"] == ["q1"]
         assert rep["compile_deltas"] == [
             {"query": "q1", "base": 0, "new": 2, "regressed": True}]
+
+
+class TestDispatchShareGate:
+    """The breakdown gate (whole-stage fusion satellite): bench.py
+    records per-query device/transfer/dispatch shares in BENCH_DETAIL;
+    a dispatch share growing more than the threshold between sweeps
+    regresses like a slowdown (the engine got MORE dispatch-bound)."""
+
+    def test_load_dispatch_detail_shape(self, tmp_path):
+        p = _detail(tmp_path, "d.json", {"q1": 2.0, "q2": 1.5},
+                    dispatch={"q1": 0.42})
+        with open(p) as f:
+            doc = json.load(f)
+        assert perfdiff.dispatch_from_doc(doc) == {"q1": 0.42}
+
+    def test_dispatch_increase_regresses(self, tmp_path, capsys):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0},
+                       dispatch={"q1": 0.20})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0},
+                      dispatch={"q1": 0.55})
+        assert perfdiff.main([base, new]) == 1
+        assert "DISPATCH-SHARE REGRESSION" in capsys.readouterr().out
+
+    def test_dispatch_decrease_and_small_increase_pass(self, tmp_path):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0, "q2": 2.0},
+                       dispatch={"q1": 0.60, "q2": 0.30})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0, "q2": 2.0},
+                      dispatch={"q1": 0.10, "q2": 0.35})
+        assert perfdiff.main([base, new]) == 0
+
+    def test_dispatch_threshold_flag(self, tmp_path):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0},
+                       dispatch={"q1": 0.30})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0},
+                      dispatch={"q1": 0.38})
+        assert perfdiff.main([base, new]) == 0  # default 0.10
+        assert perfdiff.main(
+            [base, new, "--dispatch-threshold", "0.05"]) == 1
+
+    def test_ignore_dispatch_flag(self, tmp_path):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0},
+                       dispatch={"q1": 0.10})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0},
+                      dispatch={"q1": 0.90})
+        assert perfdiff.main([base, new, "--ignore-dispatch"]) == 0
+
+    def test_missing_dispatch_data_does_not_gate(self, tmp_path):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0},
+                      dispatch={"q1": 0.90})
+        assert perfdiff.main([base, new]) == 0
+
+    def test_dispatch_deltas_in_json(self, tmp_path, capsys):
+        base = _detail(tmp_path, "base.json", {"q1": 2.0},
+                       dispatch={"q1": 0.20})
+        new = _detail(tmp_path, "new.json", {"q1": 2.0},
+                      dispatch={"q1": 0.80})
+        out_p = str(tmp_path / "diff.json")
+        assert perfdiff.main([base, new, "--json", out_p]) == 1
+        with open(out_p) as f:
+            rep = json.load(f)
+        assert rep["dispatch_regressions"] == ["q1"]
+        assert rep["dispatch_deltas"] == [
+            {"query": "q1", "base": 0.2, "new": 0.8, "regressed": True}]
 
 
 def _serve(tmp_path, name, qps, verified=True, p50=0.5, p99=1.2,

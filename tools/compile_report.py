@@ -80,6 +80,7 @@ def _load_entries(path: str) -> List[Dict[str, Any]]:
                 "op": ev.get("op"), "kernel": ev.get("kernel"),
                 "avals": ev.get("avals"), "query": name,
                 "outcome": ev.get("outcome"),
+                "members": ev.get("members"),
                 "seconds": float(ev.get("seconds", 0.0))})
         return out
     with open_event_file(path) as f:
@@ -138,6 +139,14 @@ def render_text(rep: Dict[str, Any], top_n: int = 15,
             if g["op"]:
                 ops = ", ".join(o[:60] for o in g["ops"][:2])
                 lines.append(f"{'':>28}  op: {ops}")
+            if g.get("members"):
+                # fused-stage compiles name the member pipeline inside
+                # the fused program (exec/stagecompiler)
+                lines.append(
+                    f"{'':>28}  members: "
+                    + " -> ".join(m.split("(", 1)[0]
+                                  for m in g["members"][:8])
+                    + (" ..." if len(g["members"]) > 8 else ""))
             if g["queries"]:
                 lines.append(
                     f"{'':>28}  queries: "

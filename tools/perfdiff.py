@@ -27,6 +27,13 @@ pathology that inflates wall time no speedup threshold reliably
 catches. Any increase on a common query exits 1, same as a speedup
 regression (``--ignore-compiles`` disables).
 
+It also gates the **dispatch share** of the per-query device/transfer/
+dispatch breakdown bench.py records in BENCH_DETAIL
+(``dispatch_share``): a query whose dispatch fraction grows more than
+``--dispatch-threshold`` (default 0.10 absolute) between sweeps got
+MORE dispatch-bound — the pathology whole-stage fusion exists to
+collapse (docs/fusion.md). ``--ignore-dispatch`` disables.
+
 Exit codes: 0 = no regression, 1 = regression (any common query slower
 than ``--threshold``, default 10%, geomean drift below
 ``--geomean-threshold``, default 5%, or a steady-state compile-count
@@ -110,6 +117,17 @@ def compiles_from_doc(doc: Dict[str, Any]) -> Dict[str, int]:
     return {}
 
 
+def dispatch_from_doc(doc: Dict[str, Any]) -> Dict[str, float]:
+    """Per-query dispatch-time share of the device/transfer/dispatch
+    breakdown (``bench.py`` records it in BENCH_DETAIL under
+    ``dispatch_share``); empty for artifact shapes without it."""
+    if isinstance(doc.get("queries"), dict):
+        return {name: float(rec["dispatch_share"])
+                for name, rec in doc["queries"].items()
+                if isinstance(rec, dict) and "dispatch_share" in rec}
+    return {}
+
+
 def serve_from_doc(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Serve-mode artifact (``BENCH_SERVE.json`` from ``bench.py
     --concurrency N``): throughput + latency quantiles. None when the
@@ -176,7 +194,10 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
             new: Dict[str, float], new_geo: Optional[float],
             threshold: float, geo_threshold: float,
             base_compiles: Optional[Dict[str, int]] = None,
-            new_compiles: Optional[Dict[str, int]] = None) -> Dict[str, Any]:
+            new_compiles: Optional[Dict[str, int]] = None,
+            base_dispatch: Optional[Dict[str, float]] = None,
+            new_dispatch: Optional[Dict[str, float]] = None,
+            dispatch_threshold: float = 0.10) -> Dict[str, Any]:
     common = sorted(set(base) & set(new))
     deltas = []
     for q in common:
@@ -212,9 +233,25 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
                                    "regressed": n > b})
     compile_regressions = [d["query"] for d in compile_deltas
                            if d["regressed"]]
+    # dispatch-share gate: the breakdown's dispatch fraction growing
+    # between sweeps means the engine got MORE dispatch-bound — the
+    # exact pathology whole-stage fusion exists to collapse. An absolute
+    # share increase beyond dispatch_threshold regresses.
+    dispatch_deltas = []
+    for q in sorted(set(base_dispatch or {}) & set(new_dispatch or {})):
+        b, n = base_dispatch[q], new_dispatch[q]
+        if abs(n - b) > 1e-9:
+            dispatch_deltas.append({
+                "query": q, "base": round(b, 4), "new": round(n, 4),
+                "regressed": (n - b) > dispatch_threshold})
+    dispatch_regressions = [d["query"] for d in dispatch_deltas
+                            if d["regressed"]]
     return {
         "compile_deltas": compile_deltas,
         "compile_regressions": compile_regressions,
+        "dispatch_deltas": dispatch_deltas,
+        "dispatch_regressions": dispatch_regressions,
+        "dispatch_threshold": round(dispatch_threshold, 4),
         "common_queries": len(common),
         "only_in_base": sorted(set(base) - set(new)),
         "only_in_new": sorted(set(new) - set(base)),
@@ -229,7 +266,7 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
         "improvements": [r["query"] for r in deltas if r["improved"]],
         "deltas": deltas,
         "regressed": bool(regressions) or geo_regressed
-        or bool(compile_regressions),
+        or bool(compile_regressions) or bool(dispatch_regressions),
     }
 
 
@@ -267,6 +304,11 @@ def render_text(rep: Dict[str, Any]) -> str:
             else " (improved)"
         lines.append(f"-- timed_compiles {d['query']}: "
                      f"{d['base']} -> {d['new']}{mark}")
+    for d in rep.get("dispatch_deltas", []):
+        if d["regressed"]:
+            lines.append(f"-- dispatch_share {d['query']}: "
+                         f"{d['base']:.2f} -> {d['new']:.2f} "
+                         "DISPATCH-SHARE REGRESSION")
     lines.append("RESULT: " + ("REGRESSED" if rep["regressed"] else "ok"))
     return "\n".join(lines)
 
@@ -285,6 +327,14 @@ def main(argv=None) -> int:
     ap.add_argument("--ignore-compiles", action="store_true",
                     help="do not gate on steady-state (timed_compiles) "
                          "compile-count increases")
+    ap.add_argument("--ignore-dispatch", action="store_true",
+                    help="do not gate on per-query dispatch-share "
+                         "increases (the device/transfer/dispatch "
+                         "breakdown bench.py records)")
+    ap.add_argument("--dispatch-threshold", type=float, default=0.10,
+                    help="absolute dispatch-share increase that counts "
+                         "as a regression (default 0.10 = 10 share "
+                         "points)")
     ap.add_argument("--json", metavar="OUT", default="",
                     help="also write the machine-shape diff ('-' = "
                          "stdout)")
@@ -316,6 +366,10 @@ def main(argv=None) -> int:
             else compiles_from_doc(base_doc)
         new_c = {} if args.ignore_compiles \
             else compiles_from_doc(new_doc)
+        base_d = {} if args.ignore_dispatch \
+            else dispatch_from_doc(base_doc)
+        new_d = {} if args.ignore_dispatch \
+            else dispatch_from_doc(new_doc)
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
         print(f"perfdiff: {e}", file=sys.stderr)
         return 2
@@ -330,7 +384,9 @@ def main(argv=None) -> int:
             return 2
     rep = compare(base, base_geo, new, new_geo,
                   args.threshold, args.geomean_threshold,
-                  base_compiles=base_c, new_compiles=new_c)
+                  base_compiles=base_c, new_compiles=new_c,
+                  base_dispatch=base_d, new_dispatch=new_d,
+                  dispatch_threshold=args.dispatch_threshold)
     if args.json == "-":
         print(json.dumps(rep, indent=1))
     else:
