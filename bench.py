@@ -217,15 +217,23 @@ def _worker():
     sf = float(os.environ.get("BENCH_SF", "0.5"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
 
-    compile_counts = {"n": 0, "secs": 0.0}
+    compile_counts = {"n": 0, "secs": 0.0, "cache_hits": 0}
 
     def _on_event_duration(name, dur, **kw):
         if "backend_compile" in name:
             compile_counts["n"] += 1
             compile_counts["secs"] += dur
 
+    def _on_event(name, **kw):
+        # a persistent-cache hit still fires a backend_compile duration
+        # (the deserialize) — count hits separately so warm_compiles
+        # reports REAL XLA compiles, not shared-cache loads
+        if name == "/jax/compilation_cache/cache_hits":
+            compile_counts["cache_hits"] += 1
+
     from jax import monitoring
     monitoring.register_event_duration_secs_listener(_on_event_duration)
+    monitoring.register_event_listener(_on_event)
 
     from spark_rapids_tpu.session import TpuSparkSession
     from spark_rapids_tpu.utils import kernelcache
@@ -239,7 +247,24 @@ def _worker():
         # the dispatch-bound laggards are the queries it exists for;
         # BENCH_FUSION=0 reproduces the per-operator plans
         "spark.rapids.sql.fusion.stageEnabled",
-        os.environ.get("BENCH_FUSION", "1") != "0").get_or_create()
+        os.environ.get("BENCH_FUSION", "1") != "0").config(
+        # coarse secondary-dimension shape buckets (docs/aot.md): bench
+        # default ON — one compile serves a dimension range;
+        # BENCH_SHAPE_BUCKETS=0 reproduces unpadded shapes
+        "spark.rapids.tpu.compile.shapeBuckets",
+        os.environ.get("BENCH_SHAPE_BUCKETS", "1") != "0").get_or_create()
+
+    # cross-process shared compile cache + AOT pre-warm: point two
+    # sweeps at the same BENCH_SHARED_CACHE_DIR (and feed the second the
+    # first's manifest via BENCH_AOT_MANIFEST) and the second's worker
+    # reaches steady state with warm_compiles ~ 0 — the fresh-process
+    # zero-warm-up demonstration (docs/aot.md)
+    if os.environ.get("BENCH_SHARED_CACHE_DIR"):
+        session.set_conf("spark.rapids.tpu.compile.sharedCache.dir",
+                         os.environ["BENCH_SHARED_CACHE_DIR"])
+    if os.environ.get("BENCH_AOT_MANIFEST"):
+        session.set_conf("spark.rapids.tpu.compile.aot.manifest",
+                         os.environ["BENCH_AOT_MANIFEST"])
 
     # --event-log: every query of the sweep journals durable facts
     # (query lifecycle, fallbacks, spills, retries, compiles) so the run
@@ -312,6 +337,7 @@ def _worker():
     def measure(fn):
         rec = {}
         c0, s0 = compile_counts["n"], compile_counts["secs"]
+        h0 = compile_counts["cache_hits"]
         t0 = time.perf_counter()
         # warm until the compile count settles (max 4 runs): adaptive
         # paths (partial-skip ratio learning, seen-plan dense grouping)
@@ -323,21 +349,42 @@ def _worker():
             cb = compile_counts["n"]
             tpu_out = run_query(fn, True)
             warm_runs += 1
+            if warm_runs == 1:
+                # cold first-query wall: the p99-first-query number the
+                # zero-warm-up work (shared cache + AOT replay) drives
+                # toward steady state; perfdiff's warm-up gate compares
+                # it between sweeps
+                rec["first_run_s"] = round(time.perf_counter() - t0, 4)
             if compile_counts["n"] == cb and warm_runs >= 2:
                 break
         rec["warm_s"] = round(time.perf_counter() - t0, 4)
         rec["warm_runs"] = warm_runs
-        rec["warm_compiles"] = compile_counts["n"] - c0
+        # REAL XLA compiles during warm-up: persistent-cache hits fire a
+        # backend_compile duration too (the deserialize), so subtract
+        # them — a fresh process riding a warm shared cache reports ~0
+        warm_hits = compile_counts["cache_hits"] - h0
+        rec["warm_compiles"] = max(
+            compile_counts["n"] - c0 - warm_hits, 0)
+        rec["warm_cache_hits"] = warm_hits
         rec["warm_compile_s"] = round(compile_counts["secs"] - s0, 3)
 
         c0, s0 = compile_counts["n"], compile_counts["secs"]
+        h0 = compile_counts["cache_hits"]
         k0 = kernelcache.cache_stats()["misses"]
         tpu_iters = []
         for _ in range(iters):
             t0 = time.perf_counter()
             tpu_out = run_query(fn, True)
             tpu_iters.append(round(time.perf_counter() - t0, 4))
-        rec["timed_compiles"] = compile_counts["n"] - c0
+        # real retraces only: with the shared cache on, a background AOT
+        # replay's persistent-cache DESERIALIZE can land inside the
+        # timed window — a cache load, not the steady-state recompile
+        # pathology this counter gates (hits are zero without the cache,
+        # so the default-config number is unchanged)
+        timed_hits = compile_counts["cache_hits"] - h0
+        rec["timed_compiles"] = max(
+            compile_counts["n"] - c0 - timed_hits, 0)
+        rec["timed_cache_hits"] = timed_hits
         rec["timed_compile_s"] = round(compile_counts["secs"] - s0, 3)
         # the ROADMAP item 2 trajectory number: total compiler seconds
         # this query paid, warm-up + (pathological) steady state
@@ -787,6 +834,27 @@ def _parse_sweep():
     return suite_env, sweep
 
 
+def _cold_start_by_suite(sweep, detail):
+    """{suite: {first_query_s, warm_compiles, warm_compile_s}} — the
+    suite's FIRST scored query's cold wall plus its summed real warm-up
+    compiles (persistent-cache hits excluded by the worker)."""
+    out = {}
+    for name, sn, _q in sweep:
+        rec = detail.get(name)
+        if not isinstance(rec, dict) or "speedup" not in rec:
+            continue
+        d = out.setdefault(sn, {"first_query_s": None,
+                                "warm_compiles": 0,
+                                "warm_compile_s": 0.0})
+        if d["first_query_s"] is None and rec.get("first_run_s") \
+                is not None:
+            d["first_query_s"] = rec["first_run_s"]
+        d["warm_compiles"] += rec.get("warm_compiles", 0)
+        d["warm_compile_s"] = round(
+            d["warm_compile_s"] + rec.get("warm_compile_s", 0.0), 3)
+    return out
+
+
 def _wait_for_idle_box():
     """Refuse to start measuring on a loaded box: spin-wait (up to
     BENCH_LOAD_WAIT_S, default 600s) until 1-min loadavg drops below
@@ -1092,6 +1160,14 @@ def main():
                                     for v in scored.values()),
         "warm_compiles_total": sum(v.get("warm_compiles", 0)
                                    for v in scored.values()),
+        "warm_cache_hits_total": sum(v.get("warm_cache_hits", 0)
+                                     for v in scored.values()),
+        # cold-process metrics per suite: the first query's cold wall
+        # (paid once per fresh worker) + the suite's real warm-up
+        # compiles — the numbers the zero-warm-up layer (shape buckets,
+        # shared cache, AOT replay; docs/aot.md) exists to zero, gated
+        # run-over-run by tools/perfdiff.py's warm-up gate
+        "cold_start": _cold_start_by_suite(sweep, detail),
         "warm_compile_s_total": round(sum(v.get("warm_compile_s", 0.0)
                                           for v in scored.values()), 1),
         # compile count + seconds per sweep (warm + timed): the

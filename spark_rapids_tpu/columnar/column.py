@@ -571,8 +571,13 @@ def host_dict_encode_stateful(values: np.ndarray,
 
 
 def _char_bucket(n: int, minimum: int = 16) -> int:
-    """Round a char-buffer size up to a power-of-two bucket."""
+    """Round a char-buffer size up to a power-of-two bucket. With shape
+    buckets on (spark.rapids.tpu.compile.shapeBuckets) the bucket pads
+    up the coarse ladder (utils/kernelcache.bucket_dim) — char-slab
+    capacities are one of the dimensions the recompile-cause analyzer
+    flags as varying per value."""
     cap = minimum
     while cap < n:
         cap <<= 1
-    return cap
+    from spark_rapids_tpu.utils.kernelcache import bucket_dim
+    return bucket_dim(cap)

@@ -710,6 +710,91 @@ COMPILE_LEDGER_MAX_ENTRIES = register(
     "first). 2048 covers ~50 fully-cold warm-up queries at the observed "
     "19-36 compiles per query.", validator=_positive)
 
+# --- zero-warm-up serving (utils/kernelcache.py shape buckets,
+# obs/compilecache.py shared cache, serving/prewarm.py AOT replay — the
+# ledger's recompile-cause analysis ACTED on: one compile serves a
+# dimension range, each kernel compiles once per cluster, and history
+# pre-warms a fresh process before traffic arrives) ------------------------
+COMPILE_SHAPE_BUCKETS = register(
+    "spark.rapids.tpu.compile.shapeBuckets", _to_bool, False,
+    "Bucket-padded kernel signatures on the batch path: SECONDARY shape "
+    "dimensions the recompile-cause analyzer flags as varying (join "
+    "build-table capacities, join-expansion output capacities, "
+    "aggregation group capacities, hash-table sizes, string char-slab "
+    "capacities) are padded up to a coarser bucket ladder at the "
+    "cached-kernel dispatch boundary (utils/kernelcache.bucket_dim), so "
+    "ONE compile serves a dimension range instead of one per observed "
+    "bucket. Row counts stay exact (num_rows is data; the padding region "
+    "is masked exactly like today's capacity padding), so results are "
+    "value-identical — only capacities grow. false (default) is "
+    "byte-identical to the unpadded engine; the bench harness turns it "
+    "on (BENCH_SHAPE_BUCKETS=0 reproduces unpadded shapes). Batch ROW "
+    "capacities (spark.rapids.sql.batchSizeRows buckets) are already "
+    "the stable primary dimension and are never re-padded.")
+
+COMPILE_SHAPE_BUCKETS_MIN = register(
+    "spark.rapids.tpu.compile.shapeBuckets.minBucket", int, 4096,
+    "Floor of the coarse secondary-dimension bucket ladder: every padded "
+    "dimension is at least this, collapsing the small buckets "
+    "(8..minBucket/2) — the long tail of per-query build-table and "
+    "char-slab compiles — into one compiled shape. Padding cost is "
+    "bounded by minBucket elements per small dimension.",
+    validator=_positive)
+
+COMPILE_SHAPE_BUCKETS_GROWTH = register(
+    "spark.rapids.tpu.compile.shapeBuckets.growth", float, 2.0,
+    "Growth factor between coarse secondary-dimension buckets above the "
+    "floor. 2.0 keeps the analyzer's power-of-two ladder; 4.0 halves the "
+    "number of compiled shapes again at the cost of up to 4x padding on "
+    "those dimensions.", validator=_fraction(1.1, 16.0))
+
+COMPILE_SHARED_CACHE_DIR = register(
+    "spark.rapids.tpu.compile.sharedCache.dir", str, "",
+    "Directory of the CROSS-PROCESS shared persistent compile cache "
+    "(obs/compilecache.py SharedCompileCache). When set: jax's "
+    "persistent executable cache is pointed at <dir>/xla (explicitly "
+    "including the CPU backend — the opt-in overrides the "
+    "accelerated-only default, safe because the versioned manifest keys "
+    "carry the jax version + backend + machine so a foreign executable "
+    "is never attributed as warm), and every backend compile appends a "
+    "file-locked record to <dir>/manifest.jsonl so a fleet of workers "
+    "compiles each kernel once per CLUSTER, not once per process. "
+    "Hit/miss/steal/write counters surface as srt_sharedcache_* "
+    "Prometheus series ('steal' = this process reused an executable "
+    "another process compiled). Empty (default) disables — the "
+    "per-process behavior is unchanged.")
+
+COMPILE_SHARED_CACHE_MIN_S = register(
+    "spark.rapids.tpu.compile.sharedCache.minCompileSeconds", float, 0.0,
+    "Minimum compile seconds before an executable is persisted into the "
+    "shared cache (jax_persistent_cache_min_compile_time_secs while the "
+    "shared cache is enabled). 0 persists everything — right for "
+    "cluster-wide reuse where even a 50ms compile times N workers x M "
+    "shapes adds up.", validator=_non_negative)
+
+COMPILE_AOT_MANIFEST = register(
+    "spark.rapids.tpu.compile.aot.manifest", str, "",
+    "Path of an AOT pre-warm manifest (tools/compile_report.py "
+    "--aot-manifest, distilled from a sweep's event log): observed "
+    "kernel identities + shape signatures + replayable argument specs. "
+    "When set, the session starts a background pre-warm pass "
+    "(serving/prewarm.py): as each listed kernel is built, every "
+    "historical shape signature recorded for it is compiled (and its "
+    "jit dispatch cache warmed) on a worker thread — overlapping "
+    "planning/scan instead of serializing into first-query latency, "
+    "and pulling executables straight out of the shared cache when one "
+    "is configured. Cancellable, budget-capped "
+    "(compile.aot.budgetSeconds); progress (warmed/pending/skipped) "
+    "surfaces at /api/status and as srt_aot_* series. Empty (default) "
+    "disables.")
+
+COMPILE_AOT_BUDGET = register(
+    "spark.rapids.tpu.compile.aot.budgetSeconds", float, 120.0,
+    "Wall-clock budget of the AOT pre-warm pass; once spent, remaining "
+    "manifest entries are left to warm on demand (counted as pending, "
+    "never blocking queries — the pass runs strictly in the "
+    "background). 0 disables the cap.", validator=_non_negative)
+
 COMPILE_LEDGER_COST_ANALYSIS = register(
     "spark.rapids.tpu.compileLedger.costAnalysis", _to_bool, False,
     "After each backend compile, re-lower the kernel and attach XLA "

@@ -164,8 +164,12 @@ class TpuCountDistinctExec(PhysicalPlan):
             if not batches:
                 yield DeviceBatch.empty(self._schema)
                 return
+            # coarse materialization: the fused pass's kernel signature
+            # rides the merged capacity — the shape-bucket ladder keeps
+            # it stable across input sizes (compile.shapeBuckets)
             merged = _concat_device(
-                batches, self.children[0].output_schema(), growth)
+                batches, self.children[0].output_schema(), growth,
+                coarse=True)
             yield kernel(merged)
         return [run]
 

@@ -37,7 +37,8 @@ class RequireSingleBatch(CoalesceGoal):
 
 
 def coalesce_iter(batches, goal: CoalesceGoal, schema: Schema,
-                  growth: float) -> Iterator[DeviceBatch]:
+                  growth: float, coarse: bool = False
+                  ) -> Iterator[DeviceBatch]:
     """Accumulate a batch stream to ``goal`` and concatenate — the one
     coalescing loop, shared by TpuCoalesceBatchesExec and the fused
     stage's input re-batching (exec/stagecompiler/fusedexec.py).
@@ -45,7 +46,13 @@ def coalesce_iter(batches, goal: CoalesceGoal, schema: Schema,
     Capacity-based accounting: an exact count would cost a device->host
     scalar sync per batch (~hundreds of ms through remote attachments);
     the bucketed capacity over-estimates by at most 2x, which only makes
-    coalesced outputs slightly smaller than the goal."""
+    coalesced outputs slightly smaller than the goal.
+
+    ``coarse``: pad the concatenated capacity up the shape-bucket ladder
+    (spark.rapids.tpu.compile.shapeBuckets; identity when off) — the
+    fused-stage re-batching uses it so small tail fragments land on the
+    same compiled capacity as each other instead of one program per
+    tail size."""
     from spark_rapids_tpu.exec.tpu import _concat_device
     single = isinstance(goal, RequireSingleBatch)
     target = 0 if single else goal.rows
@@ -58,10 +65,10 @@ def coalesce_iter(batches, goal: CoalesceGoal, schema: Schema,
         pending.append(batch)
         pending_rows += rows
         if not single and pending_rows >= target:
-            yield _concat_device(pending, schema, growth)
+            yield _concat_device(pending, schema, growth, coarse=coarse)
             pending, pending_rows = [], 0
     if pending:
-        yield _concat_device(pending, schema, growth)
+        yield _concat_device(pending, schema, growth, coarse=coarse)
 
 
 class TpuCoalesceBatchesExec(PhysicalPlan):

@@ -143,7 +143,10 @@ class TpuGenerateExec(PhysicalPlan):
                         continue
                     ccaps = tuple(_char_bucket(c) for c in sizes[1:-1])
                     tcap = _char_bucket(sizes[-1])
-                    out_cap = bucket_capacity(total, growth)
+                    from spark_rapids_tpu.utils.kernelcache import (
+                        bucket_dim,
+                    )
+                    out_cap = bucket_dim(bucket_capacity(total, growth))
                     emitted = True
                     yield self._expand(batch, out_cap, ccaps, tcap)
                 if not emitted:

@@ -187,7 +187,11 @@ class TpuFusedStageExec(PhysicalPlan):
                 yield from part()
                 return
             from spark_rapids_tpu.exec.coalesce import coalesce_iter
-            yield from coalesce_iter(part(), goal, in_schema, growth)
+            # coarse re-batching: the fused program's compile rides the
+            # input capacity, so tail fragments pad onto the shape-
+            # bucket ladder (compile.shapeBuckets; identity when off)
+            yield from coalesce_iter(part(), goal, in_schema, growth,
+                                     coarse=True)
 
         out_goal = self.output_goal
         out_schema = self.output_schema()

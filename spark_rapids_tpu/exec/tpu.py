@@ -41,12 +41,25 @@ class TpuExec(PhysicalPlan):
 
 
 def _concat_device(batches: List[DeviceBatch], schema: Schema,
-                   growth: float, keep_masks=None) -> DeviceBatch:
+                   growth: float, keep_masks=None,
+                   coarse: bool = False) -> DeviceBatch:
     """Concatenate device batches (GpuCoalesceBatches / ConcatAndConsumeAll,
     GpuCoalesceBatches.scala:38-165). ``keep_masks``: per-batch keep
-    vectors of a fused Filter (see _fused_filter_source)."""
+    vectors of a fused Filter (see _fused_filter_source). ``coarse``:
+    pad the output capacity up the shape-bucket ladder
+    (utils/kernelcache.bucket_dim) — used for SECONDARY-dimension
+    materializations (join build tables, broadcast tables, fused
+    count-distinct inputs) so one downstream compile serves a capacity
+    range; identity while spark.rapids.tpu.compile.shapeBuckets is off."""
     if len(batches) == 1 and keep_masks is None:
-        return batches[0]
+        if coarse:
+            from spark_rapids_tpu.utils.kernelcache import bucket_dim
+            if bucket_dim(batches[0].capacity) == batches[0].capacity:
+                return batches[0]
+            # single-batch build tables still re-pad to the coarse
+            # bucket: the point is a STABLE downstream capacity
+        else:
+            return batches[0]
     if not batches:
         return DeviceBatch.empty(schema)
     # mesh execution commits batches to their shard device; a concat that
@@ -63,6 +76,9 @@ def _concat_device(batches: List[DeviceBatch], schema: Schema,
             keep_masks = [jax.device_put(k, target) for k in keep_masks]
     total_cap = sum(b.capacity for b in batches)
     out_cap = bucket_capacity(total_cap, growth)
+    if coarse:
+        from spark_rapids_tpu.utils.kernelcache import bucket_dim
+        out_cap = bucket_dim(out_cap)
     # one generic jitted concat kernel; jax re-specializes per pytree shape.
     # char capacity 0 = per-column sum computed inside concat_batches
     if keep_masks is None:

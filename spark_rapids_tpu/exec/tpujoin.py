@@ -20,7 +20,7 @@ from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema, bucket_capacity
 from spark_rapids_tpu.columnar.column import _char_bucket
 from spark_rapids_tpu.exec.base import ExecContext, Partition, PhysicalPlan
 from spark_rapids_tpu.ops import joins as join_ops
-from spark_rapids_tpu.utils.kernelcache import cached_jit
+from spark_rapids_tpu.utils.kernelcache import bucket_dim, cached_jit
 
 SUPPORTED_JOIN_TYPES = ("inner", "left", "right", "full", "leftsemi",
                         "leftanti", "cross")
@@ -63,14 +63,14 @@ class TpuBroadcastExchangeExec(PhysicalPlan):
             batches = [b for p in parts for b in p()]
             if not batches:
                 return _concat_device(batches, child.output_schema(),
-                                      growth)
+                                      growth, coarse=True)
             masks = None
             if mask_kernel is not None:
                 masks = [mask_kernel(b) for b in batches]
                 if out_sel is not None:
                     batches = [_select_view(b, out_sel) for b in batches]
             out = _concat_device(batches, child.output_schema(), growth,
-                                 masks)
+                                 masks, coarse=True)
             if ctx.metrics_enabled:
                 # build-table size on record: the broadcast twin of the
                 # exchanges' MapStatus sizes, so a (static or AQE-demoted)
@@ -233,7 +233,7 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
         table_size = 1024
         while table_size < rng:
             table_size <<= 1
-        return lo, table_size
+        return lo, bucket_dim(table_size)
 
     def _dense_kernel(self, table_size: int):
         bk, sk = self._bkey[0], self._skey[0]
@@ -319,7 +319,8 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                             mesh_broadcast,
                         )
                         build0 = _concat_device(list(orig_bp()),
-                                                build_schema, growth)
+                                                build_schema, growth,
+                                                coarse=True)
                         bstate["v"] = mesh_broadcast(mesh, build0)
                     return bstate["v"]
 
@@ -365,7 +366,8 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
         def make(sp: Partition, bp: Partition, pidx: int) -> Partition:
             def run() -> Iterator[DeviceBatch]:
                 from spark_rapids_tpu.exec.tpu import _concat_device
-                build = _concat_device(list(bp()), build_schema, growth)
+                build = _concat_device(list(bp()), build_schema, growth,
+                                       coarse=True)
                 matched_acc = None
                 emitted = False
                 nonlocal dense
@@ -497,7 +499,8 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                                        for c in sizes[1:1 + n_s])
                         b_caps = tuple(_char_bucket(c)
                                        for c in sizes[1 + n_s:])
-                        out_cap = bucket_capacity(total, growth)
+                        out_cap = bucket_dim(
+                            bucket_capacity(total, growth))
                         if spec_hit:
                             caps_used.append((out_cap, s_caps, b_caps))
                         emitted = True

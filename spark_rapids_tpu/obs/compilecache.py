@@ -38,12 +38,292 @@ objects.
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
+import socket
 import threading
+from typing import Any, Dict, Optional
 
 _LOCK = threading.Lock()
 # the marker attribute set on jax's monitoring module: survives a reload
 # of THIS module, which a module-local flag would not
 _MARKER = "_srt_compile_listeners_installed"
+
+
+# ---------------------------------------------------------------------------
+# Cross-process shared persistent compile cache
+# ---------------------------------------------------------------------------
+
+class SharedCompileCache:
+    """Fleet-wide compile-once coordination
+    (``spark.rapids.tpu.compile.sharedCache.dir``).
+
+    Two halves:
+
+      * the EXECUTABLES live in jax's persistent compilation cache,
+        pointed at ``<dir>/xla`` — the mechanism that actually lets a
+        fresh process skip the XLA compile. The shared-cache opt-in
+        extends it to the CPU backend (the package default is
+        accelerated-only, see ``enable_persistent_cache_if_accelerated``)
+        because the explicit dir conveys same-fleet intent, and the
+        manifest keys below carry the jax version + backend + machine so
+        accounting never attributes a foreign build as warm;
+      * the MANIFEST (``<dir>/manifest.jsonl``) is the durable fleet
+        record: one file-locked appended line per backend compile that
+        actually ran, carrying the versioned key, kernel identity, aval
+        signature, op, seconds and the writing (pid, host). It feeds the
+        hit/miss/STEAL counters — a "steal" is this process reusing an
+        executable another process compiled, the cluster-amortization
+        the whole layer exists for — and doubles as a cluster-wide
+        warm-shape census.
+
+    Thread-safe; every filesystem touch is best-effort (a broken shared
+    volume degrades to per-process behavior, never fails a query).
+    Counters resolve through the registry at event time so a test-time
+    ``REGISTRY.clear()`` cannot orphan them.
+    """
+
+    VERSION = "srtcc-1"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.directory = ""
+        self._manifest_path = ""
+        self._index: Dict[str, Dict[str, Any]] = {}
+        self._index_size = -1
+        self._ident = (os.getpid(), socket.gethostname())
+        self._key_prefix: Optional[str] = None
+        # jax cache dir in force before we pointed it at the shared
+        # volume, restored when the shared cache is conf'd back off
+        self._prev_jax_dir = None
+        self._jax_dir_overridden = False
+
+    # -- configuration ------------------------------------------------------
+    def configure_from_conf(self, conf) -> bool:
+        d = str(conf.get("spark.rapids.tpu.compile.sharedCache.dir", "")
+                or "")
+        min_s = float(conf.get(
+            "spark.rapids.tpu.compile.sharedCache.minCompileSeconds",
+            0.0))
+        return self.configure(d, min_compile_seconds=min_s)
+
+    def configure(self, directory: str,
+                  min_compile_seconds: float = 0.0) -> bool:
+        with self._lock:
+            if not directory:
+                if self._jax_dir_overridden:
+                    # conf'd back off: restore the per-process policy
+                    try:
+                        import jax
+                        jax.config.update("jax_compilation_cache_dir",
+                                          self._prev_jax_dir)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._jax_dir_overridden = False
+                self.enabled = False
+                self.directory = ""
+                return False
+            if self.enabled and directory == self.directory:
+                return True
+            try:
+                import jax
+                xla_dir = os.path.join(directory, "xla")
+                os.makedirs(xla_dir, exist_ok=True)
+                if not self._jax_dir_overridden:
+                    self._prev_jax_dir = \
+                        jax.config.jax_compilation_cache_dir
+                    self._jax_dir_overridden = True
+                jax.config.update("jax_compilation_cache_dir", xla_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs",
+                    float(min_compile_seconds))
+                try:
+                    # persist tiny executables too: a 50ms kernel x N
+                    # workers x M shapes is exactly the warm-up tax
+                    jax.config.update(
+                        "jax_persistent_cache_min_entry_size_bytes", -1)
+                except Exception:  # noqa: BLE001 — knob absent on old jax
+                    pass
+            except Exception:  # noqa: BLE001 — shared volume problems
+                self.enabled = False
+                return False
+            self.directory = directory
+            self._manifest_path = os.path.join(directory,
+                                               "manifest.jsonl")
+            self._index = {}
+            self._index_size = -1
+            self.enabled = True
+            self._refresh_locked()
+            return True
+
+    def _prefix(self) -> str:
+        """Versioned key prefix: cache format + jax version + resolved
+        backend + machine, so executables compiled by an incompatible
+        stack are never counted as this fleet's warmth (the
+        machine-feature/SIGILL concern of the package-level CPU
+        policy)."""
+        if self._key_prefix is None:
+            import platform
+
+            import jax
+            try:
+                backend = jax.default_backend()
+            except Exception:  # noqa: BLE001 — no device yet
+                backend = "?"
+            self._key_prefix = "|".join(
+                (self.VERSION, jax.__version__, backend,
+                 platform.machine()))
+        return self._key_prefix
+
+    def key_for(self, kernel: Optional[str], avals) -> str:
+        blob = "|".join((self._prefix(), kernel or "?",
+                         ",".join(avals or ())))
+        return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:20]
+
+    # -- manifest -----------------------------------------------------------
+    def _refresh_locked(self) -> None:
+        """Re-read the manifest when its size changed (another process
+        appended): the steal census must see foreign records."""
+        try:
+            size = os.path.getsize(self._manifest_path)
+        except OSError:
+            return
+        if size == self._index_size:
+            return
+        idx: Dict[str, Dict[str, Any]] = {}
+        try:
+            with open(self._manifest_path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail from a crashed writer
+                    if isinstance(rec, dict) and "key" in rec:
+                        idx.setdefault(rec["key"], rec)
+        except OSError:
+            return
+        self._index = idx
+        self._index_size = size
+
+    def _append_locked(self, rec: Dict[str, Any]) -> bool:
+        """One flock-serialized line append: concurrent workers on a
+        shared volume interleave whole lines, never bytes."""
+        line = (json.dumps(rec, default=str) + "\n").encode("utf-8")
+        try:
+            fd = os.open(self._manifest_path,
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        except OSError:
+            return False
+        try:
+            try:
+                import fcntl
+                fcntl.flock(fd, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass  # O_APPEND alone still lands whole small lines
+            os.write(fd, line)
+        except OSError:
+            return False
+        finally:
+            try:
+                os.close(fd)  # releases the flock
+            except OSError:
+                pass
+        return True
+
+    # -- event hooks --------------------------------------------------------
+    def note_compile(self, entry: Dict[str, Any]) -> None:
+        """One backend compile that actually ran (the ledger's record
+        path). Persistent-cache HITS are deserializations of an
+        executable that is already shared — only real compiles append a
+        manifest record."""
+        if not self.enabled or entry.get("outcome") == "hit":
+            return
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        # key on the full-signature hash (kernelKey): the readable
+        # kernel string is truncated for event-size hygiene and two
+        # long signatures could collide at the cut
+        key = self.key_for(entry.get("kernelKey")
+                           or entry.get("kernel"), entry.get("avals"))
+        rec = {"key": key, "kernel": entry.get("kernel"),
+               "op": entry.get("op"), "avals": entry.get("avals"),
+               "seconds": entry.get("seconds"),
+               "pid": self._ident[0], "host": self._ident[1],
+               "ts": entry.get("ts")}
+        with self._lock:
+            if not self.enabled:
+                return
+            ok = self._append_locked(rec)
+            if ok:
+                self._index.setdefault(key, rec)
+        if ok:
+            REGISTRY.counter("sharedCache.writes").add(1)
+
+    def note_cache_event(self, outcome: str, dispatch) -> None:
+        """Persistent-cache lookup outcome from the jax monitoring
+        stream, attributed against the fleet manifest: a hit whose
+        manifest record was written by ANOTHER process is a steal —
+        cross-process amortization working."""
+        if not self.enabled:
+            return
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        if outcome == "miss":
+            REGISTRY.counter("sharedCache.misses").add(1)
+            return
+        stolen = False
+        if dispatch is not None:
+            from spark_rapids_tpu.obs.compileledger import (
+                aval_signature, kernel_key,
+            )
+            try:
+                key = self.key_for(
+                    kernel_key(dispatch.kernel),
+                    aval_signature(dispatch.args, dispatch.kwargs))
+            except Exception:  # noqa: BLE001 — accounting only
+                key = None
+            if key is not None:
+                with self._lock:
+                    self._refresh_locked()
+                    rec = self._index.get(key)
+                stolen = (rec is not None and
+                          (rec.get("pid"), rec.get("host"))
+                          != self._ident)
+        REGISTRY.counter("sharedCache.steals" if stolen
+                         else "sharedCache.hits").add(1)
+
+    # -- introspection ------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        with self._lock:
+            self._refresh_locked()
+            known = len(self._index)
+        out = {"enabled": self.enabled, "dir": self.directory,
+               "knownKernels": known}
+        for name in ("hits", "misses", "steals", "writes"):
+            out[name] = REGISTRY.counter(f"sharedCache.{name}").value
+        return out
+
+    def manifest_entries(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            self._refresh_locked()
+            return dict(self._index)
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self.enabled = False
+            self.directory = ""
+            self._manifest_path = ""
+            self._index = {}
+            self._index_size = -1
+            self._key_prefix = None
+
+
+SHARED = SharedCompileCache()
 
 
 def install() -> bool:
@@ -58,15 +338,19 @@ def install() -> bool:
             return True
 
         def on_event(name: str, **kw) -> None:
+            from spark_rapids_tpu.obs import compileledger
             from spark_rapids_tpu.obs.compileledger import LEDGER
             from spark_rapids_tpu.obs.events import EVENTS
             from spark_rapids_tpu.obs.metrics import REGISTRY
             if name == "/jax/compilation_cache/cache_hits":
                 REGISTRY.counter("compileCache.persistentHits").add(1)
                 LEDGER.note_cache_event("hit")
+                SHARED.note_cache_event(
+                    "hit", compileledger.current_dispatch())
             elif name == "/jax/compilation_cache/cache_misses":
                 REGISTRY.counter("compileCache.persistentMisses").add(1)
                 LEDGER.note_cache_event("miss")
+                SHARED.note_cache_event("miss", None)
                 # a miss means a real XLA compile is coming: the durable
                 # warmup fact the qualification report attributes
                 EVENTS.emit("compileCacheMiss")

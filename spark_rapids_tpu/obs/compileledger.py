@@ -200,6 +200,18 @@ class _suppress_recording:
 _AVAL_CAP = 96  # leaves listed per entry before truncation
 
 
+def kernel_key(signature: Optional[str]) -> Optional[str]:
+    """Stable short hash of a FULL kernel-cache signature. Ledger
+    entries truncate ``kernel`` to 200 chars for event-size hygiene;
+    the key survives truncation, so the AOT pre-warmer can match a
+    manifest entry back to the kernel build it names
+    (utils/kernelcache.set_build_hook -> serving/prewarm.py)."""
+    if signature is None:
+        return None
+    import hashlib
+    return hashlib.sha1(signature.encode("utf-8")).hexdigest()[:16]
+
+
 def aval_signature(args, kwargs) -> List[str]:
     """Shape/dtype signature of a dispatched argument tree: array leaves
     render as ``int32[8,128]``, static scalars (capacity buckets, flags)
@@ -319,6 +331,8 @@ class CompileLedger:
             "query": EVENTS.current_query,
             "op": op,
             "kernel": (d.kernel[:200] if d is not None else None),
+            "kernelKey": (kernel_key(d.kernel) if d is not None
+                          else None),
             "avals": (aval_signature(d.args, d.kwargs)
                       if d is not None else None),
             "outcome": (d.cache_outcome if d is not None else None),
@@ -328,6 +342,12 @@ class CompileLedger:
             # fused-stage attribution: the compile belongs to the fused
             # kernel AND names the member-operator pipeline inside it
             entry["members"] = [m[:200] for m in members]
+        if d is not None:
+            # replayable argument spec (utils/argspec.py): what the AOT
+            # pre-warmer needs to compile this exact program again in a
+            # fresh process; None marks an honestly non-replayable call
+            from spark_rapids_tpu.utils import argspec as _argspec
+            entry["argspec"] = _argspec.capture(d.args, d.kwargs)
         with self._lock:
             self._seq += 1
             entry["seq"] = self._seq
@@ -349,14 +369,21 @@ class CompileLedger:
             qp = PROGRESS.current
             if qp is not None:
                 qp.note_compile(seconds, entry["kernel"])
+        # shared cross-process cache accounting (obs/compilecache.py):
+        # the manifest record that tells OTHER workers this kernel+shape
+        # is already compiled in the shared executable cache
+        from spark_rapids_tpu.obs.compilecache import SHARED
+        SHARED.note_compile(entry)
         # durable record: the enriched journal event compile_report and
         # qualification mine (tools/)
         extra = {"members": entry["members"]} if "members" in entry \
             else {}
+        if entry.get("argspec") is not None:
+            extra["argspec"] = entry["argspec"]
         EVENTS.emit(
             "backendCompile", seconds=round(seconds, 4), op=op,
-            kernel=entry["kernel"], avals=entry["avals"],
-            outcome=entry["outcome"], **extra)
+            kernel=entry["kernel"], kernelKey=entry["kernelKey"],
+            avals=entry["avals"], outcome=entry["outcome"], **extra)
         return entry
 
     def attach_cost(self, entry: Dict[str, Any], fn, args, kwargs) -> None:
@@ -410,6 +437,8 @@ class CompileLedger:
             avals = c.get("avals")
             if avals and len(avals) > 8:
                 c["avals"] = avals[:8] + [f"...+{len(avals) - 8}"]
+            # replay specs are manifest payload, not hang-dump signal
+            c.pop("argspec", None)
             out.append(c)
         return out
 
@@ -509,17 +538,28 @@ def analyze(entries: List[Dict[str, Any]],
         sigs = [s for s in g["sigs"] if s]
         varying: List[Dict[str, Any]] = []
         n_buckets = 1
+        all_stable = False
         if len(sigs) > 1:
             varying = _diff_signatures(sigs)
+            # a dim whose observed values are ALREADY all power-of-two
+            # bucket values (the row-capacity dim, char buckets, hash
+            # tables) is bucket-STABLE: recommending "pad to powers of
+            # two" for it is noise, and padding cannot reclaim its
+            # compiles — only a COARSER ladder
+            # (spark.rapids.tpu.compile.shapeBuckets) can
+            all_stable = bool(varying) and all(
+                v.get("stable") for v in varying)
             n_buckets = max(
                 (len(v["buckets"]) for v in varying), default=1)
         # projected savings: with stable (bucket-padded) shapes, this
         # kernel would compile once per recommended bucket instead of
-        # once per observed signature
+        # once per observed signature; a group whose every varying dim
+        # is already bucket-stable projects ZERO (actionability is the
+        # point of the recommendation list)
         n_sigs = max(len(g["sigs"]), 1)
         mean_s = g["seconds"] / max(g["compiles"], 1)
         wasted = max(g["compiles"] - n_buckets, 0) * mean_s \
-            if len(sigs) > 1 else 0.0
+            if len(sigs) > 1 and not all_stable else 0.0
         out_groups.append({
             "kernel": g["kernel"],
             "op": sorted(g["ops"])[0] if g["ops"] else None,
@@ -530,6 +570,7 @@ def analyze(entries: List[Dict[str, Any]],
             "seconds": round(g["seconds"], 4),
             "signatures": n_sigs,
             "varying": varying,
+            "already_bucketed": all_stable,
             "projected_savings_s": round(wasted, 4),
         })
     out_groups.sort(key=lambda g: (-g["projected_savings_s"],
@@ -569,26 +610,45 @@ def _diff_signatures(sigs: List[Tuple[str, ...]]) -> List[Dict[str, Any]]:
             vals = {p[1] for p in parsed}
             if len(vals) > 1:
                 ints = _as_ints(vals)
+                stable = bool(ints) and _already_bucketed(ints)
                 varying.append({
                     "arg": i, "dtype": "static", "axis": None,
                     "values": sorted(vals, key=str),
+                    "stable": stable,
                     "buckets": sorted({_bucket_up(v) for v in ints})
-                    if ints else []})
+                    if ints and not stable else []})
             continue
         shapes = [p[1] for p in parsed]
         ranks = {len(s) for s in shapes}
         if len(ranks) > 1:
             varying.append({"arg": i, "dtype": dt, "axis": "rank",
                             "values": sorted({str(s) for s in shapes}),
-                            "buckets": []})
+                            "stable": False, "buckets": []})
             continue
         for ax in range(next(iter(ranks))):
             vals = sorted({s[ax] for s in shapes})
             if len(vals) > 1:
+                # values already ON the power-of-two ladder are a
+                # bucket-stable dim: re-recommending the same buckets
+                # is analyzer noise (tools/compile_report.py)
+                stable = _already_bucketed(vals)
                 varying.append({
                     "arg": i, "dtype": dt, "axis": ax, "values": vals,
-                    "buckets": sorted({_bucket_up(v) for v in vals})})
+                    "stable": stable,
+                    "buckets": [] if stable else
+                    sorted({_bucket_up(v) for v in vals})})
     return varying
+
+
+def _already_bucketed(vals) -> bool:
+    """True when every observed value is already an exact power-of-two
+    bucket value: padding to the recommended buckets would change
+    nothing for this dimension."""
+    try:
+        ints = [int(v) for v in vals]
+    except (TypeError, ValueError):
+        return False
+    return all(v > 0 and (v & (v - 1)) == 0 for v in ints)
 
 
 def _as_ints(vals) -> List[int]:

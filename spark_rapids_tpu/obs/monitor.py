@@ -182,6 +182,16 @@ def status_snapshot() -> Dict[str, Any]:
         if sem is not None:
             out["semaphore"] = {"permits": sem.permits,
                                 "available": sem.available_permits()}
+    # zero-warm-up layer: AOT pre-warm progress (kernels warmed /
+    # pending / skipped) and shared-compile-cache hit rates — the
+    # serving fleet's "is this worker warm yet?" probe
+    from spark_rapids_tpu.serving import prewarm
+    p = prewarm.active()
+    if p is not None:
+        out["aot"] = p.snapshot()
+    from spark_rapids_tpu.obs.compilecache import SHARED
+    if SHARED.enabled:
+        out["sharedCompileCache"] = SHARED.stats()
     return out
 
 

@@ -403,7 +403,8 @@ def _dict_matmul_reduce(batch: DeviceBatch, key_idx: List[int],
                                                       dense_jobs)
     used = row_count > 0
     slot_perm, n_used = compact_permutation(used)
-    out_cap = bucket_capacity(T)
+    from spark_rapids_tpu.utils.kernelcache import bucket_dim
+    out_cap = bucket_dim(bucket_capacity(T))
     pad_n = out_cap - T
     perm_pad = jnp.concatenate(
         [slot_perm, jnp.zeros((pad_n,), jnp.int32)]) if pad_n else slot_perm

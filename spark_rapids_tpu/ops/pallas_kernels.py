@@ -220,11 +220,15 @@ _HASH_SEED = 0x243F6A8885A308D3
 
 
 def hash_table_size(capacity: int) -> int:
-    """Static power-of-two table size at load factor <= 1/2."""
+    """Static power-of-two table size at load factor <= 1/2. With shape
+    buckets on (spark.rapids.tpu.compile.shapeBuckets) the size pads up
+    the coarse ladder so one compiled table program serves a capacity
+    range; the load factor only drops."""
     t = 16
     while t < 2 * max(int(capacity), 1):
         t <<= 1
-    return t
+    from spark_rapids_tpu.utils.kernelcache import bucket_dim
+    return bucket_dim(t)
 
 
 def _mix_images(images) -> jnp.ndarray:

@@ -1,0 +1,255 @@
+"""AOT pre-warm from history: background compile replay at session start.
+
+The compile ledger's durable record (enriched ``backendCompile`` events)
+says exactly which kernels a workload compiles and at which shape
+signatures; ``tools/compile_report.py --aot-manifest`` distills a
+sweep's event log into a replay manifest. This module ACTS on it
+(ROADMAP item 3): a session configured with
+``spark.rapids.tpu.compile.aot.manifest`` starts one background worker
+that, as each manifested kernel comes into existence
+(``utils/kernelcache.py``'s build hook — kernels are built during
+PLANNING, well before data flows), compiles every historical shape
+signature recorded for it by calling the real kernel with a zero-filled
+argument tree reconstructed from the recorded argspec
+(``utils/argspec.py``). The replay call populates BOTH caches that
+matter: jax's in-process jit dispatch cache (the query's own call is
+then a pure cache hit — no compile, no trace) and the persistent /
+shared executable cache (``obs/compilecache.py``), so a fleet's fresh
+workers warm from each other's history instead of from live traffic.
+
+Properties the serving layer needs:
+
+  * **background**: the worker never blocks a query; warming overlaps
+    planning/scan/decode of the first queries;
+  * **cancellable**: ``cancel()`` (and ``session.stop()``) stops the
+    pass at the next entry boundary;
+  * **budget-capped**: ``compile.aot.budgetSeconds`` bounds the wall
+    time spent warming; past it, remaining entries stay "pending" and
+    warm on demand like today;
+  * **honest accounting**: entries whose argument trees were not
+    reconstructible are "skipped", never silently replayed as a
+    DIFFERENT program. Progress (warmed / pending / skipped / failed,
+    seconds) surfaces at ``/api/status`` and as ``srt_aot_*`` series.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_ACTIVE: Optional["AotPrewarmer"] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def load_manifest(path: str) -> List[Dict[str, Any]]:
+    """Entries of an AOT manifest (``compile_report --aot-manifest``
+    shape, or a bare list of entry dicts)."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("entries") if isinstance(doc, dict) else doc
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: not an AOT manifest")
+    return [e for e in entries if isinstance(e, dict)]
+
+
+class AotPrewarmer:
+    def __init__(self, manifest_path: str, budget_s: float = 120.0):
+        self.manifest_path = manifest_path
+        self.budget_s = float(budget_s)
+        self._lock = threading.Lock()
+        self._cancel = threading.Event()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._started_ts = 0.0
+        # sig -> replayable entries (deduped by shape signature)
+        self._pending: Dict[str, List[Dict[str, Any]]] = {}
+        self.warmed = 0
+        self.failed = 0
+        self.skipped = 0
+        self.seconds = 0.0
+        self.budget_exhausted = False
+        self._outstanding = 0  # enqueued build tasks not yet processed
+        from spark_rapids_tpu.obs.compileledger import kernel_key
+        seen = set()
+        for e in load_manifest(manifest_path):
+            # match by the FULL-signature hash: ledger entries truncate
+            # the human-readable kernel string, but the build hook sees
+            # the untruncated signature (obs/compileledger.kernel_key)
+            kk = e.get("kernelKey") or kernel_key(e.get("kernel"))
+            key = (kk, tuple(e.get("avals") or ()))
+            if kk is None or key in seen:
+                continue
+            seen.add(key)
+            if not e.get("argspec"):
+                self.skipped += 1
+                continue
+            self._pending.setdefault(kk, []).append(e)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "AotPrewarmer":
+        from spark_rapids_tpu.utils import kernelcache
+        self._started_ts = time.time()
+        kernelcache.set_build_hook(self._on_build)
+        # kernels built before the pre-warmer existed (a warm process
+        # re-configuring) still replay
+        for sig, fn in kernelcache.cache_snapshot().items():
+            self._on_build(sig, fn)
+        self._thread = threading.Thread(
+            target=self._run, name="srt-aot-prewarm", daemon=True)
+        self._thread.start()
+        return self
+
+    def cancel(self) -> None:
+        self._cancel.set()
+        from spark_rapids_tpu.utils import kernelcache
+        # only OUR registration: a newer pass may already own the hook
+        kernelcache.clear_build_hook(self._on_build)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    # -- kernel-build hook (utils/kernelcache.py) ----------------------------
+    def _on_build(self, sig: str, fn) -> None:
+        from spark_rapids_tpu.obs.compileledger import kernel_key
+        with self._lock:
+            entries = self._pending.pop(kernel_key(sig), None)
+            if entries:
+                self._outstanding += 1
+        if entries:
+            self._queue.put((fn, entries))
+
+    # -- worker --------------------------------------------------------------
+    def _run(self) -> None:
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        while not self._cancel.is_set():
+            try:
+                fn, entries = self._queue.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            for e in entries:
+                if self._cancel.is_set():
+                    return
+                if self.budget_s > 0 and self.seconds >= self.budget_s:
+                    # budget spent: what is left warms on demand. Keyed
+                    # by kernelKey — the SAME keyspace _on_build pops —
+                    # so a later pass over the pending map still finds
+                    # these entries
+                    from spark_rapids_tpu.obs.compileledger import (
+                        kernel_key,
+                    )
+                    kk = e.get("kernelKey") \
+                        or kernel_key(e.get("kernel")) or "?"
+                    with self._lock:
+                        self.budget_exhausted = True
+                        self._pending.setdefault(kk, []).append(e)
+                    continue
+                t0 = time.perf_counter()
+                ok = self._warm_one(fn, e)
+                dt = time.perf_counter() - t0
+                with self._lock:
+                    self.seconds += dt
+                    if ok:
+                        self.warmed += 1
+                    else:
+                        self.failed += 1
+                REGISTRY.counter(
+                    "aot.warmed" if ok else "aot.failed").add(1)
+                REGISTRY.timer("aot.seconds").record(dt)
+            with self._lock:
+                self._outstanding -= 1
+
+    @staticmethod
+    def _warm_one(fn, entry: Dict[str, Any]) -> bool:
+        """Compile one historical shape by calling the real kernel with
+        a reconstructed zero-filled argument tree: identical treedef +
+        avals = identical program. The call attributes to the
+        "AotPrewarm" op in the ledger, so replay compiles are
+        first-class, visibly distinct warm-up facts."""
+        from spark_rapids_tpu.obs import compileledger
+        from spark_rapids_tpu.utils import argspec
+        try:
+            args, kwargs = argspec.build(entry["argspec"])
+            with compileledger.op_context("AotPrewarm"):
+                fn(*args, **kwargs)
+            return True
+        except Exception:  # noqa: BLE001 — a bad entry must not stop the pass
+            return False
+
+    # -- introspection -------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            pending = sum(len(v) for v in self._pending.values())
+            queued = self._queue.qsize()
+            return {
+                "manifest": self.manifest_path,
+                "warmed": self.warmed,
+                "failed": self.failed,
+                "skipped": self.skipped,
+                "pending": pending + queued,
+                "seconds": round(self.seconds, 3),
+                "budgetSeconds": self.budget_s,
+                "budgetExhausted": self.budget_exhausted,
+                "cancelled": self._cancel.is_set(),
+            }
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Test helper: wait until every queued replay ran (or the
+        budget/cancel stopped the pass)."""
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            with self._lock:
+                idle = self._outstanding == 0
+            if idle or self._cancel.is_set():
+                return True
+            time.sleep(0.02)
+        return False
+
+
+def active() -> Optional[AotPrewarmer]:
+    return _ACTIVE
+
+
+def cancel_active() -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        p, _ACTIVE = _ACTIVE, None
+    if p is not None:
+        p.cancel()
+
+
+def maybe_start_from_conf(conf) -> Optional[AotPrewarmer]:
+    """Session hook: start (once per manifest path) the background
+    pre-warm pass when ``spark.rapids.tpu.compile.aot.manifest`` is set.
+    Idempotent per path; a path change cancels the old pass, and
+    clearing the conf CANCELS an active pass (the documented disable
+    knob, not just a no-start)."""
+    global _ACTIVE
+    path = str(conf.get("spark.rapids.tpu.compile.aot.manifest", "")
+               or "")
+    if not path:
+        cancel_active()
+        return None
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None and _ACTIVE.manifest_path == path \
+                and not _ACTIVE._cancel.is_set():
+            return _ACTIVE
+        old, _ACTIVE = _ACTIVE, None
+        if old is not None:
+            old.cancel()
+        try:
+            p = AotPrewarmer(path, budget_s=float(conf.get(
+                "spark.rapids.tpu.compile.aot.budgetSeconds", 120.0)))
+        except (OSError, ValueError, json.JSONDecodeError):
+            return None
+        # assign + start under the lock: a concurrent cancel_active
+        # either sees no active pass yet (and this one starts cleanly)
+        # or pops THIS one after start and cancels it properly — never
+        # the old interleaving that left an orphaned build hook behind
+        _ACTIVE = p
+        p.start()
+    return p

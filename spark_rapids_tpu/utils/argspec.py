@@ -1,0 +1,184 @@
+"""Replayable kernel-argument specifications (AOT pre-warm substrate).
+
+The compile ledger records every backend compile with its kernel
+identity and aval signature (obs/compileledger.py) — enough to say WHAT
+recompiled, not enough to compile it again: an aval list is a flat leaf
+rendering that loses the pytree structure (DeviceBatch schemas, static
+dictionary tuples, static-argnum scalars) jax's trace identity hangs on.
+This module closes that gap:
+
+  * ``capture(args, kwargs)`` — at compile time (rare), walk the
+    dispatched argument tree and produce a JSON-able SPEC that preserves
+    everything trace identity depends on: batch schemas (column names +
+    dtypes), per-column capacities / char-slab capacities / prefix8
+    presence / static dictionary tuples, array shapes+dtypes, and the
+    exact python values of static scalars and tuples. Returns ``None``
+    when any leaf is not reconstructible (oversized dictionaries, host
+    objects) — the entry is then honestly non-replayable and the AOT
+    pre-warmer counts it "skipped" instead of warming a DIFFERENT
+    program.
+  * ``build(spec)`` — in a later (possibly fresh) process, reconstruct a
+    ZERO-FILLED concrete argument tree with the identical treedef and
+    avals: validity all-false, ``num_rows`` 0, data zeros. Calling the
+    real kernel with it compiles — and executes, on all-padding input,
+    which every kernel treats as masked — the exact program the
+    historical call compiled, populating both jax's in-process jit
+    dispatch cache and the (shared) persistent executable cache.
+
+The spec deliberately captures no data values beyond static dictionaries
+and static scalars: those ARE part of the compiled program (pytree aux /
+static argnums); row data is not.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+# serialized-dictionary budget per spec: a static dictionary tuple is
+# pytree aux data (part of trace identity), so it must be reproduced
+# EXACTLY — but an unbounded one would bloat every backendCompile event
+_MAX_DICT_CHARS = 4096
+
+
+class _NotReplayable(Exception):
+    pass
+
+
+def _dict_values_spec(values: tuple) -> List[Any]:
+    total = 0
+    out: List[Any] = []
+    for v in values:
+        if hasattr(v, "item"):  # numpy scalar -> exact python twin
+            v = v.item()
+        if not isinstance(v, (str, int, float, bool)):
+            raise _NotReplayable(f"dict value {type(v).__name__}")
+        total += len(v) if isinstance(v, str) else 8
+        if total > _MAX_DICT_CHARS:
+            raise _NotReplayable("dictionary too large")
+        out.append(v)
+    return out
+
+
+def _col_spec(col) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {"t": "col", "dtype": col.dtype.name,
+                            "cap": int(col.validity.shape[0])}
+    if col.dict_values is not None:
+        spec["dict"] = _dict_values_spec(col.dict_values)
+    # read the PRIVATE slots: touching .data/.offsets on a lazy column
+    # would materialize its char slab right here
+    if col._data is None:
+        spec["lazy"] = True
+        return spec
+    if col.dtype.is_string:
+        spec["char_cap"] = int(col._data.shape[0])
+        spec["prefix8"] = col._prefix8 is not None
+    return spec
+
+
+def _spec(v) -> Any:
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    if isinstance(v, DeviceBatch):
+        return {"t": "batch", "names": list(v.schema.names),
+                "cols": [_col_spec(c) for c in v.columns]}
+    if isinstance(v, DeviceColumn):
+        return _col_spec(v)
+    if v is None or isinstance(v, (bool, str)):
+        return {"t": "s", "v": v}
+    if isinstance(v, (int, float)):
+        return {"t": "s", "v": v}
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        # jax / numpy array (0-d scalars included): a zero array of the
+        # same shape+dtype reproduces the aval exactly
+        return {"t": "arr", "dtype": str(v.dtype),
+                "shape": [int(s) for s in v.shape]}
+    if isinstance(v, tuple):
+        return {"t": "tup", "items": [_spec(x) for x in v]}
+    if isinstance(v, list):
+        return {"t": "list", "items": [_spec(x) for x in v]}
+    if isinstance(v, dict):
+        if not all(isinstance(k, str) for k in v):
+            raise _NotReplayable("non-string dict key")
+        return {"t": "map", "items": {k: _spec(x) for k, x in v.items()}}
+    raise _NotReplayable(type(v).__name__)
+
+
+def capture(args, kwargs) -> Optional[Dict[str, Any]]:
+    """Spec of one dispatched call's arguments, or None when not
+    replayable. Never raises."""
+    try:
+        return {"args": [_spec(a) for a in (args or ())],
+                "kwargs": {k: _spec(v)
+                           for k, v in (kwargs or {}).items()}}
+    except _NotReplayable:
+        return None
+    except Exception:  # noqa: BLE001 — capture is best-effort metadata
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Reconstruction
+# ---------------------------------------------------------------------------
+
+def _build_col(spec: Dict[str, Any]):
+    # numpy leaves, deliberately: jnp.zeros/jnp.full would each run a
+    # tiny jitted fill program, polluting the very persistent-cache
+    # miss counters the replay exists to zero; numpy arrays flow into
+    # the kernel call with identical avals and no compile of their own
+    import numpy as np
+
+    from spark_rapids_tpu.columnar import dtype as dtypes
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    dt = dtypes.by_name(spec["dtype"])
+    cap = int(spec["cap"])
+    validity = np.zeros((cap,), np.bool_)
+    dict_values = tuple(spec["dict"]) if spec.get("dict") is not None \
+        else None
+    dict_codes = None
+    if dict_values is not None:
+        # NULL sentinel = cardinality: in-range for every consumer
+        dict_codes = np.full((cap,), len(dict_values), np.int32)
+    if spec.get("lazy"):
+        return DeviceColumn(dt, None, validity, dict_codes=dict_codes,
+                            dict_values=dict_values)
+    if dt.is_string:
+        data = np.zeros((int(spec["char_cap"]),), np.uint8)
+        offsets = np.zeros((cap + 1,), np.int32)
+        prefix8 = np.zeros((cap,), np.uint64) if spec.get("prefix8") \
+            else None
+        return DeviceColumn(dt, data, validity, offsets=offsets,
+                            prefix8=prefix8, dict_codes=dict_codes,
+                            dict_values=dict_values)
+    data = np.zeros((cap,), dt.np_dtype)
+    return DeviceColumn(dt, data, validity, dict_codes=dict_codes,
+                        dict_values=dict_values)
+
+
+def _build(spec) -> Any:
+    import numpy as np
+    t = spec["t"]
+    if t == "batch":
+        from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+        cols = [_build_col(c) for c in spec["cols"]]
+        schema = Schema(spec["names"], [c.dtype for c in cols])
+        return DeviceBatch(schema, cols, np.asarray(0, np.int32))
+    if t == "col":
+        return _build_col(spec)
+    if t == "s":
+        return spec["v"]
+    if t == "arr":
+        return np.zeros(tuple(spec["shape"]), spec["dtype"])
+    if t == "tup":
+        return tuple(_build(x) for x in spec["items"])
+    if t == "list":
+        return [_build(x) for x in spec["items"]]
+    if t == "map":
+        return {k: _build(x) for k, x in spec["items"].items()}
+    raise ValueError(f"unknown argspec node: {t}")
+
+
+def build(spec: Dict[str, Any]) -> Tuple[tuple, dict]:
+    """(args, kwargs) reconstructed from a ``capture`` spec: identical
+    treedef and avals, zero-filled all-padding data."""
+    return (tuple(_build(s) for s in spec.get("args", [])),
+            {k: _build(s) for k, s in spec.get("kwargs", {}).items()})

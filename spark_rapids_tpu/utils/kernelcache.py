@@ -92,6 +92,103 @@ _MISSES = _REGISTRY.counter("kernelCache.misses")
 _BUILD_TIME = _REGISTRY.timer("kernelCache.buildTime")
 
 
+# ---------------------------------------------------------------------------
+# Shape buckets (spark.rapids.tpu.compile.shapeBuckets): coarse padding of
+# SECONDARY shape dimensions at the dispatch boundary
+# ---------------------------------------------------------------------------
+#
+# The recompile-cause analyzer (obs/compileledger.analyze) names the
+# dimensions that vary across one kernel's compiles: join build-table
+# capacities, expansion output capacities, aggregation group capacities,
+# hash-table sizes, char-slab capacities. Each is already a power-of-two
+# bucket VALUE, but the ladder has ~17 rungs (8..1M) and every rung is
+# its own XLA program — the long warm-up tail. ``bucket_dim`` re-pads an
+# already-bucketed dimension up a COARSER ladder (floor ``minBucket``,
+# growth ``growth``) so one compile serves a dimension range. Row counts
+# are data (DeviceBatch.num_rows) and the padding region is masked the
+# same way capacity padding always is, so results are value-identical;
+# disabled (the default) it returns its input unchanged — byte-identical
+# shapes. Batch ROW capacities (the primary dimension) never route
+# through here.
+
+_BUCKETS = {"enabled": False, "min": 4096, "growth": 2.0}
+
+
+def configure_shape_buckets(enabled: bool, min_bucket: int = 4096,
+                            growth: float = 2.0) -> None:
+    _BUCKETS["enabled"] = bool(enabled)
+    _BUCKETS["min"] = max(8, int(min_bucket))
+    _BUCKETS["growth"] = max(1.1, float(growth))
+
+
+def configure_shape_buckets_from_conf(conf) -> bool:
+    # SRT_SHAPE_BUCKETS=1/0 overrides the conf for a whole process —
+    # the validation lever that runs an UNMODIFIED test suite or sweep
+    # with padding forced on (oracle verification across the tier-1
+    # suite, docs/aot.md) or forced off
+    env = os.environ.get("SRT_SHAPE_BUCKETS")
+    enabled = (env != "0") if env is not None else conf.get_bool(
+        "spark.rapids.tpu.compile.shapeBuckets", False)
+    configure_shape_buckets(
+        enabled,
+        min_bucket=int(conf.get(
+            "spark.rapids.tpu.compile.shapeBuckets.minBucket", 4096)),
+        growth=float(conf.get(
+            "spark.rapids.tpu.compile.shapeBuckets.growth", 2.0)))
+    return _BUCKETS["enabled"]
+
+
+def shape_buckets_enabled() -> bool:
+    return _BUCKETS["enabled"]
+
+
+def bucket_dim(n: int) -> int:
+    """Pad a secondary shape dimension up the coarse ladder (identity
+    when shape buckets are off — the byte-identical contract)."""
+    if not _BUCKETS["enabled"] or n <= 0:
+        return n
+    import math
+    b = _BUCKETS["min"]
+    growth = _BUCKETS["growth"]
+    while b < n:
+        b = int(math.ceil(b * growth))
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Build hook (serving/prewarm.py): the AOT pre-warmer is told when a
+# kernel it holds historical shape signatures for comes into existence,
+# so it can compile every recorded shape in the background while the
+# first query is still planning/scanning.
+# ---------------------------------------------------------------------------
+
+_BUILD_HOOK: Any = None
+
+
+def set_build_hook(hook) -> None:
+    """Register (or clear, with None) the kernel-build observer:
+    ``hook(signature, fn)`` fires after a kernel is first BUILT and
+    cached (never on cache hits — those return before the hook site).
+    One observer; never raises into the build path."""
+    global _BUILD_HOOK
+    _BUILD_HOOK = hook
+
+
+def clear_build_hook(hook) -> None:
+    """Clear the observer only if it is still ``hook``: a cancelled
+    pre-warm pass must not tear down a NEWER pass's registration."""
+    global _BUILD_HOOK
+    if _BUILD_HOOK is hook:
+        _BUILD_HOOK = None
+
+
+def cache_snapshot() -> Dict[str, Any]:
+    """signature -> cached kernel fn (for the pre-warmer's scan of
+    kernels built before it started)."""
+    with _LOCK:
+        return dict(_CACHE)
+
+
 def _wrap_ledgered(signature: str, fn):
     """Compile-ledger dispatch context (obs/compileledger.py): every call
     of a cached kernel publishes its signature + argument references to a
@@ -152,7 +249,14 @@ def cached_jit(signature: str, builder: Callable[[], Any]):
     if _PROFILE:
         fn = _wrap_profiled(signature, fn)
     with _LOCK:
-        return _CACHE.setdefault(signature, fn)
+        fn = _CACHE.setdefault(signature, fn)
+    hook = _BUILD_HOOK
+    if hook is not None:
+        try:
+            hook(signature, fn)
+        except Exception:  # noqa: BLE001 — prewarm must not fail builds
+            pass
+    return fn
 
 
 def cache_stats() -> Dict[str, int]:

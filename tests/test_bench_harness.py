@@ -19,6 +19,7 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(
 
 
 @pytest.mark.smoke
+@pytest.mark.slow  # ~22s harness selftest (spawns workers); tier-1 headroom
 def test_timeout_kills_worker_and_next_query_unaffected(tmp_path):
     detail_file = str(tmp_path / "detail.json")
     env = dict(

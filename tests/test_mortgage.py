@@ -21,6 +21,7 @@ def _tables(s, mortgage_pandas):
     return (s.create_dataframe(perf_pd, 3), s.create_dataframe(acq_pd, 2))
 
 
+@pytest.mark.slow  # ~18s full ETL sweep; agg/percentile tests stay tier-1
 def test_full_etl(session, mortgage_pandas):
     """Run.parquet equivalent: prepare -> delinquency windows -> name
     normalization -> final join."""

@@ -382,3 +382,70 @@ class TestServeGate:
         assert rep["mode"] == "serve"
         assert rep["regressed"] is True
         assert rep["qps_drift_pct"] == -25.0
+
+
+class TestWarmupGate:
+    """The zero-warm-up gate (docs/aot.md): warm_compiles growth or a
+    cold first-query wall regression between artifacts exits 1;
+    --ignore-warmup opts out."""
+
+    def _warm_detail(self, tmp_path, name, warm, first=None):
+        doc = {"sf": 0.5, "queries": {}}
+        for q, n in warm.items():
+            doc["queries"][q] = {"speedup": 2.0, "warm_compiles": n}
+        for q, s in (first or {}).items():
+            doc["queries"][q]["first_run_s"] = s
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        return p
+
+    def test_warmup_from_doc_reads_queries_and_cold_start(self):
+        doc = {"queries": {
+            "q1": {"warm_compiles": 7, "first_run_s": 3.0},
+            "tpcxbb.q9": {"warm_compiles": 2, "first_run_s": 1.0}}}
+        w = perfdiff.warmup_from_doc(doc)
+        assert w["warm_compiles"] == {"q1": 7, "tpcxbb.q9": 2}
+        # per-suite cold wall: first query of each suite
+        assert w["first_query_s"] == {"tpch": 3.0, "tpcxbb": 1.0}
+        # summary-line shape: the cold_start block
+        w2 = perfdiff.warmup_from_doc(
+            {"parsed": {"cold_start": {"tpch": {"first_query_s": 9.0}}},
+             "tail": ""})
+        assert w2["first_query_s"] == {"tpch": 9.0}
+
+    def test_warm_compile_growth_regresses(self, tmp_path):
+        base = self._warm_detail(tmp_path, "b.json", {"q1": 0, "q2": 3})
+        new = self._warm_detail(tmp_path, "n.json", {"q1": 5, "q2": 3})
+        assert perfdiff.main([base, new]) == 1
+        assert perfdiff.main([base, new, "--ignore-warmup"]) == 0
+
+    def test_warm_compile_drop_is_improvement_not_regression(
+            self, tmp_path):
+        base = self._warm_detail(tmp_path, "b.json", {"q1": 9})
+        new = self._warm_detail(tmp_path, "n.json", {"q1": 0})
+        assert perfdiff.main([base, new]) == 0
+
+    def test_first_query_latency_regression(self, tmp_path):
+        base = self._warm_detail(tmp_path, "b.json", {"q1": 0},
+                                 first={"q1": 2.0})
+        new = self._warm_detail(tmp_path, "n.json", {"q1": 0},
+                                first={"q1": 4.0})
+        rep_rc = perfdiff.main([base, new])
+        assert rep_rc == 1  # 2x cold wall > 50% default threshold
+        assert perfdiff.main([base, new, "--warmup-threshold", "1.5"]) \
+            == 0
+        assert perfdiff.main([base, new, "--ignore-warmup"]) == 0
+
+    def test_compare_reports_warmup_fields(self):
+        rep = perfdiff.compare(
+            {"q1": 2.0}, None, {"q1": 2.0}, None, 0.10, 0.05,
+            base_warmup={"warm_compiles": {"q1": 1},
+                         "first_query_s": {"tpch": 1.0}},
+            new_warmup={"warm_compiles": {"q1": 4},
+                        "first_query_s": {"tpch": 1.1}})
+        assert rep["warmup_regressions"] == ["q1"]
+        assert rep["first_query_regressions"] == []
+        assert rep["regressed"]
+        text = perfdiff.render_text(rep)
+        assert "WARM-UP COMPILE REGRESSION" in text

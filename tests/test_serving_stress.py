@@ -79,6 +79,7 @@ def _canon(df: pd.DataFrame) -> pd.DataFrame:
         .reset_index(drop=True)
 
 
+@pytest.mark.slow  # ~20s stress sweep; test_serving keeps tier-1 coverage
 def test_eight_way_concurrent_mixed_tenant_sweep(session, stress_tables):
     from spark_rapids_tpu.memory.semaphore import TpuSemaphore
     from spark_rapids_tpu.obs import monitor as obs_monitor

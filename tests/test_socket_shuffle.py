@@ -137,6 +137,7 @@ def test_socket_error_response_propagates():
 # --------------------------------------------------------------------------
 
 @pytest.mark.smoke
+@pytest.mark.slow  # ~20s wire sweep; test_socket_xproc keeps tier-1 coverage
 def test_socket_shuffle_join_agg(socket_session, rng):
     left = _frame(rng)
     right = pd.DataFrame({"k": np.arange(50),

@@ -119,6 +119,7 @@ class TestRawByteOrdering:
 
 
 class TestStringAggregates:
+    @pytest.mark.slow  # ~15s oracle sweep; all_null/prefix-tie stay tier-1
     def test_group_min_max(self, session, rng):
         df = _str_df(rng)
         assert_tpu_and_cpu_equal(
@@ -126,6 +127,7 @@ class TestStringAggregates:
             .agg(F.min("s").alias("mn"), F.max("s").alias("mx"),
                  F.count("s").alias("c")))
 
+    @pytest.mark.slow  # ~19s oracle sweep; tier-1 headroom
     def test_group_min_max_long_ties(self, session, rng):
         # winners differ only past the 64-byte prefix — exercises the
         # lax.cond exact-refinement path
@@ -147,6 +149,7 @@ class TestStringAggregates:
             lambda s: s.create_dataframe(df, 1).group_by("k")
             .agg(F.min("s").alias("mn"), F.max("s").alias("mx")))
 
+    @pytest.mark.slow  # ~18s oracle sweep; tier-1 headroom
     def test_global_min_max(self, session, rng):
         df = _str_df(rng)
         assert_tpu_and_cpu_equal(

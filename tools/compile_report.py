@@ -81,6 +81,8 @@ def _load_entries(path: str) -> List[Dict[str, Any]]:
                 "avals": ev.get("avals"), "query": name,
                 "outcome": ev.get("outcome"),
                 "members": ev.get("members"),
+                "argspec": ev.get("argspec"),
+                "kernelKey": ev.get("kernelKey"),
                 "seconds": float(ev.get("seconds", 0.0))})
         return out
     with open_event_file(path) as f:
@@ -112,6 +114,41 @@ def build_report(entries: List[Dict[str, Any]],
     rep["per_query"] = dict(sorted(
         per_query.items(), key=lambda kv: -kv[1]["seconds"]))
     return rep
+
+
+def build_aot_manifest(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Distill compile entries into the AOT pre-warm replay input
+    (serving/prewarm.py; ``spark.rapids.tpu.compile.aot.manifest``):
+    one entry per distinct (kernel, shape signature), carrying the
+    replayable argspec when the ledger captured one. Entries without an
+    argspec stay in the manifest as honest "skipped" rows — the
+    pre-warm progress report counts what history could NOT replay."""
+    seen: Dict[Any, Dict[str, Any]] = {}
+    for e in entries:
+        kernel = e.get("kernel")
+        if kernel is None:
+            continue
+        key = (e.get("kernelKey") or kernel,
+               tuple(e.get("avals") or ()))
+        rec = seen.get(key)
+        if rec is None:
+            rec = seen[key] = {
+                "kernel": kernel, "kernelKey": e.get("kernelKey"),
+                "avals": e.get("avals"),
+                "argspec": e.get("argspec"), "op": e.get("op"),
+                "seconds": 0.0, "count": 0}
+        elif rec.get("argspec") is None and e.get("argspec") is not None:
+            rec["argspec"] = e["argspec"]
+        rec["count"] += max(int(e.get("count", 1) or 1), 1)
+        rec["seconds"] = round(rec["seconds"]
+                               + float(e.get("seconds", 0.0)), 4)
+    ents = sorted(seen.values(), key=lambda r: -r["seconds"])
+    return {
+        "version": 1,
+        "entries": ents,
+        "replayable": sum(1 for r in ents if r.get("argspec")),
+        "total_seconds": round(sum(r["seconds"] for r in ents), 2),
+    }
 
 
 def render_text(rep: Dict[str, Any], top_n: int = 15,
@@ -158,10 +195,18 @@ def render_text(rep: Dict[str, Any], top_n: int = 15,
                             if v["axis"] is not None else ""))
                 vals = ",".join(str(x) for x in v["values"][:8])
                 bucks = ",".join(str(b) for b in v["buckets"][:8])
+                # bucket-STABLE dims (values already on the power-of-two
+                # ladder) carry no recommendation: re-suggesting the
+                # same buckets was analyzer noise — only the coarse
+                # shape-bucket ladder (compile.shapeBuckets) helps them
+                suffix = ""
+                if bucks:
+                    suffix = f" -> recommend padding buckets [{bucks}]"
+                elif v.get("stable"):
+                    suffix = (" (already bucket-stable; coarsen via "
+                              "spark.rapids.tpu.compile.shapeBuckets)")
                 lines.append(
-                    f"{'':>28}  varies: {where} in [{vals}]"
-                    + (f" -> recommend padding buckets [{bucks}]"
-                       if bucks else ""))
+                    f"{'':>28}  varies: {where} in [{vals}]" + suffix)
     if per_query and rep.get("per_query"):
         lines.append("")
         lines.append("-- per-query compile totals")
@@ -188,6 +233,12 @@ def main(argv=None) -> int:
                     help="cause groups shown (default 15)")
     ap.add_argument("--per-query", action="store_true",
                     help="append the per-query compile totals table")
+    ap.add_argument("--aot-manifest", metavar="OUT", default="",
+                    help="write an AOT pre-warm manifest distilled from "
+                         "the inputs: one entry per distinct (kernel, "
+                         "shape signature) with the replayable argspec; "
+                         "feed it to spark.rapids.tpu.compile.aot."
+                         "manifest (serving/prewarm.py)")
     args = ap.parse_args(argv)
 
     entries: List[Dict[str, Any]] = []
@@ -204,6 +255,14 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     rep = build_report(entries, args.top)
+    if args.aot_manifest:
+        man = build_aot_manifest(entries)
+        with open(args.aot_manifest, "w") as f:
+            json.dump(man, f, indent=1)
+        print(f"compile_report: AOT manifest -> {args.aot_manifest} "
+              f"({man['replayable']}/{len(man['entries'])} entries "
+              f"replayable, {man['total_seconds']:.1f}s of history)",
+              file=sys.stderr)
     if args.json == "-":
         print(json.dumps(rep, indent=1))
     else:

@@ -243,6 +243,7 @@ def render_index(store: HistoryStore) -> str:
                if r.get("coverage_pct") is not None else "-")
         wall = f"{r['wall_s']:.3f}" if r.get("wall_s") is not None else "-"
         aqe = r.get("aqe") or {}
+        ws = (r.get("compile") or {}).get("warmup_share_pct")
         rows.append(
             f"<tr><td><a href='/query/{_href(r['query'])}'>"
             f"{_esc(r['query'])}</a></td>"
@@ -251,7 +252,8 @@ def render_index(store: HistoryStore) -> str:
             f"<td>{wall}</td><td>{cov}</td>"
             f"<td>{len(r['fallbacks'])}</td>"
             f"<td>{aqe.get('stages', 0) if aqe.get('adaptive') else '-'}"
-            f"</td></tr>")
+            f"</td>"
+            f"<td>{f'{ws:.0f}%' if ws is not None else '-'}</td></tr>")
     return (
         f"<!doctype html><html><head><meta charset='utf-8'>"
         f"<title>tpu history server</title><style>{_CSS}</style></head>"
@@ -269,7 +271,7 @@ def render_index(store: HistoryStore) -> str:
         f"<a href='/api/tenants'>/api/tenants</a></p>"
         f"<table><tr><th>query</th><th>tenant</th><th>status</th>"
         f"<th>wall_s</th><th>coverage</th><th>fallbacks</th>"
-        f"<th>aqe stages</th></tr>{''.join(rows)}</table>"
+        f"<th>aqe stages</th><th>warm-up</th></tr>{''.join(rows)}</table>"
         f"</body></html>")
 
 
@@ -286,13 +288,28 @@ def render_query_page(r: Dict[str, Any], detail: Dict[str, Any]) -> str:
            if r.get("coverage_pct") is not None else "?")
     tcov = (f"{r['time_coverage_pct']:.1f}%"
             if r.get("time_coverage_pct") is not None else "?")
+    # warm-up share: what fraction of this query's wall went to the XLA
+    # compiler, split into real compiles vs persistent-cache loads
+    # (outcome=hit entries are deserializations, not compiles) — the
+    # per-query face of the zero-warm-up work (docs/aot.md)
+    comp0 = r.get("compile") or {}
+    warm = ""
+    if r.get("wall_s") and comp0.get("seconds") is not None:
+        share = 100.0 * comp0["seconds"] / r["wall_s"] \
+            if r["wall_s"] > 0 else 0.0
+        ents0 = comp0.get("entries") or []
+        n_hits = sum(1 for e in ents0 if e.get("outcome") == "hit")
+        cached = (f", {n_hits}/{len(ents0)} served from the persistent "
+                  f"cache" if ents0 else "")
+        warm = (f" &middot; warm-up share <b>{min(share, 100.0):.1f}%"
+                f"</b>{cached}")
     out.append(
         f"<p>tenant <b>{_esc(r.get('tenant') or 'default')}</b> &middot; "
         f"wall {wall} &middot; op coverage <b>{cov}</b> &middot; "
         f"time coverage {tcov} &middot; "
         f"spill {r['spill']['bytes']}B &middot; "
         f"fetch retries {r['fetch']['retries']} &middot; "
-        f"compile {r['compile']['seconds']:.2f}s</p>")
+        f"compile {r['compile']['seconds']:.2f}s{warm}</p>")
     if r.get("error"):
         out.append(f"<p class='failed'>error: {_esc(r['error'])}</p>")
     serving = r.get("serving") or {}

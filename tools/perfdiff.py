@@ -34,6 +34,14 @@ dispatch breakdown bench.py records in BENCH_DETAIL
 MORE dispatch-bound — the pathology whole-stage fusion exists to
 collapse (docs/fusion.md). ``--ignore-dispatch`` disables.
 
+And it gates **warm-up** (docs/aot.md): a common query whose REAL
+warm-up compile count (``warm_compiles``; persistent-cache hits already
+excluded by bench.py) grew between sweeps, or a suite whose cold
+first-query wall (``first_run_s`` / the summary's ``cold_start``) rose
+more than ``--warmup-threshold`` (default 0.50 relative), exits 1 —
+the zero-warm-up contract of the shape-bucket / shared-cache / AOT
+layer. ``--ignore-warmup`` disables.
+
 Exit codes: 0 = no regression, 1 = regression (any common query slower
 than ``--threshold``, default 10%, geomean drift below
 ``--geomean-threshold``, default 5%, or a steady-state compile-count
@@ -128,6 +136,36 @@ def dispatch_from_doc(doc: Dict[str, Any]) -> Dict[str, float]:
     return {}
 
 
+def warmup_from_doc(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Warm-up facts of a sweep artifact (``bench.py``'s cold-process
+    metrics): per-query REAL warm-up compile counts
+    (``warm_compiles``, persistent-cache hits already excluded by the
+    worker) and the per-suite cold first-query wall (``first_run_s`` of
+    each suite's first scored query; the summary's ``cold_start`` block
+    when present). Empty maps for artifact shapes without them."""
+    out: Dict[str, Any] = {"warm_compiles": {}, "first_query_s": {}}
+    queries = doc.get("queries")
+    if isinstance(queries, dict):
+        for name, rec in queries.items():
+            if not isinstance(rec, dict):
+                continue
+            if "warm_compiles" in rec:
+                out["warm_compiles"][name] = int(rec["warm_compiles"])
+            suite = name.split(".", 1)[0] if "." in name else "tpch"
+            if rec.get("first_run_s") is not None \
+                    and suite not in out["first_query_s"]:
+                out["first_query_s"][suite] = float(rec["first_run_s"])
+    cold = (doc.get("parsed") or {}).get("cold_start") \
+        if ("parsed" in doc or "tail" in doc) else doc.get("cold_start")
+    if isinstance(cold, dict):
+        for suite, rec in cold.items():
+            if isinstance(rec, dict) \
+                    and rec.get("first_query_s") is not None:
+                out["first_query_s"].setdefault(
+                    suite, float(rec["first_query_s"]))
+    return out
+
+
 def serve_from_doc(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Serve-mode artifact (``BENCH_SERVE.json`` from ``bench.py
     --concurrency N``): throughput + latency quantiles. None when the
@@ -197,7 +235,10 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
             new_compiles: Optional[Dict[str, int]] = None,
             base_dispatch: Optional[Dict[str, float]] = None,
             new_dispatch: Optional[Dict[str, float]] = None,
-            dispatch_threshold: float = 0.10) -> Dict[str, Any]:
+            dispatch_threshold: float = 0.10,
+            base_warmup: Optional[Dict[str, Any]] = None,
+            new_warmup: Optional[Dict[str, Any]] = None,
+            warmup_threshold: float = 0.50) -> Dict[str, Any]:
     common = sorted(set(base) & set(new))
     deltas = []
     for q in common:
@@ -246,7 +287,38 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
                 "regressed": (n - b) > dispatch_threshold})
     dispatch_regressions = [d["query"] for d in dispatch_deltas
                             if d["regressed"]]
+    # warm-up gate: a query whose REAL warm-up compile count grew
+    # between sweeps lost part of its zero-warm-up story (shape buckets
+    # / shared cache / AOT replay, docs/aot.md) — gated like a
+    # steady-state recompile. The cold first-query wall is gated per
+    # suite with its own (looser) threshold: cold walls carry one-off
+    # I/O noise a 10% bound would false-positive on.
+    bw = base_warmup or {"warm_compiles": {}, "first_query_s": {}}
+    nw = new_warmup or {"warm_compiles": {}, "first_query_s": {}}
+    warmup_deltas = []
+    for q in sorted(set(bw["warm_compiles"]) & set(nw["warm_compiles"])):
+        b, n = bw["warm_compiles"][q], nw["warm_compiles"][q]
+        if b != n:
+            warmup_deltas.append({"query": q, "base": b, "new": n,
+                                  "regressed": n > b})
+    warmup_regressions = [d["query"] for d in warmup_deltas
+                          if d["regressed"]]
+    first_query_deltas = []
+    for sn in sorted(set(bw["first_query_s"]) & set(nw["first_query_s"])):
+        b, n = bw["first_query_s"][sn], nw["first_query_s"][sn]
+        d = n / b - 1.0 if b > 0 else 0.0
+        first_query_deltas.append({
+            "suite": sn, "base": round(b, 4), "new": round(n, 4),
+            "delta_pct": round(100.0 * d, 2),
+            "regressed": d > warmup_threshold})
+    first_query_regressions = [d["suite"] for d in first_query_deltas
+                               if d["regressed"]]
     return {
+        "warmup_deltas": warmup_deltas,
+        "warmup_regressions": warmup_regressions,
+        "first_query_deltas": first_query_deltas,
+        "first_query_regressions": first_query_regressions,
+        "warmup_threshold": round(warmup_threshold, 4),
         "compile_deltas": compile_deltas,
         "compile_regressions": compile_regressions,
         "dispatch_deltas": dispatch_deltas,
@@ -266,7 +338,8 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
         "improvements": [r["query"] for r in deltas if r["improved"]],
         "deltas": deltas,
         "regressed": bool(regressions) or geo_regressed
-        or bool(compile_regressions) or bool(dispatch_regressions),
+        or bool(compile_regressions) or bool(dispatch_regressions)
+        or bool(warmup_regressions) or bool(first_query_regressions),
     }
 
 
@@ -309,6 +382,17 @@ def render_text(rep: Dict[str, Any]) -> str:
             lines.append(f"-- dispatch_share {d['query']}: "
                          f"{d['base']:.2f} -> {d['new']:.2f} "
                          "DISPATCH-SHARE REGRESSION")
+    for d in rep.get("warmup_deltas", []):
+        mark = " WARM-UP COMPILE REGRESSION" if d["regressed"] \
+            else " (improved)"
+        lines.append(f"-- warm_compiles {d['query']}: "
+                     f"{d['base']} -> {d['new']}{mark}")
+    for d in rep.get("first_query_deltas", []):
+        if d["regressed"]:
+            lines.append(f"-- first-query wall [{d['suite']}]: "
+                         f"{d['base']:.2f}s -> {d['new']:.2f}s "
+                         f"({d['delta_pct']:+.1f}%) COLD-START "
+                         "REGRESSION")
     lines.append("RESULT: " + ("REGRESSED" if rep["regressed"] else "ok"))
     return "\n".join(lines)
 
@@ -335,6 +419,14 @@ def main(argv=None) -> int:
                     help="absolute dispatch-share increase that counts "
                          "as a regression (default 0.10 = 10 share "
                          "points)")
+    ap.add_argument("--ignore-warmup", action="store_true",
+                    help="do not gate on warm-up regressions (per-query "
+                         "warm_compiles growth, per-suite cold "
+                         "first-query wall)")
+    ap.add_argument("--warmup-threshold", type=float, default=0.50,
+                    help="relative cold first-query wall increase that "
+                         "counts as a regression (default 0.50 = 50%%; "
+                         "cold walls carry one-off I/O noise)")
     ap.add_argument("--json", metavar="OUT", default="",
                     help="also write the machine-shape diff ('-' = "
                          "stdout)")
@@ -370,6 +462,10 @@ def main(argv=None) -> int:
             else dispatch_from_doc(base_doc)
         new_d = {} if args.ignore_dispatch \
             else dispatch_from_doc(new_doc)
+        base_w = None if args.ignore_warmup \
+            else warmup_from_doc(base_doc)
+        new_w = None if args.ignore_warmup \
+            else warmup_from_doc(new_doc)
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
         print(f"perfdiff: {e}", file=sys.stderr)
         return 2
@@ -386,7 +482,9 @@ def main(argv=None) -> int:
                   args.threshold, args.geomean_threshold,
                   base_compiles=base_c, new_compiles=new_c,
                   base_dispatch=base_d, new_dispatch=new_d,
-                  dispatch_threshold=args.dispatch_threshold)
+                  dispatch_threshold=args.dispatch_threshold,
+                  base_warmup=base_w, new_warmup=new_w,
+                  warmup_threshold=args.warmup_threshold)
     if args.json == "-":
         print(json.dumps(rep, indent=1))
     else:
