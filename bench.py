@@ -81,6 +81,16 @@ per-tenant plan/result-cache hit rates, every job verified against the
 CPU oracle. `tools/perfdiff.py OLD_SERVE.json BENCH_SERVE.json` gates
 serve-mode throughput regressions.
 
+Stress tier (`--stress`): runs ONLY the out-of-core stress phase —
+join/agg/sort over BENCH_STRESS_ROWS rows (default 400000, ~10MB
+working set) with spark.rapids.tpu.outOfCore.* enabled at a
+BENCH_STRESS_BUDGET working budget (default 8MB, so the working set
+EXCEEDS it and grace partitioning + spill engages), every query
+verified against the CPU oracle, writing BENCH_STRESS.json (throughput
+rows/s, per-query spill-event counts). `tools/perfdiff.py
+OLD_STRESS.json BENCH_STRESS.json` gates spill-count and throughput
+drift (docs/spill.md).
+
 Scan-inclusive mode (`--include-scan` or BENCH_INCLUDE_SCAN=1): for the
 tpch queries in BENCH_SCAN_QUERIES (default q1,q6,q14), additionally time
 the TPU path over real multi-row-group Parquet files with the device scan
@@ -675,6 +685,90 @@ def _worker():
             "queries": per_query,
         }
 
+    # --stress: the out-of-core tier (docs/spill.md) — join/agg/sort at a
+    # working-set scale EXCEEDING the configured working budget, with
+    # spark.rapids.tpu.outOfCore.* enabled, every query verified against
+    # the CPU oracle and the per-run spill-event count recorded. The
+    # artifact (BENCH_STRESS.json) is the stress axis tools/perfdiff.py
+    # gates (spill-count and throughput drift).
+    def measure_stress():
+        import numpy as np
+        import pandas as pd
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        from spark_rapids_tpu.sql import functions as F
+        rows = int(os.environ.get("BENCH_STRESS_ROWS", "400000"))
+        budget = int(os.environ.get("BENCH_STRESS_BUDGET", str(8 << 20)))
+        rng = np.random.default_rng(11)
+        fact = pd.DataFrame({
+            "k": rng.integers(0, 2000, rows).astype(np.int64),
+            "v": rng.random(rows),
+            "w": rng.integers(0, 1000, rows).astype(np.int64),
+        })
+        dim = pd.DataFrame({"k": np.arange(2000, dtype=np.int64),
+                            "tag": ["t%d" % (i % 97) for i in range(2000)]})
+
+        def q_join(s):
+            return (s.create_dataframe(fact, 4)
+                    .join(s.create_dataframe(dim, 2), on="k", how="inner")
+                    .group_by("tag")
+                    .agg(F.sum("v").alias("sv"), F.count("*").alias("n")))
+
+        def q_agg(s):
+            return (s.create_dataframe(fact, 4).group_by("k")
+                    .agg(F.sum("v").alias("sv"), F.count("*").alias("n"),
+                         F.max("w").alias("mw")))
+
+        def q_sort(s):
+            return s.create_dataframe(fact, 4).order_by("v")
+
+        def spill_snapshot():
+            return (REGISTRY.value("spill.events",
+                                   direction="device_to_host")
+                    + REGISTRY.value("spill.events",
+                                     direction="host_to_disk"))
+
+        rec = {"mode": "stress", "budget_bytes": budget, "rows": rows,
+               "queries": {}}
+        throughputs, total_spills, verified_all = [], 0, True
+        for name, fn in (("stress_join", q_join), ("stress_agg", q_agg),
+                         ("stress_sort", q_sort)):
+            cpu_out = run_query(fn, False)
+            saved = dict(session.conf._settings)
+            try:
+                session.set_conf("spark.rapids.tpu.outOfCore.enabled",
+                                 True)
+                session.set_conf(
+                    "spark.rapids.tpu.outOfCore.partitionBytes", budget)
+                session.set_conf(
+                    "spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+                run_query(fn, True)  # warm compiles out of the window
+                s0 = spill_snapshot()
+                t0 = time.perf_counter()
+                tpu_out = run_query(fn, True)
+                wall = time.perf_counter() - t0
+                spills = int(spill_snapshot() - s0)
+            finally:
+                session.conf._settings = saved
+            verified = _results_match(tpu_out, cpu_out)
+            rps = round(rows / wall, 1) if wall > 0 else None
+            rec["queries"][name] = {
+                "wall_s": round(wall, 4), "rows_per_s": rps,
+                "spill_events": spills, "verified": verified,
+            }
+            total_spills += spills
+            verified_all = verified_all and verified
+            if rps:
+                throughputs.append(rps)
+            print(f"bench: {name} wall={wall:.2f}s rows/s={rps} "
+                  f"spills={spills} verified={verified}",
+                  file=sys.stderr, flush=True)
+        geo = (math.exp(sum(math.log(t) for t in throughputs)
+                        / len(throughputs)) if throughputs else None)
+        rec["throughput_rows_per_s"] = round(geo, 1) if geo else None
+        rec["spill_events_total"] = total_spills
+        rec["verified"] = verified_all
+        return rec
+
     out = os.fdopen(os.dup(1), "w", buffering=1)
     os.dup2(2, 1)  # anything stray printed inside the engine -> stderr
     for line in sys.stdin:
@@ -688,6 +782,9 @@ def _worker():
                 if sn not in suites:
                     suites[sn] = _build_suite(sn)
                 out.write(json.dumps({"built": sn}) + "\n")
+                continue
+            if req.get("op") == "stress":
+                out.write(json.dumps({"stress": measure_stress()}) + "\n")
                 continue
             if req.get("op") == "serve":
                 sweep = [tuple(e) for e in req["sweep"]]
@@ -887,6 +984,46 @@ def _wait_for_idle_box():
 def main():
     if "--worker" in sys.argv:
         _worker()
+        return
+    if "--stress" in sys.argv:
+        # out-of-core stress tier: runs ONLY the stress phase (join/agg/
+        # sort at a scale exceeding BENCH_STRESS_BUDGET with
+        # spark.rapids.tpu.outOfCore.* on), writing BENCH_STRESS.json.
+        # Gate drift run-over-run with
+        # `python tools/perfdiff.py OLD_STRESS.json BENCH_STRESS.json`.
+        _wait_for_idle_box()
+        worker = _Worker()
+        try:
+            deadline = int(os.environ.get("BENCH_STRESS_TIMEOUT_S",
+                                          "1800"))
+            reply = worker.ask({"op": "stress"}, deadline)
+        finally:
+            worker.close()
+        summary = {"metric": "stress_throughput_rows_per_s", "value": 0.0,
+                   "unit": "rows/s"}
+        if reply is None or "stress" not in reply:
+            summary["error"] = (f"stress phase failed: {str(reply)[:200]}"
+                                if reply else "stress phase timed out")
+            print(json.dumps(summary))
+            return
+        rec = reply["stress"]
+        stress_file = os.environ.get("BENCH_STRESS_FILE",
+                                     "BENCH_STRESS.json")
+        try:
+            with open(stress_file, "w") as f:
+                json.dump(rec, f, indent=1)
+        except OSError as e:
+            print(f"bench: could not write {stress_file}: {e}",
+                  file=sys.stderr, flush=True)
+        summary.update({
+            "value": rec.get("throughput_rows_per_s") or 0.0,
+            "spill_events_total": rec.get("spill_events_total"),
+            "verified": rec.get("verified"),
+            "budget_bytes": rec.get("budget_bytes"),
+            "rows": rec.get("rows"),
+            "detail_file": stress_file,
+        })
+        print(json.dumps(summary))
         return
     if "--include-scan" in sys.argv:
         # worker inherits the env; the flag form exists so CI invocations

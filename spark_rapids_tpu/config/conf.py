@@ -513,6 +513,67 @@ SHUFFLE_BOUNCE_BUFFER_COUNT = register(
     "spark.rapids.shuffle.bounceBuffers.count", int, 16,
     "Number of staging buffers per direction.", validator=_positive)
 
+SHUFFLE_TRANSPORT_MODE = register(
+    "spark.rapids.tpu.shuffle.transport.mode", str, "legacy",
+    "Per-edge shuffle transport selection (shuffle/manager.py "
+    "ShuffleTransportKind). 'legacy' (default) reproduces the historical "
+    "selection byte-identically: a configured device mesh routes "
+    "hash/range (and device-count roundrobin) exchanges over the ICI "
+    "mesh collective, spark.rapids.shuffle.transport.enabled routes them "
+    "through the catalog+transport shuffle manager (inprocess/socket "
+    "wire), everything else collapses locally. 'auto' picks per edge: "
+    "in-slice edges (a mesh is configured and the partitioning is mesh-"
+    "compatible) ride ICI, cross-host edges (a multi-executor transport "
+    "pool is configured) ride the socket/DCN manager path, the rest stay "
+    "local. 'ici' forces the mesh collective for every compatible edge "
+    "(local fallback without a mesh); 'manager' forces the shuffle-"
+    "manager wire path; 'local' forces single-process collapse — the "
+    "rollback switch.",
+    validator=(lambda v: None if str(v) in
+               ("legacy", "auto", "ici", "manager", "local")
+               else f"must be one of legacy|auto|ici|manager|local, "
+                    f"got {v}"))
+
+# --- out-of-core (larger-than-HBM) operators (exec/outofcore.py: grace
+# hash join, external merge sort, spillable agg maps on the 3-tier spill
+# store — PAPER.md L2's multi-tier store driven by measured sizes) ----------
+OOC_ENABLED = register(
+    "spark.rapids.tpu.outOfCore.enabled", _to_bool, False,
+    "Out-of-core execution for join/aggregate/sort: when an operator's "
+    "measured device working set exceeds the working-set budget "
+    "(spark.rapids.tpu.outOfCore.partitionBytes), its input is hash- (or "
+    "for sort, range-) partitioned into spillable fan-out buckets "
+    "registered on the 3-tier store (HBM->host->disk, memory/spill.py) "
+    "and processed one bucket at a time: grace hash join (build-side "
+    "fragments recursed when still over budget), external merge sort, "
+    "and per-bucket aggregate merges. Fan-out is chosen from the same "
+    "measured batch sizes AQE collects. false (default) keeps every "
+    "operator's in-HBM path byte-identical.")
+
+OOC_PARTITION_BYTES = register(
+    "spark.rapids.tpu.outOfCore.partitionBytes", _to_bytes, 0,
+    "Working-set budget of one out-of-core operator: partitioning fans "
+    "out until each bucket is expected to fit in this many bytes, and "
+    "the device store is synchronously spilled down to it while buckets "
+    "accumulate. 0 (default) = auto: half the metered HBM budget "
+    "(spark.rapids.memory.tpu.allocFraction x device HBM). Tests set a "
+    "tiny value to force spilling at toy scale.")
+
+OOC_FANOUT = register(
+    "spark.rapids.tpu.outOfCore.fanout", int, 0,
+    "Fixed fan-out (bucket count) for out-of-core partitioning. 0 "
+    "(default) = auto from measured sizes: the next power of two of "
+    "total_bytes / partitionBytes, clamped to [2, 64].",
+    validator=_non_negative)
+
+OOC_MAX_RECURSION = register(
+    "spark.rapids.tpu.outOfCore.maxRecursion", int, 3,
+    "Grace hash join recursion bound: a bucket whose build fragment "
+    "still exceeds the working-set budget is re-partitioned with a "
+    "different hash up to this many levels; past it the fragment joins "
+    "in one pass regardless (correct, just memory-hungry — mirrors the "
+    "reference's sub-partitioning bound).", validator=_positive)
+
 EXPORT_COLUMNAR_RDD = register(
     "spark.rapids.sql.exportColumnarRdd", _to_bool, False,
     "Expose query output as device-resident columnar data for ML frameworks "

@@ -507,8 +507,27 @@ class TpuHashAggregateExec(TpuExec):
 
         def make(part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
+                # out-of-core: a grouped aggregate whose input exceeds
+                # the budget hash-partitions its partial-layout batches
+                # onto the spill store and merges bucket by bucket
+                # (disjoint key sets; exec/outofcore.py)
+                from spark_rapids_tpu.exec import outofcore as ooc
+                src = part
+                if ooc.enabled_for(ctx) and self.plan.num_keys > 0:
+                    # streaming probe: never materializes past the
+                    # budget — on engagement the unconsumed tail flows
+                    # straight into the grace driver's staging pass
+                    prefix, rest, engaged = ooc.split_stream_on_budget(
+                        ctx, iter(part()))
+                    if engaged:
+                        import itertools
+                        yield from ooc.grace_aggregate(
+                            ctx, self, itertools.chain(prefix, rest),
+                            growth)
+                        return
+                    src = lambda ob=prefix: iter(ob)  # noqa: E731
                 if self.mode == "partial":
-                    it = iter(part())
+                    it = iter(src())
                     first = next(it, None)
                     if first is None:
                         yield self._kernel(DeviceBatch.empty(
@@ -574,7 +593,7 @@ class TpuHashAggregateExec(TpuExec):
                                             growth)
                     yield merge_kernel(merged)
                     return
-                batches = list(part())
+                batches = list(src())
                 merged_in = _concat_device(batches, self.plan.partial_schema,
                                            growth)
                 merged = merge_kernel(merged_in)
@@ -640,7 +659,24 @@ class TpuSortExec(TpuExec):
 
         def make(part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
-                batches = list(part())
+                # out-of-core: a working set past the budget range-
+                # partitions onto the spill store and sorts bucket by
+                # bucket (external merge sort, exec/outofcore.py). The
+                # probe streams — the input is never fully materialized
+                # past the budget.
+                from spark_rapids_tpu.exec import outofcore as ooc
+                if ooc.enabled_for(ctx):
+                    prefix, rest, engaged = ooc.split_stream_on_budget(
+                        ctx, iter(part()))
+                    if engaged:
+                        import itertools
+                        yield from ooc.external_sort(
+                            ctx, self, itertools.chain(prefix, rest),
+                            schema, growth)
+                        return
+                    batches = prefix
+                else:
+                    batches = list(part())
                 merged = _concat_device(batches, schema, growth)
                 yield self._kernel(merged)
             return run
@@ -1066,82 +1102,59 @@ class TpuShuffleExchangeExec(TpuExec):
         growth = ctx.conf.capacity_growth
         kind = self.partitioning[0]
 
-        # single-device collapse: with no mesh there is one chip, so n
-        # hash/range/roundrobin buckets only serialize onto it anyway —
-        # while costing a bucket-count device->host sync and n x padded
-        # capacity. Collapse to one fused concat (zero syncs); real
-        # partitioning happens on the mesh path (parallel/distributed.py)
-        # where the exchange is an all_to_all over ICI. The reference has
-        # no single-device analogue (GPUs shuffle between executors even
-        # locally, RapidsShuffleInternalManager.scala:186-362); this is
-        # the latency-driven TPU redesign.
+        # per-edge transport selection (shuffle/manager.py
+        # ShuffleTransportKind): ICI = in-slice mesh collective, MANAGER =
+        # catalog + transport wire (inprocess/socket — the cross-host /
+        # DCN path), LOCAL = single-process collapse or bucket
+        # materialization. The default mode ('legacy') reproduces the
+        # historical inline selection byte-identically.
+        from spark_rapids_tpu.shuffle.manager import (
+            ShuffleTransportKind, select_transport_kind,
+        )
         mesh = getattr(ctx.session, "mesh", None) if ctx.session else None
-        manager_on = (ctx.session is not None and ctx.conf.get_bool(
-            "spark.rapids.shuffle.transport.enabled", False))
-        # roundrobin is exempt: it IS the user-visible repartition(n) shape
-        # (output partition/file count of a following write)
-        collapse = (mesh is None and not manager_on
+        n_req = self.partitioning[-1] if kind != "single" else 1
+        tkind = select_transport_kind(ctx.conf, ctx.session, kind, n_req)
+        manager_on = tkind is ShuffleTransportKind.MANAGER
+        # roundrobin is exempt from collapse: it IS the user-visible
+        # repartition(n) shape (output partition/file count of a
+        # following write)
+        collapse = (tkind is ShuffleTransportKind.LOCAL
                     and kind in ("hash", "range")
                     and ctx.conf.get_bool(
                         "spark.rapids.sql.shuffle.localCollapse", True))
 
-        mesh_kinds = ("hash", "range")
-        if (mesh is not None and kind == "roundrobin"
-                and self.partitioning[-1] == mesh.devices.size):
-            # user-visible repartition(n) keeps its partition count; it can
-            # only ride the mesh when n matches the device count
-            mesh_kinds = ("hash", "range", "roundrobin")
-        if mesh is not None and kind in mesh_kinds:
+        if tkind is ShuffleTransportKind.ICI:
             # distributed exchange: one fused shard_map program whose core
-            # is an ICI all_to_all (parallel/distributed.py), replacing the
-            # reference's UCX transfers (RapidsShuffleInternalManager.scala)
-            # for EVERY exchange kind (GpuShuffleExchangeExec.scala:60-215):
-            # hash (joins/aggregates), range (distributed global sort:
+            # is an ICI all_to_all (shuffle/ici.py over
+            # parallel/distributed.py), replacing the reference's UCX
+            # transfers (RapidsShuffleInternalManager.scala) for EVERY
+            # exchange kind (GpuShuffleExchangeExec.scala:60-215): hash
+            # (joins/aggregates), range (distributed global sort:
             # per-shard sample -> host bounds -> all_to_all), roundrobin.
-            # Each upstream partition stays resident on its own mesh device
-            # end-to-end — no single-device funnel.
-            n_dev = mesh.devices.size
-            state = {"shards": None}
-
-            def shards():
-                if state["shards"] is None:
-                    from spark_rapids_tpu.parallel import distributed as dist
-                    per_shard: List[List[DeviceBatch]] = \
-                        [[] for _ in range(n_dev)]
-                    for j, p in enumerate(child_parts):
-                        per_shard[j % n_dev].extend(p())
-                    shard_batches = dist.mesh_collect_shards(
-                        mesh, schema, per_shard, growth)
-                    if kind == "hash":
-                        key_idx = list(self.partitioning[1])
-
-                        def pid_fn(b):
-                            return dist._hash_pid(b, key_idx, n_dev)
-                    elif kind == "range":
-                        key_idx = list(self.partitioning[1])
-                        asc = list(self.partitioning[2])
-                        nf = list(self.partitioning[3])
-                        bounds = dist.mesh_range_bounds(
-                            shard_batches, key_idx, asc, nf, n_dev)
-
-                        def pid_fn(b):
-                            return sortops.range_partition_ids(
-                                b, key_idx, asc, nf, bounds)
-                    else:
-                        def pid_fn(b):
-                            return (jnp.arange(b.capacity, dtype=jnp.int32)
-                                    % jnp.int32(n_dev))
-                    state["shards"] = dist.mesh_exchange_parts(
-                        mesh, schema, shard_batches, pid_fn)
-                return state["shards"]
-
-            def make_mesh_part(i: int) -> Partition:
-                def run() -> Iterator[DeviceBatch]:
-                    yield shards()[i]
-                return run
-            return [make_mesh_part(i) for i in range(n_dev)]
+            # Each upstream partition stays resident on its own mesh
+            # device end-to-end — no single-device funnel — and the
+            # backend folds device-side send counts into
+            # MapOutputStatistics + skew/journal/ledger surfaces.
+            from spark_rapids_tpu.shuffle.ici import IciMeshExchange
+            backend = IciMeshExchange(self, mesh, schema, growth)
+            return backend.partitions(ctx, child_parts)
 
         if kind == "single" or collapse:
+            from spark_rapids_tpu.exec import outofcore as ooc
+            if ooc.enabled_for(ctx):
+                # out-of-core mode: the collapse concat IS the whole-
+                # dataset funnel array larger-than-HBM execution must
+                # avoid — stream the pieces through individually and let
+                # the downstream grace operators partition-and-spill them
+                def stream_pieces() -> Iterator[DeviceBatch]:
+                    got = False
+                    for p in child_parts:
+                        for b in p():
+                            got = True
+                            yield b
+                    if not got:
+                        yield DeviceBatch.empty(schema)
+                return [stream_pieces]
             # sync-free collapse: when no aggregate feeds this exchange,
             # the producer batches are NOT systematically over-padded, so
             # the count-fetch sync + per-batch shrink gathers cost more

@@ -366,8 +366,40 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
         def make(sp: Partition, bp: Partition, pidx: int) -> Partition:
             def run() -> Iterator[DeviceBatch]:
                 from spark_rapids_tpu.exec.tpu import _concat_device
-                build = _concat_device(list(bp()), build_schema, growth,
-                                       coarse=True)
+                # out-of-core: when the measured working set (build +
+                # stream batches) exceeds the budget, grace-hash-
+                # partition both sides onto the spill store and join
+                # bucket by bucket (exec/outofcore.py) instead of
+                # holding one giant build table
+                from spark_rapids_tpu.exec import outofcore as ooc
+                sp_local, bp_local = sp, bp
+                if ooc.join_applicable(ctx, self):
+                    # streaming probe on BOTH sides (never materializes
+                    # past the budget): the build side is consumed up to
+                    # the budget; if it fits, the stream side gets the
+                    # remainder; on engagement the unconsumed tails flow
+                    # straight into the grace driver's staging pass
+                    import itertools
+                    budget = ooc.working_set_budget(ctx)
+                    bpre, brest, bover = ooc.split_stream_on_budget(
+                        ctx, iter(bp()), budget)
+                    if bover:
+                        yield from ooc.grace_join(
+                            ctx, self, itertools.chain(bpre, brest),
+                            sp(), growth)
+                        return
+                    bbytes = ooc.total_batch_bytes(bpre)
+                    spre, srest, sover = ooc.split_stream_on_budget(
+                        ctx, iter(sp()), max(budget - bbytes, 1))
+                    if sover:
+                        yield from ooc.grace_join(
+                            ctx, self, bpre,
+                            itertools.chain(spre, srest), growth)
+                        return
+                    bp_local = lambda bl=bpre: iter(bl)  # noqa: E731
+                    sp_local = lambda sl=spre: iter(sl)  # noqa: E731
+                build = _concat_device(list(bp_local()), build_schema,
+                                       growth, coarse=True)
                 matched_acc = None
                 emitted = False
                 nonlocal dense
@@ -384,7 +416,7 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                         # probe every batch first, ONE ok-flag fetch for
                         # all of them (a per-batch device_get would pay a
                         # full RTT each on the tunneled attachment)
-                        streams = list(sp())
+                        streams = list(sp_local())
                         raw = [dkern(build, s, lo_arr) for s in streams]
                         oks_d = [r[3] for r in raw]
                         entry = cache.get(key) if cache is not None else None
@@ -409,7 +441,7 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                                           else self._probe(build, stream)[0])
                                 yield self._semi(stream, counts)
                     else:
-                        for stream in sp():
+                        for stream in sp_local():
                             emitted = True
                             yield self._semi(stream,
                                              probe_fn(build, stream)[0])
@@ -417,8 +449,11 @@ class TpuShuffledHashJoinExec(PhysicalPlan):
                     # probe EVERY stream batch first (dispatch is async and
                     # nearly free), then fetch all expansion totals in ONE
                     # device->host round trip — a per-batch fetch would pay
-                    # ~150-250ms each on a tunneled attachment
-                    streams = list(sp())
+                    # ~150-250ms each on a tunneled attachment.
+                    # NB: exec/outofcore.py _join_bucket is this loop's
+                    # simplified per-bucket twin — semantic changes to the
+                    # probe/totals/expand contract must be mirrored there
+                    streams = list(sp_local())
                     oks_d = []
                     if dense:
                         raw = [dkern(build, s, lo_arr) for s in streams]

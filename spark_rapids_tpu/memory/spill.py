@@ -146,7 +146,15 @@ class SpillableBuffer:
         self._host_data = None
 
     def spill_to_disk(self, disk_dir: str) -> int:
-        """HOST -> DISK. Returns host bytes freed."""
+        """HOST -> DISK. Returns host bytes freed.
+
+        A disk-write failure (full/unwritable spill dir) must never
+        corrupt the catalog: the buffer stays intact in the HOST tier, a
+        partial file is removed, and the failure surfaces as a
+        ``memoryPressure`` event + ``spill.diskWriteFailures`` counter —
+        the store simply cannot shrink further (the reference handles
+        disk-store IOExceptions the same way: buffer keeps its current
+        tier, pressure propagates)."""
         with self._lock:
             if self.tier != StorageTier.HOST or self.closed:
                 return 0
@@ -154,9 +162,22 @@ class SpillableBuffer:
             leaves = self._host_leaves()
             arrays = {f"a{i}": np.asarray(leaf)
                       for i, leaf in enumerate(leaves)}
-            with TRACER.span("spill.toDisk", buffer=self.id,
-                             bytes=self.size):
-                np.savez(path, **arrays)
+            try:
+                with TRACER.span("spill.toDisk", buffer=self.id,
+                                 bytes=self.size):
+                    np.savez(path, **arrays)
+            except OSError as e:
+                try:
+                    if os.path.exists(path):
+                        os.unlink(path)
+                except OSError:
+                    pass
+                self._disk_write_failed = True  # host store backs off
+                REGISTRY.counter("spill.diskWriteFailures").add(1)
+                EVENTS.emit("memoryPressure", neededBytes=self.size,
+                            freedBytes=0, buffer=self.id,
+                            diskWriteError=str(e)[:200])
+                return 0
             self._treedef = self._host_data["treedef"]
             self._nleaves = len(leaves)
             self._disk_path = path
@@ -321,17 +342,32 @@ class HostStore(BufferStore):
     """Bounded host tier (reference: RapidsHostMemoryStore.scala,
     spark.rapids.memory.host.spillStorageSize, default 1 GiB)."""
 
+    #: seconds to back off after a disk-write failure: a full/unwritable
+    #: spill dir would otherwise re-serialize every host buffer (and
+    #: re-emit a memoryPressure event each) on EVERY spill pass — a hot
+    #: loop of wasted I/O exactly when the box is already in trouble
+    DISK_RETRY_COOLDOWN_S = 5.0
+
     def __init__(self, limit_bytes: int, spill_store: "DiskStore"):
         super().__init__(StorageTier.HOST, spill_store)
         self.limit_bytes = limit_bytes
+        self._disk_retry_at = 0.0
         # native aligned host pool for spilled leaf bytes (pinned-pool
         # analogue); plain numpy fallback engages per-buffer when full
         from spark_rapids_tpu.nativelib import HostArena
         self.arena = HostArena(max(limit_bytes, 1 << 20))
 
     def spill_one(self, buf: SpillableBuffer) -> int:
+        import time
+        if time.monotonic() < self._disk_retry_at:
+            return 0
         freed = buf.spill_to_disk(self.spill_store.disk_dir)
+        if getattr(buf, "_disk_write_failed", False):
+            buf._disk_write_failed = False
+            self._disk_retry_at = (time.monotonic()
+                                   + self.DISK_RETRY_COOLDOWN_S)
         if freed:
+            self._disk_retry_at = 0.0
             REGISTRY.counter("spill.events", direction="host_to_disk") \
                 .add(1)
             REGISTRY.counter("spill.bytes", direction="host_to_disk") \

@@ -182,6 +182,62 @@ def status_snapshot() -> Dict[str, Any]:
         if sem is not None:
             out["semaphore"] = {"permits": sem.permits,
                                 "available": sem.available_permits()}
+        # shuffle data plane: which transport kinds are live (the
+        # ShuffleTransportKind policy, shuffle/manager.py) and their wire
+        # (socket) / collective (ICI) counters side by side — the same
+        # series a Prometheus scrape reads as srt_shuffle_transport_* /
+        # srt_shuffle_ici_*
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        peers: Dict[str, Dict[str, Any]] = {}
+        ici_info: Dict[str, Any] = {"exchanges": 0, "rows": 0}
+        for m in REGISTRY.metrics():
+            if m.name.startswith("shuffle.transport."):
+                peer = m.labels.get("peer")
+                if peer is None:
+                    continue
+                rec = peers.setdefault(peer, {})
+                if m.name == "shuffle.transport.rttSeconds":
+                    rec["rtt_p50_s"] = round(m.percentile(50), 6)
+                    rec["rtt_p99_s"] = round(m.percentile(99), 6)
+                    rec["requests_timed"] = m.count
+                else:
+                    key = m.name.rsplit(".", 1)[-1]
+                    d = m.labels.get("direction") or m.labels.get("kind")
+                    rec[f"{key}_{d}" if d else key] = \
+                        rec.get(f"{key}_{d}" if d else key, 0) + m.value
+            elif m.name == "shuffle.ici.exchanges":
+                ici_info["exchanges"] += m.value
+            elif m.name == "shuffle.ici.rows":
+                ici_info["rows"] += m.value
+        # most recent mesh exchange's folded MapOutputStatistics
+        # (shuffle/ici.py): per-partition distribution next to the
+        # socket peers' wire counters
+        from spark_rapids_tpu.shuffle.ici import recent_exchange_stats
+        if recent_exchange_stats:
+            st = recent_exchange_stats[-1]
+            if callable(getattr(st, "stats", None)):
+                st = st.stats()       # lazy record: fold on first read
+            ici_info["lastExchange"] = {
+                "maps": st.num_maps,
+                "partitions": st.num_partitions,
+                "totalBytesEst": int(st.total_bytes),
+                "maxPartitionBytesEst": int(st.max_bytes()),
+                "rows": (sum(st.rows_by_partition)
+                         if st.rows_by_partition is not None else None),
+            }
+        out["shuffleTransport"] = {
+            "mode": str(s.conf.get(
+                "spark.rapids.tpu.shuffle.transport.mode", "legacy")),
+            "managerEnabled": bool(s.conf.get_bool(
+                "spark.rapids.shuffle.transport.enabled", False)),
+            "transportClass": str(s.conf.get(
+                "spark.rapids.shuffle.transport.class", "inprocess")),
+            "meshDevices": (s.mesh.devices.size
+                            if getattr(s, "mesh", None) is not None
+                            else None),
+            "socketPeers": peers,
+            "ici": ici_info,
+        }
     # zero-warm-up layer: AOT pre-warm progress (kernels warmed /
     # pending / skipped) and shared-compile-cache hit rates — the
     # serving fleet's "is this worker warm yet?" probe

@@ -15,7 +15,7 @@ Validated on a virtual 8-device CPU mesh in tests and by the driver's
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -196,7 +196,9 @@ def mesh_collect_shards(mesh: Mesh, schema: Schema,
 def _make_local(schema: Schema, n: int, pid_fn):
     """The shard_map body shared by every mesh exchange kind: rebuild the
     local batch from its flat buffers, partition rows by ``pid_fn``,
-    all_to_all, compact."""
+    all_to_all, compact. The LAST output is this shard's (n,) send-row
+    counts — the device-side MapStatus.partition_sizes the ICI backend
+    folds into MapOutputStatistics (shuffle/manager.py)."""
     def local(*args):
         it = iter(args[:-1])
         rows = args[-1][0]
@@ -224,13 +226,15 @@ def _make_local(schema: Schema, n: int, pid_fn):
             out.append(c.validity[None])
             if c.dtype.is_string:
                 out.append(c.offsets[None])
+        out.append(counts[None])
         return tuple(out)
     return local
 
 
 def mesh_exchange_parts(mesh: Mesh, schema: Schema,
                         shard_batches: Sequence[DeviceBatch],
-                        pid_fn) -> List[DeviceBatch]:
+                        pid_fn, stats_out: Optional[dict] = None
+                        ) -> List[DeviceBatch]:
     """Distributed exchange over already-sharded inputs: shard i's batch
     lives on mesh device i (mesh_collect_shards), the global (n, cap)
     operand arrays are assembled from the per-device blocks with
@@ -293,11 +297,17 @@ def mesh_exchange_parts(mesh: Mesh, schema: Schema,
             shape, sh, blocks))
         in_specs.append(P("dp", None) if len(shape) == 2 else P("dp"))
 
-    n_out = 1 + sum(3 if dt.is_string else 2 for dt in schema.dtypes)
+    # +1: the trailing (n, n) send-count matrix (_make_local's last
+    # output) — per-source-shard device-side partition sizes
+    n_out = 1 + sum(3 if dt.is_string else 2 for dt in schema.dtypes) + 1
     out_specs = tuple([P("dp")] + [P("dp", None)] * (n_out - 1))
     fn = jax.jit(shard_map(_make_local(schema, n, pid_fn), mesh=mesh,
                            in_specs=tuple(in_specs), out_specs=out_specs))
     outs = fn(*args)
+    if stats_out is not None:
+        # global (n_src, n_dst) row counts; left as a device array — the
+        # caller fetches when (and if) it folds MapOutputStatistics
+        stats_out["send_counts"] = outs[-1]
 
     # unstack: each mesh device's addressable block -> one committed
     # DeviceBatch, staying resident on its device

@@ -1,0 +1,197 @@
+"""ICI mesh shuffle backend: the in-slice transport kind.
+
+Re-founds ``parallel/distributed.py``'s shard_map + ``all_to_all``
+exchange as a first-class ``TpuShuffleExchangeExec`` backend behind the
+``ShuffleTransportKind`` abstraction (shuffle/manager.py): the exchange
+node delegates every in-slice edge here, device data never leaves HBM,
+and the observability surfaces treat the mesh stage like any other
+operator —
+
+  * ``MapOutputStatistics`` folded from DEVICE-SIDE send counts (the
+    extra shard_map output of ``mesh_exchange_parts``) — the
+    MapStatus.partition_sizes role, feeding the same skew recording
+    (obs/shuffleobs.py) AQE's statistics machinery reads;
+  * compiles attribute to the exchange operator in the compile ledger
+    (the shard_map program compiles inside its ``op_context``);
+  * ``meshExchange`` journal events, ``shuffle.ici.*`` registry series,
+    tracer spans and per-query progress map-partition beats.
+
+The reference's analogue is the UCX peer-to-peer transport
+(RapidsShuffleInternalManager.scala:186-362); ICI replaces tag-matched
+endpoint pairs with ONE fused SPMD program per exchange.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, Schema
+from spark_rapids_tpu.ops import sortops
+
+#: bounded record of recent ICI exchange statistics, newest last. Holds
+#: LazyExchangeStats records whose ``stats()`` folds the device-side
+#: send counts into MapOutputStatistics on first read — the monitor's
+#: /api/status block is the steady consumer; the journal/skew publish
+#: happens at exchange time only when a durable sink is live (see
+#: LazyExchangeStats.maybe_publish), so the default hash-exchange path
+#: keeps its historical zero-sync latency.
+recent_exchange_stats: list = []
+_RECENT_CAP = 32
+
+
+class LazyExchangeStats:
+    """Deferred fold of one mesh exchange's device-side (n_src, n_dst)
+    send counts. The (tiny) device->host fetch is a sync point, so it
+    only happens when something actually reads the statistics."""
+
+    def __init__(self, send_counts, schema: Schema, kind: str,
+                 devices: int, wall_s: float):
+        self._send_counts = send_counts      # device array
+        self.schema = schema
+        self.kind = kind
+        self.devices = devices
+        self.wall_s = wall_s
+        self._stats = None
+        self._published = False
+
+    def stats(self):
+        """MapOutputStatistics, folding (and fetching) on first call."""
+        if self._stats is None:
+            from spark_rapids_tpu.shuffle.manager import (
+                mesh_map_output_statistics,
+            )
+            self._stats = mesh_map_output_statistics(self._send_counts,
+                                                     self.schema)
+            self._send_counts = None
+        return self._stats
+
+    def maybe_publish(self) -> None:
+        """Skew gauges + meshExchange journal event + progress beats —
+        published at exchange time IFF a durable/live sink exists (event
+        log, progress heartbeats); otherwise the fold stays deferred."""
+        from spark_rapids_tpu.obs.events import EVENTS
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        from spark_rapids_tpu.obs.progress import PROGRESS
+        if self._published or not (EVENTS.enabled or PROGRESS.enabled):
+            return
+        self._published = True
+        from spark_rapids_tpu.obs.shuffleobs import record_shuffle_skew
+        st = self.stats()
+        record_shuffle_skew(st.bytes_by_partition,
+                            source=f"tpu:ici-{self.kind}")
+        rows = sum(sum(m) for m in (st.rows_by_map or []))
+        REGISTRY.counter("shuffle.ici.rows").add(rows)
+        EVENTS.emit("meshExchange", exchange=self.kind,
+                    devices=self.devices, rows=int(rows),
+                    bytesEst=int(st.total_bytes),
+                    maxPartitionBytes=int(st.max_bytes()),
+                    wallSeconds=round(self.wall_s, 4))
+        if PROGRESS.enabled:
+            for _ in range(st.num_maps):
+                PROGRESS.shuffle_map_partition()
+
+
+class IciMeshExchange:
+    """One exchange edge's mesh-collective execution.
+
+    Holds the static plan facts (partitioning, schema); ``partitions``
+    returns the per-device output partitions, materializing the fused
+    shard_map exchange once on first pull."""
+
+    def __init__(self, exchange, mesh, schema: Schema, growth: float):
+        self.exchange = exchange          # the TpuShuffleExchangeExec node
+        self.mesh = mesh
+        self.schema = schema
+        self.growth = growth
+        self.partitioning = exchange.partitioning
+        self._shards: Optional[List[DeviceBatch]] = None
+        self.last_stats = None        # LazyExchangeStats of the run
+
+    # -- pid functions per exchange kind ------------------------------------
+    def _pid_fn(self, shard_batches: Sequence[DeviceBatch]):
+        from spark_rapids_tpu.parallel import distributed as dist
+        kind = self.partitioning[0]
+        n_dev = self.mesh.devices.size
+        if kind == "hash":
+            key_idx = list(self.partitioning[1])
+            return lambda b: dist._hash_pid(b, key_idx, n_dev)
+        if kind == "range":
+            key_idx = list(self.partitioning[1])
+            asc = list(self.partitioning[2])
+            nf = list(self.partitioning[3])
+            bounds = dist.mesh_range_bounds(shard_batches, key_idx, asc,
+                                            nf, n_dev)
+            return lambda b: sortops.range_partition_ids(
+                b, key_idx, asc, nf, bounds)
+        # roundrobin (n == device count, checked by the selector)
+        return lambda b: (jnp.arange(b.capacity, dtype=jnp.int32)
+                          % jnp.int32(n_dev))
+
+    # -- execution ----------------------------------------------------------
+    def _materialize(self, ctx, child_parts) -> List[DeviceBatch]:
+        if self._shards is not None:
+            return self._shards
+        import time as _time
+
+        from spark_rapids_tpu.obs import compileledger
+        from spark_rapids_tpu.obs.trace import TRACER
+        from spark_rapids_tpu.parallel import distributed as dist
+        n_dev = self.mesh.devices.size
+        kind = self.partitioning[0]
+        # mesh-stage compiles (the shard_map program, the per-shard prep
+        # kernels) attribute to THIS exchange operator in the ledger,
+        # exactly like a host-path exchange's slice/concat kernels
+        with compileledger.op_context(self.exchange.describe(),
+                                      id(self.exchange), ctx), \
+                TRACER.span("shuffle.ici.exchange", kind=kind,
+                            devices=n_dev):
+            per_shard: List[List[DeviceBatch]] = [[] for _ in range(n_dev)]
+            for j, p in enumerate(child_parts):
+                per_shard[j % n_dev].extend(p())
+            shard_batches = dist.mesh_collect_shards(
+                self.mesh, self.schema, per_shard, self.growth)
+            stats_out: dict = {}
+            t0 = _time.perf_counter()
+            self._shards = dist.mesh_exchange_parts(
+                self.mesh, self.schema, shard_batches,
+                self._pid_fn(shard_batches), stats_out=stats_out)
+            wall = _time.perf_counter() - t0
+        self._record_stats(ctx, stats_out, wall)
+        return self._shards
+
+    def _record_stats(self, ctx, stats_out: dict, wall_s: float) -> None:
+        """Register this exchange's statistics: cheap counters eagerly,
+        the MapOutputStatistics fold LAZILY (the (n, n) device fetch is
+        a sync point the default hash-exchange path must not pay when
+        nothing consumes it — LazyExchangeStats defers it to the first
+        reader, and maybe_publish emits skew/journal/progress now only
+        when a durable sink is live)."""
+        if not getattr(ctx, "metrics_enabled", True):
+            return
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        counts = stats_out.get("send_counts")
+        kind = self.partitioning[0]
+        REGISTRY.counter("shuffle.ici.exchanges", kind=kind).add(1)
+        REGISTRY.timer("shuffle.ici.exchangeSeconds").record(wall_s)
+        if counts is None:
+            return
+        lazy = LazyExchangeStats(counts, self.schema, kind,
+                                 self.mesh.devices.size, wall_s)
+        self.last_stats = lazy
+        recent_exchange_stats.append(lazy)
+        del recent_exchange_stats[:-_RECENT_CAP]
+        lazy.maybe_publish()
+
+    def partitions(self, ctx, child_parts):
+        """One output partition per mesh device, each yielding the batch
+        resident on ITS device (funnel-free: mesh_exchange_parts commits
+        every output shard to its own device)."""
+        n_dev = self.mesh.devices.size
+
+        def make(i: int):
+            def run():
+                yield self._materialize(ctx, child_parts)[i]
+            return run
+        return [make(i) for i in range(n_dev)]

@@ -11,6 +11,7 @@ the UCX port through the BlockManagerId topology field.
 from __future__ import annotations
 
 import threading
+from enum import Enum
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from spark_rapids_tpu.columnar.batch import DeviceBatch
@@ -47,6 +48,121 @@ def aggregate_map_statistics(statuses: List[MapStatus]):
     from spark_rapids_tpu.sql.adaptive.stats import MapOutputStatistics
     return MapOutputStatistics([list(ms.partition_sizes)
                                 for ms in statuses])
+
+
+class ShuffleTransportKind(Enum):
+    """How one exchange EDGE moves its bytes — the per-edge abstraction
+    the reference spreads across RapidsShuffleManager wiring
+    (GpuShuffleEnv.scala:27-136): UCX for peer links, host fallback
+    otherwise. Here the three data planes are:
+
+      * ``LOCAL``   — single-process: collapse concat or in-process
+                      bucket materialization (no wire at all);
+      * ``MANAGER`` — the catalog + transport shuffle manager
+                      (CachingShuffleWriter/Reader over the inprocess or
+                      socket wire — the cross-host / DCN path);
+      * ``ICI``     — in-slice mesh collective: the shard_map
+                      ``all_to_all`` exchange (shuffle/ici.py over
+                      parallel/distributed.py), device data never
+                      leaving HBM.
+    """
+
+    LOCAL = "local"
+    MANAGER = "manager"
+    ICI = "ici"
+
+
+def _mesh_compatible(mesh, partitioning_kind: str, n_partitions: int) -> bool:
+    """Can this edge ride the mesh collective? hash/range always can
+    (the exchange re-partitions over the device axis); roundrobin only
+    when the requested partition count IS the device count (it is the
+    user-visible repartition(n) shape)."""
+    if mesh is None:
+        return False
+    if partitioning_kind in ("hash", "range"):
+        return True
+    return (partitioning_kind == "roundrobin"
+            and n_partitions == mesh.devices.size)
+
+
+def select_transport_kind(conf, session, partitioning_kind: str,
+                          n_partitions: int) -> ShuffleTransportKind:
+    """Pick the transport for ONE exchange edge (called by
+    TpuShuffleExchangeExec.partitions per edge).
+
+    ``spark.rapids.tpu.shuffle.transport.mode`` governs the policy;
+    the default 'legacy' reproduces the historical inline selection
+    byte-identically (mesh first, then the shuffle manager, else
+    local), so plans are unchanged until a mode is opted into."""
+    mode = str(conf.get("spark.rapids.tpu.shuffle.transport.mode",
+                        "legacy"))
+    mesh = getattr(session, "mesh", None) if session is not None else None
+    manager_on = (session is not None and conf.get_bool(
+        "spark.rapids.shuffle.transport.enabled", False))
+    manager_kinds = ("hash", "range", "roundrobin")
+    if partitioning_kind == "single":
+        return ShuffleTransportKind.LOCAL
+    if mode == "local":
+        return ShuffleTransportKind.LOCAL
+    if mode == "ici":
+        return (ShuffleTransportKind.ICI
+                if _mesh_compatible(mesh, partitioning_kind, n_partitions)
+                else ShuffleTransportKind.LOCAL)
+    if mode == "manager":
+        return (ShuffleTransportKind.MANAGER
+                if session is not None
+                and partitioning_kind in manager_kinds
+                else ShuffleTransportKind.LOCAL)
+    if mode == "auto":
+        # in-slice edges ride ICI; cross-host edges (a configured multi-
+        # executor transport pool — the DCN analogue) ride the manager
+        # wire; the rest stay local
+        if _mesh_compatible(mesh, partitioning_kind, n_partitions):
+            return ShuffleTransportKind.ICI
+        multi_exec = (session is not None and int(conf.get(
+            "spark.rapids.shuffle.executors", 1)) > 1)
+        if ((manager_on or multi_exec)
+                and partitioning_kind in manager_kinds
+                and session is not None):
+            return ShuffleTransportKind.MANAGER
+        return ShuffleTransportKind.LOCAL
+    # mode == "legacy": historical order — mesh wins, then manager
+    if _mesh_compatible(mesh, partitioning_kind, n_partitions):
+        return ShuffleTransportKind.ICI
+    if manager_on and partitioning_kind in manager_kinds:
+        return ShuffleTransportKind.MANAGER
+    return ShuffleTransportKind.LOCAL
+
+
+def estimate_row_bytes(schema) -> int:
+    """Advisory per-row byte width of a schema: exact for fixed-width
+    columns (data + validity byte), a flat 16-byte guess for strings
+    (offset word + mean chars) — the same cheap estimate class
+    sql/adaptive/stats.estimate_frame_bytes applies host-side."""
+    import numpy as np
+    total = 0
+    for dt in schema.dtypes:
+        if dt.is_string:
+            total += 16
+        else:
+            total += int(np.dtype(dt.np_dtype).itemsize) + 1
+    return max(total, 1)
+
+
+def mesh_map_output_statistics(send_counts, schema):
+    """Fold the mesh exchange's DEVICE-SIDE (n_src, n_dst) per-shard
+    send-row counts into MapOutputStatistics — the MapStatus.
+    partition_sizes role for ICI edges, so AQE's coalesce/demote/skew
+    statistics machinery reads mesh stages exactly like socket ones.
+    Bytes are rows x estimate_row_bytes(schema) (device counts are rows;
+    byte-exact sizes would need per-shard char totals)."""
+    import numpy as np
+    counts = np.asarray(send_counts)
+    width = estimate_row_bytes(schema)
+    bytes_by_map = [[int(c) * width for c in row] for row in counts]
+    rows_by_map = [[int(c) for c in row] for row in counts]
+    from spark_rapids_tpu.sql.adaptive.stats import MapOutputStatistics
+    return MapOutputStatistics(bytes_by_map, rows_by_map)
 
 
 class ShuffleEnv:

@@ -45,6 +45,21 @@ _K_TAGGED = 5
 _REQ_KIND = {RequestType.METADATA: _K_META, RequestType.TRANSFER: _K_TRANSFER}
 
 
+def _transport_counter(name: str, **labels):
+    """Process-registry counter under the shuffle.transport.* family —
+    rendered as ``srt_shuffle_transport_*`` in one Prometheus scrape, so
+    socket edges are comparable to ICI edges (shuffle/ici.py's
+    ``shuffle.ici.*`` series) side by side."""
+    from spark_rapids_tpu.obs.metrics import REGISTRY
+    return REGISTRY.counter(name, transport="socket", **labels)
+
+
+def _rtt_histogram(peer: str):
+    from spark_rapids_tpu.obs.metrics import REGISTRY
+    return REGISTRY.histogram("shuffle.transport.rttSeconds",
+                              transport="socket", peer=peer)
+
+
 def _send_frame(sock: socket.socket, kind: int, ident: int,
                 payload: bytes) -> None:
     sock.sendall(_HDR.pack(kind, ident, len(payload)) + payload)
@@ -232,6 +247,18 @@ class _SocketServer(ServerConnection):
         self._peers: Dict[str, socket.socket] = {}
         self._write_locks: Dict[socket.socket, threading.Lock] = {}
         self._lock = threading.Lock()
+        # per-peer sent-side counters, resolved once (see _SocketClient)
+        self._sent_counters: Dict[str, tuple] = {}
+
+    def _sent(self, peer_id: str) -> tuple:
+        c = self._sent_counters.get(peer_id)
+        if c is None:
+            c = (_transport_counter("shuffle.transport.bytes",
+                                    peer=peer_id, direction="sent"),
+                 _transport_counter("shuffle.transport.frames",
+                                    peer=peer_id, direction="sent"))
+            self._sent_counters[peer_id] = c
+        return c
 
     def register_request_handler(self, req_type: RequestType,
                                  handler: Callable[[bytes], bytes]) -> None:
@@ -292,6 +319,9 @@ class _SocketServer(ServerConnection):
             self.write_frame(conn, _K_TAGGED, tag, data)
             self.transport.stats["tagged_frames"] += 1
             self.transport.stats["tagged_bytes"] += len(data)
+            cbytes, cframes = self._sent(peer_id)
+            cbytes.add(len(data))
+            cframes.add(1)
             txn.complete(TransactionStatus.SUCCESS, len(data))
         except (ConnectionError, OSError) as e:
             txn.complete(TransactionStatus.ERROR, 0, str(e))
@@ -319,6 +349,19 @@ class _SocketClient(ClientConnection):
     def __init__(self, transport: SocketTransport, peer_id: str):
         self.transport = transport
         self.peer_id = peer_id
+        # wire counters resolved ONCE per connection (peer is fixed):
+        # the registry lookup hashes labels under a process-wide lock,
+        # which the per-frame reader loop must not pay
+        self._bytes_recv = _transport_counter(
+            "shuffle.transport.bytes", peer=peer_id, direction="received")
+        self._frames_recv = _transport_counter(
+            "shuffle.transport.frames", peer=peer_id,
+            direction="received")
+        self._rtt = _rtt_histogram(peer_id)
+        self._req_counters = {
+            rt: _transport_counter("shuffle.transport.requests",
+                                   peer=peer_id, kind=rt.value)
+            for rt in RequestType}
         self._sock: Optional[socket.socket] = None
         self._sock_lock = threading.Lock()
         self._write_lock = threading.Lock()
@@ -366,6 +409,8 @@ class _SocketClient(ClientConnection):
             self._fail_all(f"connection lost: {e}")
 
     def _deliver_tagged(self, tag: int, payload: bytes) -> None:
+        self._bytes_recv.add(len(payload))
+        self._frames_recv.add(1)
         with self._state_lock:
             posted = self._recvs.pop(tag, None)
             if posted is None:
@@ -414,20 +459,47 @@ class _SocketClient(ClientConnection):
     def request(self, req_type: RequestType, payload: bytes,
                 cb: Callable[[Transaction, bytes], None]) -> Transaction:
         txn = Transaction()
+        import time as _time
+        self._req_counters[req_type].add(1)
+        ident = None
         try:
             s = self._ensure_connected()
+            # RTT clock starts AFTER the connection exists: a lazy (re)
+            # connect's multi-second TCP setup is not round-trip time
+            # and would dominate low-traffic peers' p99
+            t0 = _time.perf_counter()
+
+            def finish(t: Transaction, resp: bytes) -> None:
+                # per-peer request round-trip time: send -> matching
+                # response frame delivered by the reader loop (the one-
+                # scrape socket-vs-ICI comparison the monitor exposes).
+                # SUCCESS only: a failure callback's elapsed time is
+                # time-to-error (_fail_all sweeps), not an RTT sample
+                if t.status is TransactionStatus.SUCCESS:
+                    self._rtt.observe(_time.perf_counter() - t0)
+                    self._bytes_recv.add(len(resp))
+                txn.complete(t.status, t.length, t.error_message)
+                cb(txn, resp)
+
             with self._state_lock:
                 self._req_seq += 1
                 ident = self._req_seq
-                self._reqs[ident] = (
-                    lambda t, resp: (txn.complete(t.status, t.length,
-                                                  t.error_message),
-                                     cb(txn, resp)))
+                self._reqs[ident] = finish
             with self._write_lock:
                 _send_frame(s, _REQ_KIND[req_type], ident, payload)
         except (KeyError, ConnectionError, OSError) as e:
-            txn.complete(TransactionStatus.ERROR, 0, str(e))
-            cb(txn, b"")
+            # exactly-once completion: if the reader thread's _fail_all
+            # swept this request concurrently (pop finds nothing), it
+            # already completed the callback — completing here too would
+            # double-drive the caller's fetch bookkeeping
+            already_completed = False
+            if ident is not None:
+                with self._state_lock:
+                    already_completed = \
+                        self._reqs.pop(ident, None) is None
+            if not already_completed:
+                txn.complete(TransactionStatus.ERROR, 0, str(e))
+                cb(txn, b"")
         return txn
 
     def receive(self, tag: int, target: bytearray,

@@ -449,3 +449,84 @@ class TestWarmupGate:
         assert rep["regressed"]
         text = perfdiff.render_text(rep)
         assert "WARM-UP COMPILE REGRESSION" in text
+
+
+class TestStressMode:
+    """Stress-tier gate (BENCH_STRESS.json from bench.py --stress):
+    throughput + spill-count drift + oracle verification."""
+
+    def _stress(self, tmp_path, name, rps=1000.0, spills=40,
+                verified=True):
+        doc = {"mode": "stress", "budget_bytes": 8 << 20, "rows": 100,
+               "queries": {}, "throughput_rows_per_s": rps,
+               "spill_events_total": spills, "verified": verified}
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        return p
+
+    def test_stress_from_doc_detects_artifact(self, tmp_path):
+        p = self._stress(tmp_path, "s.json")
+        with open(p) as f:
+            doc = json.load(f)
+        rec = perfdiff.stress_from_doc(doc)
+        assert rec == {"throughput": 1000.0, "spills": 40,
+                       "verified": True, "budget_bytes": 8 << 20}
+        assert perfdiff.stress_from_doc({"queries": {}}) is None
+        assert perfdiff.stress_from_doc({"qps": 1.0}) is None
+
+    def test_equal_stress_docs_pass(self, tmp_path):
+        base = self._stress(tmp_path, "b.json")
+        new = self._stress(tmp_path, "n.json")
+        assert perfdiff.main([base, new]) == 0
+
+    def test_throughput_drop_regresses(self, tmp_path, capsys):
+        base = self._stress(tmp_path, "b.json", rps=1000.0)
+        new = self._stress(tmp_path, "n.json", rps=500.0)
+        assert perfdiff.main([base, new]) == 1
+        assert "STRESS REGRESSION" in capsys.readouterr().out
+        # within the noise threshold: ok
+        new2 = self._stress(tmp_path, "n2.json", rps=950.0)
+        assert perfdiff.main([base, new2]) == 0
+
+    def test_spill_growth_regresses(self, tmp_path):
+        base = self._stress(tmp_path, "b.json", spills=40)
+        new = self._stress(tmp_path, "n.json", spills=120)
+        assert perfdiff.main([base, new]) == 1
+        # growth bound is configurable
+        assert perfdiff.main([base, new,
+                              "--stress-spill-threshold", "3.0"]) == 0
+        # spills DROPPING is an improvement, never a regression
+        fewer = self._stress(tmp_path, "f.json", spills=0)
+        assert perfdiff.main([base, fewer]) == 0
+        # base had zero spills and new grew from nothing: regression
+        zbase = self._stress(tmp_path, "z.json", spills=0)
+        assert perfdiff.main([zbase, new]) == 1
+
+    def test_unverified_new_regresses(self, tmp_path, capsys):
+        base = self._stress(tmp_path, "b.json")
+        new = self._stress(tmp_path, "n.json", verified=False)
+        assert perfdiff.main([base, new]) == 1
+        assert "FAILED result verification" in capsys.readouterr().out
+
+    def test_ignore_stress_opt_out(self, tmp_path, capsys):
+        base = self._stress(tmp_path, "b.json", rps=1000.0, spills=10)
+        new = self._stress(tmp_path, "n.json", rps=100.0, spills=500,
+                           verified=False)
+        assert perfdiff.main([base, new, "--ignore-stress"]) == 0
+        assert "IGNORED" in capsys.readouterr().out
+
+    def test_stress_vs_sweep_mismatch_exits_2(self, tmp_path, capsys):
+        stress = self._stress(tmp_path, "s.json")
+        sweep = _detail(tmp_path, "d.json", {"q1": 2.0})
+        assert perfdiff.main([stress, sweep]) == 2
+        assert "stress-tier" in capsys.readouterr().err
+
+    def test_stress_json_report(self, tmp_path, capsys):
+        base = self._stress(tmp_path, "b.json")
+        new = self._stress(tmp_path, "n.json", rps=500.0)
+        assert perfdiff.main([base, new, "--json", "-"]) == 1
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["mode"] == "stress"
+        assert rep["throughput_drift_pct"] == -50.0
+        assert rep["regressed"] is True

@@ -1,0 +1,146 @@
+"""Fault injection for the 3-tier spill store (memory/spill.py).
+
+Two hazards the out-of-core layer leans on:
+
+  * concurrent ``acquire_batch`` racing ``synchronous_spill`` across
+    host->disk->device round trips — the per-buffer RLock + catalog
+    re-registration must keep every reader seeing intact data and the
+    stores consistent;
+  * a disk-write failure mid host->disk spill must surface a
+    ``memoryPressure`` event (+ spill.diskWriteFailures counter) and
+    leave the buffer intact in the HOST tier instead of corrupting the
+    catalog.
+"""
+
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.memory.spill import BufferCatalog, StorageTier
+from spark_rapids_tpu.obs.events import EVENTS
+from spark_rapids_tpu.obs.metrics import REGISTRY
+
+
+def _batch(i, rows=64):
+    return DeviceBatch.from_pandas(pd.DataFrame({
+        "a": np.full(rows, i, dtype=np.int64),
+        "b": np.full(rows, float(i)),
+    }))
+
+
+def test_concurrent_acquire_races_synchronous_spill():
+    catalog = BufferCatalog(host_limit_bytes=1 << 16)
+    try:
+        rows = 64
+        bids = [catalog.add_batch(_batch(i, rows)) for i in range(8)]
+        errors = []
+        stop = threading.Event()
+
+        def reader(bid, want):
+            try:
+                for _ in range(30):
+                    if stop.is_set():
+                        return
+                    got = catalog.acquire_batch(bid)
+                    data = np.asarray(got.columns[0].data)[:rows]
+                    assert (data == want).all(), (bid, want)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                stop.set()
+
+        def spiller():
+            try:
+                for _ in range(60):
+                    if stop.is_set():
+                        return
+                    # push everything device -> host -> disk, repeatedly
+                    catalog.device_store.synchronous_spill(0)
+                    catalog.host_store.synchronous_spill(0)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                stop.set()
+
+        threads = [threading.Thread(target=reader, args=(bid, i))
+                   for i, bid in enumerate(bids)]
+        threads += [threading.Thread(target=spiller) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[0]
+        # every buffer still acquirable with intact contents afterwards
+        for i, bid in enumerate(bids):
+            got = catalog.acquire_batch(bid)
+            assert (np.asarray(got.columns[0].data)[:rows] == i).all()
+            assert catalog.buffer_tier(bid) == StorageTier.DEVICE
+    finally:
+        catalog.close()
+
+
+def test_disk_write_failure_surfaces_memory_pressure(monkeypatch):
+    catalog = BufferCatalog(host_limit_bytes=1 << 16)
+    try:
+        rows = 64
+        bid = catalog.add_batch(_batch(7, rows))
+        assert catalog.device_store.synchronous_spill(0) > 0
+        assert catalog.buffer_tier(bid) == StorageTier.HOST
+
+        def boom(*a, **kw):
+            raise OSError("disk full (injected)")
+        monkeypatch.setattr(np, "savez", boom)
+
+        def _pressure_events():
+            # only THIS hazard's events: the flight ring is process-wide
+            # and other tests emit plain memoryPressure entries too
+            return [e for e in EVENTS.flight_events()
+                    if e.get("kind") == "memoryPressure"
+                    and e.get("diskWriteError")]
+        f0 = REGISTRY.value("spill.diskWriteFailures")
+        e0 = len(_pressure_events())
+        freed = catalog.host_store.synchronous_spill(0)
+        # nothing freed, buffer NOT corrupted: still host-resident and
+        # acquirable with intact contents
+        assert freed == 0
+        assert REGISTRY.value("spill.diskWriteFailures") == f0 + 1
+        assert len(_pressure_events()) == e0 + 1
+        assert catalog.buffer_tier(bid) == StorageTier.HOST
+        monkeypatch.undo()
+        got = catalog.acquire_batch(bid)
+        assert (np.asarray(got.columns[0].data)[:rows] == 7).all()
+    finally:
+        catalog.close()
+
+
+def test_disk_failure_then_recovery_round_trip(monkeypatch):
+    # after the injected failure clears, the SAME buffer must spill to
+    # disk and fault back normally (no poisoned state left behind)
+    catalog = BufferCatalog(host_limit_bytes=1 << 16)
+    try:
+        rows = 32
+        bid = catalog.add_batch(_batch(3, rows))
+        catalog.device_store.synchronous_spill(0)
+        real_savez = np.savez
+        calls = {"n": 0}
+
+        def flaky(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("transient (injected)")
+            return real_savez(*a, **kw)
+        monkeypatch.setattr(np, "savez", flaky)
+        assert catalog.host_store.synchronous_spill(0) == 0
+        # cooldown: the failure arms a retry backoff, so an immediate
+        # second pass does NOT re-serialize (no hot loop on a full disk)
+        assert catalog.host_store.synchronous_spill(0) == 0
+        assert calls["n"] == 1
+        catalog.host_store._disk_retry_at = 0.0  # cooldown elapsed
+        assert catalog.host_store.synchronous_spill(0) > 0
+        assert catalog.buffer_tier(bid) == StorageTier.DISK
+        got = catalog.acquire_batch(bid)
+        assert (np.asarray(got.columns[0].data)[:rows] == 3).all()
+        assert catalog.buffer_tier(bid) == StorageTier.DEVICE
+    finally:
+        catalog.close()

@@ -1,0 +1,220 @@
+"""Per-edge shuffle transport selection (shuffle/manager.py
+ShuffleTransportKind) + the satellite observability: socket transport
+wire counters (srt_shuffle_transport_*) and the ICI backend's
+device-side MapOutputStatistics."""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.config.conf import TpuConf
+from spark_rapids_tpu.shuffle.manager import (
+    ShuffleTransportKind, estimate_row_bytes, mesh_map_output_statistics,
+    select_transport_kind,
+)
+
+
+class _FakeMesh:
+    def __init__(self, n):
+        self.devices = SimpleNamespace(size=n)
+
+
+def _sess(mesh=None):
+    return SimpleNamespace(mesh=mesh)
+
+
+# --- selection policy -------------------------------------------------------
+
+def test_legacy_default_matches_historical_selection():
+    conf = TpuConf({})
+    # no mesh, manager off: everything local
+    for kind in ("hash", "range", "roundrobin", "single"):
+        assert select_transport_kind(conf, _sess(), kind, 8) \
+            is ShuffleTransportKind.LOCAL
+    # mesh set: hash/range ride ICI; roundrobin only at the device count
+    mesh = _FakeMesh(8)
+    assert select_transport_kind(conf, _sess(mesh), "hash", 8) \
+        is ShuffleTransportKind.ICI
+    assert select_transport_kind(conf, _sess(mesh), "range", 4) \
+        is ShuffleTransportKind.ICI
+    assert select_transport_kind(conf, _sess(mesh), "roundrobin", 8) \
+        is ShuffleTransportKind.ICI
+    assert select_transport_kind(conf, _sess(mesh), "roundrobin", 3) \
+        is ShuffleTransportKind.LOCAL
+    # manager on (no mesh): the catalog+transport path
+    conf = TpuConf({"spark.rapids.shuffle.transport.enabled": True})
+    assert select_transport_kind(conf, _sess(), "hash", 8) \
+        is ShuffleTransportKind.MANAGER
+    # mesh wins over the manager (the historical precedence)
+    assert select_transport_kind(conf, _sess(mesh), "hash", 8) \
+        is ShuffleTransportKind.ICI
+    # single collapses regardless
+    assert select_transport_kind(conf, _sess(), "single", 1) \
+        is ShuffleTransportKind.LOCAL
+    # no session at all: local
+    assert select_transport_kind(TpuConf({}), None, "hash", 8) \
+        is ShuffleTransportKind.LOCAL
+
+
+def test_mode_overrides():
+    mesh = _FakeMesh(8)
+    local = TpuConf({"spark.rapids.tpu.shuffle.transport.mode": "local"})
+    assert select_transport_kind(local, _sess(mesh), "hash", 8) \
+        is ShuffleTransportKind.LOCAL
+    ici = TpuConf({"spark.rapids.tpu.shuffle.transport.mode": "ici"})
+    assert select_transport_kind(ici, _sess(mesh), "hash", 8) \
+        is ShuffleTransportKind.ICI
+    assert select_transport_kind(ici, _sess(), "hash", 8) \
+        is ShuffleTransportKind.LOCAL   # no mesh: graceful fallback
+    mgr = TpuConf({"spark.rapids.tpu.shuffle.transport.mode": "manager"})
+    assert select_transport_kind(mgr, _sess(mesh), "hash", 8) \
+        is ShuffleTransportKind.MANAGER
+    assert select_transport_kind(mgr, None, "hash", 8) \
+        is ShuffleTransportKind.LOCAL
+
+
+def test_mode_auto_prefers_in_slice_then_wire():
+    mesh = _FakeMesh(8)
+    auto = TpuConf({"spark.rapids.tpu.shuffle.transport.mode": "auto"})
+    assert select_transport_kind(auto, _sess(mesh), "hash", 8) \
+        is ShuffleTransportKind.ICI
+    # cross-host analogue: a multi-executor transport pool
+    auto2 = TpuConf({"spark.rapids.tpu.shuffle.transport.mode": "auto",
+                     "spark.rapids.shuffle.executors": 2})
+    assert select_transport_kind(auto2, _sess(), "hash", 8) \
+        is ShuffleTransportKind.MANAGER
+    assert select_transport_kind(auto, _sess(), "hash", 8) \
+        is ShuffleTransportKind.LOCAL
+
+
+def test_invalid_mode_rejected():
+    with pytest.raises(ValueError):
+        TpuConf({"spark.rapids.tpu.shuffle.transport.mode": "ucx"})
+
+
+# --- mesh MapOutputStatistics ----------------------------------------------
+
+def test_mesh_map_output_statistics_folds_counts():
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    df = pd.DataFrame({"k": np.arange(4, dtype=np.int64),
+                       "s": ["a", "b", "c", "d"]})
+    schema = DeviceBatch.from_pandas(df).schema
+    counts = np.array([[3, 1], [0, 2]])
+    stats = mesh_map_output_statistics(counts, schema)
+    assert stats.num_maps == 2 and stats.num_partitions == 2
+    assert stats.rows_by_partition == [3, 3]
+    width = estimate_row_bytes(schema)
+    assert stats.bytes_by_partition == [3 * width, 3 * width]
+    assert stats.partition_map_sizes(0) == [3 * width, 0]
+
+
+def test_mesh_exchange_parts_reports_device_side_counts(rng):
+    # the ICI backend's statistics source: the trailing shard_map output
+    # carries per-(source, dest) send counts; their sum is the row count
+    import jax
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.parallel.distributed import (
+        _hash_pid, data_parallel_mesh, mesh_collect_shards,
+        mesh_exchange_parts,
+    )
+    n = 8
+    mesh = data_parallel_mesh(n)
+    df = pd.DataFrame({"k": rng.integers(0, 100, 256).astype(np.int64),
+                       "v": rng.random(256)})
+    batch = DeviceBatch.from_pandas(df)
+    shards = mesh_collect_shards(mesh, batch.schema,
+                                 [[batch]] + [[] for _ in range(n - 1)])
+    stats_out = {}
+    outs = mesh_exchange_parts(mesh, batch.schema, shards,
+                               lambda b: _hash_pid(b, [0], n),
+                               stats_out=stats_out)
+    counts = np.asarray(jax.device_get(stats_out["send_counts"]))
+    assert counts.shape == (n, n)
+    assert counts.sum() == len(df)
+    # per-destination counts match the actual shard row counts
+    got = [int(jax.device_get(b.num_rows)) for b in outs]
+    assert list(counts.sum(axis=0)) == got
+
+
+# --- socket transport wire counters ----------------------------------------
+
+def test_socket_transport_per_peer_counters():
+    import threading
+    from spark_rapids_tpu.obs.metrics import REGISTRY
+    from spark_rapids_tpu.shuffle.socket_transport import SocketTransport
+    from spark_rapids_tpu.shuffle.transport import RequestType
+
+    a = SocketTransport("mx-a")
+    b = SocketTransport("mx-b")
+    try:
+        b.get_server().register_request_handler(
+            RequestType.METADATA, lambda payload: b"ok:" + payload)
+        client = a.make_client("mx-b")
+        r0 = REGISTRY.value("shuffle.transport.requests", transport="socket",
+                            peer="mx-b", kind="metadata")
+        got = {}
+        done = threading.Event()
+
+        def cb(txn, resp):
+            got["resp"] = resp
+            done.set()
+        client.request(RequestType.METADATA, b"hello", cb).wait(5)
+        assert done.wait(5) and got["resp"] == b"ok:hello"
+        assert REGISTRY.value("shuffle.transport.requests",
+                              transport="socket", peer="mx-b",
+                              kind="metadata") == r0 + 1
+        assert REGISTRY.value("shuffle.transport.bytes",
+                              transport="socket", peer="mx-b",
+                              direction="received") > 0
+        # RTT histogram recorded at least this round trip
+        h = REGISTRY.histogram("shuffle.transport.rttSeconds",
+                               transport="socket", peer="mx-b")
+        assert h.count >= 1 and h.percentile(50) >= 0.0
+        # tagged data-plane frame: server->client, counted on both ends
+        recv_done = threading.Event()
+        target = bytearray(16)
+        client.receive(7, target, lambda txn: recv_done.set())
+        b.get_server().send("mx-a", 7, b"0123456789abcdef",
+                            lambda txn: None)
+        assert recv_done.wait(5)
+        assert bytes(target) == b"0123456789abcdef"
+        assert REGISTRY.value("shuffle.transport.bytes",
+                              transport="socket", peer="mx-a",
+                              direction="sent") >= 16
+        assert REGISTRY.value("shuffle.transport.frames",
+                              transport="socket", peer="mx-b",
+                              direction="received") >= 1
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_status_snapshot_has_transport_block(session):
+    from spark_rapids_tpu.obs.monitor import status_snapshot
+    snap = status_snapshot()
+    tr = snap.get("shuffleTransport")
+    assert tr is not None
+    assert tr["mode"] == "legacy"
+    assert "socketPeers" in tr and "ici" in tr
+    assert tr["transportClass"] == "inprocess"
+
+
+def test_status_renders_last_ici_exchange(session):
+    # the monitor is the consumer of the ICI backend's folded
+    # MapOutputStatistics ring (shuffle/ici.py recent_exchange_stats)
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.obs.monitor import status_snapshot
+    from spark_rapids_tpu.shuffle import ici
+    df = pd.DataFrame({"k": np.arange(4, dtype=np.int64)})
+    schema = DeviceBatch.from_pandas(df).schema
+    stats = mesh_map_output_statistics(np.array([[2, 1], [0, 3]]), schema)
+    ici.recent_exchange_stats.append(stats)
+    try:
+        last = status_snapshot()["shuffleTransport"]["ici"]["lastExchange"]
+        assert last["maps"] == 2 and last["partitions"] == 2
+        assert last["rows"] == 6
+        assert last["maxPartitionBytesEst"] >= last["totalBytesEst"] // 2
+    finally:
+        ici.recent_exchange_stats.pop()

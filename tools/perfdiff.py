@@ -42,6 +42,15 @@ more than ``--warmup-threshold`` (default 0.50 relative), exits 1 —
 the zero-warm-up contract of the shape-bucket / shared-cache / AOT
 layer. ``--ignore-warmup`` disables.
 
+And it gates the **out-of-core stress tier** (``BENCH_STRESS.json``
+from ``bench.py --stress``, docs/spill.md): when BOTH sides are stress
+artifacts the gate compares stress throughput (rows/s dropping more
+than ``--threshold`` regresses, like serve-mode qps), spill-count
+drift (total spill events growing more than
+``--stress-spill-threshold``, default 0.50 relative — the working-set
+management got worse), and oracle verification. ``--ignore-stress``
+reports the deltas without gating.
+
 Exit codes: 0 = no regression, 1 = regression (any common query slower
 than ``--threshold``, default 10%, geomean drift below
 ``--geomean-threshold``, default 5%, or a steady-state compile-count
@@ -218,6 +227,87 @@ def render_serve_text(rep: Dict[str, Any]) -> str:
                      f"-{rep['threshold_pct']:.0f}%")
     lines.append("RESULT: " + ("REGRESSED" if rep["regressed"]
                                else "ok"))
+    return "\n".join(lines)
+
+
+def stress_from_doc(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Stress-tier artifact (``BENCH_STRESS.json`` from ``bench.py
+    --stress``): out-of-core throughput + spill counts. None when the
+    doc is not a stress artifact."""
+    if doc.get("mode") != "stress" or "spill_events_total" not in doc:
+        return None
+    return {
+        "throughput": doc.get("throughput_rows_per_s"),
+        "spills": int(doc.get("spill_events_total") or 0),
+        "verified": doc.get("verified"),
+        "budget_bytes": doc.get("budget_bytes"),
+    }
+
+
+def compare_stress(base: Dict[str, Any], new: Dict[str, Any],
+                   threshold: float,
+                   spill_threshold: float = 0.50) -> Dict[str, Any]:
+    """Stress-tier gate: NEW rows/s dropping more than ``threshold``
+    below BASE regresses (same bound as serve-mode qps); NEW's total
+    spill-event count growing more than ``spill_threshold`` relative
+    regresses (the out-of-core layer started thrashing); a NEW sweep
+    failing oracle verification regresses unconditionally."""
+    tb, tn = base.get("throughput"), new.get("throughput")
+    if tb and tn:
+        drift = tn / tb - 1.0
+    elif tb and not tn:
+        # BASE measured throughput, NEW has none (null/0 = no query
+        # produced a positive wall): a total collapse is the WORST
+        # regression and must not sail through the gate
+        drift = -1.0
+    else:
+        drift = None
+    sb, sn = base.get("spills", 0), new.get("spills", 0)
+    if sb > 0:
+        spill_growth = (sn - sb) / sb
+    else:
+        spill_growth = None if sn == 0 else float("inf")
+    regressed = ((drift is not None and drift < -threshold)
+                 or (spill_growth is not None
+                     and spill_growth > spill_threshold)
+                 or new.get("verified") is False)
+    return {
+        "mode": "stress",
+        "throughput_base": tb, "throughput_new": tn,
+        "throughput_drift_pct": round(100.0 * drift, 2)
+        if drift is not None else None,
+        "spills_base": sb, "spills_new": sn,
+        "spill_growth_pct": (round(100.0 * spill_growth, 2)
+                             if spill_growth not in (None, float("inf"))
+                             else ("inf" if spill_growth == float("inf")
+                                   else None)),
+        "threshold_pct": round(100.0 * threshold, 2),
+        "spill_threshold_pct": round(100.0 * spill_threshold, 2),
+        "new_verified": new.get("verified"),
+        "regressed": regressed,
+    }
+
+
+def render_stress_text(rep: Dict[str, Any]) -> str:
+    lines = [
+        f"perfdiff (stress mode): rows/s {rep['throughput_base']} -> "
+        f"{rep['throughput_new']}"
+        + (f" ({rep['throughput_drift_pct']:+.2f}%)"
+           if rep["throughput_drift_pct"] is not None else "")
+        + f", spill events {rep['spills_base']} -> {rep['spills_new']}"
+        + (f" ({rep['spill_growth_pct']:+.2f}%)"
+           if isinstance(rep["spill_growth_pct"], (int, float)) else
+           (" (inf%)" if rep["spill_growth_pct"] == "inf" else ""))]
+    if rep["new_verified"] is False:
+        lines.append("-- NEW stress sweep FAILED result verification")
+    if rep.get("ignored"):
+        lines.append("-- stress gate IGNORED (--ignore-stress)")
+    elif rep["regressed"]:
+        lines.append("-- STRESS REGRESSION (throughput drop beyond "
+                     f"-{rep['threshold_pct']:.0f}%, spill growth beyond "
+                     f"+{rep['spill_threshold_pct']:.0f}%, or failed "
+                     "verification)")
+    lines.append("RESULT: " + ("REGRESSED" if rep["regressed"] else "ok"))
     return "\n".join(lines)
 
 
@@ -427,6 +517,13 @@ def main(argv=None) -> int:
                     help="relative cold first-query wall increase that "
                          "counts as a regression (default 0.50 = 50%%; "
                          "cold walls carry one-off I/O noise)")
+    ap.add_argument("--ignore-stress", action="store_true",
+                    help="report stress-tier (BENCH_STRESS.json) deltas "
+                         "without gating on them")
+    ap.add_argument("--stress-spill-threshold", type=float, default=0.50,
+                    help="relative spill-event-count growth between "
+                         "stress sweeps that counts as a regression "
+                         "(default 0.50 = 50%%)")
     ap.add_argument("--json", metavar="OUT", default="",
                     help="also write the machine-shape diff ('-' = "
                          "stdout)")
@@ -434,6 +531,29 @@ def main(argv=None) -> int:
     try:
         base_doc = _read_doc(args.base)
         new_doc = _read_doc(args.new)
+        # stress-tier artifacts (bench.py --stress) gate on out-of-core
+        # throughput + spill-count drift
+        base_stress = stress_from_doc(base_doc)
+        new_stress = stress_from_doc(new_doc)
+        if base_stress is not None and new_stress is not None:
+            rep = compare_stress(base_stress, new_stress, args.threshold,
+                                 args.stress_spill_threshold)
+            if args.ignore_stress:
+                rep["ignored"] = True
+                rep["regressed"] = False
+            if args.json == "-":
+                print(json.dumps(rep, indent=1))
+            else:
+                print(render_stress_text(rep))
+                if args.json:
+                    with open(args.json, "w") as f:
+                        json.dump(rep, f, indent=1)
+            return 1 if rep["regressed"] else 0
+        if (base_stress is None) != (new_stress is None):
+            raise ValueError(
+                "cannot compare a stress-tier artifact against a sweep "
+                "artifact (one side has 'spill_events_total', the other "
+                "does not)")
         # serve-mode artifacts (bench.py --concurrency) gate on
         # throughput instead of per-query speedups
         base_serve = serve_from_doc(base_doc)
