@@ -262,7 +262,17 @@ def _worker():
         # default ON — one compile serves a dimension range;
         # BENCH_SHAPE_BUCKETS=0 reproduces unpadded shapes
         "spark.rapids.tpu.compile.shapeBuckets",
-        os.environ.get("BENCH_SHAPE_BUCKETS", "1") != "0").get_or_create()
+        os.environ.get("BENCH_SHAPE_BUCKETS", "1") != "0").config(
+        # gather-free execution (docs/gatherfree.md): bench default ON —
+        # end-to-end dictionary codes + blocked char slabs are the whole
+        # point of the string-heavy laggards; BENCH_DICT=0 restores the
+        # packed chars+offsets legacy layout everywhere
+        "spark.rapids.sql.dict.enabled",
+        os.environ.get("BENCH_DICT", "1") != "0").config(
+        # tiny-query overhead-floor fast path: bench default ON;
+        # BENCH_SMALL_QUERY=0 restores general-path planning
+        "spark.rapids.sql.smallQuery.enabled",
+        os.environ.get("BENCH_SMALL_QUERY", "1") != "0").get_or_create()
 
     # cross-process shared compile cache + AOT pre-warm: point two
     # sweeps at the same BENCH_SHARED_CACHE_DIR (and feed the second the
@@ -565,15 +575,23 @@ def _worker():
             session.set_conf("spark.rapids.sql.adaptive.enabled", False)
         return rec
 
-    # scan-cost probes (VERDICT r4 next #8): the sweep runs with
-    # cacheDeviceScans=true on BOTH paths (symmetric residency), which
-    # hides host-decode + upload cost. For a few representative queries,
-    # time the TPU path WITHOUT the device scan cache so the per-suite
-    # scan cost is a published number instead of a blind spot
+    # scan-cost probes (VERDICT r4 next #8, r5 Missing #2 "measured must
+    # now become paid-for"): the sweep runs with cacheDeviceScans=true on
+    # BOTH paths (symmetric residency), which hides host-decode + upload
+    # cost. EVERY query is probed WITHOUT the device scan cache by
+    # default so the scan-inclusive number is a published per-query fact
+    # (and a geomean on the summary line) instead of a 3-query spot check
     # (ref: GpuParquetScan.scala:316-373 — decode cost is first-class).
-    scan_cost_queries = set(os.environ.get(
-        "BENCH_SCAN_COST_QUERIES",
-        "q6,tpcxbb.q9,mortgage.agg_join").split(","))
+    # BENCH_SCAN_COST_QUERIES=none disables; =q6,tpcxbb.q9 restricts.
+    _scan_probe_env = os.environ.get("BENCH_SCAN_COST_QUERIES", "all")
+    scan_cost_queries = set(_scan_probe_env.split(","))
+
+    def scan_probe_wanted(name: str) -> bool:
+        if _scan_probe_env.strip().lower() == "none":
+            return False
+        if _scan_probe_env.strip().lower() == "all":
+            return True
+        return name in scan_cost_queries
 
     def measure_scan_off(fn):
         session.set_conf("spark.rapids.sql.cacheDeviceScans", False)
@@ -823,7 +841,7 @@ def _worker():
                     pass
             if os.environ.get("BENCH_AQE", "") == "1":
                 rec["aqe"] = measure_aqe(suites[sn][q])
-            if req["name"] in scan_cost_queries:
+            if scan_probe_wanted(req["name"]):
                 so = measure_scan_off(suites[sn][q])
                 rec["tpu_scan_off_iters"] = so
                 rec["tpu_scan_off_s"] = min(so)
@@ -1281,6 +1299,17 @@ def main():
                   file=sys.stderr, flush=True)
 
     scored = {k: v for k, v in detail.items() if "speedup" in v}
+    # scan-inclusive honesty (VERDICT r5 Missing #2): the geomean of
+    # cpu_s / tpu_scan_off_s over every probed query — the speedup the
+    # engine delivers when it has to PAY for the scan instead of replaying
+    # the device cache. Gated run-over-run by tools/perfdiff.py
+    # --scan-threshold.
+    scan_incl = [v["cpu_s"] / v["tpu_scan_off_s"]
+                 for v in scored.values()
+                 if v.get("tpu_scan_off_s") and v.get("cpu_s")]
+    scan_incl_geo = (round(math.exp(sum(math.log(x) for x in scan_incl)
+                                    / len(scan_incl)), 4)
+                     if scan_incl else None)
     summary = {
         "metric": f"{suite_names}_geomean_speedup_tpu_vs_cpu_path",
         "value": 0.0,
@@ -1293,6 +1322,8 @@ def main():
         "n_queries": len(sweep),
         "n_scored": len(scored),
         "n_below_1x": sum(1 for v in scored.values() if v["speedup"] < 1.0),
+        "scan_inclusive_geomean": scan_incl_geo,
+        "n_scan_probed": len(scan_incl),
         "timed_compiles_total": sum(v.get("timed_compiles", 0)
                                     for v in scored.values()),
         "warm_compiles_total": sum(v.get("warm_compiles", 0)

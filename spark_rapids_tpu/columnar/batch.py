@@ -160,6 +160,7 @@ class DeviceBatch:
                     dict_encode: bool = True,
                     dict_state: Optional[dict] = None,
                     dict_numerics: bool = True,
+                    blocked_chars: int = 0,
                     device=None) -> "DeviceBatch":
         """Host -> device transition (reference: GpuRowToColumnarExec /
         HostColumnarToGpu, GpuRowToColumnarExec.scala:45-502).
@@ -169,8 +170,16 @@ class DeviceBatch:
         fast path's direct slot addressing rides it (see
         DeviceColumn.dict_codes). ``dict_state``: a mutable per-scan
         registry making every batch of one scan share one dictionary (see
-        host_dict_encode_stateful)."""
-        from spark_rapids_tpu.columnar.column import host_dict_encode_stateful
+        host_dict_encode_stateful). ``blocked_chars``: when > 0, string
+        columns that did NOT dictionary-encode and whose longest row fits
+        the given byte stride upload as fixed-stride char SLABS (+lens)
+        instead of packed chars+offsets — row movement then rides 2-D
+        lane-contiguous row gathers and packed chars only materialize if
+        an operator genuinely reads them (spark.rapids.sql.dict.
+        blockedChars)."""
+        from spark_rapids_tpu.columnar.column import (
+            host_dict_encode_stateful, np_build_slab, slab_stride_for,
+        )
         if schema is None:
             schema = Schema.from_pandas(df)
         n = len(df)
@@ -185,6 +194,7 @@ class DeviceBatch:
         # round trip on remote attachments)
         host_bufs = []
         dict_metas = []
+        slab_metas = []
         # positional iteration: join outputs may carry duplicate column names
         for i, dt in enumerate(schema.dtypes):
             values, validity = _pandas_to_numpy(df.iloc[:, i], dt)
@@ -216,8 +226,37 @@ class DeviceBatch:
                 codes, vals = enc
                 bufs = bufs + (codes,)
                 dict_metas.append(vals)
+                slab_metas.append(0)
             else:
                 dict_metas.append(None)
+                stride = 0
+                if blocked_chars > 0 and dt.is_string:
+                    chars_b, _v, offs_b = bufs[0], bufs[1], bufs[2]
+                    max_len = int((offs_b[1:n + 1] - offs_b[:n]).max()) \
+                        if n else 0
+                    stride = slab_stride_for(max_len, blocked_chars)
+                    if stride and dict_state is not None:
+                        # per-scan stride registry (the slab twin of the
+                        # dictionary registry): LATER batches pad to the
+                        # widest stride seen so far. A later batch can
+                        # still WIDEN the stride (one new program shape),
+                        # but strides are pow2-bucketed so churn is
+                        # bounded at log2(maxStride/8) widenings per
+                        # column per scan
+                        prev = int(dict_state.get(("slab", i), 0) or 0)
+                        if prev < 0:
+                            stride = 0  # column exceeded maxStride earlier
+                        else:
+                            stride = max(stride, prev)
+                            dict_state[("slab", i)] = stride
+                    if not stride and dict_state is not None \
+                            and dt.is_string and blocked_chars > 0:
+                        dict_state[("slab", i)] = -1
+                    if stride:
+                        words, lens = np_build_slab(chars_b, offs_b, cap,
+                                                    stride)
+                        bufs = (words, bufs[1], lens)
+                slab_metas.append(stride)
             host_bufs.append(bufs)
         # ``device``: explicit placement for sharded scans (mesh execution
         # uploads partition i to mesh device i so data is born distributed)
@@ -225,10 +264,15 @@ class DeviceBatch:
                              device=device)
         dev_bufs, num_rows = dev
         cols = []
-        for dt, bufs, dvals in zip(schema.dtypes, dev_bufs, dict_metas):
+        for dt, bufs, dvals, stride in zip(schema.dtypes, dev_bufs,
+                                           dict_metas, slab_metas):
             if dvals is not None:
                 cols.append(DeviceColumn(dt, *bufs[:-1], dict_codes=bufs[-1],
                                          dict_values=dvals))
+            elif stride:
+                words, vpad, lens = bufs
+                cols.append(DeviceColumn(dt, None, vpad, slab64=words,
+                                         lens=lens))
             else:
                 cols.append(DeviceColumn(dt, *bufs))
         batch = DeviceBatch(schema, cols, num_rows)
@@ -319,7 +363,13 @@ class DeviceBatch:
         for b in batches:
             fields = [("rows", np.dtype(np.int32), 1)]
             for col in b.columns:
-                if col.dtype.is_string and col.is_lazy:
+                if col.dtype.is_string and col.has_slab:
+                    cap = int(col.validity.shape[0])
+                    w = int(col._slab64.shape[1])
+                    fields.append(("slab", np.dtype(np.uint64), cap * w))
+                    fields.append(("lens", np.dtype(np.int32), cap))
+                    fields.append(("validity", np.dtype(np.uint8), cap))
+                elif col.dtype.is_string and col.is_lazy:
                     cap = int(col.validity.shape[0])
                     fields.append(("codes", np.dtype(np.int32), cap))
                     fields.append(("validity", np.dtype(np.uint8), cap))
@@ -366,7 +416,13 @@ class DeviceBatch:
                     segs.append(to_bytes(
                         b.num_rows.astype(jnp.int32).reshape(1)))
                     for col in b.columns:
-                        if col.dtype.is_string and col.is_lazy:
+                        if col.dtype.is_string and col.has_slab:
+                            segs.append(to_bytes(
+                                col._slab64.reshape(-1)))
+                            segs.append(to_bytes(
+                                col._lens.astype(jnp.int32)))
+                            segs.append(col.validity.astype(jnp.uint8))
+                        elif col.dtype.is_string and col.is_lazy:
                             segs.append(to_bytes(
                                 col.dict_codes.astype(jnp.int32)))
                             segs.append(col.validity.astype(jnp.uint8))
@@ -408,7 +464,15 @@ class DeviceBatch:
             b._host_rows = n
             series: List[pd.Series] = []
             for col, cdt in zip(b.columns, b.schema.dtypes):
-                if cdt.is_string and col.is_lazy:
+                if cdt.is_string and col.has_slab:
+                    w = int(col._slab64.shape[1])
+                    # NB: do not name this ``slab`` — that is the outer
+                    # fetched byte buffer take() slices from
+                    slab_col = take(*next(it)[1:]).reshape(-1, w)
+                    lens = take(*next(it)[1:])
+                    validity = take(*next(it)[1:]).astype(bool)
+                    trimmed = (validity[:n], lens[:n], slab_col[:n])
+                elif cdt.is_string and col.is_lazy:
                     codes = take(*next(it)[1:])
                     validity = take(*next(it)[1:]).astype(bool)
                     trimmed = (validity[:n], codes[:n])
@@ -449,7 +513,10 @@ class DeviceBatch:
             # lazy (codes-only) string columns ship codes+validity and
             # decode through their static dictionary on the host —
             # touching .data here would materialize the worst-case char
-            # slab on device and ship it over the tunnel
+            # slab on device and ship it over the tunnel. Slab columns
+            # ship words+lens and unpack host-side.
+            if c.dtype.is_string and c.has_slab:
+                return (c.validity, c._lens, c._slab64)
             if c.dtype.is_string and c.is_lazy:
                 return (c.validity, c.dict_codes)
             if c.dtype.is_string:
@@ -466,7 +533,10 @@ class DeviceBatch:
             b._host_rows = n
             series: List[pd.Series] = []
             for dt, col, parts in zip(b.schema.dtypes, b.columns, host_cols):
-                if dt.is_string and col.is_lazy:
+                if dt.is_string and col.has_slab:
+                    validity, lens, slab = (np.asarray(p) for p in parts)
+                    trimmed = (validity[:n], lens[:n], slab[:n])
+                elif dt.is_string and col.is_lazy:
                     validity, codes = (np.asarray(p) for p in parts)
                     trimmed = (validity[:n], codes[:n])
                 elif dt.is_string:

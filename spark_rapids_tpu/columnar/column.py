@@ -41,7 +41,9 @@ class DeviceColumn:
                  offsets: Optional[jnp.ndarray] = None,
                  prefix8: Optional[jnp.ndarray] = None,
                  dict_codes: Optional[jnp.ndarray] = None,
-                 dict_values: Optional[tuple] = None):
+                 dict_values: Optional[tuple] = None,
+                 slab64: Optional[jnp.ndarray] = None,
+                 lens: Optional[jnp.ndarray] = None):
         self.dtype = dtype
         # codes-only (lazy) string column: ``data=None`` with a dictionary
         # present. Chars/offsets materialize from the static dictionary on
@@ -51,9 +53,22 @@ class DeviceColumn:
         # measured ~2x cheaper than even the dict-rebuild char gather at
         # fact-table scale. The TPU answer to cuDF keeping dictionary
         # columns encoded end-to-end.
+        #
+        # slab (blocked-chars) string column: ``data=None`` with a
+        # fixed-stride uint64 slab present. ``slab64`` is (capacity,
+        # stride/8) with row i's bytes packed value-wise (byte j at bit
+        # 8*(j%8) of word j//8) and ZERO past the row's length; ``lens``
+        # is int32 (capacity,). Row movement is then a 2-D lane-
+        # contiguous row gather (the stacked-gather form, 4-6x cheaper
+        # than the 1-D char-index gather on TPU) and sort/group/hash
+        # images derive densely from the words. Packed chars+offsets
+        # materialize lazily only when an operator actually reads them.
         assert data is not None or (dtype.is_string
-                                    and dict_values is not None), dtype
+                                    and (dict_values is not None
+                                         or slab64 is not None)), dtype
         self._data = data
+        self._slab64 = slab64
+        self._lens = lens
         self.validity = validity
         self._offsets = offsets
         # optional per-row big-endian image of the first 8 bytes (uint64,
@@ -76,22 +91,35 @@ class DeviceColumn:
         self.dict_codes = dict_codes
         self.dict_values = dict_values
 
-    # --- lazy chars (codes-only string columns) ---------------------------
+    # --- lazy chars (codes-only / slab string columns) --------------------
     @property
     def data(self):
         if self._data is None:
-            self._materialize_chars()
+            if self._slab64 is not None:
+                self._materialize_from_slab()
+            else:
+                self._materialize_chars()
         return self._data
 
     @property
     def offsets(self):
         if self._offsets is None and self._data is None \
                 and self.dtype.is_string:
-            self._materialize_chars()
+            if self._slab64 is not None:
+                self._materialize_from_slab()
+            else:
+                self._materialize_chars()
         return self._offsets
 
     @property
     def prefix8(self):
+        if (self._prefix8 is None and self.dtype.is_string
+                and self.has_slab):
+            # big-endian image of the first 8 bytes == byte-reversed word
+            # 0 of the slab (0-padded past the end by the slab invariant)
+            # — a dense op, no char gathers
+            self._prefix8 = _bswap64(self._slab64[:, 0])
+            return self._prefix8
         if (self._prefix8 is None and self.dtype.is_string
                 and self.dict_values is not None
                 and self.dict_codes is not None):
@@ -115,8 +143,61 @@ class DeviceColumn:
 
     @property
     def is_lazy(self) -> bool:
-        """True while chars/offsets are unmaterialized (codes-only)."""
+        """True while chars/offsets are unmaterialized (codes-only or
+        slab-backed)."""
         return self._data is None
+
+    @property
+    def has_slab(self) -> bool:
+        """True for a slab-backed (blocked-chars) string column whose
+        packed chars have not been materialized."""
+        return self._slab64 is not None and self._data is None
+
+    @property
+    def char_stride(self) -> int:
+        """Static per-row byte stride of the slab layout."""
+        assert self._slab64 is not None
+        return int(self._slab64.shape[1]) * 8
+
+    def lens_(self) -> jnp.ndarray:
+        """Per-row byte lengths (int32) WITHOUT materializing a lazy
+        column: slab columns carry them, dictionary columns derive them
+        from the static dictionary, packed columns diff their offsets."""
+        if self._slab64 is not None and self._lens is not None:
+            return self._lens
+        if self.is_lazy:
+            _dc, _ds, dlens = self.dict_tables()
+            card = len(self.dict_values)
+            lens = jnp.asarray(dlens)[jnp.clip(self.dict_codes, 0, card)]
+            return jnp.where(self.validity, lens, 0).astype(jnp.int32)
+        return (self.offsets[1:] - self.offsets[:-1]).astype(jnp.int32)
+
+    def _materialize_from_slab(self) -> None:
+        """Rebuild packed chars+offsets from the fixed-stride slab. The
+        flat slab is the gather source, so this is the ONLY remaining
+        1-D char gather on the blocked path — paid solely by operators
+        that genuinely need the packed layout (byte-level string
+        expressions), never by row movement, sorting, grouping, hashing
+        or the result fetch."""
+        cap, w = int(self._slab64.shape[0]), int(self._slab64.shape[1])
+        stride = w * 8
+        lens = jnp.where(self.validity, self._lens, 0).astype(jnp.int32)
+        offsets = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32), jnp.cumsum(lens).astype(jnp.int32)])
+        total = offsets[cap]
+        char_cap = _char_bucket(cap * stride)
+        # value-semantics byte expansion (endian-independent): byte j of
+        # a row is (word[j//8] >> 8*(j%8)) & 0xFF
+        shifts = (jnp.uint64(8) * jnp.arange(8, dtype=jnp.uint64))
+        flat = ((self._slab64[:, :, None] >> shifts[None, None, :])
+                & jnp.uint64(0xFF)).astype(jnp.uint8).reshape(cap * stride)
+        from spark_rapids_tpu.ops.rowops import rank_of_iota
+        k = jnp.arange(char_cap, dtype=jnp.int32)
+        out_row = jnp.clip(rank_of_iota(offsets, char_cap) - 1, 0, cap - 1)
+        src = out_row * stride + (k - offsets[out_row])
+        chars = flat[jnp.clip(src, 0, cap * stride - 1)]
+        self._data = jnp.where(k < total, chars, 0).astype(jnp.uint8)
+        self._offsets = offsets
 
     def dict_tables(self):
         """Host constants of the static dictionary: (chars u8, starts
@@ -158,6 +239,14 @@ class DeviceColumn:
 
     # --- pytree protocol ---------------------------------------------------
     def tree_flatten(self):
+        if self.has_slab:
+            # slab layout: validity + slab words + lens are the whole
+            # payload; packed chars materialize on the other side on
+            # demand (a column that already materialized packed chars
+            # flattens as packed below — the slab is dropped, its cost
+            # has been paid)
+            return ((self.validity, self._slab64, self._lens),
+                    ("slab", self.dtype))
         lazy = self._data is None
         if lazy:
             # codes-only: validity + codes are the whole payload; chars
@@ -177,6 +266,9 @@ class DeviceColumn:
 
     @classmethod
     def tree_unflatten(cls, aux, children):
+        if isinstance(aux, tuple) and len(aux) == 2 and aux[0] == "slab":
+            validity, slab64, lens = children
+            return cls(aux[1], None, validity, slab64=slab64, lens=lens)
         if isinstance(aux, tuple):
             if len(aux) == 4:
                 dtype, has_prefix, dict_values, lazy = aux
@@ -301,7 +393,12 @@ class DeviceColumn:
         Kept lazy so a whole batch's views can ride ONE jax.device_get —
         per-buffer fetches each pay a full round trip on remote
         attachments. Codes-only columns ship just codes+validity and
-        decode through the static dictionary on the host."""
+        decode through the static dictionary on the host; slab columns
+        ship the fixed-stride words + lens and unpack host-side (numpy) —
+        neither ever runs a device char gather for the fetch."""
+        if self.has_slab:
+            return (self.validity[:num_rows], self._lens[:num_rows],
+                    self._slab64[:num_rows])
         if self._data is None and self.dtype.is_string:
             return (self.validity[:num_rows], self.dict_codes[:num_rows])
         if self.dtype.is_string:
@@ -319,6 +416,11 @@ class DeviceColumn:
     def numpy_from_host(self, host_parts,
                         num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
         """Finish a host copy from already-fetched device_views buffers."""
+        if self.has_slab:
+            validity, lens, slab = (np.asarray(p) for p in host_parts)
+            chars, offsets = np_slab_to_packed(slab, lens, validity)
+            return self.numpy_from_host_packed(chars, offsets, validity,
+                                               num_rows)
         if self._data is None and self.dtype.is_string:
             validity, codes = (np.asarray(p) for p in host_parts)
             card = len(self.dict_values)
@@ -328,32 +430,97 @@ class DeviceColumn:
             out[~validity] = None
             return out, validity
         if self.dtype.is_string:
-            import pyarrow as pa
             validity, offsets, chars = (np.asarray(p) for p in host_parts)
-            offsets = np.ascontiguousarray(offsets)
-            chars = np.ascontiguousarray(chars)
-            null_count = int(num_rows - validity.sum())
-            vbuf = (pa.py_buffer(np.packbits(validity, bitorder="little"))
-                    if null_count else None)
-            arr = pa.StringArray.from_buffers(
-                num_rows, pa.py_buffer(offsets), pa.py_buffer(chars),
-                vbuf, null_count)
-            try:
-                out = arr.to_numpy(zero_copy_only=False)
-            except Exception:
-                # byte-oriented device kernels (substring on multi-byte
-                # UTF-8) can produce invalid UTF-8; decode leniently
-                out = np.empty(num_rows, dtype=object)
-                for i in range(num_rows):
-                    if validity[i]:
-                        out[i] = bytes(
-                            chars[offsets[i]:offsets[i + 1]]).decode(
-                                "utf-8", errors="replace")
-                    else:
-                        out[i] = None
-            return out, validity
+            return self.numpy_from_host_packed(chars, offsets, validity,
+                                               num_rows)
         data, validity = (np.asarray(p) for p in host_parts)
         return data, validity
+
+    def numpy_from_host_packed(self, chars, offsets, validity,
+                               num_rows: int):
+        """Packed chars+offsets -> python strings (the shared tail of the
+        packed and slab host-decode paths)."""
+        import pyarrow as pa
+        offsets = np.ascontiguousarray(offsets)
+        chars = np.ascontiguousarray(chars)
+        null_count = int(num_rows - validity.sum())
+        vbuf = (pa.py_buffer(np.packbits(validity, bitorder="little"))
+                if null_count else None)
+        arr = pa.StringArray.from_buffers(
+            num_rows, pa.py_buffer(offsets), pa.py_buffer(chars),
+            vbuf, null_count)
+        try:
+            out = arr.to_numpy(zero_copy_only=False)
+        except Exception:
+            # byte-oriented device kernels (substring on multi-byte
+            # UTF-8) can produce invalid UTF-8; decode leniently
+            out = np.empty(num_rows, dtype=object)
+            for i in range(num_rows):
+                if validity[i]:
+                    out[i] = bytes(
+                        chars[offsets[i]:offsets[i + 1]]).decode(
+                            "utf-8", errors="replace")
+                else:
+                    out[i] = None
+        return out, validity
+
+
+def _bswap64(x: jnp.ndarray) -> jnp.ndarray:
+    """Byte-reverse uint64 values (value semantics, endian-independent):
+    turns a little-ordered slab word into the big-endian order-preserving
+    image the sort/group kernels compare."""
+    out = jnp.zeros(x.shape, jnp.uint64)
+    for b in range(8):
+        byte = (x >> (jnp.uint64(8) * jnp.uint64(b))) & jnp.uint64(0xFF)
+        out = out | (byte << (jnp.uint64(8) * jnp.uint64(7 - b)))
+    return out
+
+
+def slab_stride_for(max_len: int, max_stride: int) -> int:
+    """Power-of-two per-row byte stride (>= 8) for the blocked char-slab
+    layout, or 0 when the column's longest row exceeds ``max_stride``."""
+    stride = 8
+    while stride < max_len:
+        stride <<= 1
+    return stride if stride <= max_stride else 0
+
+
+def np_build_slab(chars: np.ndarray, offsets: np.ndarray, capacity: int,
+                  stride: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side packed -> fixed-stride slab conversion (upload path):
+    (slab uint64 (capacity, stride/8), lens int32 (capacity,)). Bytes
+    past each row's length are ZERO — the slab invariant every dense
+    image derivation relies on. Word packing is value-based (byte j at
+    bit 8*(j%8)), matching the device-side extraction exactly."""
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int64)
+    starts = offsets[:-1].astype(np.int64)
+    nc = max(len(chars), 1)
+    j = np.arange(stride)
+    idx = np.clip(starts[:, None] + j[None, :], 0, nc - 1)
+    mask = j[None, :] < lens[:, None]
+    bytes_ = np.where(mask, chars[idx], 0).astype(np.uint64)
+    shifts = np.uint64(8) * np.arange(8, dtype=np.uint64)
+    words = (bytes_.reshape(capacity, stride // 8, 8)
+             << shifts[None, None, :]).sum(axis=2, dtype=np.uint64)
+    return words, lens.astype(np.int32)
+
+
+def np_slab_to_packed(slab: np.ndarray, lens: np.ndarray,
+                      validity: np.ndarray
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side slab -> packed chars+offsets (the result-fetch decode):
+    pure vectorized numpy, no device work at all."""
+    n, w = slab.shape
+    stride = w * 8
+    lens = np.clip(np.asarray(lens, np.int64), 0, stride)
+    shifts = np.uint64(8) * np.arange(8, dtype=np.uint64)
+    bytes_ = ((slab[:, :, None] >> shifts[None, None, :])
+              & np.uint64(0xFF)).astype(np.uint8).reshape(n, stride)
+    mask = np.arange(stride)[None, :] < lens[:, None]
+    chars = np.ascontiguousarray(bytes_[mask])
+    offsets = np.zeros(n + 1, np.int32)
+    offsets[1:] = np.cumsum(lens).astype(np.int32)
+    return chars, offsets
 
 
 def _np_prefix8(chars: np.ndarray, offsets: np.ndarray,

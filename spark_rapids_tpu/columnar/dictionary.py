@@ -1,0 +1,182 @@
+"""First-class dictionary-column support: merge semantics + value tables.
+
+The gather-free execution mode (docs/gatherfree.md) carries
+dictionary-encoded string columns end-to-end as int32 codes and only ever
+touches char space through the STATIC dictionary — a host tuple riding
+jit cache keys as pytree aux data. Everything per-VALUE is therefore
+computable host-side once per dictionary and baked into traces as
+constants:
+
+  * ``union_dictionaries``: the exchange-boundary merge — union of the
+    input dictionaries in canonical sorted order plus one O(cardinality)
+    int32 remap table per input. The same stateful-remap shape the scan
+    path already uses (column.host_dict_encode_stateful), applied between
+    batches instead of between a batch and a scan registry.
+  * ``value_prefix_chunk_tables``: the 64-byte big-endian prefix images +
+    length key of every dictionary value — bit-identical to
+    ops/sortops._string_prefix_chunks on the decoded column, so
+    sort/join/range-partition operands of dictionary columns are ONE tiny
+    table gather per image instead of 64 char gathers per row.
+  * ``value_hash_tables``: the two polynomial hashes of every value —
+    bit-identical to ops/hashing.string_poly_hashes on the decoded
+    column, so exchange partitioning and join tiebreaks of dictionary
+    columns are a table gather instead of a char-scanning segment hash.
+
+Rollback: spark.rapids.sql.dict.enabled=false disables dictionary
+encoding at upload, so none of these paths can engage (legacy
+chars+offsets execution everywhere).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+_M64 = (1 << 64) - 1
+_PREFIX_CHUNKS = 8  # keep in sync with ops/sortops.STRING_PREFIX_CHUNKS
+
+
+# module flags configured per query from conf (session._execute).
+# ``hash_values`` gates a VALUE-IDENTICAL path (per-value hash tables vs
+# char scans), so a kernel traced under the other setting is never a
+# correctness hazard. ``merge_exchange`` changes output REPRESENTATION
+# (codes vs chars), so its consumers bake it into their kernel-cache
+# signatures (exec/tpu._concat_device). ``wire`` picks the shuffle frame
+# format (shuffle/wire.py) — both ends of the in-process transport read
+# the same flag.
+#
+# Scope: these are PROCESS-wide, like the session conf they mirror —
+# concurrent queries of one session share one conf, so they agree by
+# construction. Under concurrent serving a mid-flight set_conf can flip
+# a flag between two queries' kernels; every reachable combination is
+# CORRECT (codes and chars are value-equal, v1 and v2 frames both
+# deserialize) — only which representation ran is affected, same
+# semantics as every other session-global conf.
+_FLAGS = {"hash_values": True, "merge_exchange": True, "wire": True}
+
+
+def configure_from_conf(conf) -> None:
+    _FLAGS["hash_values"] = conf.get_bool(
+        "spark.rapids.sql.dict.hashValues", True)
+    _FLAGS["merge_exchange"] = conf.get_bool(
+        "spark.rapids.sql.dict.mergeOnExchange", True)
+    _FLAGS["wire"] = conf.get_bool("spark.rapids.sql.dict.wire", True)
+
+
+def hash_values_enabled() -> bool:
+    return _FLAGS["hash_values"]
+
+
+def merge_exchange_enabled() -> bool:
+    return _FLAGS["merge_exchange"]
+
+
+def wire_enabled() -> bool:
+    return _FLAGS["wire"]
+
+
+def _value_bytes(v) -> bytes:
+    if isinstance(v, str):
+        return v.encode("utf-8")
+    return str(v).encode("utf-8")
+
+
+@functools.lru_cache(maxsize=512)
+def value_prefix_chunk_tables(dict_values: tuple) -> Tuple[np.ndarray, ...]:
+    """(card + 1,) uint64 tables, one per prefix-chunk image plus the
+    trailing length key — entry ``card`` is the NULL/padding sentinel
+    (all-zero images, length 0, exactly what an empty-extent invalid row
+    produces on the char path)."""
+    card = len(dict_values)
+    out = [np.zeros(card + 1, np.uint64) for _ in range(_PREFIX_CHUNKS + 1)]
+    for i, v in enumerate(dict_values):
+        raw = _value_bytes(v)
+        for c in range(_PREFIX_CHUNKS):
+            img = 0
+            for b in range(8):
+                pos = c * 8 + b
+                byte = raw[pos] if pos < len(raw) else 0
+                img = ((img << 8) | byte) & _M64
+            out[c][i] = img
+        out[_PREFIX_CHUNKS][i] = len(raw)
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=512)
+def value_hash_tables(dict_values: tuple) -> Tuple[np.ndarray, np.ndarray]:
+    """(h1, h2) uint64 tables of shape (card + 1,): the two independent
+    polynomial hashes of each dictionary value, bit-identical to
+    ops/hashing.string_poly_hashes over the decoded rows. Entry ``card``
+    (NULL) carries the NULL_HASH sentinel — the same value the char path
+    assigns every invalid row."""
+    from spark_rapids_tpu.ops.hashing import (
+        NULL_HASH, P1, P2, SALT1, SALT2, np_splitmix64,
+    )
+    card = len(dict_values)
+    acc1 = np.zeros(card + 1, np.uint64)
+    acc2 = np.zeros(card + 1, np.uint64)
+    lens = np.zeros(card + 1, np.uint64)
+    for i, v in enumerate(dict_values):
+        raw = _value_bytes(v)
+        a1 = a2 = 0
+        for b in raw:
+            a1 = (a1 * P1 + b) & _M64
+            a2 = (a2 * P2 + b) & _M64
+        acc1[i], acc2[i], lens[i] = a1, a2, len(raw)
+    h1 = np_splitmix64(acc1 + np.uint64(SALT1) + lens)
+    h2 = np_splitmix64(acc2 + np.uint64(SALT2) + lens)
+    h1[card] = NULL_HASH
+    h2[card] = NULL_HASH
+    return h1, h2
+
+
+def union_dictionaries(dicts: Sequence[tuple]
+                       ) -> Tuple[tuple, List[np.ndarray]]:
+    """Union the value sets in canonical sorted order (the same order
+    host_dict_encode establishes, so identical value SETS keep producing
+    identical — compile-key-stable — dictionaries) and build one int32
+    remap table per input: ``remap[old_code] -> new_code`` with the NULL
+    sentinel (old card) mapping to the union's NULL sentinel (union
+    card)."""
+    seen = set()
+    union: list = []
+    for d in dicts:
+        for v in d:
+            if v not in seen:
+                seen.add(v)
+                union.append(v)
+    union.sort()
+    pos = {v: i for i, v in enumerate(union)}
+    ucard = len(union)
+    remaps = []
+    for d in dicts:
+        r = np.empty(len(d) + 1, np.int32)
+        for i, v in enumerate(d):
+            r[i] = pos[v]
+        r[len(d)] = ucard
+        remaps.append(r)
+    return tuple(union), remaps
+
+
+# bounded memo of union results keyed by the input dictionary tuples —
+# exchanges re-concat the same per-scan dictionaries every execution
+@functools.lru_cache(maxsize=256)
+def _union_cached(dict_tuple_of_tuples: tuple):
+    vals, remaps = union_dictionaries(list(dict_tuple_of_tuples))
+    return vals, tuple(r.tobytes() for r in remaps), \
+        tuple(len(r) for r in remaps)
+
+
+def union_dictionaries_cached(dicts: Sequence[tuple]
+                              ) -> Tuple[tuple, List[np.ndarray]]:
+    vals, blobs, lens = _union_cached(tuple(dicts))
+    return vals, [np.frombuffer(b, np.int32).copy() for b in blobs]
+
+
+def mergeable(parts) -> bool:
+    """True when every column in ``parts`` carries a dictionary (possibly
+    different ones) — the precondition for the union+remap merge."""
+    return all(p.dict_values is not None and p.dict_codes is not None
+               for p in parts)

@@ -371,6 +371,94 @@ SCAN_DIRECT_DECODE = register(
     "reader: ignored when spark.rapids.sql.scan.prefetchDepth is 0 (the "
     "legacy reader keeps the full conversion).")
 
+# --- gather-free execution (docs/gatherfree.md) ----------------------------
+DICT_ENABLED = register(
+    "spark.rapids.sql.dict.enabled", _to_bool, True,
+    "Dictionary-encode low-cardinality string columns at upload and carry "
+    "the encoded (codes-only) representation end-to-end through "
+    "filter/join/agg/sort/exchange, decoding to chars only at "
+    "collect()/write. Comparison, hashing and grouping run on int32 "
+    "codes; per-value image tables (order-preserving prefix chunks, "
+    "polynomial hashes) make cross-batch consumers exact without any "
+    "char-space gathers. false disables dictionary encoding entirely — "
+    "byte-identical legacy (chars + offsets) execution everywhere.")
+
+DICT_MERGE_EXCHANGE = register(
+    "spark.rapids.sql.dict.mergeOnExchange", _to_bool, True,
+    "When batches with DIFFERENT dictionaries for the same string column "
+    "meet at an exchange/concat boundary, union the (static, host-side) "
+    "dictionaries and remap each part's codes through an O(cardinality) "
+    "table instead of decoding to char slabs. Keeps columns codes-only "
+    "across exchange boundaries. false falls back to decoding at the "
+    "boundary (legacy).")
+
+DICT_HASH_VALUES = register(
+    "spark.rapids.sql.dict.hashValues", _to_bool, True,
+    "Hash dictionary-encoded string columns for exchange partitioning and "
+    "join keys through per-VALUE hash tables (the dictionary's values "
+    "hashed once, rows gather by code) instead of the char-scanning "
+    "polynomial hashes. Bit-identical hash values by construction — this "
+    "only removes the char reads. false recomputes hashes from chars.")
+
+DICT_WIRE = register(
+    "spark.rapids.sql.dict.wire", _to_bool, True,
+    "Ship dictionary-encoded string columns over the shuffle wire as "
+    "int32 codes + the dictionary values (wire format v2) instead of "
+    "materialized char slabs, and rebuild them codes-only on the reduce "
+    "side. false writes legacy v1 chars+offsets frames (dictionary "
+    "columns decode host-side at serialization, still with no device "
+    "char gather).")
+
+DICT_BLOCKED_CHARS = register(
+    "spark.rapids.sql.dict.blockedChars", _to_bool, True,
+    "Blocked char-slab movement for plain (non-dictionary) string "
+    "columns: rows are carried as a fixed-stride (capacity, stride/8) "
+    "uint64 slab so row movement (gathers, join expands, concats) is a "
+    "2-D lane-contiguous row gather — the stacked-gather form measured "
+    "4-6x cheaper than the 1-D char-index gather — and sort/group/hash "
+    "images derive densely from the slab words with no char gathers at "
+    "all. Packed chars+offsets materialize lazily only when an operator "
+    "actually needs them. Applies to columns whose longest row fits "
+    "spark.rapids.sql.dict.blockedChars.maxStride. false keeps the "
+    "legacy packed layout everywhere.")
+
+DICT_BLOCKED_MAX_STRIDE = register(
+    "spark.rapids.sql.dict.blockedChars.maxStride", int, 64,
+    "Largest per-row byte stride (rounded up to a power of two, min 8) a "
+    "string column may have and still ride the blocked char-slab "
+    "representation; longer columns keep the packed layout. The slab "
+    "costs capacity x stride bytes of HBM, so this bounds padding bloat "
+    "for mostly-short columns with rare long rows.", validator=_positive)
+
+SMALL_QUERY_ENABLED = register(
+    "spark.rapids.sql.smallQuery.enabled", _to_bool, True,
+    "Tiny-query overhead-floor fast path: when every leaf source of a "
+    "plan reports a known row count and the total fits one resident "
+    "batch under spark.rapids.sql.smallQuery.maxRows, plan every "
+    "exchange single-partition (hash/range partitioning degenerates to "
+    "a LOCAL collapse — no row hashing, no partition-id sort, no "
+    "per-bucket slices), skip the collapse's capacity-shrink "
+    "device->host sync, and skip the task-admission semaphore. The "
+    "packed result fetch already coalesces the whole output into one "
+    "transfer. false restores the general path exactly.")
+
+SMALL_QUERY_MAX_ROWS = register(
+    "spark.rapids.sql.smallQuery.maxRows", int, 32768,
+    "Row-count ceiling (summed over all leaf sources with known counts) "
+    "under which the small-query fast path engages. Also clamped to one "
+    "batch: inputs above spark.rapids.sql.batchSizeRows never engage.",
+    validator=_positive)
+
+SMALL_QUERY_LITE = register(
+    "spark.rapids.sql.smallQuery.liteBookkeeping", _to_bool, True,
+    "With the small-query fast path engaged, replace the per-batch-pull "
+    "operator bookkeeping (per-batch timers, tracer spans, ledger "
+    "scopes) with one per-partition record per operator. Per-operator "
+    "SQL metrics stay populated (one batch entry per partition); "
+    "profile syncEachOp, tracing, live progress and cancellation scopes "
+    "all force the full wrapper back on. Pure fixed-cost removal for "
+    "queries whose wall time is dominated by Python dispatch.")
+
 # --- test hooks (ref RapidsConf.scala:476-501) -----------------------------
 TEST_ENABLED = register(
     "spark.rapids.sql.test.enabled", _to_bool, False,

@@ -70,6 +70,50 @@ class PhysicalPlan:
                 and cancel is None:
             return parts
         import time
+        # tiny-query lite bookkeeping
+        # (spark.rapids.sql.smallQuery.liteBookkeeping): one record per
+        # operator per partition instead of per-batch timers + ledger
+        # scopes + tracer spans — a pure fixed-cost removal for queries
+        # whose wall time is Python dispatch. Anything that genuinely
+        # needs batch granularity (tracing, profile sync, live progress,
+        # cancellation scopes) forces the full wrapper back on.
+        if (ctx.small_query and ctx.small_query_lite
+                and not TRACER.enabled and prog is None
+                and cancel is None and not ctx.profile_sync):
+            record_lite = ctx.metrics_enabled
+            lite_op = self.describe()
+            lite_id = id(self)
+            members = getattr(self, "member_ops", None)
+
+            def lite_wrap(part: Partition) -> Partition:
+                def run():
+                    t0 = time.perf_counter()
+                    rows = 0
+                    it = part()
+                    while True:
+                        # ledger scope around the pull only (a thread-
+                        # local set/unset): compile attribution — and a
+                        # fused stage's member pipeline — survive, while
+                        # the per-batch timers, tracer spans and
+                        # progress heartbeats are elided
+                        prev_op = compileledger.push_op(
+                            lite_op, lite_id, ctx, members)
+                        try:
+                            batch = next(it)
+                        except StopIteration:
+                            break
+                        finally:
+                            compileledger.pop_op(prev_op)
+                        r = getattr(batch, "_host_rows", None)
+                        if r is None and not hasattr(batch, "num_rows"):
+                            r = len(batch)
+                        rows += r or 0
+                        yield batch
+                    if record_lite:
+                        ctx.record_op(lite_op, lite_id,
+                                      time.perf_counter() - t0, rows)
+                return run
+            return [lite_wrap(p) for p in parts]
         op = self.describe()
         record = ctx.metrics_enabled
         node_id = id(self)
@@ -258,6 +302,19 @@ class ExecContext:
         # None (the default) keeps the hot path untouched.
         from spark_rapids_tpu.serving.cancellation import current_scope
         self.cancel = current_scope()
+        # tiny-query overhead-floor fast path (sql/planner.py
+        # note_input_size): the session sets this after planning when the
+        # measured input is a single resident batch under the threshold.
+        # Exchanges skip their shrink sync, uploads skip the semaphore,
+        # and executed_partitions swaps the per-batch-pull bookkeeping
+        # for one per-partition record (liteBookkeeping).
+        self.small_query = False
+        # expanding plans (joins/explode) keep the admission semaphore
+        # even under the fast path — leaf row counts do not bound THEIR
+        # working set (sql/planner.note_input_size)
+        self.small_query_keep_sem = False
+        self.small_query_lite = conf.get_bool(
+            "spark.rapids.sql.smallQuery.liteBookkeeping", True)
         # per-QUERY resource tracking (shuffle ids registered, transient
         # spillable buffer ids): concurrent queries must each release
         # exactly their own at query end — a shared session-level list

@@ -80,15 +80,28 @@ def _concat_device(batches: List[DeviceBatch], schema: Schema,
         from spark_rapids_tpu.utils.kernelcache import bucket_dim
         out_cap = bucket_dim(out_cap)
     # one generic jitted concat kernel; jax re-specializes per pytree shape.
-    # char capacity 0 = per-column sum computed inside concat_batches
+    # char capacity 0 = per-column sum computed inside concat_batches.
+    # dict-merge (union+remap at the boundary) changes the OUTPUT
+    # representation for mixed-dictionary inputs, so the flag is part of
+    # the kernel-cache signature — flipping
+    # spark.rapids.sql.dict.mergeOnExchange mid-process cannot serve a
+    # stale trace.
+    from spark_rapids_tpu.columnar.dictionary import merge_exchange_enabled
+    # NB: bind the flag as a default arg, not a closure — this frame
+    # reuses the name ``dm`` below for the device manager, and a closure
+    # over a reassigned local would silently flip the merge behavior on
+    # every re-trace of the cached kernel
+    dmerge = merge_exchange_enabled()
     if keep_masks is None:
-        kernel = cached_jit("concat", lambda: jax.jit(
-            rowops.concat_batches, static_argnums=(1, 2)))
+        kernel = cached_jit(f"concat|dm{int(dmerge)}", lambda: jax.jit(
+            lambda bs, oc, cc, _dm=dmerge: rowops.concat_batches(
+                bs, oc, cc, dict_merge=_dm), static_argnums=(1, 2)))
         out = kernel(batches, out_cap, 0)
     else:
-        kernel = cached_jit("concatmask", lambda: jax.jit(
-            lambda bs, ks, oc, cc: rowops.concat_batches(
-                bs, oc, cc, keep_masks=ks), static_argnums=(2, 3)))
+        kernel = cached_jit(f"concatmask|dm{int(dmerge)}", lambda: jax.jit(
+            lambda bs, ks, oc, cc, _dm=dmerge: rowops.concat_batches(
+                bs, oc, cc, keep_masks=ks, dict_merge=_dm),
+            static_argnums=(2, 3)))
         out = kernel(batches, list(keep_masks), out_cap, 0)
     from spark_rapids_tpu.memory.device import TpuDeviceManager
     dm = TpuDeviceManager.current()
@@ -1191,6 +1204,14 @@ class TpuShuffleExchangeExec(TpuExec):
                 if not batches:
                     yield DeviceBatch.empty(schema)
                     return
+                if getattr(ctx, "small_query", False):
+                    # tiny-query fast path: the shrink exists to drop
+                    # pre-aggregation padding before heavy downstream
+                    # kernels — at single-resident-batch scale the
+                    # count-fetch round trip costs more than the padding
+                    # it would remove
+                    yield _concat_device(batches, schema, growth)
+                    return
                 # capacity shrink: post-aggregate partials carry their
                 # pre-aggregate input capacity as padding; ONE batched
                 # row-count fetch lets each piece drop to its true bucket
@@ -1241,7 +1262,12 @@ class TpuShuffleExchangeExec(TpuExec):
                 def batch_stats(b):
                     vals = [b.num_rows]
                     for col in b.columns:
-                        if col.dtype.is_string and col.dict_values is None:
+                        if (col.dtype.is_string
+                                and col.dict_values is None
+                                and not col.has_slab):
+                            # slab columns carry a STATIC stride — no
+                            # char total to fetch (and reading offsets
+                            # here would materialize their packed chars)
                             vals.append(col.offsets[jnp.minimum(
                                 b.num_rows.astype(jnp.int32),
                                 jnp.int32(col.offsets.shape[0] - 1))])
@@ -1289,7 +1315,8 @@ class TpuShuffleExchangeExec(TpuExec):
                     for col in b.columns:
                         if not col.dtype.is_string:
                             continue
-                        if col.dict_values is None and hc:
+                        if (col.dict_values is None and not col.has_slab
+                                and hc):
                             ccaps.append(_char_bucket(max(hc.pop(0), 1)))
                         else:
                             ccaps.append(0)

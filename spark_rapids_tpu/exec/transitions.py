@@ -65,6 +65,22 @@ def note_scan_stats(session, df: pd.DataFrame) -> None:
         reg[str(name)] = (lo, hi)
 
 
+def upload_blocked_chars(ctx: ExecContext) -> int:
+    """Max byte stride for the blocked char-slab upload layout
+    (spark.rapids.sql.dict.blockedChars, docs/gatherfree.md), or 0 when
+    disabled — string columns that fail dictionary encoding and fit the
+    stride then upload as fixed-stride slabs and move through the whole
+    operator stack without 1-D char gathers. Requires dict.enabled owner
+    switch too: with the gather-free mode off entirely, uploads are
+    byte-identical legacy."""
+    if not ctx.conf.get_bool("spark.rapids.sql.dict.enabled", True):
+        return 0
+    if not ctx.conf.get_bool("spark.rapids.sql.dict.blockedChars", True):
+        return 0
+    return max(0, ctx.conf.get_int(
+        "spark.rapids.sql.dict.blockedChars.maxStride", 64))
+
+
 def scan_dict_numerics(ctx: ExecContext, source) -> bool:
     """Whether file-scan uploads dictionary-probe NUMERIC columns
     (spark.rapids.sql.scan.dictEncodeNumerics, default off with the
@@ -105,6 +121,14 @@ def upload_partition(ctx: ExecContext, part: Partition, schema: Schema,
     from spark_rapids_tpu.obs.progress import PROGRESS
     from spark_rapids_tpu.obs.trace import TRACER
     sem = ctx.session.semaphore if ctx.session else None
+    if getattr(ctx, "small_query", False) \
+            and not getattr(ctx, "small_query_keep_sem", False):
+        # tiny-query fast path: a single resident batch of a NON-
+        # expanding plan cannot oversubscribe HBM — the admission lock is
+        # pure fixed cost here (release on the drain side is a tolerated
+        # no-op). Plans with joins/explode keep the semaphore: their
+        # working set is not bounded by the leaf row counts.
+        sem = None
     if sem is not None:
         sem.acquire_if_necessary()
     if cache is not None and i in cache:
@@ -121,6 +145,8 @@ def upload_partition(ctx: ExecContext, part: Partition, schema: Schema,
     dm = ctx.session.device_manager if ctx.session else None
     double_buffer = int(ctx.conf.get(
         "spark.rapids.sql.scan.prefetchDepth", 2) or 0) > 0
+    dict_on = ctx.conf.get_bool("spark.rapids.sql.dict.enabled", True)
+    blocked = upload_blocked_chars(ctx)
 
     def uploads():
         for df in part():
@@ -152,7 +178,9 @@ def upload_partition(ctx: ExecContext, part: Partition, schema: Schema,
                     _t0 = _time.perf_counter()
                     batch = DeviceBatch.from_pandas(
                         chunk, schema=schema, dict_state=dict_state,
+                        dict_encode=dict_on,
                         dict_numerics=dict_numerics,
+                        blocked_chars=blocked,
                         device=(mesh_devs[i % len(mesh_devs)]
                                 if mesh_devs else None))
                     # host->device transfer attribution (host buffer

@@ -269,9 +269,11 @@ def _sorted_payload_reduce(batch: DeviceBatch, key_idx: List[int],
             # image, zero char reads (vs prefix+length+two poly hashes)
             per = [col.dict_codes.astype(jnp.uint64)]
         elif col.dtype.is_string:
-            lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
-            h1, h2 = hashing.string_poly_hashes(col.offsets, col.data,
-                                                col.validity)
+            # layout-aware: slab columns derive lens/prefix/hashes
+            # densely from their words, packed columns scan chars —
+            # bit-identical images either way (docs/gatherfree.md)
+            lens = col.lens_()
+            h1, h2 = hashing.string_poly_hashes_col(col)
             per = [string_prefix8(col), lens.astype(jnp.uint64), h1, h2]
         else:
             per = u64_key_image(col)
@@ -669,9 +671,10 @@ def _slot_hash_attempt(batch: DeviceBatch, key_idx: List[int], live=None):
         col = batch.columns[ki]
         if col.dtype.is_string:
             from spark_rapids_tpu.ops.sortops import string_prefix8
-            lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
-            # host-computed at upload (gather-propagated, zero char reads)
-            # or one device reconstruction pass
+            lens = col.lens_()
+            # host-computed at upload (gather-propagated, zero char reads),
+            # derived densely from the slab words, or one device
+            # reconstruction pass
             img = string_prefix8(col)
             # the raw prefix is injective over the bytes, but 0-padding
             # aliases 'a' with 'a\x00' — the length joins the agreement
@@ -935,9 +938,8 @@ def count_distinct_reduce(batch: DeviceBatch, g2_idx: List[int],
             if col.dtype.is_string and col.dict_values is not None:
                 per = [col.dict_codes.astype(jnp.uint64)]
             elif col.dtype.is_string:
-                lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
-                h1, h2 = hashing.string_poly_hashes(
-                    col.offsets, col.data, col.validity)
+                lens = col.lens_()
+                h1, h2 = hashing.string_poly_hashes_col(col)
                 per = [string_prefix8(col), lens.astype(jnp.uint64), h1, h2]
             else:
                 per = u64_key_image(col)

@@ -43,14 +43,18 @@ def row_hashes(batch: DeviceBatch, key_indices: Sequence[int],
     dictionary-encoded string columns then hash their int32 codes (exact
     per batch by construction, zero char reads) instead of running the
     char-scanning poly hashes. NEVER set for exchange/join partitioning:
-    two tables' dictionaries assign different codes to equal values."""
+    two tables' dictionaries assign different codes to equal values.
+    Cross-batch string hashing is still gather-free for encoded layouts:
+    dictionary columns gather per-VALUE hash tables by code and slab
+    columns hash densely from their words (string_poly_hashes_col) —
+    bit-identical to the char-scanning hashes, so partition assignment
+    is unchanged."""
     h1s, h2s = [], []
     for ki in key_indices:
         col = batch.columns[ki]
         if col.dtype.is_string and not (
                 batch_local and col.dict_values is not None):
-            h1, h2 = hashing.string_poly_hashes(col.offsets, col.data,
-                                                col.validity)
+            h1, h2 = hashing.string_poly_hashes_col(col)
         else:
             data = (col.dict_codes
                     if col.dtype.is_string else col.data)

@@ -97,6 +97,80 @@ def string_poly_hashes(offsets: jnp.ndarray, chars: jnp.ndarray,
     return hashes[0], hashes[1]
 
 
+# modular inverses of the poly multipliers (both odd, so invertible mod
+# 2^64): the slab hash evaluates sum c_j * q^j densely over the words and
+# multiplies by p^(len-1) once per row — bit-identical to the char-path
+# polynomial, with zero char gathers.
+Q1 = pow(P1, -1, 1 << 64)
+Q2 = pow(P2, -1, 1 << 64)
+
+
+def slab_poly_hashes(slab64: jnp.ndarray, lens: jnp.ndarray,
+                     validity: jnp.ndarray
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """string_poly_hashes over a fixed-stride char slab (blocked-chars
+    columns): all DENSE vector ops over the slab words — no per-char
+    gathers, no segment ops. Bit-identical to the packed-chars spelling
+    (bytes past each row's length are zero by the slab invariant, so they
+    contribute nothing to the q-polynomial)."""
+    import numpy as np
+    cap, w = int(slab64.shape[0]), int(slab64.shape[1])
+    stride = w * 8
+    lens64 = jnp.clip(lens, 0, stride).astype(_U64)
+    out = []
+    for p, q, salt in ((P1, Q1, SALT1), (P2, Q2, SALT2)):
+        qtab = np.empty(stride, np.uint64)
+        acc = 1
+        for j in range(stride):
+            qtab[j] = acc
+            acc = (acc * q) & ((1 << 64) - 1)
+        ptab = np.empty(stride + 1, np.uint64)
+        ptab[0] = 1  # len 0 -> S is 0, multiplier irrelevant
+        acc = 1
+        for l in range(1, stride + 1):
+            ptab[l] = acc  # p^(l-1)
+            acc = (acc * p) & ((1 << 64) - 1)
+        qt = jnp.asarray(qtab.reshape(w, 8))
+        s = jnp.zeros((cap,), _U64)
+        for b in range(8):
+            bytes_b = (slab64 >> (jnp.uint64(8) * jnp.uint64(b))) \
+                & jnp.uint64(0xFF)
+            s = s + (bytes_b * qt[None, :, b]).sum(axis=1, dtype=_U64)
+        pl = jnp.asarray(ptab)[jnp.clip(lens, 0, stride)]
+        h = splitmix64(s * pl + jnp.asarray(salt, _U64) + lens64)
+        out.append(jnp.where(validity, h,
+                             jnp.asarray(NULL_HASH, _U64)))
+    return out[0], out[1]
+
+
+def string_poly_hashes_col(col) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The two polynomial hashes of a string COLUMN, picking the cheapest
+    exact spelling for its layout (docs/gatherfree.md):
+
+      * dictionary columns gather per-VALUE hash tables by code (host-
+        computed once per dictionary; value hashes depend only on the
+        value bytes so they agree across batches AND across different
+        dictionaries — exactly what exchange partitioning needs);
+      * slab (blocked-chars) columns hash densely from the words;
+      * packed columns run the segment-op char scan.
+
+    All three produce bit-identical values, so this is safe at every
+    call site regardless of conf state."""
+    from spark_rapids_tpu.columnar import dictionary as dict_mod
+    if (col.dict_values is not None and col.dict_codes is not None
+            and dict_mod.hash_values_enabled()):
+        h1t, h2t = dict_mod.value_hash_tables(col.dict_values)
+        card = len(col.dict_values)
+        code_c = jnp.clip(col.dict_codes, 0, card)
+        null_h = jnp.asarray(NULL_HASH, _U64)
+        h1 = jnp.where(col.validity, jnp.asarray(h1t)[code_c], null_h)
+        h2 = jnp.where(col.validity, jnp.asarray(h2t)[code_c], null_h)
+        return h1, h2
+    if col.has_slab:
+        return slab_poly_hashes(col._slab64, col.lens_(), col.validity)
+    return string_poly_hashes(col.offsets, col.data, col.validity)
+
+
 def combine_hashes(hs: List[jnp.ndarray]) -> jnp.ndarray:
     """Combine per-column 64-bit hashes into one row hash."""
     out = jnp.asarray(0x243F6A8885A308D3, _U64)

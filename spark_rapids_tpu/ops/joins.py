@@ -96,7 +96,7 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
     #     prefix-chunk+hash image (64 char gathers + 2 poly-hash scans
     #     per side) that dominated string-keyed join profiles.
     import numpy as np
-    from spark_rapids_tpu.ops.hashing import string_poly_hashes
+    from spark_rapids_tpu.ops.hashing import string_poly_hashes_col
     from spark_rapids_tpu.ops.sortops import u64_key_image
     b_imgs: List[jnp.ndarray] = []
     s_imgs: List[jnp.ndarray] = []
@@ -129,9 +129,12 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
         b_imgs.extend(u64_key_image(bc))
         s_imgs.extend(u64_key_image(sc))
         if bc.dtype.is_string:
-            h1, h2 = string_poly_hashes(bc.offsets, bc.data, bc.validity)
+            # layout-aware hashes (ops/hashing.string_poly_hashes_col):
+            # one-side-dict and slab keys stay gather-free — value-table
+            # or dense-word hashes, bit-identical to the char scan
+            h1, h2 = string_poly_hashes_col(bc)
             b_imgs.extend([h1, h2])
-            h1, h2 = string_poly_hashes(sc.offsets, sc.data, sc.validity)
+            h1, h2 = string_poly_hashes_col(sc)
             s_imgs.extend([h1, h2])
             plain_str_pairs.append((bc, sc))
     assert len(b_imgs) == len(s_imgs), (len(b_imgs), len(s_imgs))
@@ -181,7 +184,10 @@ def join_probe(build: DeviceBatch, stream: DeviceBatch,
         long_present = jnp.asarray(False)
         for bcol, scol in str_pairs:
             for col, kv in ((bcol, bkv), (scol, skv)):
-                lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+                # lens_() never materializes a lazy/slab column; slab
+                # strides are bounded so slab keys can only trip the
+                # repair when the stride genuinely exceeds 64 bytes
+                lens = col.lens_()
                 long_present = long_present | jnp.any(
                     jnp.where(kv, lens, 0) > 64)
         need = long_present & jnp.any(tie)
@@ -322,14 +328,9 @@ def expand_totals(build: DeviceBatch, stream: DeviceBatch,
     source strings once); build-side totals ride a prefix sum over the
     sorted build rows."""
     def str_lens(c):
-        """Per-row byte lengths WITHOUT materializing lazy (codes-only)
-        columns: dictionary lengths ride a tiny-table row-space gather."""
-        if c.is_lazy:
-            _dchars, _dstarts, dlens = c.dict_tables()
-            card = len(c.dict_values)
-            lens = jnp.asarray(dlens)[jnp.clip(c.dict_codes, 0, card)]
-            return jnp.where(c.validity, lens, 0).astype(jnp.int64)
-        return (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+        """Per-row byte lengths WITHOUT materializing lazy (codes-only or
+        slab) columns (DeviceColumn.lens_)."""
+        return c.lens_().astype(jnp.int64)
 
     parts = [counts_adj.sum().astype(jnp.int64)]
     for c in stream.columns:

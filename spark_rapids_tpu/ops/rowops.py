@@ -77,6 +77,7 @@ def gather_columns(cols: Sequence[DeviceColumn], perm: jnp.ndarray,
             arr = arr.astype(jnp.int8)
         plans.setdefault(str(arr.dtype), []).append((arr, ci, field))
 
+    slabs: dict = {}
     for i, c in enumerate(cols):
         add(c.validity, i, "validity")
         if c.dtype.is_string:
@@ -86,6 +87,15 @@ def gather_columns(cols: Sequence[DeviceColumn], perm: jnp.ndarray,
                 # static dictionary if a consumer ever reads them. Char
                 # space (tens of MB at fact scale) is never touched here.
                 add(c.dict_codes, i, "codes")
+                continue
+            if c.has_slab:
+                # blocked chars: the fixed-stride slab moves with ONE 2-D
+                # row gather (k lane-contiguous words per index — the
+                # stacked-gather form), lens ride the packed int32 group.
+                # No char-index gather happens at all; packed chars only
+                # materialize if a downstream consumer reads them.
+                add(c.lens_(), i, "slens")
+                slabs[i] = c._slab64
                 continue
             # _ExtentColumn (concat's flat view) carries explicit extents;
             # plain columns derive them from the offsets vector
@@ -134,6 +144,14 @@ def gather_columns(cols: Sequence[DeviceColumn], perm: jnp.ndarray,
             continue
         occ = char_caps[si] if si < len(char_caps) else 0
         si += 1
+        if i in slabs:
+            slab_out = slabs[i][perm]
+            slab_out = jnp.where(live[:, None], slab_out,
+                                 jnp.uint64(0))
+            lens_out = jnp.where(live, p["slens"], 0).astype(jnp.int32)
+            out.append(DeviceColumn(c.dtype, None, validity,
+                                    slab64=slab_out, lens=lens_out))
+            continue
         if codes is not None:
             # codes-only output: chars never move (see the add() loop) —
             # the column materializes from its static dictionary only if
@@ -185,6 +203,57 @@ def _shared_dict(parts: Sequence[DeviceColumn]):
     return parts[0].dict_values
 
 
+# union-dictionary cardinality ceiling for the exchange-boundary merge:
+# beyond it the merged dictionary would stop being "small host constant"
+# material (it rides jit cache keys as aux data), so the concat decodes
+# instead — the same bound the small-table pre-seed uses.
+DICT_MERGE_MAX_CARD = 1 << 14
+
+
+def _concat_dict_info(parts: Sequence[DeviceColumn], dict_merge: bool):
+    """(values, effective per-part codes) for a concat keeping codes:
+    identical dictionaries pass through; DIFFERENT dictionaries merge by
+    union + an O(cardinality) static remap per part (the exchange-
+    boundary merge, docs/gatherfree.md) when ``dict_merge`` is on.
+    (None, None) -> the caller must decode (legacy char path)."""
+    shared = _shared_dict(parts)
+    if shared is not None:
+        return shared, [p.dict_codes for p in parts]
+    if not dict_merge:
+        return None, None
+    if any(p.dict_values is None or p.dict_codes is None for p in parts):
+        return None, None
+    from spark_rapids_tpu.columnar.dictionary import (
+        union_dictionaries_cached,
+    )
+    vals, remaps = union_dictionaries_cached(
+        [p.dict_values for p in parts])
+    if len(vals) > DICT_MERGE_MAX_CARD:
+        return None, None
+    eff = []
+    for p, r in zip(parts, remaps):
+        card_p = len(p.dict_values)
+        eff.append(jnp.asarray(r)[jnp.clip(p.dict_codes, 0, card_p)])
+    return vals, eff
+
+
+def _concat_slabs(parts: Sequence[DeviceColumn]):
+    """Per-part slabs re-padded to the widest word count, or None when
+    some part is not slab-backed (the caller then takes the char path,
+    which transparently materializes slab parts)."""
+    if any(not p.has_slab for p in parts):
+        return None
+    w_out = max(int(p._slab64.shape[1]) for p in parts)
+    out = []
+    for p in parts:
+        s = p._slab64
+        w = int(s.shape[1])
+        if w < w_out:
+            s = jnp.pad(s, ((0, 0), (0, w_out - w)))
+        out.append(s)
+    return out
+
+
 def gather_batch(batch: DeviceBatch, perm: jnp.ndarray,
                  num_rows: jnp.ndarray) -> DeviceBatch:
     out_cap = perm.shape[0]
@@ -206,7 +275,8 @@ def filter_batch(batch: DeviceBatch, keep: jnp.ndarray) -> DeviceBatch:
 def concat_batches(batches: Sequence[DeviceBatch],
                    out_capacity: int,
                    out_char_capacity: int = 0,
-                   keep_masks: Optional[Sequence[jnp.ndarray]] = None
+                   keep_masks: Optional[Sequence[jnp.ndarray]] = None,
+                   dict_merge: bool = True
                    ) -> DeviceBatch:
     """Concatenate batches into one of ``out_capacity`` (device analogue of
     cuDF Table.concatenate under GpuCoalesceBatches).
@@ -277,6 +347,18 @@ def concat_batches(batches: Sequence[DeviceBatch],
             base = base + b.num_rows.astype(jnp.int32)
         return out
 
+    def _block_copy2d(arrs):
+        # slab rows: same contiguous block-copy trick, one word-matrix
+        # per part landing at its dynamic row base
+        w = int(arrs[0].shape[1])
+        out = jnp.zeros((out_capacity, w), arrs[0].dtype)
+        base = jnp.asarray(0, jnp.int32)
+        for arr, b in zip(arrs, batches):
+            out = jax.lax.dynamic_update_slice(
+                out, arr, (base, jnp.asarray(0, jnp.int32)))
+            base = base + b.num_rows.astype(jnp.int32)
+        return out
+
     blockable = keep_masks is None and all(
         b.capacity <= out_capacity for b in batches)
 
@@ -290,14 +372,27 @@ def concat_batches(batches: Sequence[DeviceBatch],
     block_out: dict = {}
     for ci, dt in enumerate(schema.dtypes):
         parts = [b.columns[ci] for b in batches]
-        shared = _shared_dict(parts)
+        shared, eff_codes = _concat_dict_info(parts, dict_merge)
+        slab_parts = (_concat_slabs(parts)
+                      if dt.is_string and shared is None else None)
+        if blockable and dt.is_string and slab_parts is not None:
+            # blocked chars: slab rows block-copy exactly like fixed-
+            # width payloads — 2-D contiguous copies, no char gather
+            validity = _block_copy([p.validity for p in parts]) & live_out
+            lens_b = jnp.where(live_out,
+                               _block_copy([p.lens_() for p in parts]),
+                               0).astype(jnp.int32)
+            slab_b = jnp.where(live_out[:, None],
+                               _block_copy2d(slab_parts), jnp.uint64(0))
+            block_out[ci] = DeviceColumn(dt, None, validity,
+                                         slab64=slab_b, lens=lens_b)
+            continue
         if blockable and (not dt.is_string or shared is not None):
             validity = _block_copy([p.validity for p in parts]) & live_out
             if dt.is_string:
                 card = len(shared)
                 codes_b = jnp.where(live_out, _block_copy(
-                    [p.dict_codes for p in parts],
-                    fill=jnp.int32(card)), jnp.int32(card))
+                    eff_codes, fill=jnp.int32(card)), jnp.int32(card))
                 block_out[ci] = DeviceColumn(
                     dt, None, validity, dict_codes=codes_b,
                     dict_values=shared)
@@ -306,20 +401,30 @@ def concat_batches(batches: Sequence[DeviceBatch],
                 if shared is not None:
                     card = len(shared)
                     codes_b = jnp.where(live_out, _block_copy(
-                        [p.dict_codes for p in parts],
-                        fill=jnp.int32(card)), jnp.int32(card))
+                        eff_codes, fill=jnp.int32(card)), jnp.int32(card))
                 block_out[ci] = DeviceColumn(
                     dt, _block_copy([p.data for p in parts]), validity,
                     dict_codes=codes_b, dict_values=shared)
             continue
-        codes = (jnp.concatenate([p.dict_codes for p in parts])
+        codes = (jnp.concatenate(eff_codes)
                  if shared is not None else None)
         if dt.is_string and shared is not None:
             # dictionary strings concat as codes only — no char extents,
-            # no char slab reads (and lazy inputs stay unmaterialized)
+            # no char slab reads (and lazy inputs stay unmaterialized);
+            # differing dictionaries merged by union+remap above
             flat_cols.append(DeviceColumn(
                 dt, None, jnp.concatenate([p.validity for p in parts]),
                 dict_codes=codes, dict_values=shared))
+            char_caps.append(0)
+            continue
+        if dt.is_string and slab_parts is not None:
+            # slab flat view: rows are self-contained (no cross-part
+            # offset bases), so the compaction gather moves slab rows
+            # directly — including under keep_masks
+            flat_cols.append(DeviceColumn(
+                dt, None, jnp.concatenate([p.validity for p in parts]),
+                slab64=jnp.concatenate(slab_parts),
+                lens=jnp.concatenate([p.lens_() for p in parts])))
             char_caps.append(0)
             continue
         if dt.is_string:

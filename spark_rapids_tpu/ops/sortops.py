@@ -74,7 +74,36 @@ def _string_prefix_chunks(col: DeviceColumn) -> List[jnp.ndarray]:
     Bytes pack raw into full 8-bit lanes (a +1 shift would overflow 0xff
     into the neighbouring lane and collapse distinct strings); past-end
     positions pack as 0x00 and the final length key settles the
-    prefix-of case ('a' < 'ab'), which is exact for raw 0-padding."""
+    prefix-of case ('a' < 'ab'), which is exact for raw 0-padding.
+
+    Gather-free forms (bit-identical images, docs/gatherfree.md):
+      * dictionary columns gather per-VALUE host tables by code — the
+        images are pure functions of the value bytes, so they compare
+        exactly against ANY other column's images (unlike raw codes);
+      * slab (blocked-chars) columns derive each chunk densely from the
+        fixed-stride words — a byte swap per word, zero char gathers
+        (bytes past each row's length are zero by the slab invariant,
+        matching the char path's 0-padding)."""
+    if col.dict_values is not None and col.dict_codes is not None:
+        from spark_rapids_tpu.columnar.dictionary import (
+            value_prefix_chunk_tables,
+        )
+        tables = value_prefix_chunk_tables(col.dict_values)
+        card = len(col.dict_values)
+        code_c = jnp.clip(col.dict_codes, 0, card)
+        return [jnp.asarray(t)[code_c] for t in tables]
+    if col.has_slab:
+        from spark_rapids_tpu.columnar.column import _bswap64
+        w = int(col._slab64.shape[1])
+        capacity = int(col._slab64.shape[0])
+        chunks = []
+        for c in range(STRING_PREFIX_CHUNKS):
+            if c < w:
+                chunks.append(_bswap64(col._slab64[:, c]))
+            else:
+                chunks.append(jnp.zeros((capacity,), jnp.uint64))
+        chunks.append(col.lens_().astype(jnp.uint64))
+        return chunks
     capacity = col.offsets.shape[0] - 1
     nchars = col.data.shape[0]
     lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
@@ -99,6 +128,8 @@ def string_prefix8(col: DeviceColumn) -> jnp.ndarray:
     pass — the single spelling shared by the slot-hash and payload-sort
     aggregation paths (0-padded past-end bytes; pair with the length as a
     separate image, 'a' vs 'a\\x00' alias otherwise)."""
+    # NB slab columns are served by the property read above: prefix8
+    # derives (and caches) the byte-swapped word 0 — one spelling
     if getattr(col, "prefix8", None) is not None:
         return col.prefix8
     capacity = col.offsets.shape[0] - 1

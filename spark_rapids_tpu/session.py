@@ -503,6 +503,10 @@ class TpuSparkSession:
 
         conf = self.conf
         ctx = ExecContext(conf, self)
+        # gather-free execution flags (docs/gatherfree.md): per-value hash
+        # tables, exchange-boundary dictionary merge, codes-on-the-wire
+        from spark_rapids_tpu.columnar import dictionary as _dictionary
+        _dictionary.configure_from_conf(conf)
         # per-query tracer window: configure from conf, clear so an
         # exported file holds exactly this query (a speculation re-run is
         # part of the same query and keeps its spans)
@@ -658,6 +662,13 @@ class TpuSparkSession:
         logical = prune_filter_columns(logical)
         annotate_scan_pruning(logical)
         planner = Planner(conf)
+        # tiny-query overhead-floor fast path: single-partition planning
+        # + semaphore/shrink-sync/bookkeeping elision (docs/gatherfree.md);
+        # mesh execution keeps the general plan (data is born distributed)
+        if getattr(self, "mesh", None) is None:
+            planner.note_input_size(logical)
+        ctx.small_query = planner.small_query
+        ctx.small_query_keep_sem = planner.small_query_keep_sem
         if isinstance(logical, lp.LogicalLimit):
             # root-position limit plans as one CollectLimit operator
             cpu_plan = planner.plan_collect_limit(logical)
@@ -781,8 +792,12 @@ class TpuSparkSession:
                 self.release_active_shuffles(ctx)
                 self.release_transient_buffers(ctx)
                 prev_progress = ctx.progress
+                small = ctx.small_query
+                keep_sem = ctx.small_query_keep_sem
                 ctx = ExecContext(conf, self, speculate=False)
                 ctx.progress = prev_progress  # same query, same record
+                ctx.small_query = small
+                ctx.small_query_keep_sem = keep_sem
                 # re-point this thread's execution scope at the fresh
                 # context so the re-run's registrations release with IT
                 self._exec_scope.ctx = ctx

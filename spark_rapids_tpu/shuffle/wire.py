@@ -17,6 +17,16 @@ Layout (little-endian):
     dtype_len u8, dtype name bytes
     data_len u64, validity_len u64, offsets_len u64
   then per column: data bytes, validity bytes, offsets bytes
+
+Version 2 (spark.rapids.sql.dict.wire, docs/gatherfree.md) adds a
+``kind`` byte per column after the dtype name: 0 = plain (v1 layout),
+1 = dictionary-encoded string — ``data`` then carries int32 CODES,
+``offsets_len`` covers a values blob (u32 count, then per value u32 len +
+utf-8 bytes) instead of an offsets vector, and the reduce side rebuilds
+the column codes-only: dictionary columns cross the shuffle without ever
+materializing a char slab on either end. v1 frames stay byte-identical
+(and the native writer keeps producing them); a frame is only written as
+v2 when it actually contains a dictionary column.
 """
 
 from __future__ import annotations
@@ -115,11 +125,94 @@ def _serialize_native(schema: Schema, num_rows: int, columns) -> bytes:
     return dest.raw[:size]
 
 
+def _np_dict_packed(col, n: int):
+    """Host-side packed chars+offsets of a dictionary column's first ``n``
+    rows, rebuilt from fetched CODES through the static dictionary —
+    zero device char work (the v1-compat spelling when dict.wire is
+    off)."""
+    codes = np.asarray(col.dict_codes[:n], dtype=np.int32)
+    validity = np.asarray(col.validity[:n])
+    vals_b = [v.encode("utf-8") for v in col.dict_values]
+    card = len(vals_b)
+    lens_tab = np.asarray([len(v) for v in vals_b] + [0], np.int64)
+    starts_tab = np.zeros(card + 1, np.int64)
+    starts_tab[1:] = np.cumsum(lens_tab[:-1])
+    dchars = np.frombuffer(b"".join(vals_b) or b"\0", np.uint8)
+    code_c = np.clip(codes, 0, card)
+    lens = np.where(validity, lens_tab[code_c], 0)
+    offsets = np.zeros(n + 1, np.int32)
+    offsets[1:] = np.cumsum(lens).astype(np.int32)
+    # vectorized char emission (the np_slab_to_packed mask trick): one
+    # table gather over (n, maxlen) — no per-row Python loop
+    maxlen = int(lens_tab[:card].max()) if card else 0
+    if n and maxlen:
+        j = np.arange(maxlen)
+        idx = np.clip(starts_tab[code_c][:, None] + j[None, :], 0,
+                      len(dchars) - 1)
+        mask = j[None, :] < lens[:, None]
+        chars = np.ascontiguousarray(dchars[idx][mask])
+    else:
+        chars = np.empty(0, np.uint8)
+    return chars, validity, offsets
+
+
+def _dict_values_blob(values: tuple) -> bytes:
+    parts = [struct.pack("<I", len(values))]
+    for v in values:
+        raw = v.encode("utf-8")
+        parts.append(struct.pack("<I", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def _dict_values_unblob(blob) -> tuple:
+    (count,) = struct.unpack_from("<I", blob, 0)
+    pos = 4
+    out = []
+    for _ in range(count):
+        (ln,) = struct.unpack_from("<I", blob, pos)
+        pos += 4
+        out.append(bytes(blob[pos:pos + ln]).decode("utf-8"))
+        pos += ln
+    return tuple(out)
+
+
 def serialize_batch(batch: DeviceBatch) -> bytes:
-    """Device batch -> wire bytes (one device->host copy of the live rows)."""
+    """Device batch -> wire bytes (one device->host copy of the live rows).
+
+    Layout-aware (docs/gatherfree.md): dictionary string columns ship as
+    codes (+ the values blob, v2) or rebuild packed chars HOST-side from
+    codes (v1 rollback) — never a device char gather; slab columns fetch
+    words+lens and pack host-side."""
+    from spark_rapids_tpu.columnar import dictionary as dict_mod
+    from spark_rapids_tpu.columnar.column import np_slab_to_packed
     n = batch.num_rows_host()
+    dict_wire = dict_mod.wire_enabled()
     cols = []
+    kinds = []
     for col, dt in zip(batch.columns, batch.schema.dtypes):
+        if dt.is_string and col.dict_values is not None \
+                and col.dict_codes is not None:
+            if dict_wire:
+                codes = np.ascontiguousarray(
+                    np.asarray(col.dict_codes[:n], dtype=np.int32))
+                validity = np.asarray(col.validity[:n])
+                cols.append((codes, validity,
+                             ("dict", col.dict_values)))
+                kinds.append(1)
+                continue
+            chars, validity, offsets = _np_dict_packed(col, n)
+            cols.append((chars, validity, offsets))
+            kinds.append(0)
+            continue
+        if dt.is_string and col.has_slab:
+            validity = np.asarray(col.validity[:n])
+            slab = np.asarray(col._slab64[:n])
+            lens = np.asarray(col._lens[:n])
+            chars, offsets = np_slab_to_packed(slab, lens, validity)
+            cols.append((chars, validity, offsets))
+            kinds.append(0)
+            continue
         if dt.is_string:
             offsets = np.asarray(col.offsets[:n + 1], dtype=np.int32)
             nchars = int(offsets[-1]) if n else 0
@@ -129,7 +222,32 @@ def serialize_batch(batch: DeviceBatch) -> bytes:
             data = np.ascontiguousarray(np.asarray(col.data[:n]))
         validity = np.asarray(col.validity[:n])
         cols.append((data, validity, offsets))
+        kinds.append(0)
+    if any(kinds):
+        return _serialize_v2(batch.schema, n, cols, kinds)
     return serialize_host_table(batch.schema, n, cols)
+
+
+def _serialize_v2(schema: Schema, num_rows: int, columns, kinds) -> bytes:
+    head = [struct.pack("<IIII", MAGIC, 2, num_rows, len(schema))]
+    bufs = []
+    for (name, dt), (data, validity, offsets), kind in zip(
+            zip(schema.names, schema.dtypes), columns, kinds):
+        nb = name.encode("utf-8")
+        db = dt.name.encode("ascii")
+        data_b = data.tobytes()
+        val_b = np.packbits(validity.astype(np.bool_),
+                            bitorder="little").tobytes()
+        if kind == 1:
+            off_b = _dict_values_blob(offsets[1])
+        else:
+            off_b = offsets.tobytes() if offsets is not None else b""
+        head.append(struct.pack("<H", len(nb)) + nb)
+        head.append(struct.pack("<B", len(db)) + db)
+        head.append(struct.pack("<B", kind))
+        head.append(struct.pack("<QQQ", len(data_b), len(val_b), len(off_b)))
+        bufs.extend((data_b, val_b, off_b))
+    return b"".join(head + bufs)
 
 
 def deserialize_table(buf: bytes):
@@ -138,19 +256,27 @@ def deserialize_table(buf: bytes):
     mv = memoryview(buf)
     magic, version, nrows, ncols = struct.unpack_from("<IIII", mv, 0)
     assert magic == MAGIC, "bad magic in shuffle payload"
-    assert version == VERSION, f"unsupported wire version {version}"
+    assert version in (VERSION, 2), f"unsupported wire version {version}"
     pos = 16
-    names, dts, extents = [], [], []
+    names, dts, extents, kinds = [], [], [], []
     for _ in range(ncols):
         (nlen,) = struct.unpack_from("<H", mv, pos); pos += 2
         names.append(bytes(mv[pos:pos + nlen]).decode("utf-8")); pos += nlen
         (dlen,) = struct.unpack_from("<B", mv, pos); pos += 1
         dts.append(dtypes.by_name(bytes(mv[pos:pos + dlen]).decode("ascii")))
         pos += dlen
+        if version >= 2:
+            (kind,) = struct.unpack_from("<B", mv, pos); pos += 1
+        else:
+            kind = 0
+        kinds.append(kind)
         extents.append(struct.unpack_from("<QQQ", mv, pos)); pos += 24
     cols = []
-    for dt, (data_len, val_len, off_len) in zip(dts, extents):
-        if dt.is_string:
+    for dt, kind, (data_len, val_len, off_len) in zip(dts, kinds, extents):
+        if kind == 1:
+            data = np.frombuffer(mv, dtype=np.int32, count=data_len // 4,
+                                 offset=pos)
+        elif dt.is_string:
             data = np.frombuffer(mv, dtype=np.uint8, count=data_len,
                                  offset=pos)
         else:
@@ -164,8 +290,14 @@ def deserialize_table(buf: bytes):
         pos += val_len
         offsets = None
         if off_len:
-            offsets = np.frombuffer(mv, dtype=np.int32, count=off_len // 4,
-                                    offset=pos)
+            if kind == 1:
+                # dictionary column: the third buffer is the values blob;
+                # surface it as ("dict", values) so deserialize_batch can
+                # rebuild the column CODES-ONLY
+                offsets = ("dict", _dict_values_unblob(mv[pos:pos + off_len]))
+            else:
+                offsets = np.frombuffer(mv, dtype=np.int32,
+                                        count=off_len // 4, offset=pos)
             pos += off_len
         cols.append((data, validity, offsets))
     return Schema(names, dts), nrows, cols
@@ -181,6 +313,21 @@ def deserialize_batch(buf: bytes) -> DeviceBatch:
     cap = bucket_capacity(max(nrows, 1))
     out = []
     for dt, (data, validity, offsets) in zip(schema.dtypes, cols):
+        if isinstance(offsets, tuple) and offsets and offsets[0] == "dict":
+            # dictionary column off the wire: rebuild CODES-ONLY — the
+            # reduce side keeps late materialization going (chars only
+            # ever rebuild from the static dictionary on demand)
+            values = offsets[1]
+            card = len(values)
+            codes = np.full(cap, card, np.int32)
+            codes[:nrows] = data
+            codes[:nrows][~validity] = card
+            vpad = np.zeros(cap, np.bool_)
+            vpad[:nrows] = validity
+            out.append(DeviceColumn(dt, None, jnp.asarray(vpad),
+                                    dict_codes=jnp.asarray(codes),
+                                    dict_values=values))
+            continue
         if dt.is_string:
             strings_cap = _char_bucket(max(len(data), 1))
             chars = np.zeros(strings_cap, np.uint8)

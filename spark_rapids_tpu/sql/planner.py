@@ -23,6 +23,84 @@ from spark_rapids_tpu.sql.exprs.core import bind_references
 class Planner:
     def __init__(self, conf):
         self.conf = conf
+        # tiny-query overhead-floor fast path
+        # (spark.rapids.sql.smallQuery.*): when every leaf source of the
+        # logical plan reports a known row count and the total fits one
+        # resident batch under the threshold, plan every exchange
+        # single-partition — hash/range partitioning degenerates to the
+        # exchange's LOCAL collapse (no row hashing, no pid sort, no
+        # per-bucket slices) and the session skips the semaphore and the
+        # collapse's shrink sync (exec/tpu.py, exec/transitions.py).
+        self.small_query = False
+        # row-EXPANDING plans (joins, explode, grouping-set expand) can
+        # blow a tiny input far past one resident batch, so they keep the
+        # HBM admission semaphore even when the fast path engages; only
+        # the exchange collapse + bookkeeping elision apply to them
+        self.small_query_keep_sem = False
+
+    def _shuffle_n(self) -> int:
+        return 1 if self.small_query else self.conf.shuffle_partitions
+
+    def note_input_size(self, logical: lp.LogicalPlan) -> None:
+        """Inspect the logical plan's leaf sources BEFORE planning and
+        engage the small-query fast path when the measured input is a
+        single resident batch under the threshold. Unknown-size sources
+        (file scans without footer counts) disengage — the fast path
+        never guesses."""
+        if not self.conf.get_bool("spark.rapids.sql.smallQuery.enabled",
+                                  True):
+            return
+        # TPU-path optimization only: the CPU (oracle/fallback) path keeps
+        # its partitioning so fallback behavior — and CPU-side
+        # observability like per-exchange skew — is unchanged
+        if not self.conf.sql_enabled:
+            return
+        # the fast path degenerates exchanges to single-partition LOCAL
+        # collapses — modes whose whole point is multi-partition exchange
+        # machinery (AQE stage stats, the shuffle-manager transport wire,
+        # multi-executor striping) keep the general plan
+        if self.conf.get_bool("spark.rapids.sql.adaptive.enabled", False):
+            return
+        if self.conf.get_bool("spark.rapids.shuffle.transport.enabled",
+                              False):
+            return
+        if self.conf.get_int("spark.rapids.shuffle.executors", 1) > 1:
+            return
+        if str(self.conf.get("spark.rapids.tpu.shuffle.transport.mode",
+                             "legacy")) != "legacy":
+            return
+        # an EXPLICIT partition-count setting wins over the collapse: the
+        # user asked for that fan-out (repartition tests, skew probes,
+        # file-count-shaping writes)
+        if "spark.rapids.sql.shuffle.partitions" in getattr(
+                self.conf, "_settings", {}):
+            return
+        max_rows = min(
+            self.conf.get_int("spark.rapids.sql.smallQuery.maxRows", 32768),
+            self.conf.batch_size_rows)
+        total = 0
+        expanding = False
+        stack = [logical]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children)
+            if isinstance(node, (lp.LogicalJoin, lp.LogicalGenerate,
+                                 lp.LogicalExpand)):
+                expanding = True
+            if isinstance(node, lp.LogicalScan):
+                df = getattr(node.source, "df", None)
+                if df is None:
+                    return  # unknown-size source: stay on the general path
+                total += len(df)
+            elif isinstance(node, lp.LogicalRange):
+                if not node.step:
+                    return
+                total += max(
+                    0, -(-(node.end - node.start) // node.step))
+            if total > max_rows:
+                return
+        self.small_query = True
+        self.small_query_keep_sem = expanding
 
     def plan(self, node: lp.LogicalPlan) -> PhysicalPlan:
         fn = getattr(self, f"_plan_{type(node).__name__}", None)
@@ -76,7 +154,7 @@ class Planner:
         if plan.num_keys == 0:
             exchange = cpu.CpuShuffleExchangeExec(partial, ("single",))
         else:
-            n = self.conf.shuffle_partitions
+            n = self._shuffle_n()
             exchange = cpu.CpuShuffleExchangeExec(
                 partial, ("hash", list(range(plan.num_keys)), n))
         return cpu.CpuHashAggregateExec(exchange, plan, "final")
@@ -90,7 +168,7 @@ class Planner:
             # columns (reference: GpuRangePartitioner.scala + Spark's
             # rangepartitioning requirement); single-partition otherwise
             from spark_rapids_tpu.sql.exprs.core import BoundRef
-            n = self.conf.shuffle_partitions
+            n = self._shuffle_n()
             simple = all(isinstance(o.expr, BoundRef) for o in orders)
             if simple and n > 1:
                 child = cpu.CpuShuffleExchangeExec(
@@ -172,7 +250,7 @@ class Planner:
             else:
                 right = cpu.CpuBroadcastExchangeExec(right)
             return cpu.CpuBroadcastHashJoinExec(left, right, jt, lidx, ridx)
-        n = self.conf.shuffle_partitions
+        n = self._shuffle_n()
         left = cpu.CpuShuffleExchangeExec(left, ("hash", lidx, n))
         right = cpu.CpuShuffleExchangeExec(right, ("hash", ridx, n))
         return cpu.CpuJoinExec(left, right, jt, lidx, ridx)
@@ -236,7 +314,7 @@ class Planner:
         pidx = [e.index for e in spec0.partition_cols
                 if isinstance(e, BoundRef)]
         if spec0.partition_cols and len(pidx) == len(spec0.partition_cols):
-            n = self.conf.shuffle_partitions
+            n = self._shuffle_n()
             child = cpu.CpuShuffleExchangeExec(child, ("hash", pidx, n))
         else:
             child = cpu.CpuShuffleExchangeExec(child, ("single",))

@@ -11,17 +11,27 @@ Per-call times include ~0.09s of forced-sync round trip on the tunneled
 attachment; the report subtracts that baseline per call. Peak numbers:
 TPU v5e ≈ 394 TFLOP/s bf16, ≈ 819 GB/s HBM.
 
-Usage: SRT_KERNEL_PROFILE=1 python tools/roofline.py [query ...]
-Writes a markdown table to stdout (docs/roofline_r5.md is the committed
-capture).
+Usage:
+  SRT_KERNEL_PROFILE=1 python tools/roofline.py [query ...]
+      run the probe and WRITE the versioned artifacts docs/roofline.json
+      + docs/roofline.md (override with ROOFLINE_OUT_DIR); when a
+      previous docs/roofline.json exists it is compared against first,
+      so gather-path wins are provable per round.
+  python tools/roofline.py --compare BASE.json NEW.json
+      compare two committed artifacts without running anything.
+
+docs/roofline_r5.md is the round-5 hand-captured table; docs/roofline.*
+are the tool-written artifacts from this mode onward.
 """
+import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if os.environ.get("SRT_KERNEL_PROFILE") != "1":
+if "--compare" not in sys.argv \
+        and os.environ.get("SRT_KERNEL_PROFILE") != "1":
     print("re-exec with SRT_KERNEL_PROFILE=1", file=sys.stderr)
     os.environ["SRT_KERNEL_PROFILE"] = "1"
     os.execv(sys.executable, [sys.executable] + sys.argv)
@@ -30,7 +40,69 @@ HBM_PEAK_GBS = 819.0
 BF16_PEAK_TFLOPS = 394.0
 SYNC_BASELINE_S = 0.09  # forced per-call completion fetch round trip
 
-QUERIES = sys.argv[1:] or ["q1", "q9", "q16", "tpcxbb.q28", "mortgage.etl"]
+QUERIES = [a for a in sys.argv[1:] if not a.startswith("-")] \
+    or ["q1", "q9", "q16", "tpcxbb.q28", "mortgage.etl"]
+OUT_DIR = os.environ.get("ROOFLINE_OUT_DIR") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs")
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    assert isinstance(doc.get("queries"), dict), f"{path}: not a roofline artifact"
+    return doc
+
+
+def compare_artifacts(base: dict, new: dict) -> str:
+    """Per-query GB/s + wall deltas between two roofline artifacts: the
+    per-round proof that the gather-bound kernels moved toward memory
+    speed (or quietly fell back)."""
+    lines = ["| query | GB/s base | GB/s new | Δ | % peak new | "
+             "wall base | wall new |", "|---|---|---|---|---|---|---|"]
+    common = sorted(set(base["queries"]) & set(new["queries"]))
+    for q in common:
+        b, n = base["queries"][q], new["queries"][q]
+        d = (n["gbs"] / b["gbs"] - 1.0) * 100 if b.get("gbs") else 0.0
+        lines.append(
+            f"| {q} | {b.get('gbs')} | {n.get('gbs')} | {d:+.0f}% "
+            f"| {n.get('pct_hbm_peak')}% | {b.get('wall_s')}s "
+            f"| {n.get('wall_s')}s |")
+    for q in sorted(set(base["queries"]) - set(new["queries"])):
+        lines.append(f"| {q} | (dropped from new) | | | | | |")
+    for q in sorted(set(new["queries"]) - set(base["queries"])):
+        lines.append(f"| {q} | (new) | {new['queries'][q].get('gbs')} "
+                     f"| | {new['queries'][q].get('pct_hbm_peak')}% | "
+                     f"| {new['queries'][q].get('wall_s')}s |")
+    return "\n".join(lines)
+
+
+def write_artifacts(doc: dict) -> None:
+    os.makedirs(OUT_DIR, exist_ok=True)
+    jpath = os.path.join(OUT_DIR, "roofline.json")
+    prev = None
+    if os.path.exists(jpath):
+        try:
+            prev = load_artifact(jpath)
+        except Exception:
+            prev = None
+    with open(jpath, "w") as f:
+        json.dump(doc, f, indent=1)
+    md = ["# Roofline capture (tools/roofline.py)", "",
+          f"SF={doc['sf']}, HBM peak {HBM_PEAK_GBS} GB/s.", "",
+          "| query | top kernel | calls | t(s) | t-sync(s) | MB moved "
+          "| GB/s | % HBM peak | wall(s) |", "|---|---|---|---|---|---|---|---|---|"]
+    for q, r in doc["queries"].items():
+        md.append(f"| {q} | `{r['kernel']}` | {r['calls']} | {r['total_s']} "
+                  f"| {r['compute_s']} | {r['mb_moved']} | {r['gbs']} "
+                  f"| {r['pct_hbm_peak']} | {r['wall_s']} |")
+    if prev is not None:
+        md += ["", "## vs previous committed artifact", "",
+               compare_artifacts(prev, doc)]
+        print("\n-- vs previous docs/roofline.json --")
+        print(compare_artifacts(prev, doc))
+    with open(os.path.join(OUT_DIR, "roofline.md"), "w") as f:
+        f.write("\n".join(md) + "\n")
+    print(f"roofline: wrote {jpath} and roofline.md")
 
 
 def main():
@@ -113,6 +185,27 @@ def main():
         print(f"| {r[0]} | `{r[1]}` | {r[2]} | {r[3]} | {r[4]} | {r[5]} "
               f"| {r[6]} | {r[7]} |")
 
+    write_artifacts({
+        "sf": sf,
+        "hbm_peak_gbs": HBM_PEAK_GBS,
+        "sync_baseline_s": SYNC_BASELINE_S,
+        "queries": {
+            r[0]: {"kernel": r[1], "calls": r[2], "total_s": r[3],
+                   "compute_s": r[4], "mb_moved": r[5], "gbs": r[6],
+                   "pct_hbm_peak": r[7], "wall_s": r[9]}
+            for r in rows},
+    })
+
 
 if __name__ == "__main__":
+    if "--compare" in sys.argv:
+        i = sys.argv.index("--compare")
+        try:
+            b, n = sys.argv[i + 1], sys.argv[i + 2]
+        except IndexError:
+            print("usage: roofline.py --compare BASE.json NEW.json",
+                  file=sys.stderr)
+            sys.exit(2)
+        print(compare_artifacts(load_artifact(b), load_artifact(n)))
+        sys.exit(0)
     main()
