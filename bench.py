@@ -272,7 +272,15 @@ def _worker():
         # tiny-query overhead-floor fast path: bench default ON;
         # BENCH_SMALL_QUERY=0 restores general-path planning
         "spark.rapids.sql.smallQuery.enabled",
-        os.environ.get("BENCH_SMALL_QUERY", "1") != "0").get_or_create()
+        os.environ.get("BENCH_SMALL_QUERY", "1") != "0").config(
+        # one-pass hash aggregation (docs/hashagg.md): bench default OFF
+        # — on CPU attachments the jnp twin runs the slot table as
+        # scatter rounds and measures ~6% behind sort+segment on the
+        # tpcxbb q5 grouping tail it targets; BENCH_HASH_AGG=1 opts the
+        # sweep into the hash partial pass (the Pallas kernel's home is
+        # a directly-attached chip, see docs/hashagg.md)
+        "spark.rapids.sql.agg.hashAggEnabled",
+        os.environ.get("BENCH_HASH_AGG", "0") != "0").get_or_create()
 
     # cross-process shared compile cache + AOT pre-warm: point two
     # sweeps at the same BENCH_SHARED_CACHE_DIR (and feed the second the

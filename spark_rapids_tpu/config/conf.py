@@ -171,6 +171,46 @@ AGG_SKIP_RATIO = register(
     "faster skipped). 1.0 disables skipping.",
     validator=_fraction(0.0, 1.0))
 
+AGG_HASH_ENABLED = register(
+    "spark.rapids.sql.agg.hashAggEnabled", _to_bool, False,
+    "One-pass open-addressing hash aggregation "
+    "(ops/pallas_kernels.hash_grouped_aggregate): rows claim slots in a "
+    "load-factor-1/2 table and fold sum/min/max/count accumulators in "
+    "the same probe walk — no sort, no segment scan. Engages for "
+    "exact-one-word key images (fixed-width values, dictionary codes) "
+    "where the dense-key path cannot and the payload-sort path is the "
+    "fallback today; batches whose table exceeds "
+    "spark.rapids.sql.agg.hash.maxTableSlots recurse through the "
+    "out-of-core hash fan-out into in-budget sub-aggregations. Under "
+    "SPARK_RAPIDS_TPU_PALLAS=1 on a directly attached TPU the Pallas "
+    "slot-table kernel runs; otherwise the vectorized jnp twin "
+    "(identical contract, docs/hashagg.md). Off by default this round.")
+
+AGG_HASH_MAX_SLOTS = register(
+    "spark.rapids.sql.agg.hash.maxTableSlots", int, 1 << 17,
+    "Slot-count bound of the hash-aggregation table "
+    "(spark.rapids.sql.agg.hashAggEnabled). The compiled Pallas kernel "
+    "keeps the whole (keys x slots) uint64 table VMEM-resident in a "
+    "single-step grid, so the bound is a VMEM budget: at the default "
+    "128Ki slots a 2-image key table is 2MiB plus accumulators. Batches "
+    "sizing past the bound split by key hash (exec/outofcore.py) and "
+    "aggregate per bucket — a handful of in-VMEM passes instead of one "
+    "oversized table.", validator=_positive)
+
+AGG_RUNTIME_SKIP = register(
+    "spark.rapids.sql.agg.runtimeSkip", _to_bool, True,
+    "AQE-style RUNTIME decision for the partial-aggregation skip: "
+    "instead of committing to the first execution's first-batch ratio "
+    "forever (the session-cache heuristic this replaces), the partial "
+    "pass measures output_groups/input_rows per batch and flips to "
+    "passthrough MID-STREAM once the cumulative measured ratio exceeds "
+    "spark.rapids.sql.agg.skipAggPassReductionRatio — already-reduced "
+    "partials flush as-is (the final aggregate reduces any mix). Each "
+    "decision is journaled (aggSkipDecision event) with the measured "
+    "rate, and decided signatures still seed the session cache so later "
+    "executions skip from batch 0. false restores the legacy "
+    "first-batch-only heuristic.")
+
 CACHE_DEVICE_SCANS = register(
     "spark.rapids.sql.cacheDeviceScans", _to_bool, False,
     "Keep uploaded scan batches resident in device memory across query "

@@ -221,6 +221,34 @@ class SpilledPartitions:
         return out
 
 
+def split_batch_by_hash(ctx, key_idx, batch: DeviceBatch, n: int,
+                        level: int, growth: float) -> List[DeviceBatch]:
+    """In-memory hash fan-out of ONE batch into <= n disjoint-key slices
+    (equal keys co-locate; empty buckets are dropped). The light sibling
+    of SpilledPartitions.add_batch — same partitioner and slice kernels,
+    no spill-store registration — used by the hash-aggregation VMEM
+    bound (exec/tpu.py): a batch whose slot table would exceed
+    spark.rapids.sql.agg.hash.maxTableSlots splits here and aggregates
+    per slice, the disjoint key sets making the slices' partial outputs
+    union to exactly the whole batch's groups."""
+    split = hash_split_kernel(key_idx, n, level)
+    sorted_b, counts = split(batch)
+    host_counts = np.asarray(jax.device_get(counts))
+    offsets = np.concatenate([[0], np.cumsum(host_counts)])
+    out: List[DeviceBatch] = []
+    for p in range(n):
+        c = int(host_counts[p])
+        if c == 0:
+            continue
+        out_cap = bucket_capacity(c, growth)
+        kern = cached_jit(f"slice|{out_cap}", lambda oc=out_cap: jax.jit(
+            lambda bb, s, cc: rowops.slice_batch_to(bb, s, cc, oc)))
+        out.append(kern(sorted_b, jnp.asarray(int(offsets[p]), jnp.int32),
+                        jnp.asarray(c, jnp.int32)))
+    _record(ctx, "hashAggSplit", n, batch.device_memory_size(), 0, level)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # partition-id kernels
 # ---------------------------------------------------------------------------

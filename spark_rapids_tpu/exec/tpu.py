@@ -379,6 +379,16 @@ class TpuHashAggregateExec(TpuExec):
                     b, key_exprs, p.update_inputs, reductions,
                     p.partial_schema, mask_expr=pre_mask,
                     dense=(los, sizes))))
+            # one-pass hash-aggregation variant (spark.rapids.sql.agg.
+            # hashAggEnabled): same program, the slot-table branch armed
+            # with its slot budget — _hash_payload_reduce declines at
+            # TRACE time where inapplicable, so this kernel is safe for
+            # any batch
+            self._hash_update = lambda mt: cached_jit(
+                f"aggupd|{p.signature}{mask_sig}|hash{mt}",
+                lambda: jax.jit(lambda b: agg_ops.aggregate_update(
+                    b, key_exprs, p.update_inputs, reductions,
+                    p.partial_schema, mask_expr=pre_mask, hash_table=mt)))
             # adaptive low-reduction skip: rows projected straight into the
             # partial layout (spark.rapids.sql.agg.skipAggPassReductionRatio)
             self._passthrough_kernel = cached_jit(
@@ -408,6 +418,11 @@ class TpuHashAggregateExec(TpuExec):
             lambda: jax.jit(lambda b, los: agg_ops.aggregate_merge(
                 b, p.num_keys, reductions, p.partial_schema,
                 dense=(los, sizes))))
+        self._hash_merge = lambda mt: cached_jit(
+            f"aggmrg|{p.signature}|hash{mt}",
+            lambda: jax.jit(lambda b: agg_ops.aggregate_merge(
+                b, p.num_keys, reductions, p.partial_schema,
+                hash_table=mt)))
         return cached_jit(
             "aggmrg|" + p.signature,
             lambda: jax.jit(lambda b: agg_ops.aggregate_merge(
@@ -482,14 +497,99 @@ class TpuHashAggregateExec(TpuExec):
             extra = "|mask:" + expr_signature(self.pre_mask)
         return self.plan.signature + extra
 
+    # batches sampled before an undecided signature commits to the
+    # update path: bounds the row-count syncs a first execution pays
+    _SKIP_SAMPLE_BATCHES = 3
+
+    def _runtime_partial(self, ctx, it, first, update_kernel, merge_kernel,
+                         cache, sig, adaptive, prior, skip_ratio, growth):
+        """Runtime partial-aggregation skip (spark.rapids.sql.agg.
+        runtimeSkip): the partial pass measures output_groups/input_rows
+        as batches stream and flips to passthrough MID-STREAM once the
+        cumulative ratio exceeds the threshold — already-updated partials
+        flush as-is (the final aggregate reduces any mix of grouped and
+        passthrough layouts). Decisions are journaled (aggSkipDecision,
+        carrying the measured rate) and recorded in the session ratio
+        cache either way, so later executions decide from batch 0 with
+        zero syncs; capacity-shrunk outputs prove strong reduction
+        without any sync and are never recorded (the bounded-cardinality
+        paths, matching the legacy heuristic)."""
+        from spark_rapids_tpu.obs.events import EVENTS
+        partials = []
+        # a recorded good ratio short-circuits measurement entirely
+        decided = "update" if (not adaptive or prior is not None) else None
+        in_rows = out_rows = sampled = 0
+        b = first
+        while b is not None:
+            if decided == "skip":
+                yield self._passthrough_kernel(b)
+                b = next(it, None)
+                continue
+            p = update_kernel(b)
+            partials.append(p)
+            if decided is None:
+                if p.capacity < b.capacity:
+                    decided = "update"
+                else:
+                    out_rows += p.num_rows_host()
+                    in_rows += b.num_rows_hint()
+                    sampled += 1
+                    measured = out_rows / max(in_rows, 1)
+                    if measured > skip_ratio:
+                        decided = "skip"
+                        cache[sig] = [measured, 0]
+                        ctx.ratio_writes.append(sig)
+                        EVENTS.emit("aggSkipDecision", decision="skip",
+                                    source="measured",
+                                    measuredRatio=float(measured),
+                                    batches=sampled, threshold=skip_ratio)
+                        for pp in partials:
+                            yield pp
+                        partials = []
+                    elif sampled >= self._SKIP_SAMPLE_BATCHES:
+                        decided = "update"
+                        cache[sig] = [measured, 0]
+                        ctx.ratio_writes.append(sig)
+                        EVENTS.emit("aggSkipDecision", decision="update",
+                                    source="measured",
+                                    measuredRatio=float(measured),
+                                    batches=sampled, threshold=skip_ratio)
+            b = next(it, None)
+        if decided is None and sampled > 0:
+            # stream ended while still sampling (short partitions): the
+            # cumulative measurement is the signature's decision —
+            # recorded so later executions decide from batch 0 with no
+            # syncs (the legacy heuristic's single-batch learning)
+            measured = out_rows / max(in_rows, 1)
+            cache[sig] = [measured, 0]
+            ctx.ratio_writes.append(sig)
+            EVENTS.emit("aggSkipDecision", decision="update",
+                        source="measured", measuredRatio=float(measured),
+                        batches=sampled, threshold=skip_ratio)
+        if len(partials) == 1:
+            yield partials[0]
+        elif partials:
+            merged = _concat_device(partials, self.plan.partial_schema,
+                                    growth)
+            yield merge_kernel(merged)
+
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child_parts = self.children[0].executed_partitions(ctx)
         growth = ctx.conf.capacity_growth
 
-        from spark_rapids_tpu.config.conf import AGG_SKIP_RATIO
+        from spark_rapids_tpu.config.conf import (
+            AGG_HASH_ENABLED, AGG_HASH_MAX_SLOTS, AGG_RUNTIME_SKIP,
+            AGG_SKIP_RATIO,
+        )
         skip_ratio = float(ctx.conf.get(AGG_SKIP_RATIO.key))
+        runtime_skip = ctx.conf.get_bool(AGG_RUNTIME_SKIP.key, True)
+        hash_on = ctx.conf.get_bool(AGG_HASH_ENABLED.key, False)
+        max_slots = int(ctx.conf.get(AGG_HASH_MAX_SLOTS.key))
 
         dense = self._dense_group_plan(ctx)
+        # dense keys outrank the hash table (exact composite key, fewer
+        # sort operands); hash engages exactly where dense cannot
+        use_hash = hash_on and self.plan.num_keys > 0 and dense is None
         if dense is not None:
             los_arr = jnp.asarray(dense[0], jnp.int64)
             sizes, skey = dense[1], dense[2]
@@ -514,9 +614,48 @@ class TpuHashAggregateExec(TpuExec):
                     return out
             else:
                 update_kernel = None
+        elif use_hash:
+            merge_kernel = self._hash_merge(max_slots)
+            update_kernel = (self._hash_update(max_slots)
+                             if self.mode == "partial" else None)
         else:
             merge_kernel = self._merge_kernel
             update_kernel = self._kernel if self.mode == "partial" else None
+
+        # VMEM-bound recursed bucketing: a batch whose slot table would
+        # exceed maxTableSlots splits by key hash into in-budget slices
+        # (disjoint key sets), each aggregates in-VMEM, and the slices'
+        # partial outputs concatenate back into ONE valid partial batch
+        # (no cross-slice merge needed — no key spans two slices). Only
+        # column-reference grouping keys can drive the input-batch
+        # partitioner; expression keys keep the in-trace sorted fallback.
+        hash_split_idx = None
+        if use_hash and self.mode == "partial":
+            from spark_rapids_tpu.sql.exprs.core import BoundRef
+            if all(isinstance(e, BoundRef) for _, e in self.plan.grouping):
+                hash_split_idx = [e.index for _, e in self.plan.grouping]
+
+        if hash_split_idx is not None and update_kernel is not None:
+            from spark_rapids_tpu.exec import outofcore as ooc
+            from spark_rapids_tpu.ops import pallas_kernels as pk
+            base_update = update_kernel
+
+            def _bucketed_update(b, level=0):
+                if (level >= 3
+                        or pk.hash_table_size(b.capacity) <= max_slots):
+                    return base_update(b)
+                need = -(-pk.hash_table_size(b.capacity) // max_slots)
+                n = 2
+                while n < 2 * need and n < 64:
+                    n <<= 1
+                parts = [_bucketed_update(s, level + 1)
+                         for s in ooc.split_batch_by_hash(
+                             ctx, hash_split_idx, b, n, level, growth)]
+                if not parts:
+                    return base_update(b)
+                return _concat_device(parts, self.plan.partial_schema,
+                                      growth)
+            update_kernel = _bucketed_update
 
         def make(part: Partition) -> Partition:
             def run() -> Iterator[DeviceBatch]:
@@ -564,14 +703,35 @@ class TpuHashAggregateExec(TpuExec):
                     sig = plan_fingerprint(self) + "|ratio"
                     adaptive = (skip_ratio < 1.0 and cache is not None
                                 and self.plan.num_keys > 0)
+                    prior = None
                     if adaptive and sig in cache:
                         ratio_known, uses = cache[sig]
+                        prior = ratio_known
                         if ratio_known > skip_ratio:
                             cache[sig][1] = uses + 1
+                            if runtime_skip:
+                                from spark_rapids_tpu.obs.events import (
+                                    EVENTS,
+                                )
+                                EVENTS.emit(
+                                    "aggSkipDecision", decision="skip",
+                                    source="cache",
+                                    measuredRatio=float(ratio_known),
+                                    threshold=skip_ratio)
                             yield self._passthrough_kernel(first)
                             for b in it:
                                 yield self._passthrough_kernel(b)
                             return
+                    if runtime_skip:
+                        # AQE-style runtime decision from measured
+                        # per-batch reduction rates (spark.rapids.sql.
+                        # agg.runtimeSkip); false restores the legacy
+                        # first-batch-only heuristic below
+                        yield from self._runtime_partial(
+                            ctx, it, first, update_kernel, merge_kernel,
+                            cache, sig, adaptive, prior, skip_ratio,
+                            growth)
+                        return
                     p0 = update_kernel(first)
                     second = next(it, None)
                     # learn the ratio (one row-count sync, first execution

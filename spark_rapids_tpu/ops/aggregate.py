@@ -29,11 +29,13 @@ def aggregate_update(batch: DeviceBatch,
                      reductions: Sequence[Tuple[str, int, DType]],
                      out_schema: Schema,
                      mask_expr: Expression = None,
-                     dense=None) -> DeviceBatch:
+                     dense=None, hash_table=None) -> DeviceBatch:
     """Partial aggregation of one batch: group by evaluated keys, reduce
     evaluated inputs. reductions: (kind, input_index, out_dtype).
     ``dense``: optional (los device vector, static sizes tuple) enabling
     the exact bounded-int composite grouping key (dense_composite).
+    ``hash_table``: optional max slot count enabling the one-pass hash
+    aggregation branch (_hash_payload_reduce).
 
     ``mask_expr``: optional fused pre-filter predicate evaluated over the
     INPUT batch; failing rows are excluded from every group without the
@@ -65,7 +67,7 @@ def aggregate_update(batch: DeviceBatch,
                             for kind, idx, dt in reductions],
                            out_schema,
                            force_single_group=len(key_cols) == 0,
-                           live=live, dense=dense)
+                           live=live, dense=dense, hash_table=hash_table)
 
 
 def aggregate_passthrough(batch: DeviceBatch,
@@ -120,12 +122,13 @@ def aggregate_passthrough(batch: DeviceBatch,
 
 def aggregate_merge(batch: DeviceBatch, num_keys: int,
                     reductions: Sequence[Tuple[str, int, DType]],
-                    out_schema: Schema, dense=None) -> DeviceBatch:
+                    out_schema: Schema, dense=None,
+                    hash_table=None) -> DeviceBatch:
     """Merge partial outputs: group by leading key columns, reduce
     intermediate columns with merge kinds. reductions: (kind, col_idx, dt)."""
     return _grouped_reduce(batch, list(range(num_keys)), list(reductions),
                            out_schema, force_single_group=num_keys == 0,
-                           dense=dense)
+                           dense=dense, hash_table=hash_table)
 
 
 # group-slot width of the fast aggregation branch: segment reductions at
@@ -172,7 +175,7 @@ def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
                     reductions: List[Tuple[str, int, DType]],
                     out_schema: Schema,
                     force_single_group: bool,
-                    live=None, dense=None) -> DeviceBatch:
+                    live=None, dense=None, hash_table=None) -> DeviceBatch:
     def out(res):
         # dense callers always receive (result, ok): paths the dense key
         # does not apply to are trivially ok
@@ -203,6 +206,18 @@ def _grouped_reduce(batch: DeviceBatch, key_idx: List[int],
         comp, ok = dense_composite(batch, key_idx, los, sizes, lv)
         return _dense_payload_reduce(batch, key_idx, reductions,
                                      out_schema, lv, comp), ok
+    if hash_table is not None:
+        # opt-in one-pass hash aggregation (spark.rapids.sql.agg.
+        # hashAggEnabled): claims slots and folds accumulators in one
+        # walk — no sort, no segment scan. Engages exactly where the
+        # dense path cannot (unbounded keys) and the sorted path is
+        # today's fallback; declines (None) at TRACE time when a key
+        # needs char-level images or the table exceeds the slot budget,
+        # falling through to the branches below.
+        res = _hash_payload_reduce(batch, key_idx, reductions, out_schema,
+                                   live, hash_table)
+        if res is not None:
+            return out(res)
     # dictionary-encoded keys (bounded cardinality): the sort-free slot
     # attempt usually wins; otherwise (high/unknown cardinality) the
     # payload-sort path — its segment ops see SORTED ids, which XLA lowers
@@ -359,6 +374,128 @@ def _sorted_dead_mask(info: "gb.GroupInfo", live) -> jnp.ndarray:
     capacity = info.perm.shape[0]
     n_live = jnp.sum(live.astype(jnp.int32))
     return jnp.arange(capacity, dtype=jnp.int32) >= n_live
+
+
+def _hash_payload_reduce(batch: DeviceBatch, key_idx: List[int],
+                         reductions: List[Tuple[str, int, DType]],
+                         out_schema: Schema, live, max_slots: int):
+    """One-pass hash aggregation over the open-addressing slot table
+    (ops/pallas_kernels.hash_grouped_aggregate): every row probes to its
+    key's slot and folds its value into per-slot accumulators in the same
+    walk — no sort, no segment scan, no per-reduction re-sweep. This is
+    the cuDF open-addressing groupby shape (aggregate.scala:338-396) the
+    sorted path only approximates.
+
+    Trace-time applicability (returns None -> caller falls through to the
+    sorted/row-space branches):
+      * every key must have an EXACT one-word image: fixed-width values
+        (u64_key_image) or dictionary codes (exact per batch by
+        construction). Plain un-dictionaried strings would need
+        char-level images — declined.
+      * hash_table_size(capacity) must fit ``max_slots``
+        (spark.rapids.sql.agg.hash.maxTableSlots — the VMEM-class bound;
+        exec/tpu.py buckets oversized batches through the out-of-core
+        fan-out before calling in here).
+
+    Null keys form real groups: the null image is a canonical sentinel
+    and the per-key validity bits join the key image vector, so a real
+    value sharing the sentinel stays a distinct group (the sorted path's
+    nullsig spelling)."""
+    from spark_rapids_tpu.ops import pallas_kernels as pk
+    from spark_rapids_tpu.ops.rowops import gather_columns
+    from spark_rapids_tpu.ops.sortops import u64_key_image
+
+    capacity = batch.capacity
+    for ki in key_idx:
+        col = batch.columns[ki]
+        if col.dtype.is_string and col.dict_values is None:
+            return None
+    T = pk.hash_table_size(capacity)
+    if T > max_slots:
+        return None
+    if live is None:
+        live = batch.row_mask()
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+
+    imgs: List[jnp.ndarray] = []
+    nullsig = jnp.zeros((capacity,), jnp.uint32)
+    for j, ki in enumerate(key_idx):
+        col = batch.columns[ki]
+        if col.dtype.is_string:
+            per = [col.dict_codes.astype(jnp.uint64)]
+        else:
+            per = u64_key_image(col)
+        imgs.extend(jnp.where(col.validity, im, jnp.uint64(0))
+                    for im in per)
+        nullsig = nullsig | (col.validity.astype(jnp.uint32)
+                             << jnp.uint32(j))
+    imgs.append(nullsig.astype(jnp.uint64))
+
+    # lower every reduction kind onto the kernel's {sum,min,max} job
+    # contract; semantics mirror _seg_reduce_kind exactly (the oracle the
+    # tier-1 tests pin this path against)
+    jobs = []
+    for kind, ci, out_dt in reductions:
+        col = batch.columns[ci]
+        valid = col.validity & live
+        if kind == "count_valid":
+            jobs.append(("sum", valid.astype(jnp.int64), live))
+        elif kind == "sum":
+            jobs.append(("sum",
+                         jnp.where(valid, col.data, 0).astype(
+                             out_dt.np_dtype), valid))
+        elif kind in ("min", "max"):
+            v2, _neutral = gb.minmax_operands(col.data, kind)
+            jobs.append((kind, v2, valid))
+        elif kind in ("first", "last", "first_valid", "last_valid"):
+            eligible = valid if kind.endswith("_valid") else live
+            jobs.append(("min" if kind.startswith("first") else "max",
+                         pos, eligible))
+        elif kind == "any":
+            jobs.append(("max", (col.data & valid).astype(jnp.int32),
+                         live))
+        else:
+            raise ValueError(f"unknown reduction kind: {kind}")
+
+    counts, rep, accs, nels = pk.hash_grouped_aggregate(imgs, live, jobs, T)
+
+    # compact used slots to the front; n_used <= live rows <= capacity and
+    # T >= 2*capacity, so the first ``capacity`` compacted entries hold
+    # every used slot — output width stays the input bucket (as the
+    # sorted path) and downstream shape bucketing is undisturbed
+    used = counts > 0
+    slot_perm, n_used = pk.compact_permutation(used)
+    sel = slot_perm[:capacity]
+    group_live = pos < n_used
+    rep_row = jnp.clip(rep, 0, capacity - 1)[sel]
+    out_cols = gather_columns([batch.columns[ki] for ki in key_idx],
+                              rep_row, group_live)
+
+    for (kind, ci, out_dt), (jkind, _d, _e), acc, nel in zip(
+            reductions, jobs, accs, nels):
+        a, ne = acc[sel], nel[sel]
+        has = ne > 0
+        if kind == "count_valid":
+            data = jnp.where(has, a, 0).astype(out_dt.np_dtype)
+            validity = group_live
+        elif kind == "sum":
+            data = jnp.where(has, a, 0).astype(out_dt.np_dtype)
+            validity = has & group_live
+        elif kind in ("min", "max"):
+            data = jnp.where(has, a, jnp.zeros((), a.dtype))
+            if out_dt.np_dtype == jnp.bool_:
+                data = data.astype(jnp.bool_)
+            data = data.astype(out_dt.np_dtype)
+            validity = has & group_live
+        elif kind in ("first", "last", "first_valid", "last_valid"):
+            rowsel = jnp.clip(a, 0, capacity - 1)
+            data = batch.columns[ci].data[rowsel].astype(out_dt.np_dtype)
+            validity = has & batch.columns[ci].validity[rowsel] & group_live
+        else:  # any
+            data = (jnp.where(has, a, 0) > 0).astype(out_dt.np_dtype)
+            validity = group_live
+        out_cols.append(DeviceColumn(out_dt, data, validity))
+    return DeviceBatch(out_schema, out_cols, n_used.astype(jnp.int32))
 
 
 def _dict_matmul_reduce(batch: DeviceBatch, key_idx: List[int],
@@ -669,7 +806,13 @@ def _slot_hash_attempt(batch: DeviceBatch, key_idx: List[int], live=None):
     ok_short = jnp.asarray(True)
     for ki in key_idx:
         col = batch.columns[ki]
-        if col.dtype.is_string:
+        if col.dtype.is_string and col.dict_values is not None:
+            # dictionary codes are exact per batch by construction: ONE
+            # image, zero char reads, and no prefix-length constraint —
+            # dict string columns are codes-only integers, so treating
+            # them as plain strings here was needlessly conservative
+            per_key = [col.dict_codes.astype(jnp.uint64)]
+        elif col.dtype.is_string:
             from spark_rapids_tpu.ops.sortops import string_prefix8
             lens = col.lens_()
             # host-computed at upload (gather-propagated, zero char reads),

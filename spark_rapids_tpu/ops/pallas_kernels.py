@@ -592,6 +592,241 @@ def hash_join_probe(build_images, build_valid: jnp.ndarray,
     return counts, bstart, bperm
 
 
+# ---------------------------------------------------------------------------
+# Grouped hash AGGREGATION: slot table with in-kernel accumulators
+# ---------------------------------------------------------------------------
+#
+# hash_join_probe/hash_group_ids only assign groups; every reduction still
+# ran as a separate segment sweep downstream. This kernel is the cuDF
+# groupby shape the reference actually calls (single-pass open-addressing
+# aggregation, PAPER.md L3): each row claims (or joins) its key's slot and
+# folds its value into per-slot accumulators IN THE SAME probe — one pass
+# over the rows, no sort, no segment scan, no per-reduction re-walk.
+#
+# Job contract (normalized by the caller, ops/aggregate.py): every engine
+# reduction kind lowers to one of THREE accumulator kinds over
+# (data, eligible) pairs —
+#   'sum'  acc += data            where eligible
+#   'min'  acc  = min(acc, data)  where eligible (first eligible seeds)
+#   'max'  acc  = max(acc, data)  where eligible
+# count = sum over ones, first/last = min/max over the row-position
+# vector, any = max over the 0/1 value. Each job also counts its eligible
+# rows (n_eligible), which doubles as the accumulator-validity flag —
+# acc is UNDEFINED where n_eligible == 0 (the pallas kernel leaves the
+# zero init, the jnp twin the segment-op neutral; callers must mask).
+
+
+def _hash_agg_kernel(k: int, T: int, kinds, keys_ref, valid_ref, *refs):
+    """Sequential insert-and-accumulate: rows fold into the table one at
+    a time with the table AND every accumulator in the kernel's output
+    refs (single-step grid). Per row: linear-probe to its key's slot
+    (claiming an empty one), then update each job's accumulator — the
+    whole grouped aggregation in one walk."""
+    import jax.experimental.pallas as pl
+    nj = len(kinds)
+    data_refs = refs[:nj]
+    elig_refs = refs[nj:2 * nj]
+    tab_ref, cnt_ref, rep_ref = refs[2 * nj:2 * nj + 3]
+    acc_refs = refs[2 * nj + 3:2 * nj + 3 + nj]
+    nel_refs = refs[2 * nj + 3 + nj:]
+    n = valid_ref.shape[1]
+    cnt_ref[...] = jnp.zeros((1, T), jnp.int32)
+    rep_ref[...] = jnp.zeros((1, T), jnp.int32)
+    tab_ref[...] = jnp.zeros((k, T), jnp.uint64)
+    for j in range(nj):
+        acc_refs[j][...] = jnp.zeros((1, T), acc_refs[j].dtype)
+        nel_refs[j][...] = jnp.zeros((1, T), jnp.int32)
+
+    def insert(e, _):
+        e = e.astype(jnp.int32)
+        v = pl.load(valid_ref, (jnp.int32(0), e)) != 0
+        row_keys = [pl.load(keys_ref, (jnp.int32(j), e)) for j in range(k)]
+        h = jnp.asarray(_HASH_SEED, jnp.uint64)
+        from spark_rapids_tpu.ops.hashing import splitmix64
+        for kk in row_keys:
+            h = splitmix64(h ^ kk)
+
+        def probe_cond(carry):
+            _p, _s, code = carry
+            return code == 0
+
+        def probe_body(carry):
+            p, _s, _code = carry
+            s = ((h + p.astype(jnp.uint64)) % jnp.uint64(T)).astype(
+                jnp.int32)
+            c = pl.load(cnt_ref, (jnp.int32(0), s))
+            eq = jnp.asarray(True)
+            for j in range(k):
+                eq = eq & (pl.load(tab_ref, (jnp.int32(j), s)) == row_keys[j])
+            code = jnp.where(c == 0, jnp.int32(1),
+                             jnp.where(eq, jnp.int32(2), jnp.int32(0)))
+            return p + jnp.int32(1), s, code
+
+        _p, s, code = jax.lax.while_loop(
+            probe_cond, probe_body, (jnp.int32(0), jnp.int32(0),
+                                     jnp.int32(0)))
+
+        @pl.when(v)
+        def _():
+            for j in range(k):
+                pl.store(tab_ref, (jnp.int32(j), s), row_keys[j])
+            c = pl.load(cnt_ref, (jnp.int32(0), s))
+            rep_old = pl.load(rep_ref, (jnp.int32(0), s))
+            pl.store(rep_ref, (jnp.int32(0), s),
+                     jnp.where(c == 0, e, rep_old))
+            pl.store(cnt_ref, (jnp.int32(0), s), c + 1)
+            # accumulator updates are branch-free (where on loaded
+            # values, unconditional store) — nesting pl.when is avoided
+            for j, kind in enumerate(kinds):
+                el = pl.load(elig_refs[j], (jnp.int32(0), e)) != 0
+                d = pl.load(data_refs[j], (jnp.int32(0), e))
+                a = pl.load(acc_refs[j], (jnp.int32(0), s))
+                ne = pl.load(nel_refs[j], (jnp.int32(0), s))
+                if kind == "sum":
+                    upd = a + d
+                elif kind == "min":
+                    upd = jnp.where(ne == 0, d, jnp.minimum(a, d))
+                else:  # max
+                    upd = jnp.where(ne == 0, d, jnp.maximum(a, d))
+                pl.store(acc_refs[j], (jnp.int32(0), s),
+                         jnp.where(el, upd, a))
+                pl.store(nel_refs[j], (jnp.int32(0), s),
+                         ne + jnp.where(el, 1, 0))
+        return 0
+
+    jax.lax.fori_loop(0, n, insert, 0)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _hash_agg_pallas(kinds, dtypes, table_size: int, interpret: bool,
+                     keys: jnp.ndarray, valid: jnp.ndarray, datas, eligs):
+    import jax.experimental.pallas as pl
+    k, n = keys.shape
+    T = table_size
+    nj = len(kinds)
+    ins = [keys, valid.astype(jnp.int32).reshape(1, n)]
+    ins += [d.reshape(1, n) for d in datas]
+    ins += [e.astype(jnp.int32).reshape(1, n) for e in eligs]
+    outs = pl.pallas_call(
+        functools.partial(_hash_agg_kernel, k, T, kinds),
+        out_shape=(
+            [jax.ShapeDtypeStruct((k, T), jnp.uint64),
+             jax.ShapeDtypeStruct((1, T), jnp.int32),
+             jax.ShapeDtypeStruct((1, T), jnp.int32)]
+            + [jax.ShapeDtypeStruct((1, T), dt) for dt in dtypes]
+            + [jax.ShapeDtypeStruct((1, T), jnp.int32)
+               for _ in range(nj)]),
+        interpret=interpret,
+    )(*ins)
+    _tab, cnt, rep = outs[0], outs[1][0], outs[2][0]
+    accs = [o[0] for o in outs[3:3 + nj]]
+    nels = [o[0] for o in outs[3 + nj:]]
+    return cnt, rep, accs, nels
+
+
+def _hash_agg_jnp(images, valid: jnp.ndarray, jobs, table_size: int):
+    """Vectorized twin: the shared round-claiming build assigns slots,
+    then each job is ONE segment op at table width. Accumulator values
+    on slots with n_eligible == 0 are the segment-op neutrals (the
+    kernel leaves zeros there) — both are in the contract's undefined
+    band and masked by callers."""
+    T = table_size
+    n = valid.shape[0]
+    slot, _rank, _tab, counts = _hash_build_jnp(images, valid, T)
+    rows = jnp.arange(n, dtype=jnp.int32)
+    sid = jnp.where(valid, slot, T)
+    rep = jnp.clip(
+        jax.ops.segment_min(rows, sid, num_segments=T + 1)[:T], 0, n - 1)
+    accs, nels = [], []
+    for kind, data, elig in jobs:
+        el = elig & valid
+        nel = jax.ops.segment_sum(el.astype(jnp.int32), sid,
+                                  num_segments=T + 1)[:T]
+        if kind == "sum":
+            x = jnp.where(el, data, jnp.zeros((), data.dtype))
+            acc = jax.ops.segment_sum(x, sid, num_segments=T + 1)[:T]
+        elif kind == "min":
+            x = jnp.where(el, data, _minmax_neutral(data.dtype, "min"))
+            acc = jax.ops.segment_min(x, sid, num_segments=T + 1)[:T]
+        else:
+            x = jnp.where(el, data, _minmax_neutral(data.dtype, "max"))
+            acc = jax.ops.segment_max(x, sid, num_segments=T + 1)[:T]
+        accs.append(acc)
+        nels.append(nel)
+    return counts, rep, accs, nels
+
+
+def _minmax_neutral(dtype, kind: str):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if kind == "min" else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if kind == "min" else info.min, dtype)
+
+
+_hash_agg_pallas_ok: Optional[bool] = None
+
+
+def _hash_agg_pallas_available() -> bool:
+    """Eager probe of the AGGREGATION kernel specifically: its feature
+    surface adds float accumulators and multi-dtype stores on top of the
+    build kernel's, so _hash_pallas_available() proving build/probe says
+    nothing about it. The probe covers the dtypes the engine actually
+    accumulates in (int64 sums, float64 sums, int32 selections)."""
+    global _hash_agg_pallas_ok
+    if _hash_agg_pallas_ok is None:
+        try:
+            keys = jnp.asarray(np.arange(32) % 5, jnp.uint64).reshape(1, -1)
+            valid = jnp.ones((32,), jnp.bool_)
+            ones = jnp.ones((32,), jnp.bool_)
+            datas = (jnp.arange(32, dtype=jnp.int64),
+                     jnp.arange(32, dtype=jnp.float64),
+                     jnp.arange(32, dtype=jnp.int32))
+            cnt, _rep, accs, _nels = _hash_agg_pallas(
+                ("sum", "sum", "min"),
+                (jnp.int64, jnp.float64, jnp.int32), 64, False,
+                keys, valid, datas, (ones, ones, ones))
+            jax.block_until_ready(accs[0])
+            _hash_agg_pallas_ok = True
+        except Exception:  # noqa: BLE001 — any compile/runtime failure
+            _hash_agg_pallas_ok = False
+            import logging
+            logging.getLogger(__name__).warning(
+                "pallas hash-aggregation kernel unavailable on this "
+                "backend; using the vectorized twin")
+    return _hash_agg_pallas_ok
+
+
+def hash_grouped_aggregate(images, valid: jnp.ndarray, jobs,
+                           table_size: int, mode: Optional[str] = None):
+    """One-pass grouped aggregation over the open-addressing table.
+
+    ``images``: exact uint64 key-image columns (nulls already
+    sentineled + validity folded in by the caller); ``valid``: live-row
+    mask (dead rows never enter the table); ``jobs``: list of
+    (kind, data (n,), eligible (n,) bool) with kind in {sum, min, max}
+    (see module contract above).
+
+    Returns slot-space results — (counts (T,) int32 rows per slot,
+    rep (T,) int32 first-arrival row per used slot, accs: per-job (T,)
+    accumulators, nels: per-job (T,) int32 eligible counts). acc is
+    undefined where its nel == 0; the caller compacts used slots into
+    group rows (counts > 0) and masks by nel."""
+    mode = mode or hash_kernels_mode()
+    if mode == "pallas" and (table_size > _PALLAS_MAX_TABLE
+                             or not _hash_agg_pallas_available()):
+        mode = "jnp"
+    if mode in ("pallas", "interpret"):
+        keys = jnp.stack([im.astype(jnp.uint64) for im in images])
+        kinds = tuple(kind for kind, _d, _e in jobs)
+        dts = tuple(jnp.dtype(d.dtype) for _k, d, _e in jobs)
+        datas = tuple(d for _k, d, _e in jobs)
+        eligs = tuple(e & valid for _k, _d, e in jobs)
+        return _hash_agg_pallas(kinds, dts, table_size,
+                                mode == "interpret", keys, valid,
+                                datas, eligs)
+    return _hash_agg_jnp(images, valid, jobs, table_size)
+
+
 def hash_group_ids(images, valid: jnp.ndarray, table_size: int,
                    mode: Optional[str] = None):
     """Grouped-agg accumulate substrate: dense group id per row from the

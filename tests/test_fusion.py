@@ -47,6 +47,7 @@ def test_fused_keyless_string_minmax_first_last(session):
     assert tpu2.l[0] == cpu2.l[0] == "ccc"
 
 
+@pytest.mark.slow  # ~18s oracle sweep; keyless string minmax stays tier-1
 def test_fused_keyed_string_reduction(session):
     rng = np.random.default_rng(9)
     n = 2000

@@ -530,3 +530,82 @@ class TestStressMode:
         assert rep["mode"] == "stress"
         assert rep["throughput_drift_pct"] == -50.0
         assert rep["regressed"] is True
+
+
+class TestRooflineGate:
+    """--roofline OLD NEW: class-rank drops between two
+    tools/roofline.py artifacts gate the sweep comparison; intra-class
+    GB/s noise never does."""
+
+    def _roof(self, tmp_path, name, pcts):
+        doc = {"sf": 0.5, "hbm_peak_gbs": 819.0,
+               "queries": {q: {"kernel": "aggupd|...", "calls": 4,
+                               "pct_hbm_peak": p, "gbs": p * 8.19,
+                               "wall_s": 1.0}
+                           for q, p in pcts.items()}}
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        return p
+
+    def test_class_boundaries(self):
+        assert perfdiff.roofline_class(0.02) == (0, "gather")
+        assert perfdiff.roofline_class(0.5) == (1, "low")
+        assert perfdiff.roofline_class(3.0) == (2, "elementwise")
+        assert perfdiff.roofline_class(11.9) == (2, "elementwise")
+        assert perfdiff.roofline_class(40.0) == (3, "high")
+
+    def test_deltas_flag_rank_drops_only(self, tmp_path):
+        base = self._roof(tmp_path, "b.json",
+                          {"q5": 4.0, "q1": 0.2, "q9": 1.0})
+        new = self._roof(tmp_path, "n.json",
+                         {"q5": 0.3, "q1": 0.4, "q9": 2.9})
+        deltas = perfdiff.roofline_deltas(
+            perfdiff._read_doc(base), perfdiff._read_doc(new))
+        by_q = {d["query"]: d for d in deltas}
+        assert by_q["q5"]["regressed"]  # elementwise -> gather
+        assert not by_q["q1"]["regressed"]  # intra-class noise
+        assert not by_q["q9"]["regressed"]  # stays "low"
+        assert by_q["q5"]["base_class"] == "elementwise"
+        assert by_q["q5"]["new_class"] == "gather"
+
+    def test_gate_fails_sweep_on_class_regression(self, tmp_path,
+                                                  capsys):
+        sweep = _detail(tmp_path, "s.json", {"q1": 2.0})
+        rb = self._roof(tmp_path, "rb.json", {"tpcxbb.q5": 4.0})
+        rn = self._roof(tmp_path, "rn.json", {"tpcxbb.q5": 0.3})
+        assert perfdiff.main([sweep, sweep, "--roofline", rb, rn]) == 1
+        out = capsys.readouterr().out
+        assert "ROOFLINE-CLASS REGRESSION" in out
+        # the explicit opt-out reports but does not gate
+        assert perfdiff.main([sweep, sweep, "--roofline", rb, rn,
+                              "--ignore-roofline"]) == 0
+
+    def test_gate_passes_on_improvement(self, tmp_path, capsys):
+        sweep = _detail(tmp_path, "s.json", {"q1": 2.0})
+        rb = self._roof(tmp_path, "rb.json", {"tpcxbb.q5": 0.3})
+        rn = self._roof(tmp_path, "rn.json", {"tpcxbb.q5": 4.0})
+        assert perfdiff.main([sweep, sweep, "--roofline", rb, rn]) == 0
+        assert "(improved)" in capsys.readouterr().out
+
+    def test_non_roofline_artifact_exits_2(self, tmp_path, capsys):
+        sweep = _detail(tmp_path, "s.json", {"q1": 2.0})
+        assert perfdiff.main(
+            [sweep, sweep, "--roofline", sweep, sweep]) == 2
+        assert "roofline" in capsys.readouterr().err
+
+    def test_disjoint_queries_exit_2(self, tmp_path):
+        sweep = _detail(tmp_path, "s.json", {"q1": 2.0})
+        rb = self._roof(tmp_path, "rb.json", {"q5": 4.0})
+        rn = self._roof(tmp_path, "rn.json", {"q16": 4.0})
+        assert perfdiff.main([sweep, sweep, "--roofline", rb, rn]) == 2
+
+    def test_json_report_carries_deltas(self, tmp_path, capsys):
+        sweep = _detail(tmp_path, "s.json", {"q1": 2.0})
+        rb = self._roof(tmp_path, "rb.json", {"q5": 4.0})
+        rn = self._roof(tmp_path, "rn.json", {"q5": 0.3})
+        assert perfdiff.main([sweep, sweep, "--roofline", rb, rn,
+                              "--json", "-"]) == 1
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["roofline_regressed"] is True
+        assert rep["roofline_deltas"][0]["query"] == "q5"

@@ -22,8 +22,15 @@ def bb_pandas():
 
 ALL_QUERIES = sorted(QUERIES, key=lambda q: int(q[1:]))
 
+# heaviest differentials (~10-13s each on the tier-1 box) ride the slow
+# tier; the remaining 14 keep per-operator tier-1 coverage
+_SLOW_QUERIES = {"q21", "q22", "q23", "q25", "q26"}
 
-@pytest.mark.parametrize("qname", ALL_QUERIES)
+
+@pytest.mark.parametrize(
+    "qname",
+    [pytest.param(q, marks=pytest.mark.slow) if q in _SLOW_QUERIES else q
+     for q in ALL_QUERIES])
 def test_tpcxbb_query_differential(session, bb_pandas, qname):
     """Every implemented TPCxBB-like query, TPU vs CPU."""
     def run(s):

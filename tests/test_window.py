@@ -247,6 +247,7 @@ def test_bounded_range_descending_falls_back(session, rng):
         allow_non_tpu=["CpuWindowExec"])
 
 
+@pytest.mark.slow  # ~9s string winner-index sweep; numeric frames stay tier-1
 def test_window_string_min_max_whole_partition(session, rng):
     """min/max over string values, whole-partition frame: winner-index
     kernel + exec-level sized gather."""

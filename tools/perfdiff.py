@@ -51,6 +51,14 @@ drift (total spill events growing more than
 management got worse), and oracle verification. ``--ignore-stress``
 reports the deltas without gating.
 
+And it gates **roofline class** (docs/roofline.md): pass ``--roofline
+OLD.json NEW.json`` with two ``tools/roofline.py`` artifacts and any
+common query whose dominant kernel's HBM-utilization class dropped
+(high > elementwise [3-12%] > low [0.5-3%] > gather-built [<0.5%])
+exits 1 — the ratchet that keeps a kernel PR from silently falling
+back to a gather-built spelling. ``--ignore-roofline`` reports the
+class moves without gating.
+
 Exit codes: 0 = no regression, 1 = regression (any common query slower
 than ``--threshold``, default 10%, geomean drift below
 ``--geomean-threshold``, default 5%, or a steady-state compile-count
@@ -72,7 +80,7 @@ import json
 import math
 import re
 import sys
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 _TAIL_RE = re.compile(
     r"bench: (\S+) tpu=([\d.]+)s cpu=([\d.]+)s speedup=([\d.]+)x")
@@ -174,6 +182,46 @@ def losers_from_doc(doc: Dict[str, Any],
     if per:
         return sum(1 for v in per.values() if v < 1.0)
     return None
+
+
+# HBM-utilization classes of a query's dominant kernel, ranked: the
+# gather-built kernels sit under 0.5% of HBM peak, healthy elementwise
+# data movement in the 3-12% band (docs/roofline.md). The roofline gate
+# fails when a common query's class RANK drops between two
+# tools/roofline.py artifacts — intra-class GB/s noise never gates.
+ROOFLINE_CLASSES = [("gather", 0.5), ("low", 3.0),
+                    ("elementwise", 12.0), ("high", float("inf"))]
+
+
+def roofline_class(pct_hbm_peak: float) -> Tuple[int, str]:
+    """(rank, name) of a %-of-HBM-peak utilization figure."""
+    for rank, (name, bound) in enumerate(ROOFLINE_CLASSES):
+        if float(pct_hbm_peak) < bound:
+            return rank, name
+    return len(ROOFLINE_CLASSES) - 1, ROOFLINE_CLASSES[-1][0]
+
+
+def roofline_deltas(base_doc: Dict[str, Any],
+                    new_doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Per-common-query class movement between two roofline artifacts
+    (tools/roofline.py ``{"queries": {name: {"pct_hbm_peak": ...}}}``)."""
+    bq, nq = base_doc.get("queries"), new_doc.get("queries")
+    if not isinstance(bq, dict) or not isinstance(nq, dict):
+        raise ValueError("not a roofline artifact (no 'queries' map)")
+    out = []
+    for q in sorted(set(bq) & set(nq)):
+        bp = bq[q].get("pct_hbm_peak")
+        np_ = nq[q].get("pct_hbm_peak")
+        if bp is None or np_ is None:
+            continue
+        br, bc = roofline_class(bp)
+        nr, nc = roofline_class(np_)
+        out.append({"query": q, "base_pct": float(bp),
+                    "new_pct": float(np_), "base_class": bc,
+                    "new_class": nc, "regressed": nr < br})
+    if not out:
+        raise ValueError("roofline artifacts share no gateable queries")
+    return out
 
 
 def warmup_from_doc(doc: Dict[str, Any]) -> Dict[str, Any]:
@@ -587,6 +635,14 @@ def render_text(rep: Dict[str, Any]) -> str:
             else ""
         lines.append(f"-- n_below_1x: {rep['n_below_1x_base']} -> "
                      f"{rep['n_below_1x_new']}{mark}")
+    for d in rep.get("roofline_deltas", []):
+        if d["regressed"] or d["base_class"] != d["new_class"]:
+            mark = " ROOFLINE-CLASS REGRESSION" if d["regressed"] \
+                else " (improved)"
+            lines.append(
+                f"-- roofline {d['query']}: {d['base_class']} "
+                f"({d['base_pct']:.2f}% peak) -> {d['new_class']} "
+                f"({d['new_pct']:.2f}% peak){mark}")
     lines.append("RESULT: " + ("REGRESSED" if rep["regressed"] else "ok"))
     return "\n".join(lines)
 
@@ -638,6 +694,15 @@ def main(argv=None) -> int:
     ap.add_argument("--ignore-losers", action="store_true",
                     help="do not gate on n_below_1x (sub-1x query "
                          "count) growth between sweeps")
+    ap.add_argument("--roofline", nargs=2, metavar=("OLD", "NEW"),
+                    default=None,
+                    help="also gate on two tools/roofline.py artifacts: "
+                         "a common query whose dominant kernel's "
+                         "HBM-utilization class dropped (gather < low < "
+                         "elementwise < high) is a regression")
+    ap.add_argument("--ignore-roofline", action="store_true",
+                    help="report roofline class moves without gating "
+                         "on them")
     ap.add_argument("--json", metavar="OUT", default="",
                     help="also write the machine-shape diff ('-' = "
                          "stdout)")
@@ -704,6 +769,10 @@ def main(argv=None) -> int:
         new_s = {} if args.ignore_scan else scan_from_doc(new_doc)
         base_l = losers_from_doc(base_doc, base)
         new_l = losers_from_doc(new_doc, new)
+        roof = None
+        if args.roofline is not None:
+            roof = roofline_deltas(_read_doc(args.roofline[0]),
+                                   _read_doc(args.roofline[1]))
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
         print(f"perfdiff: {e}", file=sys.stderr)
         return 2
@@ -727,6 +796,11 @@ def main(argv=None) -> int:
                   scan_threshold=args.scan_threshold,
                   base_losers=base_l, new_losers=new_l,
                   gate_losers=not args.ignore_losers)
+    if roof is not None:
+        rep["roofline_deltas"] = roof
+        regressed = any(d["regressed"] for d in roof)
+        rep["roofline_regressed"] = regressed and not args.ignore_roofline
+        rep["regressed"] = rep["regressed"] or rep["roofline_regressed"]
     if args.json == "-":
         print(json.dumps(rep, indent=1))
     else:
