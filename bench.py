@@ -399,11 +399,20 @@ def _worker():
         c0, s0 = compile_counts["n"], compile_counts["secs"]
         h0 = compile_counts["cache_hits"]
         k0 = kernelcache.cache_stats()["misses"]
+        # sync-ledger watermark around the timed loop: steady-state host
+        # syncs per iteration, the ROADMAP item 4 number perfdiff's
+        # --sync-threshold gates (obs/syncledger.py)
+        from spark_rapids_tpu.obs.syncledger import SYNC_LEDGER
+        sync0 = SYNC_LEDGER.seq
         tpu_iters = []
         for _ in range(iters):
             t0 = time.perf_counter()
             tpu_out = run_query(fn, True)
             tpu_iters.append(round(time.perf_counter() - t0, 4))
+        timed_syncs = SYNC_LEDGER.entries(since_seq=sync0)
+        rec["host_syncs"] = round(len(timed_syncs) / max(iters, 1), 2)
+        rec["sync_s"] = round(sum(e["seconds"] for e in timed_syncs)
+                              / max(iters, 1), 4)
         # real retraces only: with the shared cache on, a background AOT
         # replay's persistent-cache DESERIALIZE can land inside the
         # timed window — a cache load, not the steady-state recompile
@@ -1164,11 +1173,13 @@ def main():
             speedups.append(rec["speedup"])
             dshare = (f" dispatch_share={rec['dispatch_share']:.2f}"
                       if "dispatch_share" in rec else "")
+            syncs = (f" host_syncs={rec['host_syncs']:.0f}"
+                     if "host_syncs" in rec else "")
             print(f"bench: {name} tpu={rec['tpu_s']:.2f}s "
                   f"cpu={rec['cpu_s']:.2f}s speedup={rec['speedup']:.2f}x "
                   f"(timed_compiles={rec['timed_compiles']} "
                   f"warm={rec['warm_s']:.1f}s/{rec['warm_compiles']}c)"
-                  f"{dshare}",
+                  f"{dshare}{syncs}",
                   file=sys.stderr, flush=True)
         # serve-mode phase (--concurrency N): every successfully-built
         # suite's scored queries re-submitted through the scheduler
@@ -1352,6 +1363,13 @@ def main():
                               for v in scored.values()),
         "compile_s_total": round(sum(v.get("compile_s", 0.0)
                                      for v in scored.values()), 1),
+        # steady-state host syncs per sweep (per-iteration counts summed
+        # over queries): ROADMAP item 4's trajectory number, gated
+        # run-over-run by tools/perfdiff.py --sync-threshold
+        "host_syncs_total": round(sum(v.get("host_syncs", 0)
+                                      for v in scored.values()), 1),
+        "sync_s_total": round(sum(v.get("sync_s", 0.0)
+                                  for v in scored.values()), 2),
         "loadavg_before": round(load_before[0], 2),
         "loadavg_after": round(load_after[0], 2),
         "detail_file": detail_file,

@@ -23,6 +23,16 @@ import pandas as pd
 from spark_rapids_tpu.columnar import dtype as dtypes
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.columnar.dtype import DType
+from spark_rapids_tpu.obs.syncledger import sync_scope
+
+def _host_nbytes(tree) -> int:
+    """Bytes landed by a completed device->host fetch (numpy leaves)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += getattr(leaf, "nbytes", 0) or 0
+    return total
+
+
 
 MIN_CAPACITY = 8
 
@@ -127,7 +137,11 @@ class DeviceBatch:
 
     def num_rows_host(self) -> int:
         if self._host_rows is None:
-            self._host_rows = int(self.num_rows)
+            # fallback sync site: a named call-site scope (if any) wins
+            # via sync_scope reentrancy, so this only attributes scalar
+            # count fetches nobody wrapped explicitly
+            with sync_scope("batch.rowCount", nbytes=4):
+                self._host_rows = int(self.num_rows)
         return self._host_rows
 
     def num_rows_hint(self) -> int:
@@ -315,13 +329,17 @@ class DeviceBatch:
             if need:
                 return DeviceBatch._to_pandas_fused(batches)
         if need:
-            counts = jax.device_get([b.num_rows for b in need])
+            with sync_scope("batch.fetch", detail="rowCounts",
+                            nbytes=4 * len(need)):
+                counts = jax.device_get([b.num_rows for b in need])
             for b, c in zip(need, counts):
                 b._host_rows = int(c)
         all_views = [[col.device_views(b._host_rows) for col in b.columns]
                      for b in batches]
         _start_host_copies_tree(all_views)
-        host = jax.device_get(all_views)
+        with sync_scope("batch.fetch", detail="buffers") as sc:
+            host = jax.device_get(all_views)
+            sc.add_bytes(_host_nbytes(host))
         out: List[pd.DataFrame] = []
         for b, host_cols in zip(batches, host):
             n = b._host_rows
@@ -442,7 +460,9 @@ class DeviceBatch:
 
         slab_d, sides_d = cached_jit(sig, build)(list(batches))
         _start_host_copies_tree((slab_d, sides_d))
-        slab, sides = jax.device_get((slab_d, sides_d))
+        with sync_scope("batch.fetch", detail="packed") as sc:
+            slab, sides = jax.device_get((slab_d, sides_d))
+            sc.add_bytes(_host_nbytes((slab, sides)))
         slab = np.asarray(slab)
         sides = [np.asarray(sd) for sd in sides]
         side_i = 0
@@ -526,7 +546,9 @@ class DeviceBatch:
         payload = [(b.num_rows, [views(c) for c in b.columns])
                    for b in batches]
         _start_host_copies_tree(payload)
-        host = jax.device_get(payload)
+        with sync_scope("batch.fetch", detail="fused") as sc:
+            host = jax.device_get(payload)
+            sc.add_bytes(_host_nbytes(host))
         out: List[pd.DataFrame] = []
         for b, (count, host_cols) in zip(batches, host):
             n = int(count)

@@ -899,6 +899,53 @@ COMPILE_LEDGER_MAX_ENTRIES = register(
     "first). 2048 covers ~50 fully-cold warm-up queries at the observed "
     "19-36 compiles per query.", validator=_positive)
 
+# --- host-sync ledger (obs/syncledger.py: per-site attribution of every
+# device<->host blocking point, the device-occupancy instrument behind
+# ROADMAP item 4's syncs-per-query metric and perfdiff's sync gate) --------
+SYNC_LEDGER_ENABLED = register(
+    "spark.rapids.tpu.sync.ledger.enabled", _to_bool, True,
+    "Record every device<->host blocking point (collect/exchange "
+    "fetches, shrink/range-bounds/split-count syncs, out-of-core "
+    "working-set measurement, scan-pipeline stalls, semaphore waits) in "
+    "the process-wide host-sync ledger (obs/syncledger.py): sync site, "
+    "wall seconds, bytes moved, triggering plan operator, query and "
+    "thread, in a bounded in-memory ring. Feeds the profile report's "
+    "'syncs' section and device-occupancy estimate, hostSync journal "
+    "events, the sync track in the Chrome trace export, the live "
+    "monitor's srt_host_sync* series and /api/query sync stats, "
+    "flight-recorder failure dumps, bench.py's host_syncs/sync_s record "
+    "and tools/perfdiff.py's --sync-threshold gate. On by default: "
+    "syncs are the expensive operation being measured, so the "
+    "bookkeeping is noise next to the blocked wall time it accounts.")
+
+SYNC_LEDGER_MAX_ENTRIES = register(
+    "spark.rapids.tpu.sync.ledger.maxEntries", int, 4096,
+    "Entries kept in the host-sync ledger's bounded ring (oldest "
+    "evicted first). Steady-state queries record a handful of syncs "
+    "each; 4096 covers a long bench sweep between watermark reads.",
+    validator=_positive)
+
+SYNC_LEDGER_EVENT_MIN_SECONDS = register(
+    "spark.rapids.tpu.sync.ledger.eventMinSeconds", float, 0.0,
+    "Minimum blocked seconds before a sync also lands as a hostSync "
+    "journal event (the ledger entry and Prometheus series record it "
+    "regardless). 0 journals every sync; raise it on chatty "
+    "deployments where per-batch scalar syncs would dominate the "
+    "event log.", validator=_non_negative)
+
+DEBUG_TRANSFER_GUARD = register(
+    "spark.rapids.tpu.debug.transferGuard", str, "off",
+    "Coverage audit for the host-sync ledger: run query execution "
+    "under jax's device->host transfer guard. 'log' logs every "
+    "explicit device fetch that happens OUTSIDE a sync_scope; "
+    "'disallow' raises on it (sync scopes re-enter 'allow', so every "
+    "tracked site passes). Off by default — a debugging instrument, "
+    "not a production conf; guard levels only fire on real "
+    "accelerator platforms (CPU-backend fetches are same-device "
+    "copies).",
+    validator=lambda v: None if v in ("off", "log", "disallow")
+    else f"must be off|log|disallow, got {v}")
+
 # --- zero-warm-up serving (utils/kernelcache.py shape buckets,
 # obs/compilecache.py shared cache, serving/prewarm.py AOT replay — the
 # ledger's recompile-cause analysis ACTED on: one compile serves a

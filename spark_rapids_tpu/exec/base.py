@@ -129,7 +129,10 @@ class PhysicalPlan:
             nr = getattr(batch, "num_rows", None)
             if nr is not None:
                 import jax
-                jax.device_get(nr)
+
+                from spark_rapids_tpu.obs.syncledger import sync_scope
+                with sync_scope("profile.syncEachOp", nbytes=4):
+                    jax.device_get(nr)
 
         def wrap(part: Partition, pidx: int) -> Partition:
             def run():

@@ -42,6 +42,7 @@ from spark_rapids_tpu.columnar.batch import (
 )
 from spark_rapids_tpu.columnar.column import _char_bucket
 from spark_rapids_tpu.memory.spill import SpillPriorities
+from spark_rapids_tpu.obs.syncledger import sync_scope
 from spark_rapids_tpu.ops import rowops, sortops
 from spark_rapids_tpu.ops.groupby import row_hashes
 from spark_rapids_tpu.utils.kernelcache import bucket_dim, cached_jit
@@ -189,7 +190,8 @@ class SpilledPartitions:
     def add_batch(self, batch: DeviceBatch, split_kernel) -> None:
         """``split_kernel(batch) -> (pid-sorted batch, (n,) counts)``."""
         sorted_b, counts = split_kernel(batch)
-        host_counts = np.asarray(jax.device_get(counts))
+        with sync_scope("outofcore.partitionCounts", detail="spill"):
+            host_counts = np.asarray(jax.device_get(counts))
         offsets = np.concatenate([[0], np.cumsum(host_counts)])
         for p in range(self.n):
             c = int(host_counts[p])
@@ -233,7 +235,8 @@ def split_batch_by_hash(ctx, key_idx, batch: DeviceBatch, n: int,
     union to exactly the whole batch's groups."""
     split = hash_split_kernel(key_idx, n, level)
     sorted_b, counts = split(batch)
-    host_counts = np.asarray(jax.device_get(counts))
+    with sync_scope("outofcore.partitionCounts", detail="hashSplit"):
+        host_counts = np.asarray(jax.device_get(counts))
     offsets = np.concatenate([[0], np.cumsum(host_counts)])
     out: List[DeviceBatch] = []
     for p in range(n):
@@ -367,8 +370,9 @@ def _join_bucket(ctx, exec_, build: DeviceBatch,
             yield exec_._semi(stream, exec_._probe(build, stream)[0])
             continue
         counts, bstart, bperm = exec_._probe(build, stream)
-        sizes = [int(x) for x in jax.device_get(
-            exec_._totals(build, stream, counts, bstart, bperm))]
+        with sync_scope("outofcore.spillSizes", detail="joinTotals"):
+            sizes = [int(x) for x in jax.device_get(
+                exec_._totals(build, stream, counts, bstart, bperm))]
         if jt == "full":
             flags = exec_._match_flags(build, counts, bstart, bperm)
             matched_acc = (flags if matched_acc is None
@@ -433,7 +437,8 @@ def external_sort(ctx, exec_, batches, schema: Schema,
     kbox = {"k": None}
 
     def sample(b: DeviceBatch) -> None:
-        rows, ops = jax.device_get(sample_kernel(b))
+        with sync_scope("outofcore.sample", detail="sortBounds"):
+            rows, ops = jax.device_get(sample_kernel(b))
         rows = int(rows)
         ops = np.asarray(ops)
         kbox["k"] = ops.shape[0]

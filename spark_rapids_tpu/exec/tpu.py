@@ -531,7 +531,10 @@ class TpuHashAggregateExec(TpuExec):
                 if p.capacity < b.capacity:
                     decided = "update"
                 else:
-                    out_rows += p.num_rows_host()
+                    from spark_rapids_tpu.obs.syncledger import sync_scope
+                    with sync_scope("agg.runtimeSkip",
+                                    detail=f"batch={sampled}"):
+                        out_rows += p.num_rows_host()
                     in_rows += b.num_rows_hint()
                     sampled += 1
                     measured = out_rows / max(in_rows, 1)
@@ -1254,11 +1257,15 @@ class TpuShuffleExchangeExec(TpuExec):
         import time as _time
 
         from spark_rapids_tpu.obs import compileledger
+        from spark_rapids_tpu.obs.syncledger import sync_scope
         with compileledger.op_context(self.describe(), id(self), ctx):
             t0 = _time.perf_counter()
-            frames = DeviceBatch.to_pandas_many(
-                flat, fused_fetch_bytes=int(ctx.conf.get(
-                    "spark.rapids.sql.collect.fusedFetchBytes", 4 << 20)))
+            with sync_scope("aqe.stageFetch",
+                            detail=f"batches={len(flat)}"):
+                frames = DeviceBatch.to_pandas_many(
+                    flat, fused_fetch_bytes=int(ctx.conf.get(
+                        "spark.rapids.sql.collect.fusedFetchBytes",
+                        4 << 20)))
             compileledger.note_transfer(_time.perf_counter() - t0, "d2h")
         map_outputs = []
         pos = 0
@@ -1449,8 +1456,13 @@ class TpuShuffleExchangeExec(TpuExec):
                             (skey, counts_d, [], [], entry["counts"]))
                         stats = entry["counts"]
                     else:
-                        stats = [int(c)
-                                 for c in _jax.device_get(counts_d)]
+                        from spark_rapids_tpu.obs.syncledger import (
+                            sync_scope,
+                        )
+                        with sync_scope("exchange.shrink",
+                                        detail=f"counts={len(counts_d)}"):
+                            stats = [int(c)
+                                     for c in _jax.device_get(counts_d)]
                         if cache is not None:
                             if (entry is not None
                                     and entry.get("layout") == layout
@@ -1525,9 +1537,12 @@ class TpuShuffleExchangeExec(TpuExec):
             import jax
             import numpy as np
             # one batched fetch of every batch's (row count, key operands)
-            fetched = jax.device_get([(b.num_rows,
-                                       self._sample_kernel(b))
-                                      for b in batches])
+            from spark_rapids_tpu.obs.syncledger import sync_scope
+            with sync_scope("exchange.rangeBounds",
+                            detail=f"batches={len(batches)}"):
+                fetched = jax.device_get([(b.num_rows,
+                                           self._sample_kernel(b))
+                                          for b in batches])
             from spark_rapids_tpu.parallel.distributed import (
                 pick_bounds_from_samples,
             )
@@ -1566,8 +1581,12 @@ class TpuShuffleExchangeExec(TpuExec):
             WINDOW = 16
             windowed = iter(lambda: list(itertools.islice(split_iter,
                                                           WINDOW)), [])
+            from spark_rapids_tpu.obs.syncledger import sync_scope
             for window in windowed:
-                window_counts = jax.device_get([c for _, (_s, c) in window])
+                with sync_scope("exchange.split",
+                                detail=f"window={len(window)}"):
+                    window_counts = jax.device_get(
+                        [c for _, (_s, c) in window])
                 for (bi, (sorted_batch, _c)), host_counts in zip(
                         window, window_counts):
                     host_counts = np.asarray(host_counts)

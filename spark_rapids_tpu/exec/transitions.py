@@ -175,14 +175,18 @@ def upload_partition(ctx: ExecContext, part: Partition, schema: Schema,
                     import time as _time
 
                     from spark_rapids_tpu.obs import compileledger
+                    from spark_rapids_tpu.obs.syncledger import sync_scope
                     _t0 = _time.perf_counter()
-                    batch = DeviceBatch.from_pandas(
-                        chunk, schema=schema, dict_state=dict_state,
-                        dict_encode=dict_on,
-                        dict_numerics=dict_numerics,
-                        blocked_chars=blocked,
-                        device=(mesh_devs[i % len(mesh_devs)]
-                                if mesh_devs else None))
+                    with sync_scope("scan.upload",
+                                    detail=f"partition={i}") as _sc:
+                        batch = DeviceBatch.from_pandas(
+                            chunk, schema=schema, dict_state=dict_state,
+                            dict_encode=dict_on,
+                            dict_numerics=dict_numerics,
+                            blocked_chars=blocked,
+                            device=(mesh_devs[i % len(mesh_devs)]
+                                    if mesh_devs else None))
+                        _sc.add_bytes(batch.device_memory_size())
                     # host->device transfer attribution (host buffer
                     # build + device_put dispatch) against the upload
                     # operator — the "transfer" component of its profile
@@ -313,11 +317,13 @@ class DeviceToHostExec(PhysicalPlan):
                 import time as _time
 
                 from spark_rapids_tpu.obs import compileledger
+                from spark_rapids_tpu.obs.syncledger import sync_scope
                 sem = ctx.session.semaphore if ctx.session else None
                 try:
                     for batch in part():
                         t0 = _time.perf_counter()
-                        df = batch.to_pandas()
+                        with sync_scope("transition.d2h"):
+                            df = batch.to_pandas()
                         # device->host fetch seconds against this
                         # transition operator (profile breakdown)
                         compileledger.note_transfer(

@@ -157,11 +157,14 @@ class TpuSemaphore:
         # create exactly the contention where a deadline/cancel fires
         # while the thread is parked here, well before the next
         # batch-pull boundary could notice
+        from spark_rapids_tpu.obs.syncledger import sync_scope
         scope = current_scope()
         t0 = time.perf_counter()
         try:
             with TRACER.span("semaphore.wait", permits=self.permits,
-                             tenant=tkey or ""):
+                             tenant=tkey or ""), \
+                    sync_scope("semaphore.wait",
+                               detail=tkey or None):
                 with self._cond:
                     try:
                         while not self._admissible_locked(tkey):

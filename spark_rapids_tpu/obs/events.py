@@ -20,11 +20,11 @@ Event taxonomy (one JSON object per line; every event carries ``kind``,
                                  wall_s, error, coveragePct,
                                  cpuOpTime {op: seconds}
   queryCancelled    serving      reason, events[] (flight-recorder
-                                 tail), compiles[] — a job cancel
-                                 honored at a batch-pull boundary
+                                 tail), compiles[], syncs[] — a job
+                                 cancel honored at a batch-pull boundary
   queryTimeout      serving      deadlineSeconds, reason, events[],
-                                 compiles[] — the per-query deadline
-                                 fired (serving/cancellation.py)
+                                 compiles[], syncs[] — the per-query
+                                 deadline fired (serving/cancellation.py)
   planCacheHit      serving      planDigest — tag+convert planning
                                  skipped for a repeat submission
   resultCacheHit    serving      planDigest, rows — the opt-in result
@@ -57,6 +57,12 @@ Event taxonomy (one JSON object per line; every event carries ``kind``,
                                  queryFailed says WHICH operators were
                                  inside (exec/stagecompiler/fusedexec)
   scanStall         scan         split, stall_s (sql/scan_pipeline.py)
+  hostSync          obs          site, seconds, bytes, op — one device
+                                 <->host blocking point recorded by the
+                                 sync ledger (obs/syncledger.py); gated
+                                 by spark.rapids.tpu.sync.ledger.
+                                 eventMinSeconds to keep sync-heavy
+                                 queries from flooding the journal
   scanBudgetStall   scan         split (prefetch submission backpressure)
   shuffleSkew       shuffle      source, partitions, totalBytes, maxBytes,
                                  medianBytes, maxMedianRatio — every
@@ -75,12 +81,14 @@ Event taxonomy (one JSON object per line; every event carries ``kind``,
                                  queryPlan event additionally carries
                                  adaptive=true + aqeStages/aqeDecisions)
   diagnostics       monitor      reason, threads{name: stack[]},
-                                 queries[], compiles[] — SIGUSR1 /
-                                 manual dump of all-thread stacks + live
-                                 query progress + compile-ledger tail
+                                 queries[], compiles[], syncs[] —
+                                 SIGUSR1 / manual dump of all-thread
+                                 stacks + live query progress + compile-
+                                 ledger + sync-ledger tails
                                  (obs/monitor.dump_diagnostics)
-  flightRecorder    session      reason, events[], compiles[] (ring dump
-                                 + compile-ledger tail, see below)
+  flightRecorder    session      reason, events[], compiles[], syncs[]
+                                 (ring dump + compile-ledger and sync-
+                                 ledger tails, see below)
 
 Every event between queryStart and queryEnd additionally carries the
 ``tenant`` tag when the session has a job group set
@@ -413,8 +421,13 @@ class EventLog:
             compiles = LEDGER.tail()
         except Exception:  # noqa: BLE001 — a dump must never fail
             compiles = []
+        try:
+            from spark_rapids_tpu.obs.syncledger import SYNC_LEDGER
+            syncs = SYNC_LEDGER.tail()
+        except Exception:  # noqa: BLE001
+            syncs = []
         return self.emit("flightRecorder", reason=reason, count=len(snap),
-                         events=snap, compiles=compiles)
+                         events=snap, compiles=compiles, syncs=syncs)
 
     def _note_span(self, ev: Dict[str, Any]) -> None:
         """Tracer hook (TRACER.flight_hook): mirror finished spans into

@@ -429,6 +429,14 @@ class _Handler(JsonHandler):
                     stats = LEDGER.query_stats(qid)
                     if stats["compiles"]:
                         doc["compileCauses"] = stats["causes"]
+                    # live per-query host-sync counts + top sites
+                    # (obs/syncledger.py)
+                    from spark_rapids_tpu.obs.syncledger import (
+                        SYNC_LEDGER,
+                    )
+                    sstats = SYNC_LEDGER.query_stats(qid)
+                    if sstats["syncs"]:
+                        doc["syncStats"] = sstats
                     self._send_json(doc)
             elif path == "/api/tenants":
                 self._send_json(tenants_snapshot())
@@ -538,6 +546,7 @@ def dump_diagnostics(reason: str = "manual") -> Dict[str, Any]:
 
     from spark_rapids_tpu.obs.compileledger import LEDGER
     from spark_rapids_tpu.obs.events import EVENTS
+    from spark_rapids_tpu.obs.syncledger import SYNC_LEDGER
     names = {t.ident: t.name for t in threading.enumerate()}
     stacks: Dict[str, List[str]] = {}
     for tid, frame in sys._current_frames().items():
@@ -546,9 +555,11 @@ def dump_diagnostics(reason: str = "manual") -> Dict[str, Any]:
             ln.rstrip("\n") for ln in entries[-40:]]
     # the compile-ledger tail answers the first hung-warmup question —
     # "what was compiling?" — next to where each thread is stuck
+    # the sync-ledger tail answers the second one — "what was the last
+    # device<->host blocking point?" — for a query hung mid-fetch
     ev = EVENTS.emit("diagnostics", reason=reason, threads=stacks,
                      queries=PROGRESS.queries(full=False),
-                     compiles=LEDGER.tail())
+                     compiles=LEDGER.tail(), syncs=SYNC_LEDGER.tail())
     EVENTS.dump_flight(reason=f"diagnostics:{reason}")
     return ev
 

@@ -146,8 +146,8 @@ def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
     # hiding a clipped record
     from spark_rapids_tpu.obs.events import EVENTS
     from spark_rapids_tpu.obs.trace import TRACER
-    t0, e0, r0, f0, ledger0 = (tuple(obs_before) + (0,) * 5)[:5] \
-        if obs_before else (0, 0, 0, 0, 0)
+    t0, e0, r0, f0, ledger0, sync0 = (tuple(obs_before) + (0,) * 6)[:6] \
+        if obs_before else (0, 0, 0, 0, 0, 0)
     # compile attribution (obs/compileledger.py): this query's ledger
     # entries summarized by (operator, kernel) cause — who compiled,
     # which shapes, how many seconds of the wall went to the compiler
@@ -165,6 +165,39 @@ def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
                  "signatures": g["signatures"]}
                 for g in rep["groups"]],
         }
+    # host-sync attribution (obs/syncledger.py): this query's blocking
+    # device<->host points rolled up by site, plus the device-occupancy
+    # estimate — the idle-gap share ROADMAP item 4 gates on
+    from spark_rapids_tpu.obs.syncledger import (
+        SYNC_LEDGER, occupancy_pct, rollup,
+    )
+    sync_entries = SYNC_LEDGER.entries(since_seq=sync0)
+    if sync_entries:
+        roll = rollup(sync_entries)
+        summary["syncs"] = {
+            "count": roll["count"],
+            "seconds": roll["seconds"],
+            "bytes": roll["bytes"],
+            "occupancyPct": occupancy_pct(roll["seconds"], wall_s),
+            "bySite": roll["bySite"][:8],
+        }
+        # per-node sync rows: entries attribute by the triggering
+        # operator's describe() string — annotate matching plan rows
+        by_op: Dict[str, List[float]] = {}
+        for e in sync_entries:
+            if e.get("op"):
+                acc = by_op.setdefault(e["op"], [0, 0.0])
+                acc[0] += 1
+                acc[1] += float(e.get("seconds", 0.0) or 0.0)
+
+        def annotate(node: Dict[str, Any]) -> None:
+            got = by_op.get(node["op"])
+            if got:
+                node["syncs"] = got[0]
+                node["sync_s"] = round(got[1], 6)
+            for c in node["children"]:
+                annotate(c)
+        annotate(tree)
     obs = {}
     if TRACER.dropped - t0 > 0:
         obs["trace.droppedEvents"] = TRACER.dropped - t0
@@ -218,6 +251,9 @@ class ProfileReport:
                 line += (f" [device {bd['device_s']:.3f}s "
                          f"transfer {bd['transfer_s']:.3f}s "
                          f"dispatch {bd['dispatch_s']:.3f}s]")
+            if node.get("syncs"):
+                line += (f" [syncs {node['syncs']} "
+                         f"{node.get('sync_s', 0.0):.3f}s]")
             lines.append(line)
             for c in node["children"]:
                 rec(c, indent + 1)

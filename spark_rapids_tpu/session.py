@@ -532,12 +532,15 @@ class TpuSparkSession:
         # truncation counters snapshot: the profile's observability
         # section reports this query's DELTA, not the process totals.
         # The 5th element is the compile-ledger seq watermark: the
-        # profile's ``compiles`` section covers entries recorded after it
+        # profile's ``compiles`` section covers entries recorded after
+        # it; the 6th is the sync-ledger watermark feeding the profile's
+        # ``syncs`` section + occupancy estimate
         from spark_rapids_tpu.obs.compileledger import LEDGER as _LEDGER
+        from spark_rapids_tpu.obs.syncledger import SYNC_LEDGER as _SYNCS
         obs_before = (TRACER.dropped, obs_events.EVENTS.dropped,
                       obs_events.EVENTS.rotations,
                       obs_events.EVENTS.rotate_failures,
-                      _LEDGER.seq) \
+                      _LEDGER.seq, _SYNCS.seq) \
             if ctx.metrics_enabled else None
         if ctx.metrics_enabled:
             # the scan pipeline's peak gauge is state, not flow: reset it
@@ -554,6 +557,15 @@ class TpuSparkSession:
         # every backend compile this query triggers
         from spark_rapids_tpu.obs.compileledger import LEDGER
         LEDGER.configure_from_conf(conf)
+        # host-sync ledger (obs/syncledger.py): per-site attribution of
+        # every device<->host blocking point, plus the opt-in transfer-
+        # guard coverage audit (spark.rapids.tpu.debug.transferGuard)
+        from spark_rapids_tpu.obs import syncledger as _syncledger
+        _SYNCS.configure_from_conf(conf)
+        _guard_mode = str(conf.get(
+            "spark.rapids.tpu.debug.transferGuard", "off") or "off")
+        _syncledger.set_guard_mode(
+            _guard_mode if _guard_mode in ("log", "disallow") else None)
         # zero-warm-up layer: coarse secondary-dimension shape buckets
         # (one compile serves a dimension range), the cross-process
         # shared compile cache (one compile per CLUSTER) and the AOT
@@ -585,9 +597,13 @@ class TpuSparkSession:
         # while the query runs on this thread
         self._exec_scope.ctx = ctx
         try:
-            plan, outs, ctx = self._plan_and_run(
-                logical, ctx, conf, obs_metrics, global_before, t_query0,
-                trace_on, trace_path, obs_before)
+            # transfer-guard audit: untracked device->host transfers
+            # outside any sync_scope are logged (or raise) while the
+            # query body runs; sync scopes re-enter "allow"
+            with _syncledger.guard_context(_guard_mode):
+                plan, outs, ctx = self._plan_and_run(
+                    logical, ctx, conf, obs_metrics, global_before,
+                    t_query0, trace_on, trace_path, obs_before)
         except BaseException as e:
             wall_s = round(time.perf_counter() - t_query0, 6)
             err = f"{type(e).__name__}: {e}"[:300]
@@ -611,7 +627,8 @@ class TpuSparkSession:
                 obs_events.EVENTS.emit(
                     kind, reason=err, wall_s=wall_s,
                     events=obs_events.EVENTS.flight_events(),
-                    compiles=_LEDGER.tail(), **extra)
+                    compiles=_LEDGER.tail(), syncs=_SYNCS.tail(),
+                    **extra)
             obs_events.EVENTS.query_end(
                 status=status, flight_dump=kind is None, error=err,
                 wall_s=wall_s)
@@ -621,6 +638,7 @@ class TpuSparkSession:
             raise
         finally:
             self._exec_scope.ctx = None
+            _syncledger.set_guard_mode(None)
         wall_s = round(time.perf_counter() - t_query0, 6)
         rows_out = self._count_rows(outs)
         obs_events.EVENTS.query_end(
@@ -992,7 +1010,13 @@ class TpuSparkSession:
         for _key, totals_d, _caps, oks_d, _exact in ctx.spec_pending:
             flat.extend(totals_d)
             flat.extend(oks_d)
-        fetched = jax.device_get(flat) if flat else []
+        if flat:
+            from spark_rapids_tpu.obs.syncledger import sync_scope
+            with sync_scope("speculation.verify",
+                            detail=f"arrays={len(flat)}"):
+                fetched = jax.device_get(flat)
+        else:
+            fetched = []
         pos = 0
         all_good = True
         for key, totals_d, caps, oks_d, exact in ctx.spec_pending:
@@ -1078,12 +1102,15 @@ class TpuSparkSession:
             import time as _time
 
             from spark_rapids_tpu.obs import compileledger
+            from spark_rapids_tpu.obs.syncledger import sync_scope
             with compileledger.op_context("Collect", None, None):
                 _t0 = _time.perf_counter()
-                outs = DeviceBatch.to_pandas_many(
-                    batches, fused_fetch_bytes=int(conf.get(
-                        "spark.rapids.sql.collect.fusedFetchBytes",
-                        4 << 20)))
+                with sync_scope("collect.fetch",
+                                detail=f"batches={len(batches)}"):
+                    outs = DeviceBatch.to_pandas_many(
+                        batches, fused_fetch_bytes=int(conf.get(
+                            "spark.rapids.sql.collect.fusedFetchBytes",
+                            4 << 20)))
                 if ctx.metrics_enabled:
                     ctx.metric_add("Collect", "fetchTime",
                                    _time.perf_counter() - _t0)

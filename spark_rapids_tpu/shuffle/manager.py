@@ -157,7 +157,12 @@ def mesh_map_output_statistics(send_counts, schema):
     Bytes are rows x estimate_row_bytes(schema) (device counts are rows;
     byte-exact sizes would need per-shard char totals)."""
     import numpy as np
-    counts = np.asarray(send_counts)
+    from spark_rapids_tpu.obs.syncledger import sync_scope
+    # np.asarray on a device array is the blocking fetch; an enclosing
+    # named scope (the exchange drain) dedupes via scope reentrancy
+    with sync_scope("exchange.stats") as _sc:
+        counts = np.asarray(send_counts)
+        _sc.add_bytes(getattr(counts, "nbytes", 0))
     width = estimate_row_bytes(schema)
     bytes_by_map = [[int(c) * width for c in row] for row in counts]
     rows_by_map = [[int(c) for c in row] for row in counts]

@@ -186,6 +186,12 @@ def serialize_batch(batch: DeviceBatch) -> bytes:
     words+lens and pack host-side."""
     from spark_rapids_tpu.columnar import dictionary as dict_mod
     from spark_rapids_tpu.columnar.column import np_slab_to_packed
+    from spark_rapids_tpu.obs.syncledger import sync_scope
+    with sync_scope("exchange.wire", detail="serialize"):
+        return _serialize_batch_body(batch, dict_mod, np_slab_to_packed)
+
+
+def _serialize_batch_body(batch, dict_mod, np_slab_to_packed) -> bytes:
     n = batch.num_rows_host()
     dict_wire = dict_mod.wire_enabled()
     cols = []

@@ -150,11 +150,18 @@ class AdaptiveExecutor:
         # kernel shapes are a known warm-up cause under AQE — attribute
         # the compiles each stage triggers to it (obs/compileledger.py)
         from spark_rapids_tpu.obs.compileledger import LEDGER
+        from spark_rapids_tpu.obs.syncledger import SYNC_LEDGER
         ledger0 = LEDGER.seq
+        sync0 = SYNC_LEDGER.seq
         with TRACER.span("AqeStage", stage=sid):
             map_outputs, stats = converted.materialize_stage(self.ctx)
         stage_compiles = LEDGER.entries(since_seq=ledger0)
         compile_s = round(sum(e["seconds"] for e in stage_compiles), 4)
+        # sync-ledger watermark: the stage-barrier fetch is a known host
+        # sync — report how many blocking points this stage's
+        # materialization paid and their wall share (obs/syncledger.py)
+        stage_syncs = SYNC_LEDGER.entries(since_seq=sync0)
+        sync_s = round(sum(e["seconds"] for e in stage_syncs), 4)
         stage = ShuffleStage(sid, exchange.output_schema(),
                              exchange.partitioning, map_outputs, stats)
         stage.reuse_key = reuse_key
@@ -164,7 +171,9 @@ class AdaptiveExecutor:
                                 maps=stats.num_maps,
                                 totalBytes=stats.total_bytes,
                                 compiles=len(stage_compiles),
-                                compileSeconds=compile_s)
+                                compileSeconds=compile_s,
+                                syncs=len(stage_syncs),
+                                syncSeconds=sync_s)
         REGISTRY.counter("aqe.stages").add(1)
         EVENTS.emit("aqeStageStats", stage=sid,
                     partitions=stats.num_partitions, maps=stats.num_maps,
@@ -173,7 +182,8 @@ class AdaptiveExecutor:
                     medianBytes=stats.median_bytes(),
                     rows=sum(stats.rows_by_partition or []),
                     compiles=len(stage_compiles),
-                    compileSeconds=compile_s)
+                    compileSeconds=compile_s,
+                    syncs=len(stage_syncs), syncSeconds=sync_s)
         record_shuffle_skew(stats.bytes_by_partition,
                             source=f"aqe:stage-{sid}")
         return stage

@@ -263,7 +263,9 @@ class ScanPrefetcher:
             t0 = time.perf_counter()
             if PROGRESS.enabled:  # live stall state, cleared below
                 PROGRESS.scan_stalled(True)
-            with TRACER.span("scan.prefetch.stall", split=i):
+            from spark_rapids_tpu.obs.syncledger import sync_scope
+            with TRACER.span("scan.prefetch.stall", split=i), \
+                    sync_scope("scan.stall", detail=f"split={i}"):
                 wait([fut], return_when=FIRST_COMPLETED)
             if PROGRESS.enabled:
                 PROGRESS.scan_stalled(False)

@@ -314,6 +314,87 @@ class TestDispatchShareGate:
             {"query": "q1", "base": 0.2, "new": 0.8, "regressed": True}]
 
 
+class TestSyncGate:
+    """Host-sync gate (obs/syncledger.py): a query's steady-state
+    blocking sync count growing more than --sync-threshold relative, or
+    its sync-blocked wall share growing more than --sync-threshold
+    absolute, regresses like a slowdown; --ignore-syncs opts out."""
+
+    def _sync_detail(self, tmp_path, name, syncs, sync_s=None):
+        doc = {"sf": 0.5, "queries": {}}
+        for q, n in syncs.items():
+            doc["queries"][q] = {"speedup": 2.0, "tpu_s": 1.0,
+                                 "cpu_s": 2.0, "host_syncs": n}
+        for q, s in (sync_s or {}).items():
+            doc["queries"][q]["sync_s"] = s
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        return p
+
+    def test_syncs_from_doc_reads_counts_and_shares(self):
+        doc = {"queries": {
+            "q1": {"host_syncs": 4, "sync_s": 0.2, "tpu_s": 2.0},
+            "q2": {"host_syncs": 1},
+            "q3": {"speedup": 2.0}}}
+        sy = perfdiff.syncs_from_doc(doc)
+        assert sy["counts"] == {"q1": 4.0, "q2": 1.0}
+        assert sy["shares"] == {"q1": pytest.approx(0.1)}
+
+    def test_sync_count_inflation_regresses(self, tmp_path, capsys):
+        base = self._sync_detail(tmp_path, "b.json", {"q1": 4, "q2": 2})
+        new = self._sync_detail(tmp_path, "n.json", {"q1": 9, "q2": 2})
+        assert perfdiff.main([base, new]) == 1
+        out = capsys.readouterr().out
+        assert "HOST-SYNC REGRESSION" in out
+        assert "RESULT: REGRESSED" in out
+
+    def test_sync_drop_and_small_growth_pass(self, tmp_path):
+        # q1 drops (improvement), q2 grows +20% < the 25% default bound
+        base = self._sync_detail(tmp_path, "b.json",
+                                 {"q1": 10, "q2": 10})
+        new = self._sync_detail(tmp_path, "n.json", {"q1": 2, "q2": 12})
+        assert perfdiff.main([base, new]) == 0
+
+    def test_sync_share_inflation_regresses(self, tmp_path, capsys):
+        # counts stable, but the sync-blocked wall share balloons
+        base = self._sync_detail(tmp_path, "b.json", {"q1": 4},
+                                 sync_s={"q1": 0.05})
+        new = self._sync_detail(tmp_path, "n.json", {"q1": 4},
+                                sync_s={"q1": 0.40})
+        assert perfdiff.main([base, new]) == 1
+        assert "HOST-SYNC-SHARE REGRESSION" in capsys.readouterr().out
+
+    def test_sync_threshold_flag(self, tmp_path):
+        base = self._sync_detail(tmp_path, "b.json", {"q1": 10})
+        new = self._sync_detail(tmp_path, "n.json", {"q1": 12})  # +20%
+        assert perfdiff.main([base, new]) == 0  # default 0.25
+        assert perfdiff.main([base, new, "--sync-threshold", "0.1"]) == 1
+
+    def test_ignore_syncs_flag(self, tmp_path):
+        base = self._sync_detail(tmp_path, "b.json", {"q1": 2})
+        new = self._sync_detail(tmp_path, "n.json", {"q1": 50})
+        assert perfdiff.main([base, new, "--ignore-syncs"]) == 0
+
+    def test_missing_sync_data_does_not_gate(self, tmp_path):
+        # artifacts without host_syncs (old sweeps) gate on speedups only
+        base = _detail(tmp_path, "b.json", {"q1": 2.0})
+        new = self._sync_detail(tmp_path, "n.json", {"q1": 50})
+        assert perfdiff.main([base, new]) == 0
+
+    def test_sync_deltas_in_json(self, tmp_path, capsys):
+        base = self._sync_detail(tmp_path, "b.json", {"q1": 4})
+        new = self._sync_detail(tmp_path, "n.json", {"q1": 9})
+        out_p = str(tmp_path / "diff.json")
+        assert perfdiff.main([base, new, "--json", out_p]) == 1
+        with open(out_p) as f:
+            rep = json.load(f)
+        assert rep["sync_regressions"] == ["q1"]
+        assert rep["sync_deltas"] == [
+            {"query": "q1", "base": 4.0, "new": 9.0,
+             "growth_pct": 125.0, "regressed": True}]
+
+
 def _serve(tmp_path, name, qps, verified=True, p50=0.5, p99=1.2,
            concurrency=8):
     """A BENCH_SERVE.json-shaped artifact (bench.py --concurrency N)."""

@@ -1,0 +1,337 @@
+"""Host-sync ledger (obs/syncledger.py).
+
+The device-occupancy instrument behind ROADMAP item 4 (stage-boundary
+host syncs -> <= 1 collect per query): every blocking device<->host
+point runs inside a ``sync_scope`` and lands as one structured ledger
+entry carrying site, seconds, bytes, triggering operator and query.
+Tier-1 invariant: the steady-state (second) run of tpch q6 stays within
+a pinned sync budget — the regression test any new eager fetch must
+trip.
+"""
+
+import contextlib
+
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.obs import syncledger as sl
+from spark_rapids_tpu.obs.syncledger import (
+    SYNC_LEDGER, guard_context, occupancy_pct, rollup, sync_scope,
+)
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture(autouse=True)
+def _guard_off():
+    # every test leaves the audit disarmed, whatever it did
+    yield
+    sl.set_guard_mode(None)
+
+
+# ---------------------------------------------------------------------------
+# sync_scope semantics
+# ---------------------------------------------------------------------------
+
+class TestSyncScope:
+    def test_scope_records_site_seconds_bytes_detail(self):
+        seq0 = SYNC_LEDGER.seq
+        with sync_scope("test.site", detail="unit", nbytes=8):
+            pass
+        ents = SYNC_LEDGER.entries(since_seq=seq0)
+        assert len(ents) == 1
+        e = ents[0]
+        assert e["site"] == "test.site"
+        assert e["bytes"] == 8
+        assert e["detail"] == "unit"
+        assert e["seconds"] >= 0.0
+        assert e["seq"] > seq0
+
+    def test_outermost_scope_wins_inner_folds_bytes(self):
+        # the reentrancy contract: a named call-site scope dedupes the
+        # fallback scopes inside the fetch helpers — ONE entry, under
+        # the outer site, with the inner bytes folded up
+        seq0 = SYNC_LEDGER.seq
+        with sync_scope("outer.site", nbytes=4) as sc:
+            with sync_scope("inner.site", nbytes=16):
+                pass
+            sc.add_bytes(2)
+        ents = SYNC_LEDGER.entries(since_seq=seq0)
+        assert len(ents) == 1
+        assert ents[0]["site"] == "outer.site"
+        assert ents[0]["bytes"] == 4 + 16 + 2
+
+    def test_exception_records_nothing(self):
+        seq0 = SYNC_LEDGER.seq
+        with pytest.raises(RuntimeError):
+            with sync_scope("test.broken"):
+                raise RuntimeError("fetch failed")
+        assert SYNC_LEDGER.entries(since_seq=seq0) == []
+        # and the thread's scope stack unwound cleanly
+        with sync_scope("test.after"):
+            pass
+        after = SYNC_LEDGER.entries(since_seq=seq0)
+        assert [e["site"] for e in after] == ["test.after"]
+
+    def test_disabled_ledger_records_nothing(self):
+        seq0 = SYNC_LEDGER.seq
+        SYNC_LEDGER.configure(enabled=False)
+        try:
+            with sync_scope("test.disabled"):
+                pass
+            assert SYNC_LEDGER.entries(since_seq=seq0) == []
+        finally:
+            SYNC_LEDGER.configure(enabled=True)
+
+    def test_entry_carries_current_op(self):
+        from spark_rapids_tpu.obs import compileledger as cl
+        seq0 = SYNC_LEDGER.seq
+        tok = cl.push_op("TpuSyncTestExec", None, None)
+        try:
+            with sync_scope("test.op"):
+                pass
+        finally:
+            cl.pop_op(tok)
+        ents = SYNC_LEDGER.entries(since_seq=seq0)
+        assert ents[0]["op"] == "TpuSyncTestExec"
+
+
+# ---------------------------------------------------------------------------
+# Ledger bookkeeping
+# ---------------------------------------------------------------------------
+
+class TestLedger:
+    def test_bounded_deque_and_tail(self):
+        led = sl.SyncLedger(max_entries=4)
+        for i in range(10):
+            led.record(f"site.{i}", 0.001)
+        assert led.total_recorded == 10
+        ents = led.entries()
+        assert len(ents) == 4
+        assert [e["site"] for e in ents] == [
+            "site.6", "site.7", "site.8", "site.9"]
+        assert [e["site"] for e in led.tail(2)] == ["site.8", "site.9"]
+
+    def test_configure_shrinks_and_grows(self):
+        led = sl.SyncLedger(max_entries=8)
+        for i in range(8):
+            led.record(f"s{i}", 0.0)
+        led.configure(True, max_entries=2)
+        assert len(led.entries()) == 2
+        led.configure(True, max_entries=16)
+        led.record("s8", 0.0)
+        assert len(led.entries()) == 3
+
+    def test_totals_accumulate(self):
+        led = sl.SyncLedger()
+        led.record("a", 0.5, nbytes=100)
+        led.record("b", 0.25, nbytes=50)
+        assert led.total_recorded == 2
+        assert led.total_seconds == pytest.approx(0.75)
+        assert led.total_bytes == 150
+
+    def test_entries_since_seq_watermark(self):
+        led = sl.SyncLedger()
+        led.record("before", 0.0)
+        seq = led.seq
+        led.record("after", 0.0)
+        assert [e["site"] for e in led.entries(since_seq=seq)] == ["after"]
+
+    def test_reset_for_tests(self):
+        led = sl.SyncLedger()
+        led.record("x", 1.0, nbytes=5)
+        led.reset_for_tests()
+        assert led.entries() == [] and led.seq == 0
+        assert led.total_seconds == 0.0 and led.total_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# Rollup + occupancy math
+# ---------------------------------------------------------------------------
+
+def _entry(site, seconds, nbytes=0, op=None):
+    return {"site": site, "seconds": seconds, "bytes": nbytes, "op": op}
+
+
+class TestRollup:
+    def test_groups_and_ranks_by_seconds(self):
+        roll = rollup([
+            _entry("collect.fetch", 0.5, 100, "Collect"),
+            _entry("scan.upload", 0.1, 40, "TpuScanExec(lineitem)"),
+            _entry("collect.fetch", 0.25, 60, "Collect"),
+        ])
+        assert roll["count"] == 3
+        assert roll["seconds"] == pytest.approx(0.85)
+        assert roll["bytes"] == 200
+        assert [g["site"] for g in roll["bySite"]] == [
+            "collect.fetch", "scan.upload"]
+        top = roll["bySite"][0]
+        assert top["syncs"] == 2 and top["bytes"] == 160
+        assert top["op"] == "Collect"
+        # op is the SHORT name — describe() args stripped
+        assert roll["bySite"][1]["op"] == "TpuScanExec"
+
+    def test_missing_site_buckets_as_unattributed(self):
+        roll = rollup([{"seconds": 0.1, "bytes": 0}])
+        assert roll["bySite"][0]["site"] == "(unattributed)"
+
+    def test_occupancy_pct(self):
+        assert occupancy_pct(0.5, 2.0) == pytest.approx(75.0)
+        assert occupancy_pct(0.0, 1.0) == pytest.approx(100.0)
+        # syncs overlapping past the wall clamp at zero occupancy
+        assert occupancy_pct(5.0, 2.0) == pytest.approx(0.0)
+        assert occupancy_pct(0.5, None) is None
+        assert occupancy_pct(0.5, 0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Transfer-guard audit plumbing (the guard itself cannot fire on the CPU
+# backend — fetches are same-device copies — so these pin the wiring,
+# and the slow tier runs a real query under the armed guard)
+# ---------------------------------------------------------------------------
+
+class TestTransferGuard:
+    def test_off_mode_is_noop_context(self):
+        with guard_context("off"):
+            pass
+        with guard_context(None):
+            pass
+
+    def test_log_mode_returns_enterable_context(self):
+        import jax
+        import numpy as np
+        with guard_context("log"):
+            # an explicit fetch under the armed guard completes (logged
+            # at worst); the sync-scope allow re-entry is exercised by
+            # arming the mode first
+            sl.set_guard_mode("log")
+            with sync_scope("test.guarded"):
+                got = jax.device_get(jax.numpy.arange(4))
+            np.testing.assert_array_equal(got, np.arange(4))
+
+    def test_set_guard_mode_validates(self):
+        sl.set_guard_mode("log")
+        assert sl.guard_mode() == "log"
+        sl.set_guard_mode("bogus")
+        assert sl.guard_mode() is None
+
+    def test_conf_validates_transfer_guard_values(self, session):
+        session.set_conf("spark.rapids.tpu.debug.transferGuard", "log")
+        session.reset_conf()
+        with pytest.raises(Exception):
+            session.set_conf(
+                "spark.rapids.tpu.debug.transferGuard", "sideways")
+
+
+# ---------------------------------------------------------------------------
+# End-to-end attribution
+# ---------------------------------------------------------------------------
+
+def _fresh_df(session, n=100, parts=2):
+    return session.create_dataframe(
+        pd.DataFrame({"a": list(range(n)), "b": [1.5] * n}), parts)
+
+
+class TestEndToEnd:
+    def test_collect_lands_named_sites(self, session):
+        seq0 = SYNC_LEDGER.seq
+        out = _fresh_df(session).filter(F.col("a") > 10).collect()
+        assert len(out) == 89
+        ents = SYNC_LEDGER.entries(since_seq=seq0)
+        assert ents, "a collect must block at least once"
+        # the acceptance bar: blocking fetch time attributes to NAMED
+        # sites (the fallback scopes guarantee nothing lands unnamed)
+        assert all(e["site"] for e in ents)
+        sites = {e["site"] for e in ents}
+        assert "collect.fetch" in sites
+        drain = next(e for e in ents if e["site"] == "collect.fetch")
+        assert drain["op"] == "Collect"
+        assert drain["bytes"] > 0
+        assert drain["query"] is not None
+
+    def test_profile_carries_syncs_section(self, session):
+        _fresh_df(session, 64, 1).group_by().agg(
+            F.max("a").alias("m")).collect()
+        prof = session.profile_json()
+        sy = prof["summary"].get("syncs")
+        assert sy and sy["count"] > 0
+        assert sy["seconds"] >= 0.0
+        assert sy["bySite"] and sy["bySite"][0]["site"]
+        assert sy["occupancyPct"] is not None
+        assert 0.0 <= sy["occupancyPct"] <= 100.0
+
+    def test_query_stats_live_rollup(self, session):
+        seq0 = SYNC_LEDGER.seq
+        _fresh_df(session, 32, 1).collect()
+        ents = SYNC_LEDGER.entries(since_seq=seq0)
+        qid = next(e["query"] for e in ents if e.get("query"))
+        stats = SYNC_LEDGER.query_stats(qid)
+        assert stats["syncs"] >= 1
+        assert stats["sites"]
+
+    def test_flight_dump_includes_syncs(self, session):
+        from spark_rapids_tpu.obs.events import EVENTS
+        with sync_scope("test.flight", nbytes=1):
+            pass
+        ev = EVENTS.dump_flight(reason="test")
+        assert "syncs" in ev
+        assert any(e.get("site") == "test.flight" for e in ev["syncs"])
+
+    def test_diagnostics_includes_syncs(self, session):
+        from spark_rapids_tpu.obs.monitor import dump_diagnostics
+        ev = dump_diagnostics(reason="test")
+        assert "syncs" in ev and isinstance(ev["syncs"], list)
+
+    def test_q6_steady_state_sync_budget(self, session):
+        """ROADMAP item 4's invariant, pinned: the SECOND run of tpch q6
+        performs a bounded number of host syncs — measured at 5 on this
+        plan (3 partition uploads + 1 prefetch stall + 1 collect drain),
+        pinned at 8 for stall-timing headroom. A new eager fetch on the
+        q6 path (a row-count peek, an extra stats materialization) trips
+        this before any wall-clock gate notices."""
+        from spark_rapids_tpu.models import tpch_data
+        from spark_rapids_tpu.models.tpch import QUERIES
+        lineitem = tpch_data.gen_lineitem(0.002)
+
+        def run():
+            tables = {"lineitem": session.create_dataframe(lineitem, 3)}
+            return QUERIES["q6"](session, tables).collect()
+
+        first = run()
+        seq0 = SYNC_LEDGER.seq
+        second = run()
+        ents = SYNC_LEDGER.entries(since_seq=seq0)
+        budget = 8
+        assert len(ents) <= budget, (
+            f"host-sync budget regression: steady-state q6 blocked "
+            f"{len(ents)}x (budget {budget}): "
+            + ", ".join(f"{e['site']}({e.get('op')})" for e in ents))
+        pd.testing.assert_frame_equal(first, second)
+
+
+# ---------------------------------------------------------------------------
+# Transfer-guard coverage audit over a real query (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestTransferGuardAudit:
+    def test_tpch_query_completes_under_armed_guard(self, session):
+        """Coverage audit: a tpch query runs under
+        ``debug.transferGuard=log`` — every engine fetch re-enters
+        ``allow`` inside its sync_scope, so the run completes cleanly
+        and every blocking point in the window is a NAMED ledger entry
+        (an unnamed site would mean a fetch escaped the scopes)."""
+        from spark_rapids_tpu.models import tpch_data
+        from spark_rapids_tpu.models.tpch import QUERIES
+        session.set_conf("spark.rapids.tpu.debug.transferGuard", "log")
+        try:
+            lineitem = tpch_data.gen_lineitem(0.002)
+            tables = {"lineitem": session.create_dataframe(lineitem, 3)}
+            seq0 = SYNC_LEDGER.seq
+            out = QUERIES["q6"](session, tables).collect()
+            assert len(out) == 1
+            ents = SYNC_LEDGER.entries(since_seq=seq0)
+            assert ents
+            assert all(e["site"] for e in ents)
+        finally:
+            session.reset_conf()
+        assert sl.guard_mode() is None

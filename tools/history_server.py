@@ -303,13 +303,27 @@ def render_query_page(r: Dict[str, Any], detail: Dict[str, Any]) -> str:
                   f"cache" if ents0 else "")
         warm = (f" &middot; warm-up share <b>{min(share, 100.0):.1f}%"
                 f"</b>{cached}")
+    # host-sync share: how much of this query's wall the device spent
+    # blocked on host round-trips, with the dominant sites named
+    # (obs/syncledger.py — the per-query face of the occupancy auditor)
+    sy0 = r.get("sync") or {}
+    syncline = ""
+    if sy0.get("syncs"):
+        sh = sy0.get("share_pct")
+        tops = sorted((sy0.get("sites") or {}).items(),
+                      key=lambda kv: -kv[1].get("seconds", 0.0))[:3]
+        sites = ", ".join(site for site, _ in tops)
+        syncline = (f" &middot; host syncs <b>{sy0['syncs']}</b> "
+                    f"({sy0['seconds']:.3f}s"
+                    + (f", {sh:.1f}% of wall" if sh is not None else "")
+                    + (f"; {_esc(sites)}" if sites else "") + ")")
     out.append(
         f"<p>tenant <b>{_esc(r.get('tenant') or 'default')}</b> &middot; "
         f"wall {wall} &middot; op coverage <b>{cov}</b> &middot; "
         f"time coverage {tcov} &middot; "
         f"spill {r['spill']['bytes']}B &middot; "
         f"fetch retries {r['fetch']['retries']} &middot; "
-        f"compile {r['compile']['seconds']:.2f}s{warm}</p>")
+        f"compile {r['compile']['seconds']:.2f}s{warm}{syncline}</p>")
     if r.get("error"):
         out.append(f"<p class='failed'>error: {_esc(r['error'])}</p>")
     serving = r.get("serving") or {}

@@ -51,6 +51,14 @@ drift (total spill events growing more than
 management got worse), and oracle verification. ``--ignore-stress``
 reports the deltas without gating.
 
+And it gates **host syncs** (docs/observability.md, the sync ledger):
+a common query whose steady-state blocking host-sync count
+(``host_syncs`` — syncs per timed iteration) grew more than
+``--sync-threshold`` (default 0.25 relative), or whose sync-blocked
+wall share (``sync_s``/``tpu_s``) grew more than ``--sync-threshold``
+absolute, exits 1 — the device went idle on host orchestration more
+than it used to. ``--ignore-syncs`` disables.
+
 And it gates **roofline class** (docs/roofline.md): pass ``--roofline
 OLD.json NEW.json`` with two ``tools/roofline.py`` artifacts and any
 common query whose dominant kernel's HBM-utilization class dropped
@@ -166,6 +174,25 @@ def scan_from_doc(doc: Dict[str, Any]) -> Dict[str, float]:
                 out[name] = float(rec["cpu_s"]) / float(rec["tpu_scan_off_s"])
         return out
     return {}
+
+
+def syncs_from_doc(doc: Dict[str, Any]) -> Dict[str, Dict[str, float]]:
+    """Per-query steady-state host-sync facts from a BENCH_DETAIL-shaped
+    artifact (``bench.py`` records ``host_syncs`` — blocking device<->
+    host points per timed iteration, obs/syncledger.py — and ``sync_s``):
+    ``counts`` maps query -> syncs-per-iteration, ``shares`` maps
+    query -> sync-blocked fraction of steady-state wall (sync_s/tpu_s).
+    Empty maps for artifact shapes without them."""
+    out: Dict[str, Dict[str, float]] = {"counts": {}, "shares": {}}
+    if isinstance(doc.get("queries"), dict):
+        for name, rec in doc["queries"].items():
+            if not isinstance(rec, dict) or "host_syncs" not in rec:
+                continue
+            out["counts"][name] = float(rec["host_syncs"])
+            if rec.get("sync_s") is not None and rec.get("tpu_s"):
+                out["shares"][name] = (float(rec["sync_s"])
+                                       / float(rec["tpu_s"]))
+    return out
 
 
 def losers_from_doc(doc: Dict[str, Any],
@@ -413,7 +440,10 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
             scan_threshold: float = 0.10,
             base_losers: Optional[int] = None,
             new_losers: Optional[int] = None,
-            gate_losers: bool = True) -> Dict[str, Any]:
+            gate_losers: bool = True,
+            base_syncs: Optional[Dict[str, Dict[str, float]]] = None,
+            new_syncs: Optional[Dict[str, Dict[str, float]]] = None,
+            sync_threshold: float = 0.25) -> Dict[str, Any]:
     common = sorted(set(base) & set(new))
     deltas = []
     for q in common:
@@ -521,7 +551,41 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
     losers_regressed = (gate_losers and base_losers is not None
                         and new_losers is not None
                         and new_losers > base_losers)
+    # host-sync gate (--sync-threshold): a query's steady-state blocking
+    # syncs per iteration growing more than sync_threshold relative, or
+    # its sync-blocked wall SHARE growing more than sync_threshold
+    # absolute, regresses — the device sat idle on host orchestration
+    # more than it used to (obs/syncledger.py, ROADMAP item 4's
+    # "syncs per query -> <= 1 collect" trajectory)
+    bsy = base_syncs or {"counts": {}, "shares": {}}
+    nsy = new_syncs or {"counts": {}, "shares": {}}
+    sync_deltas = []
+    for q in sorted(set(bsy["counts"]) & set(nsy["counts"])):
+        b, n = bsy["counts"][q], nsy["counts"][q]
+        if abs(n - b) < 1e-9:
+            continue
+        growth = (n - b) / max(b, 1.0)
+        sync_deltas.append({"query": q, "base": b, "new": n,
+                            "growth_pct": round(100.0 * growth, 1),
+                            "regressed": growth > sync_threshold})
+    sync_regressions = [d["query"] for d in sync_deltas
+                        if d["regressed"]]
+    sync_share_deltas = []
+    for q in sorted(set(bsy["shares"]) & set(nsy["shares"])):
+        b, n = bsy["shares"][q], nsy["shares"][q]
+        if abs(n - b) < 1e-9:
+            continue
+        sync_share_deltas.append({
+            "query": q, "base": round(b, 4), "new": round(n, 4),
+            "regressed": (n - b) > sync_threshold})
+    sync_share_regressions = [d["query"] for d in sync_share_deltas
+                              if d["regressed"]]
     return {
+        "sync_deltas": sync_deltas,
+        "sync_regressions": sync_regressions,
+        "sync_share_deltas": sync_share_deltas,
+        "sync_share_regressions": sync_share_regressions,
+        "sync_threshold": round(sync_threshold, 4),
         "scan_deltas": scan_deltas,
         "scan_regressions": scan_regressions,
         "scan_threshold_pct": round(100.0 * scan_threshold, 2),
@@ -560,7 +624,8 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
         or bool(compile_regressions) or bool(dispatch_regressions)
         or bool(warmup_regressions) or bool(first_query_regressions)
         or bool(scan_regressions) or scan_geo_regressed
-        or losers_regressed,
+        or losers_regressed or bool(sync_regressions)
+        or bool(sync_share_regressions),
     }
 
 
@@ -629,6 +694,17 @@ def render_text(rep: Dict[str, Any]) -> str:
                          f"{d['base']:.2f}x -> {d['new']:.2f}x "
                          f"({d['delta_pct']:+.1f}%) SCAN-INCLUSIVE "
                          "REGRESSION")
+    for d in rep.get("sync_deltas", []):
+        if d["regressed"]:
+            lines.append(f"-- host_syncs {d['query']}: "
+                         f"{d['base']:.0f} -> {d['new']:.0f} "
+                         f"({d['growth_pct']:+.1f}%) HOST-SYNC "
+                         "REGRESSION")
+    for d in rep.get("sync_share_deltas", []):
+        if d["regressed"]:
+            lines.append(f"-- sync share {d['query']}: "
+                         f"{d['base']:.2f} -> {d['new']:.2f} "
+                         "HOST-SYNC-SHARE REGRESSION")
     if rep.get("n_below_1x_base") is not None \
             and rep.get("n_below_1x_new") is not None:
         mark = " LOSER-COUNT REGRESSION" if rep.get("losers_regressed") \
@@ -691,6 +767,14 @@ def main(argv=None) -> int:
                          "0.10 = 10%%)")
     ap.add_argument("--ignore-scan", action="store_true",
                     help="do not gate on scan-inclusive drift")
+    ap.add_argument("--sync-threshold", type=float, default=0.25,
+                    help="host-sync growth bound (default 0.25): "
+                         "relative for per-iteration sync COUNTS "
+                         "(host_syncs), absolute for the sync-blocked "
+                         "wall SHARE (sync_s/tpu_s)")
+    ap.add_argument("--ignore-syncs", action="store_true",
+                    help="do not gate on steady-state host-sync count "
+                         "or sync-share growth")
     ap.add_argument("--ignore-losers", action="store_true",
                     help="do not gate on n_below_1x (sub-1x query "
                          "count) growth between sweeps")
@@ -767,6 +851,10 @@ def main(argv=None) -> int:
             else warmup_from_doc(new_doc)
         base_s = {} if args.ignore_scan else scan_from_doc(base_doc)
         new_s = {} if args.ignore_scan else scan_from_doc(new_doc)
+        base_sy = {"counts": {}, "shares": {}} if args.ignore_syncs \
+            else syncs_from_doc(base_doc)
+        new_sy = {"counts": {}, "shares": {}} if args.ignore_syncs \
+            else syncs_from_doc(new_doc)
         base_l = losers_from_doc(base_doc, base)
         new_l = losers_from_doc(new_doc, new)
         roof = None
@@ -795,7 +883,9 @@ def main(argv=None) -> int:
                   base_scan=base_s, new_scan=new_s,
                   scan_threshold=args.scan_threshold,
                   base_losers=base_l, new_losers=new_l,
-                  gate_losers=not args.ignore_losers)
+                  gate_losers=not args.ignore_losers,
+                  base_syncs=base_sy, new_syncs=new_sy,
+                  sync_threshold=args.sync_threshold)
     if roof is not None:
         rep["roofline_deltas"] = roof
         regressed = any(d["regressed"] for d in roof)

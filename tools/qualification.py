@@ -25,6 +25,11 @@ Per query it computes:
     varying shape dimensions named and padding buckets recommended
     (obs/compileledger.analyze; ``tools/compile_report.py`` is the
     standalone deep-dive);
+  * **host-sync share** — blocking device<->host points per query
+    (``hostSync`` events / the profile's ``syncs`` section,
+    obs/syncledger.py), queries ranked by the share of their wall spent
+    sync-blocked with the top sites named — the "this workload keeps
+    the device idle on host orchestration" signal;
   * **shuffle skew** — per-query max/median partition-size ratio from
     ``shuffleSkew`` events (obs/shuffleobs.py), AQE on or off — the
     "this workload would benefit from adaptive execution" signal;
@@ -103,6 +108,8 @@ def _new_record(name: str, source: str) -> Dict[str, Any]:
         "compile": {"compiles": 0, "seconds": 0.0, "cache_misses": 0,
                     "warmup_share_pct": None, "entries": []},
         "scan": {"stalls": 0, "stall_s": 0.0, "budget_stalls": 0},
+        "sync": {"syncs": 0, "seconds": 0.0, "bytes": 0,
+                 "share_pct": None, "sites": {}},
         "shuffle_skew": {"shuffles": 0, "max_ratio": None,
                          "max_bytes": 0},
         "aqe": {"adaptive": False, "stages": 0, "coalesced_reads": 0,
@@ -192,6 +199,10 @@ def records_from_events(events: List[Dict[str, Any]],
                     r["compile"]["warmup_share_pct"] = round(min(
                         100.0 * r["compile"]["seconds"] / r["wall_s"],
                         100.0), 2)
+                if r["sync"]["seconds"]:
+                    r["sync"]["share_pct"] = round(min(
+                        100.0 * r["sync"]["seconds"] / r["wall_s"],
+                        100.0), 2)
         elif kind == "spill":
             r["spill"]["events"] += 1
             r["spill"]["bytes"] += int(ev.get("bytes", 0))
@@ -225,6 +236,17 @@ def records_from_events(events: List[Dict[str, Any]],
                 r["scan"]["stall_s"] + float(ev.get("stall_s", 0.0)), 6)
         elif kind == "scanBudgetStall":
             r["scan"]["budget_stalls"] += 1
+        elif kind == "hostSync":
+            sy = r["sync"]
+            sy["syncs"] += 1
+            sy["seconds"] = round(
+                sy["seconds"] + float(ev.get("seconds", 0.0) or 0.0), 6)
+            sy["bytes"] += int(ev.get("bytes", 0) or 0)
+            site = str(ev.get("site", "?"))
+            st = sy["sites"].setdefault(site, {"syncs": 0, "seconds": 0.0})
+            st["syncs"] += 1
+            st["seconds"] = round(
+                st["seconds"] + float(ev.get("seconds", 0.0) or 0.0), 6)
         elif kind == "shuffleSkew":
             sk = r["shuffle_skew"]
             sk["shuffles"] += 1
@@ -341,6 +363,22 @@ def record_from_profile(doc: Dict[str, Any], name: str) -> Dict[str, Any]:
             r["scan"]["stall_s"] = round(float(v), 6)
         elif k.startswith("scan.prefetch.budgetStalls"):
             r["scan"]["budget_stalls"] = int(v)
+    # archived profiles carry the sync ledger's per-site rollup (the
+    # ``syncs`` section, obs/syncledger.py): the report's host-sync
+    # share ranking works from archived bench attribution too
+    sy = summary.get("syncs") or {}
+    if sy:
+        r["sync"]["syncs"] = int(sy.get("count", 0) or 0)
+        r["sync"]["seconds"] = round(float(sy.get("seconds", 0.0)
+                                           or 0.0), 6)
+        r["sync"]["bytes"] = int(sy.get("bytes", 0) or 0)
+        for site in sy.get("bySite") or []:
+            r["sync"]["sites"][str(site.get("site", "?"))] = {
+                "syncs": int(site.get("syncs", 0) or 0),
+                "seconds": float(site.get("seconds", 0.0) or 0.0)}
+        if r["wall_s"] and r["sync"]["seconds"]:
+            r["sync"]["share_pct"] = round(min(
+                100.0 * r["sync"]["seconds"] / r["wall_s"], 100.0), 2)
     sk = summary.get("shuffleSkew") or {}
     for k, v in sk.items():
         if k.startswith("shuffle.skew.shuffles"):
@@ -407,6 +445,9 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "fetch_retries": sum(r["fetch"]["retries"] for r in records),
         "compile_seconds": round(sum(r["compile"]["seconds"]
                                      for r in records), 2),
+        "host_syncs": sum(r["sync"]["syncs"] for r in records),
+        "sync_seconds": round(sum(r["sync"]["seconds"]
+                                  for r in records), 2),
     }
     # warm-up compile causes across the whole workload: the enriched
     # backendCompile records grouped by kernel identity, varying
@@ -498,6 +539,33 @@ def render_text(report: Dict[str, Any], top_n: int = 15) -> str:
                 lines.append(f"{'':>19}  varies: {where} in [{vals}]"
                              + (f" -> pad to [{bucks}]" if bucks
                                 else ""))
+    # host-sync share ranking (obs/syncledger.py): the queries whose
+    # wall is most blocked on device<->host syncs are the ones a
+    # batching / async-drain change pays off on first
+    synced = [r for r in report["queries"]
+              if (r.get("sync") or {}).get("syncs")]
+    if synced:
+        lines.append("")
+        lines.append(
+            f"-- host-sync share ({t.get('host_syncs', 0)} syncs, "
+            f"{t.get('sync_seconds', 0.0):.2f}s blocked; queries ranked "
+            "by sync-time share of wall)")
+        lines.append(f"{'share%':>7} {'syncs':>6} {'sync_s':>8}  "
+                     f"query / top sites")
+        ranked_sync = sorted(
+            synced, key=lambda x: -(x["sync"]["share_pct"] or 0.0))
+        for r in ranked_sync[:top_n]:
+            sy = r["sync"]
+            share = f"{sy['share_pct']:.1f}" \
+                if sy["share_pct"] is not None else "-"
+            tops = sorted(sy["sites"].items(),
+                          key=lambda kv: -kv[1]["seconds"])[:3]
+            sites = ", ".join(
+                f"{site} ({st['syncs']}x {st['seconds']:.3f}s)"
+                for site, st in tops)
+            lines.append(f"{share:>7} {sy['syncs']:>6} "
+                         f"{sy['seconds']:>8.3f}  {r['query']}"
+                         + (f": {sites}" if sites else ""))
     hot = {}
     for r in report["queries"]:
         for peer, n in r["fetch"]["by_peer"].items():

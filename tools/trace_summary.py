@@ -5,8 +5,9 @@ Reads any artifact the obs/ subsystem emits:
 
   * a Chrome trace JSON (``spark.rapids.tpu.trace.path`` export) — computes
     per-span exclusive time (duration minus directly-nested child spans on
-    the same thread), aggregates by span name, and counts instant events
-    (fetch retries, transport drops);
+    the same thread), aggregates by span name, ranks the sync track's
+    ``sync.<site>`` device-idle gaps (obs/syncledger.py), and counts
+    instant events (fetch retries, transport drops);
   * a per-query profile JSON (``session.profile_json()`` /
     ``docs/bench_profiles/*.profile.json``) — walks the plan tree for
     exclusive operator time and prints the spill/shuffle/kernel-cache
@@ -67,6 +68,25 @@ def _summarize_trace(doc: Dict[str, Any], top_n: int) -> None:
     print(f"{'exclusive_s':>12}  {'count':>6}  span")
     for total, count, name in rows[:top_n]:
         print(f"{total:12.4f}  {count:6d}  {name}")
+    # the sync track (obs/syncledger.py): every ``sync.<site>`` span is
+    # a host-blocking device round-trip — an idle gap on the device
+    # timeline. Rank the individual longest gaps and name the site so
+    # "where did the device sit idle" reads straight off the summary.
+    gaps = [ev for ev in events
+            if ev.get("ph") == "X" and "dur" in ev
+            and str(ev.get("name", "")).startswith("sync.")]
+    if gaps:
+        total_s = sum(ev["dur"] for ev in gaps) / 1e6
+        print(f"-- idle gaps (host syncs): {len(gaps)} gaps, "
+              f"{total_s:.4f}s device-idle")
+        print(f"{'gap_s':>10}  site")
+        for ev in sorted(gaps, key=lambda e: -e["dur"])[:top_n]:
+            site = str(ev["name"])[len("sync."):]
+            args_ = ev.get("args") or {}
+            extra = ""
+            if args_.get("bytes"):
+                extra = f" ({int(args_['bytes'])}B)"
+            print(f"{ev['dur'] / 1e6:10.4f}  {site}{extra}")
     instants: Dict[str, int] = {}
     for ev in events:
         if ev.get("ph") == "i":
@@ -115,6 +135,21 @@ def _summarize_profile(doc: Dict[str, Any], top_n: int) -> None:
         print(f"-- warmup attribution: {ran:.1f}s backend compile "
               f"({n} compiles), {hits} persistent-cache hits "
               f"({saved:.1f}s saved)")
+    sy = doc.get("summary", {}).get("syncs") or {}
+    if sy:
+        # device-occupancy at a glance: wall share NOT blocked on host
+        # round-trips, with the dominant sync site named
+        # (obs/syncledger.py)
+        occ = sy.get("occupancyPct")
+        top_site = (sy.get("bySite") or [{}])[0]
+        print(f"-- occupancy: "
+              + (f"{occ:.1f}% device-busy estimate, "
+                 if occ is not None else "")
+              + f"{sy.get('count', 0)} host syncs "
+              f"{sy.get('seconds', 0.0):.4f}s blocked"
+              + (f" (top site {top_site.get('site')} "
+                 f"{top_site.get('seconds', 0.0):.4f}s)"
+                 if top_site.get("site") else ""))
 
 
 def _summarize_event_log(path: str, top_n: int) -> None:
