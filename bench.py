@@ -81,6 +81,17 @@ per-tenant plan/result-cache hit rates, every job verified against the
 CPU oracle. `tools/perfdiff.py OLD_SERVE.json BENCH_SERVE.json` gates
 serve-mode throughput regressions.
 
+Fleet tier (`--fleet N`): runs ONLY the multi-process serve phase — N
+fleet worker processes (spark_rapids_tpu/serving/fleet/) over one
+shared fleet dir (BENCH_FLEET_DIR; shared XLA cache + warm manifest),
+the sweep's queries routed by sticky tenant placement, every job
+verified against the owning worker's CPU oracle, writing
+BENCH_FLEET.json (per-replica qps/p99/shed, placement churn;
+BENCH_FLEET_FILE to override, BENCH_FLEET_REPEATS rounds,
+BENCH_FLEET_SCHED_WORKERS in-worker concurrency). `tools/perfdiff.py
+BENCH_SERVE.json BENCH_FLEET.json` gates the scaling ratio
+(docs/fleet.md).
+
 Stress tier (`--stress`): runs ONLY the out-of-core stress phase —
 join/agg/sort over BENCH_STRESS_ROWS rows (default 400000, ~10MB
 working set) with spark.rapids.tpu.outOfCore.* enabled at a
@@ -1016,9 +1027,152 @@ def _wait_for_idle_box():
     return True
 
 
+def _fleet_phase(n):
+    """--fleet N: the multi-process serve tier (serving/fleet/) over the
+    same sweep — N worker processes sharing one fleet dir (shared XLA
+    cache + warm manifest), tenants spread by sticky placement, every
+    job's result verified against the owning worker's CPU oracle.
+    Writes BENCH_FLEET.json; `tools/perfdiff.py BENCH_SERVE.json
+    BENCH_FLEET.json` gates the scaling ratio (qps >= --fleet-scaling
+    x N x single-process qps)."""
+    import tempfile
+
+    from spark_rapids_tpu.serving.fleet.router import (
+        launch_process_fleet,
+    )
+    suite_env, sweep = _parse_sweep()
+    sf = float(os.environ.get("BENCH_SF", "0.5"))
+    repeats = int(os.environ.get("BENCH_FLEET_REPEATS", "2"))
+    sched_workers = int(os.environ.get("BENCH_FLEET_SCHED_WORKERS", "2"))
+    fleet_dir = os.environ.get("BENCH_FLEET_DIR") or tempfile.mkdtemp(
+        prefix="bench-fleet-")
+    start_timeout = float(os.environ.get("BENCH_FLEET_START_TIMEOUT_S",
+                                         "300"))
+    per_query_timeout = float(os.environ.get("BENCH_QUERY_TIMEOUT_S",
+                                             "600"))
+    base_conf = {"spark.rapids.tpu.ui.enabled": False}
+    router = launch_process_fleet(
+        n, fleet_dir, base_conf=base_conf,
+        spec_extras={"schedulerWorkers": sched_workers},
+        start_timeout=start_timeout)
+    rec = {"mode": "fleet", "workers": n, "suite": suite_env, "sf": sf,
+           "repeats": repeats, "scheduler_workers": sched_workers}
+    try:
+        specs = {name: {"kind": "suite", "suite": sn, "query": q,
+                        "sf": sf}
+                 for name, sn, q in sweep}
+        # serial warm pass, one job per query: suite tables build on
+        # each tenant's sticky home, compiles land in the shared cache
+        # + warm manifest, and the home replica is then the oracle
+        # source for that query
+        oracles, homes, failed = {}, {}, []
+        for name, sn, q in sweep:
+            job = router.submit(specs[name], tenant=sn,
+                                description=f"warm {name}")
+            if job.wait(per_query_timeout) != "succeeded":
+                failed.append(f"warm {name}: {job.status}: "
+                              f"{job.error}"[:160])
+                continue
+            homes[name] = job.replica
+            reply = router.worker(job.replica).oracle(
+                specs[name], timeout=per_query_timeout)
+            if reply is None or reply.get("result") is None:
+                failed.append(f"oracle {name}: "
+                              f"{str(reply)[:120] if reply else 'timeout'}")
+                continue
+            from spark_rapids_tpu.serving.fleet.worker import (
+                deserialize_frame,
+            )
+            oracles[name] = deserialize_frame(reply["result"])
+        runnable = [ent for ent in sweep if ent[0] in oracles]
+        # timed phase: repeats x sweep through the router, results
+        # verified per job
+        jobs = []
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            for name, sn, q in runnable:
+                jobs.append((name, router.submit(
+                    specs[name], tenant=sn, description=name,
+                    want_result=True)))
+        router.drain(timeout=per_query_timeout * max(len(jobs), 1))
+        wall = time.perf_counter() - t0
+        lat, statuses, verified = [], {}, True
+        per_replica = {}
+        for name, job in jobs:
+            st = job.status
+            statuses[st] = statuses.get(st, 0) + 1
+            rep = per_replica.setdefault(
+                job.replica or "?", {"jobs": 0, "latencies_s": [],
+                                     "shed": 0})
+            rep["jobs"] += 1
+            if st == "shed":
+                rep["shed"] += 1
+            if job.wall_s is not None:
+                lat.append(job.wall_s)
+                rep["latencies_s"].append(job.wall_s)
+            if st != "succeeded":
+                verified = False
+                failed.append(f"{name}: {st}: {job.error}"[:160])
+            elif not _results_match(job.result(), oracles[name]):
+                verified = False
+                failed.append(f"{name}: result mismatch vs CPU oracle "
+                              f"(replica {job.replica})")
+        lat.sort()
+
+        def q_at(p):
+            return round(lat[min(len(lat) - 1,
+                                 int(p * (len(lat) - 1)))], 4) \
+                if lat else None
+        for rep in per_replica.values():
+            ls = sorted(rep.pop("latencies_s"))
+            rep["p99_s"] = round(
+                ls[min(len(ls) - 1, int(0.99 * (len(ls) - 1)))], 4) \
+                if ls else None
+        snap = router.snapshot(include_workers=False)
+        rec.update({
+            "jobs": len(jobs), "wall_s": round(wall, 4),
+            "qps": round(len(jobs) / wall, 4) if wall > 0 else None,
+            "latency_s": {"p50": q_at(0.50), "p95": q_at(0.95),
+                          "p99": q_at(0.99)},
+            "per_replica": per_replica,
+            "placement": {name: homes.get(name) for name in homes},
+            "placement_churn": snap["placementChurn"],
+            "shed": snap["shedTotal"],
+            "statuses": statuses,
+            "verified": verified and not failed,
+            "failures": failed[:20],
+        })
+    finally:
+        router.shutdown()
+    fleet_file = os.environ.get("BENCH_FLEET_FILE", "BENCH_FLEET.json")
+    try:
+        with open(fleet_file, "w") as f:
+            json.dump(rec, f, indent=1)
+    except OSError as e:
+        print(f"bench: could not write {fleet_file}: {e}",
+              file=sys.stderr, flush=True)
+    return {"metric": "fleet_qps", "value": rec.get("qps") or 0.0,
+            "unit": "qps", "workers": n,
+            "p99_s": (rec.get("latency_s") or {}).get("p99"),
+            "shed": rec.get("shed"), "verified": rec.get("verified"),
+            "placement_churn": rec.get("placement_churn"),
+            "detail_file": fleet_file}
+
+
 def main():
     if "--worker" in sys.argv:
         _worker()
+        return
+    if "--fleet" in sys.argv:
+        # multi-process serve tier: runs ONLY the fleet phase, writing
+        # BENCH_FLEET.json. Gate the scaling ratio against the single-
+        # process serve baseline with
+        # `python tools/perfdiff.py BENCH_SERVE.json BENCH_FLEET.json`.
+        idx = sys.argv.index("--fleet")
+        n = int(sys.argv[idx + 1]) if idx + 1 < len(sys.argv) and \
+            sys.argv[idx + 1].isdigit() else 2
+        _wait_for_idle_box()
+        print(json.dumps(_fleet_phase(n)))
         return
     if "--stress" in sys.argv:
         # out-of-core stress tier: runs ONLY the stress phase (join/agg/

@@ -1145,6 +1145,91 @@ SERVING_EXCHANGE_REUSE_MAX_BYTES = register(
     "exchange reuse (measured shuffle bytes; oldest evicted first).",
     validator=_positive)
 
+# --- fleet serving tier (serving/fleet/: multi-process router + worker
+# replicas, shared warm state, rolling restarts — the replicated-service
+# deployment story over the single-process serving layer above) -----------
+FLEET_WORKERS = register(
+    "spark.rapids.tpu.fleet.workers", int, 0,
+    "Number of WORKER PROCESSES in the fleet serving tier "
+    "(serving/fleet/): a front-end router process spreads tenants "
+    "across this many worker processes, each a full session "
+    "bootstrapped from the shared conf. 0 (default) disables the fleet "
+    "tier entirely — the single-process serving path is byte-identical "
+    "(serving/fleet is never even imported).", validator=_non_negative)
+
+FLEET_DIR = register(
+    "spark.rapids.tpu.fleet.dir", str, "",
+    "Shared state directory of the fleet: the cross-process compile "
+    "cache lands in <dir>/compilecache, the shared warm manifest "
+    "(plan-identity -> replayable argspec records, the rolling-restart "
+    "pre-warm source) in <dir>/warm.jsonl, and per-replica event logs "
+    "in <dir>/events-<replica>.jsonl. Empty (default) lets the router "
+    "create a per-fleet temporary directory.")
+
+FLEET_SPILLOVER_DEPTH = register(
+    "spark.rapids.tpu.fleet.spillover.queueDepth", int, 4,
+    "Queue-depth threshold past which the router abandons a tenant's "
+    "sticky replica for THIS submission and routes to the least-loaded "
+    "replica instead (placement reason 'spillover', a fleetPlacement "
+    "journal event, srt_fleet_placement_churn_total). Sticky placement "
+    "resumes as soon as the home replica's queue drains below the "
+    "threshold, so plan caches stay hot in steady state.",
+    validator=_positive)
+
+FLEET_PLACEMENT_OVERRIDES = register(
+    "spark.rapids.tpu.fleet.placement.overrides", str, "",
+    "Explicit tenant -> replica pins overriding the consistent-hash "
+    "ring, as 'tenantA=r0,tenantB=r2' (replica ids are r0..rN-1). A "
+    "pinned tenant still spills over past fleet.spillover.queueDepth "
+    "and is re-placed if its replica is lost or draining.")
+
+FLEET_ROUTER_HOST = register(
+    "spark.rapids.tpu.fleet.router.host", str, "127.0.0.1",
+    "Bind host of the router's HTTP endpoint (/api/fleet aggregating "
+    "per-worker /api/status and /api/scheduler, /metrics with "
+    "per-replica srt_fleet_* series, /healthz).")
+
+FLEET_ROUTER_PORT = register(
+    "spark.rapids.tpu.fleet.router.port", int, 0,
+    "TCP port of the router's HTTP endpoint; 0 (default) binds an "
+    "ephemeral port (the bound URL is FleetMonitor.url).",
+    validator=_non_negative)
+
+FLEET_WARM_MANIFEST = register(
+    "spark.rapids.tpu.fleet.warmManifest", str, "",
+    "Path of the fleet's SHARED WARM MANIFEST: every real backend "
+    "compile (never persistent-cache hits) appends one flock-serialized "
+    "JSONL record carrying the kernel identity, shape signature and "
+    "replayable argument spec (obs/compilecache.py append path; "
+    "obs/compileledger.py provides the entry). The file is directly "
+    "consumable as a spark.rapids.tpu.compile.aot.manifest, so ANY "
+    "replica's first compile pre-warms every later replica — the "
+    "rolling-restart replacement replays it BEFORE taking traffic. "
+    "Empty (default) disables the sidecar.")
+
+FLEET_DRAIN_TIMEOUT = register(
+    "spark.rapids.tpu.fleet.restart.drainTimeoutSeconds", float, 60.0,
+    "Rolling restart: how long to wait for a quiesced worker's "
+    "in-flight jobs to finish under their own deadlines before the "
+    "swap proceeds anyway (the old worker is stopped; still-running "
+    "jobs surface as failed with replica attribution).",
+    validator=_non_negative)
+
+FLEET_READY_TIMEOUT = register(
+    "spark.rapids.tpu.fleet.prewarm.readyTimeoutSeconds", float, 120.0,
+    "Rolling restart: how long to wait for the replacement worker's "
+    "AOT pre-warm pass (shared warm manifest + shared XLA cache) to go "
+    "idle before it takes traffic. Past the timeout the swap proceeds "
+    "with whatever warmth the replacement has (workerReady journal "
+    "event records the pre-warm snapshot either way).",
+    validator=_non_negative)
+
+FLEET_WORKER_START_TIMEOUT = register(
+    "spark.rapids.tpu.fleet.worker.startTimeoutSeconds", float, 120.0,
+    "How long the router waits for a spawned worker process to answer "
+    "its first ping (session bootstrap included) before declaring the "
+    "spawn failed.", validator=_positive)
+
 UI_SIGNAL_DIAGNOSTICS = register(
     "spark.rapids.tpu.ui.signalDiagnostics", _to_bool, True,
     "Install a SIGUSR1 handler at session creation that dumps the "

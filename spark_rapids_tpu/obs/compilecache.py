@@ -51,6 +51,45 @@ _LOCK = threading.Lock()
 _MARKER = "_srt_compile_listeners_installed"
 
 
+def locked_append(path: str, payload: bytes) -> bool:
+    """Append ``payload`` to ``path`` as ONE durable record: O_APPEND +
+    an exclusive flock held across the write, and the write itself looped
+    to completion so a short write can never publish a record prefix.
+
+    O_APPEND alone keeps small writes atomic on local filesystems, but
+    the fleet manifest is multi-writer on arbitrary (possibly networked)
+    volumes where that guarantee does not hold and a single ``os.write``
+    may land partially. Under the flock no reader-with-lock or
+    writer-with-lock ever observes a torn record; the read side's
+    torn-tail tolerance stays as a belt for lockless readers.
+    """
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    except OSError:
+        return False
+    try:
+        try:
+            import fcntl
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            pass  # O_APPEND alone still lands whole small lines
+        view = memoryview(payload)
+        while view:
+            try:
+                n = os.write(fd, view)
+            except OSError:
+                return False  # record may be torn: readers skip it
+            if n <= 0:
+                return False
+            view = view[n:]
+    finally:
+        try:
+            os.close(fd)  # releases the flock
+        except OSError:
+            pass
+    return True
+
+
 # ---------------------------------------------------------------------------
 # Cross-process shared persistent compile cache
 # ---------------------------------------------------------------------------
@@ -95,6 +134,13 @@ class SharedCompileCache:
         self._index_size = -1
         self._ident = (os.getpid(), socket.gethostname())
         self._key_prefix: Optional[str] = None
+        # fleet warm-state sidecar (spark.rapids.tpu.fleet.warmManifest):
+        # a flock-serialized JSONL of REPLAYABLE compile records — same
+        # append discipline as the manifest, but carrying kernelKey +
+        # argspec so serving/prewarm.py can AOT-replay them in a fresh
+        # replica. Independent of the shared-cache enabled state: a
+        # fleet can share warm shapes without sharing an XLA cache dir.
+        self.warm_manifest_path = ""
         # jax cache dir in force before we pointed it at the shared
         # volume, restored when the shared cache is conf'd back off
         self._prev_jax_dir = None
@@ -107,7 +153,15 @@ class SharedCompileCache:
         min_s = float(conf.get(
             "spark.rapids.tpu.compile.sharedCache.minCompileSeconds",
             0.0))
+        self.configure_warm_manifest(
+            str(conf.get("spark.rapids.tpu.fleet.warmManifest", "")
+                or ""))
         return self.configure(d, min_compile_seconds=min_s)
+
+    def configure_warm_manifest(self, path: str) -> None:
+        """Point (or un-point) the warm-state sidecar at ``path``."""
+        with self._lock:
+            self.warm_manifest_path = path or ""
 
     def configure(self, directory: str,
                   min_compile_seconds: float = 0.0) -> bool:
@@ -213,28 +267,10 @@ class SharedCompileCache:
 
     def _append_locked(self, rec: Dict[str, Any]) -> bool:
         """One flock-serialized line append: concurrent workers on a
-        shared volume interleave whole lines, never bytes."""
+        shared volume interleave whole lines, never bytes
+        (``locked_append``)."""
         line = (json.dumps(rec, default=str) + "\n").encode("utf-8")
-        try:
-            fd = os.open(self._manifest_path,
-                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-        except OSError:
-            return False
-        try:
-            try:
-                import fcntl
-                fcntl.flock(fd, fcntl.LOCK_EX)
-            except (ImportError, OSError):
-                pass  # O_APPEND alone still lands whole small lines
-            os.write(fd, line)
-        except OSError:
-            return False
-        finally:
-            try:
-                os.close(fd)  # releases the flock
-            except OSError:
-                pass
-        return True
+        return locked_append(self._manifest_path, line)
 
     # -- event hooks --------------------------------------------------------
     def note_compile(self, entry: Dict[str, Any]) -> None:
@@ -242,7 +278,10 @@ class SharedCompileCache:
         path). Persistent-cache HITS are deserializations of an
         executable that is already shared — only real compiles append a
         manifest record."""
-        if not self.enabled or entry.get("outcome") == "hit":
+        if entry.get("outcome") == "hit":
+            return
+        self._note_warm(entry)
+        if not self.enabled:
             return
         from spark_rapids_tpu.obs.metrics import REGISTRY
         # key on the full-signature hash (kernelKey): the readable
@@ -263,6 +302,33 @@ class SharedCompileCache:
                 self._index.setdefault(key, rec)
         if ok:
             REGISTRY.counter("sharedCache.writes").add(1)
+
+    def _note_warm(self, entry: Dict[str, Any]) -> None:
+        """Append a REPLAYABLE record to the fleet warm-state sidecar.
+        Only entries carrying an argspec are useful — prewarm replays
+        the build from it — so un-attributed compiles are skipped. The
+        JSONL shape matches ``prewarm.load_manifest``'s entry schema
+        (kernel/kernelKey/avals/argspec/op/seconds), so the sidecar is
+        directly consumable as ``compile.aot.manifest``."""
+        with self._lock:
+            path = self.warm_manifest_path
+        if not path or not entry.get("argspec"):
+            return
+        rec = {"kernel": entry.get("kernel"),
+               "kernelKey": entry.get("kernelKey"),
+               "avals": entry.get("avals"),
+               "argspec": entry.get("argspec"),
+               "op": entry.get("op"),
+               "seconds": entry.get("seconds"),
+               "pid": self._ident[0], "host": self._ident[1],
+               "ts": entry.get("ts")}
+        try:
+            line = (json.dumps(rec, default=str) + "\n").encode("utf-8")
+        except (TypeError, ValueError):
+            return
+        if locked_append(path, line):
+            from spark_rapids_tpu.obs.metrics import REGISTRY
+            REGISTRY.counter("fleet.warmManifest.writes").add(1)
 
     def note_cache_event(self, outcome: str, dispatch) -> None:
         """Persistent-cache lookup outcome from the jax monitoring
@@ -321,6 +387,7 @@ class SharedCompileCache:
             self._index = {}
             self._index_size = -1
             self._key_prefix = None
+            self.warm_manifest_path = ""
 
 
 SHARED = SharedCompileCache()

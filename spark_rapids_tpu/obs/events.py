@@ -89,6 +89,20 @@ Event taxonomy (one JSON object per line; every event carries ``kind``,
   flightRecorder    session      reason, events[], compiles[], syncs[]
                                  (ring dump + compile-ledger and sync-
                                  ledger tails, see below)
+  fleetPlacement    fleet        tenant, replica, reason sticky|override|
+                                 spillover, previous — the router placed
+                                 (or moved) a tenant onto a replica
+                                 (serving/fleet/router.py)
+  workerDrain       fleet        replica, inflight — a rolling restart
+                                 quiesced a worker and began draining its
+                                 in-flight jobs under their deadlines
+  workerReady       fleet        replica, aot{warmed,pending,...},
+                                 waitSeconds — a replacement worker
+                                 finished its AOT pre-warm from the
+                                 shared warm manifest and took traffic
+  workerLost        fleet        replica, inflightFailed — a worker
+                                 process died; the router failed its
+                                 in-flight jobs and re-placed its tenants
 
 Every event between queryStart and queryEnd additionally carries the
 ``tenant`` tag when the session has a job group set

@@ -26,6 +26,10 @@ Endpoints:
   ``GET /api/scheduler``  live admission-scheduler state (serving/):
                           queue depth, running jobs, per-tenant lanes,
                           HBM quota usage, load-shed counts
+  ``GET /api/fleet``      live fleet-router state (serving/fleet/):
+                          per-replica health + depths, tenant placement
+                          map, churn/shed totals; empty when no router
+                          runs in this process
   ``GET /``               minimal self-contained HTML live view (polls
                           ``/api/queries``)
 
@@ -41,6 +45,7 @@ debugging without a REPL (``kill -USR1 <pid>``).
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -448,6 +453,16 @@ class _Handler(JsonHandler):
                     snapshot_all,
                 )
                 self._send_json(snapshot_all())
+            elif path == "/api/fleet":
+                # live fleet-router state (serving/fleet/router.py):
+                # per-replica health, placement map, churn/shed totals.
+                # Resolved via sys.modules so the single-process path
+                # never imports the fleet package — an empty list when
+                # no router runs in this process
+                mod = sys.modules.get(
+                    "spark_rapids_tpu.serving.fleet.router")
+                self._send_json(mod.snapshot_all() if mod is not None
+                                else {"fleets": []})
             elif path in ("/", "/index.html"):
                 self._send(200, _INDEX_HTML, "text/html; charset=utf-8")
             else:

@@ -14,7 +14,10 @@ share; this package is that serving layer for the port:
   * ``caches.py``       — cross-query plan cache (skips tag+convert
     planning on repeat submissions), opt-in result cache for repeated
     dashboard-style queries, and the AQE exchange-reuse cache that lets a
-    new query adopt an already-materialized shuffle stage.
+    new query adopt an already-materialized shuffle stage;
+  * ``fleet/``          — the multi-process tier: a router spreading
+    tenants across N worker processes with sticky placement, shared
+    warm state and rolling restarts (docs/fleet.md).
 
 See docs/serving.md for the scheduler model, quota semantics and cache
 invalidation rules.
@@ -35,4 +38,7 @@ def __getattr__(name):
     if name in ("PlanCache", "ResultCache", "ExchangeReuseCache"):
         from spark_rapids_tpu.serving import caches
         return getattr(caches, name)
+    if name == "fleet":
+        import importlib
+        return importlib.import_module("spark_rapids_tpu.serving.fleet")
     raise AttributeError(name)

@@ -43,12 +43,22 @@ class CancelScope:
     """One query's cancellation state. Thread-safe; ``check()`` is the
     hot-path call (two attribute loads when neither flag is set)."""
 
-    __slots__ = ("deadline_ts", "deadline_s", "_cancelled", "reason")
+    __slots__ = ("deadline_ts", "deadline_s", "elapsed_s", "_cancelled",
+                 "reason")
 
-    def __init__(self, deadline_s: Optional[float] = None):
+    def __init__(self, deadline_s: Optional[float] = None,
+                 elapsed_s: float = 0.0):
+        # elapsed_s: deadline budget already spent BEFORE this scope
+        # existed — a router that queued the submission upstream
+        # forwards the elapsed seconds (monotonic clocks are not
+        # comparable across processes, elapsed durations are), so the
+        # deadline keeps counting from the ORIGINAL submission. An
+        # elapsed >= deadline scope is born expired.
         self.deadline_s = deadline_s if deadline_s and deadline_s > 0 \
             else None
+        self.elapsed_s = max(float(elapsed_s or 0.0), 0.0)
         self.deadline_ts = (time.monotonic() + self.deadline_s
+                            - self.elapsed_s
                             if self.deadline_s else None)
         self._cancelled = False
         self.reason: Optional[str] = None

@@ -1,0 +1,49 @@
+"""Fleet serving tier: multi-process router + shared warm state.
+
+One router process spreads tenants across N worker processes, each a
+full ``TpuSparkSession`` bootstrapped from a shared conf (docs/fleet.md):
+
+  * ``placement.py`` — sticky tenant->replica placement: override map,
+    consistent-hash ring, least-loaded spill-over;
+  * ``worker.py``    — the worker subprocess: a session + admission
+    scheduler behind a JSON-lines stdin/stdout protocol;
+  * ``router.py``    — the front end: dispatch, deadline/shed
+    propagation, rolling restarts, ``/api/fleet``;
+  * ``warmstate.py`` — the shared fleet directory: persistent XLA
+    cache, flock-serialized warm manifest, per-replica event logs.
+
+Everything resolves lazily: importing ``spark_rapids_tpu.serving.fleet``
+must never drag the session module in (the single-process path with
+fleet confs off stays byte-identical — pinned by tests/test_fleet.py).
+"""
+
+_EXPORTS = {
+    "FleetRouter": "router",
+    "FleetJob": "router",
+    "FleetMonitor": "router",
+    "ProcessWorker": "router",
+    "LocalWorker": "router",
+    "launch_process_fleet": "router",
+    "snapshot_all": "router",
+    "PlacementPolicy": "placement",
+    "HashRing": "placement",
+    "parse_overrides": "placement",
+    "fleet_paths": "warmstate",
+    "event_log_path": "warmstate",
+    "worker_conf": "warmstate",
+    "write_worker_spec": "warmstate",
+}
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+    module = importlib.import_module(
+        f"spark_rapids_tpu.serving.fleet.{mod}")
+    return getattr(module, name)
+
+
+def __dir__():
+    return sorted(_EXPORTS)

@@ -45,10 +45,34 @@ _ACTIVE_LOCK = threading.Lock()
 
 
 def load_manifest(path: str) -> List[Dict[str, Any]]:
-    """Entries of an AOT manifest (``compile_report --aot-manifest``
-    shape, or a bare list of entry dicts)."""
-    with open(path, "r", encoding="utf-8") as f:
-        doc = json.load(f)
+    """Entries of an AOT manifest: the ``compile_report --aot-manifest``
+    shape ({"entries": [...]}), a bare list of entry dicts, or the fleet
+    warm-state sidecar — JSONL of one entry per line as appended by
+    ``obs/compilecache.py`` (``spark.rapids.tpu.fleet.warmManifest``).
+    JSONL reads tolerate a torn tail: a record a crashed writer left
+    half-written is skipped, everything before it still warms."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        entries: List[Dict[str, Any]] = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail from a crashed writer
+            if isinstance(rec, dict):
+                entries.append(rec)
+        if not entries:
+            raise ValueError(f"{path}: not an AOT manifest") from None
+        return entries
+    if isinstance(doc, dict) and "entries" not in doc \
+            and ("kernelKey" in doc or "kernel" in doc):
+        return [doc]  # single-record JSONL parses as one dict
     entries = doc.get("entries") if isinstance(doc, dict) else doc
     if not isinstance(entries, list):
         raise ValueError(f"{path}: not an AOT manifest")

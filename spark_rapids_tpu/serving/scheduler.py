@@ -26,7 +26,12 @@ Model:
     ``serving.defaultDeadlineSeconds``, 0 = none) counts from
     *submission*: a job still queued past its deadline never starts, a
     running one is cancelled cooperatively at the next batch-pull
-    boundary (serving/cancellation.py -> exec/base.py);
+    boundary (serving/cancellation.py -> exec/base.py). When the
+    submission already waited upstream (a fleet router's queue,
+    serving/fleet/), ``submit(queued_elapsed_s=...)`` keeps the
+    deadline counting from the ORIGINAL submission — a job whose
+    upstream wait alone burned the deadline times out at admission,
+    before touching the engine;
   * **cooperative cancellation** — ``job.cancel()`` / ``cancel(id)``
     dequeues a queued job immediately and flags a running one, honored
     at its next batch pull;
@@ -72,12 +77,13 @@ class QueryJob:
     queued)."""
 
     def __init__(self, job_id: str, work, tenant: str, description: str,
-                 deadline_s: Optional[float]):
+                 deadline_s: Optional[float],
+                 queued_elapsed_s: float = 0.0):
         self.id = job_id
         self.work = work  # DataFrame or callable(session) -> DataFrame
         self.tenant = tenant
         self.description = description
-        self.scope = CancelScope(deadline_s)
+        self.scope = CancelScope(deadline_s, elapsed_s=queued_elapsed_s)
         self.status = "queued"
         self.error: Optional[str] = None
         self.result = None  # pd.DataFrame on success
@@ -202,11 +208,18 @@ class QueryScheduler:
     # -- submission ----------------------------------------------------------
     def submit(self, work: Union[Callable, Any], tenant: str = "default",
                description: str = "",
-               deadline_s: Optional[float] = None) -> QueryJob:
+               deadline_s: Optional[float] = None,
+               queued_elapsed_s: float = 0.0) -> QueryJob:
         """Enqueue one query: a DataFrame, or a callable
         ``fn(session) -> DataFrame`` built lazily on the worker. Returns
         immediately; the job may come back already ``shed`` when the
-        admission queue is full."""
+        admission queue is full.
+
+        ``queued_elapsed_s`` is deadline budget already spent UPSTREAM
+        (a fleet router's queue, serving/fleet/): the deadline counts
+        from the original submission, not from this process's admission
+        — a submission whose upstream wait alone exceeded the deadline
+        is timed out immediately instead of running a dead query."""
         from spark_rapids_tpu.obs.events import EVENTS
         from spark_rapids_tpu.obs.metrics import REGISTRY
         tenant = str(tenant or "default")
@@ -214,7 +227,33 @@ class QueryScheduler:
             d = float(self.session.conf.get(DEFAULT_DEADLINE, 0) or 0)
             deadline_s = d if d > 0 else None
         job = QueryJob(f"job-{next(self._ids)}", work, tenant,
-                       description, deadline_s)
+                       description, deadline_s,
+                       queued_elapsed_s=queued_elapsed_s)
+        if job.scope.deadline_s is not None and job.scope.expired():
+            # dead on arrival: the upstream queue already burned the
+            # whole deadline — never enqueue, never touch the engine
+            job.status = "timeout"
+            job.error = (f"deadline ({job.scope.deadline_s:.3f}s) "
+                         f"expired before admission (upstream queue "
+                         f"{job.scope.elapsed_s:.3f}s)")
+            job.finished_ts = time.time()
+            job._done.set()
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("scheduler is closed")
+                self._register_tenant(tenant)
+                self._tstats(tenant)["timeout"] = \
+                    self._tstats(tenant).get("timeout", 0) + 1
+                self._jobs[job.id] = job
+            EVENTS.emit("queryTimeout", tenant=tenant, query=None,
+                        jobId=job.id, queued=True,
+                        deadlineSeconds=job.scope.deadline_s,
+                        queuedElapsedSeconds=round(
+                            job.scope.elapsed_s, 3),
+                        reason=job.error)
+            REGISTRY.counter("serving.completed", tenant=tenant,
+                             status="timeout").add(1)
+            return job
         with self._cond:
             if self._closed:
                 raise RuntimeError("scheduler is closed")

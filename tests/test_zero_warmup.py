@@ -511,6 +511,47 @@ class TestSharedCompileCache:
         assert {r["kernel"].split("-")[0] for r in recs} \
             == {"w0", "w1"}
 
+    def test_n_process_append_hammer_zero_torn_records(self, tmp_path):
+        """Four processes hammer ``locked_append`` with records far
+        beyond any atomic-write size (up to ~64KB): the flock +
+        looped-write contract means EVERY record lands whole — exact
+        count, every line parses, every writer's full sequence present
+        — the fleet's shared warm manifest depends on it."""
+        from spark_rapids_tpu.obs.compilecache import locked_append
+        path = str(tmp_path / "hammer.jsonl")
+        n_procs, n_recs = 4, 150
+        prog = (
+            "import json, sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from spark_rapids_tpu.obs.compilecache import "
+            "locked_append\n"
+            "path, tag = sys.argv[1], sys.argv[2]\n"
+            "for i in range(%d):\n"
+            "    doc = {'writer': tag, 'seq': i,\n"
+            "           'fill': 'x' * ((i %% 16) * 4096)}\n"
+            "    assert locked_append(\n"
+            "        path, (json.dumps(doc) + '\\n').encode())\n"
+            "print('done', tag)\n" % (_REPO, n_recs))
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", prog, path, f"w{i}"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for i in range(n_procs)]
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err[-800:]
+        lines = open(path).read().splitlines()
+        assert len(lines) == n_procs * n_recs
+        recs = [json.loads(ln) for ln in lines]  # zero torn records
+        by_writer = {}
+        for r in recs:
+            by_writer.setdefault(r["writer"], []).append(r["seq"])
+            assert r["fill"] == "x" * ((r["seq"] % 16) * 4096)
+        assert set(by_writer) == {f"w{i}" for i in range(n_procs)}
+        for seqs in by_writer.values():
+            assert sorted(seqs) == list(range(n_recs))
+        # and the in-process writer interleaves with them safely too
+        assert locked_append(path, b'{"writer": "main", "seq": 0}\n')
+
 
 # ---------------------------------------------------------------------------
 # Monitor surfacing

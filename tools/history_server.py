@@ -10,6 +10,13 @@ HTML query pages, from one or more event logs — rotations (``<path>.1``,
 transparently, and the logs are re-read when their mtimes change, so a
 running ``bench.py --event-log`` sweep can be watched mid-flight.
 
+Fleet mode (docs/fleet.md): pass several worker event logs — repeated
+paths or a quoted glob (``'fleet/events-*.jsonl'``) — and the server
+folds them into ONE view with per-replica attribution: query names gain
+a ``<replica>:`` prefix (from ``qualification.replica_label``), records
+carry a ``replica`` field, and the index grows a replica column. A
+single log keeps today's pages and ``/api/*`` shapes unchanged.
+
 The per-query numbers (coverage %, fallback reasons, AQE decisions) come
 from ``tools/qualification.py``'s own folding functions — not a
 re-implementation — so ``/api/report`` is byte-equal to
@@ -153,14 +160,24 @@ class HistoryStore:
         stamp = self._stat()
         records: List[Dict[str, Any]] = []
         details: Dict[str, Any] = {}
+        fleet = len(self.paths) > 1
         for p in self.paths:
             events = read_events(p)
-            recs = qualification.records_from_events(events, source=p)
+            label = qualification.replica_label(p) if fleet else None
+            recs = qualification.records_from_events(
+                events, source=p, replica=label)
             det = details_from_events(events)
-            # names are per-log; a multi-log server disambiguates by
-            # prefixing the log basename on collision
-            existing = {r["query"] for r in records}
             rename = {}
+            if fleet:
+                # fleet fold: every name carries its replica so the one
+                # index reads like the router saw it (per-replica
+                # attribution), and cross-log name clashes cannot happen
+                for r in recs:
+                    rename[r["query"]] = f"{label}:{r['query']}"
+                    r["query"] = rename[r["query"]]
+            # names are per-log; a multi-log server disambiguates any
+            # remaining clash by prefixing the log basename
+            existing = {r["query"] for r in records}
             for r in recs:
                 name = r["query"]
                 if name in existing:
@@ -237,6 +254,7 @@ def _href(name: str) -> str:
 
 def render_index(store: HistoryStore) -> str:
     t = store.report.get("totals", {})
+    fleet = any(r.get("replica") for r in store.records)
     rows = []
     for r in store.records:
         cov = (f"{r['coverage_pct']:.0f}%"
@@ -244,9 +262,11 @@ def render_index(store: HistoryStore) -> str:
         wall = f"{r['wall_s']:.3f}" if r.get("wall_s") is not None else "-"
         aqe = r.get("aqe") or {}
         ws = (r.get("compile") or {}).get("warmup_share_pct")
+        replica_cell = (f"<td>{_esc(r.get('replica') or '-')}</td>"
+                        if fleet else "")
         rows.append(
             f"<tr><td><a href='/query/{_href(r['query'])}'>"
-            f"{_esc(r['query'])}</a></td>"
+            f"{_esc(r['query'])}</a></td>" + replica_cell +
             f"<td>{_esc(r.get('tenant') or 'default')}</td>"
             f"<td class='{_esc(r['status'])}'>{_esc(r['status'])}</td>"
             f"<td>{wall}</td><td>{cov}</td>"
@@ -269,7 +289,9 @@ def render_index(store: HistoryStore) -> str:
         + f"), mean coverage {t.get('mean_coverage_pct')}% &middot; "
         f"<a href='/api/report'>/api/report</a> &middot; "
         f"<a href='/api/tenants'>/api/tenants</a></p>"
-        f"<table><tr><th>query</th><th>tenant</th><th>status</th>"
+        f"<table><tr><th>query</th>"
+        + ("<th>replica</th>" if fleet else "") + "<th>tenant</th>"
+        f"<th>status</th>"
         f"<th>wall_s</th><th>coverage</th><th>fallbacks</th>"
         f"<th>aqe stages</th><th>warm-up</th></tr>{''.join(rows)}</table>"
         f"</body></html>")
@@ -490,19 +512,26 @@ def main(argv=None) -> int:
         description="History server over structured event logs "
                     "(obs/events.py JSONL; rotations + gzip folded in)")
     ap.add_argument("logs", nargs="+",
-                    help="event-log base paths (rotations fold in)")
+                    help="event-log base paths (rotations fold in; "
+                         "globs expanded, so a quoted "
+                         "'fleet/events-*.jsonl' serves a whole fleet)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=18080,
                     help="TCP port (default 18080; 0 = ephemeral)")
     args = ap.parse_args(argv)
-    for p in args.logs:
+    import glob as _glob
+    logs: List[str] = []
+    for inp in args.logs:
+        hits = sorted(_glob.glob(inp))
+        logs.extend(hits or [inp])
+    for p in logs:
         if not os.path.exists(p):
             print(f"history_server: {p}: no such file", file=sys.stderr)
             return 2
-    srv = HistoryServer(args.logs, host=args.host, port=args.port).start()
+    srv = HistoryServer(logs, host=args.host, port=args.port).start()
     print(f"history server on {srv.url} "
           f"({len(srv.store.records)} queries from "
-          f"{len(args.logs)} log(s)); endpoints: / /query/<id> "
+          f"{len(logs)} log(s)); endpoints: / /query/<id> "
           f"/api/queries /api/query/<id> /api/report /api/tenants",
           flush=True)
     try:

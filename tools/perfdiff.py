@@ -51,6 +51,16 @@ drift (total spill events growing more than
 management got worse), and oracle verification. ``--ignore-stress``
 reports the deltas without gating.
 
+And it gates the **fleet tier** (``BENCH_FLEET.json`` from ``bench.py
+--fleet N``, docs/fleet.md): when NEW is a fleet artifact the gate
+switches to the **scaling ratio** — against a single-process serve
+baseline (``BENCH_SERVE.json``), N-worker qps below ``--fleet-scaling``
+(default 0.8) x N x the baseline qps exits 1 (the fleet is not earning
+its processes), as does fleet p99 growing beyond
+``--fleet-p99-threshold`` (default 0.50 relative) or failed oracle
+verification; against another fleet artifact it gates qps/p99 drift
+like serve mode. ``--ignore-fleet`` reports without gating.
+
 And it gates **host syncs** (docs/observability.md, the sync ledger):
 a common query whose steady-state blocking host-sync count
 (``host_syncs`` — syncs per timed iteration) grew more than
@@ -331,6 +341,118 @@ def render_serve_text(rep: Dict[str, Any]) -> str:
         lines.append(f"-- THROUGHPUT REGRESSION: qps drift "
                      f"{rep['qps_drift_pct']:+.2f}% exceeds "
                      f"-{rep['threshold_pct']:.0f}%")
+    lines.append("RESULT: " + ("REGRESSED" if rep["regressed"]
+                               else "ok"))
+    return "\n".join(lines)
+
+
+def fleet_from_doc(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Fleet-tier artifact (``BENCH_FLEET.json`` from ``bench.py
+    --fleet N``): multi-process throughput + per-replica shape. None
+    when the doc is not a fleet artifact."""
+    if doc.get("mode") != "fleet" or "qps" not in doc:
+        return None
+    lat = doc.get("latency_s") or {}
+    return {"qps": float(doc["qps"]) if doc["qps"] else None,
+            "p50": lat.get("p50"), "p99": lat.get("p99"),
+            "workers": int(doc.get("workers") or 0),
+            "shed": doc.get("shed"),
+            "placement_churn": doc.get("placement_churn"),
+            "verified": doc.get("verified")}
+
+
+def compare_fleet(base: Dict[str, Any], new: Dict[str, Any],
+                  threshold: float, fleet_scaling: float = 0.8,
+                  p99_threshold: float = 0.50) -> Dict[str, Any]:
+    """Fleet gate, two shapes by what BASE is:
+
+    * BASE is a single-process SERVE artifact: the scaling gate — an
+      N-worker fleet must deliver at least ``fleet_scaling`` x N x the
+      baseline qps (AlpaServe's near-linear placement-aware scaling;
+      below it the tier costs processes without earning throughput);
+    * BASE is another FLEET artifact: plain drift, like serve mode —
+      qps dropping more than ``threshold`` regresses.
+
+    Either way, fleet p99 growing more than ``p99_threshold`` relative
+    over BASE p99, or NEW failing oracle verification, regresses."""
+    scaling_mode = "workers" not in base  # serve baseline
+    qb, qn = base.get("qps"), new.get("qps")
+    workers = new.get("workers") or 0
+    if scaling_mode:
+        required = (fleet_scaling * workers * qb) \
+            if qb and workers else None
+        qps_bad = (required is not None
+                   and (qn or 0.0) < required)
+        drift = None
+        ratio = round(qn / (qb * workers), 4) \
+            if qb and qn and workers else None
+    else:
+        required = None
+        ratio = None
+        drift = (qn / qb - 1.0) if qb and qn else None
+        qps_bad = drift is not None and drift < -threshold
+    pb, pn = base.get("p99"), new.get("p99")
+    p99_growth = (pn / pb - 1.0) if pb and pn else None
+    p99_bad = p99_growth is not None and p99_growth > p99_threshold
+    regressed = qps_bad or p99_bad or new.get("verified") is False
+    return {
+        "mode": "fleet",
+        "gate": "scaling" if scaling_mode else "drift",
+        "workers": workers,
+        "qps_base": qb, "qps_new": qn,
+        "qps_required": round(required, 4)
+        if required is not None else None,
+        "scaling_ratio": ratio,
+        "fleet_scaling": fleet_scaling,
+        "qps_drift_pct": round(100.0 * drift, 2)
+        if drift is not None else None,
+        "p99_base": pb, "p99_new": pn,
+        "p99_growth_pct": round(100.0 * p99_growth, 2)
+        if p99_growth is not None else None,
+        "p99_threshold_pct": round(100.0 * p99_threshold, 2),
+        "threshold_pct": round(100.0 * threshold, 2),
+        "shed_new": new.get("shed"),
+        "placement_churn_new": new.get("placement_churn"),
+        "new_verified": new.get("verified"),
+        "qps_regressed": qps_bad, "p99_regressed": p99_bad,
+        "regressed": regressed,
+    }
+
+
+def render_fleet_text(rep: Dict[str, Any]) -> str:
+    lines = [
+        f"perfdiff (fleet mode, {rep['gate']} gate, "
+        f"{rep['workers']} workers): qps {rep['qps_base']} -> "
+        f"{rep['qps_new']}"
+        + (f" (per-worker scaling {rep['scaling_ratio']:.2f}x, "
+           f"required >= {rep['qps_required']})"
+           if rep["scaling_ratio"] is not None else "")
+        + (f" ({rep['qps_drift_pct']:+.2f}%)"
+           if rep["qps_drift_pct"] is not None else "")
+        + f", p99 {rep['p99_base']}s -> {rep['p99_new']}s"
+        + (f" ({rep['p99_growth_pct']:+.2f}%)"
+           if rep["p99_growth_pct"] is not None else "")]
+    if rep.get("shed_new"):
+        lines.append(f"-- NEW fleet shed {rep['shed_new']} jobs")
+    if rep["new_verified"] is False:
+        lines.append("-- NEW fleet sweep FAILED result verification")
+    if rep.get("ignored"):
+        lines.append("-- fleet gate IGNORED (--ignore-fleet)")
+    else:
+        if rep["qps_regressed"] and rep["gate"] == "scaling":
+            lines.append(
+                f"-- FLEET SCALING REGRESSION: {rep['workers']}-worker "
+                f"qps {rep['qps_new']} below "
+                f"{rep['fleet_scaling']:.2f} x {rep['workers']} x "
+                f"baseline ({rep['qps_required']})")
+        elif rep["qps_regressed"]:
+            lines.append(f"-- THROUGHPUT REGRESSION: qps drift "
+                         f"{rep['qps_drift_pct']:+.2f}% exceeds "
+                         f"-{rep['threshold_pct']:.0f}%")
+        if rep["p99_regressed"]:
+            lines.append(f"-- LATENCY REGRESSION: p99 growth "
+                         f"{rep['p99_growth_pct']:+.2f}% exceeds "
+                         f"+{rep['p99_threshold_pct']:.0f}%")
     lines.append("RESULT: " + ("REGRESSED" if rep["regressed"]
                                else "ok"))
     return "\n".join(lines)
@@ -760,6 +882,18 @@ def main(argv=None) -> int:
                     help="relative spill-event-count growth between "
                          "stress sweeps that counts as a regression "
                          "(default 0.50 = 50%%)")
+    ap.add_argument("--fleet-scaling", type=float, default=0.8,
+                    help="required per-worker scaling when gating a "
+                         "fleet artifact (BENCH_FLEET.json) against a "
+                         "single-process serve baseline: N-worker qps "
+                         "must reach this fraction x N x baseline qps "
+                         "(default 0.8)")
+    ap.add_argument("--fleet-p99-threshold", type=float, default=0.50,
+                    help="relative fleet p99 growth over the baseline "
+                         "that counts as a regression (default 0.50)")
+    ap.add_argument("--ignore-fleet", action="store_true",
+                    help="report fleet-tier deltas without gating on "
+                         "them")
     ap.add_argument("--scan-threshold", type=float, default=0.10,
                     help="relative scan-INCLUSIVE speedup drop (per "
                          "query and geomean, from the sweep's scan-off "
@@ -817,6 +951,40 @@ def main(argv=None) -> int:
                 "cannot compare a stress-tier artifact against a sweep "
                 "artifact (one side has 'spill_events_total', the other "
                 "does not)")
+        # fleet-tier artifacts (bench.py --fleet N) dispatch BEFORE the
+        # serve pair: a fleet doc also carries qps/latency_s, and its
+        # gate is the scaling ratio against a serve baseline, not qps
+        # drift
+        base_fleet = fleet_from_doc(base_doc)
+        new_fleet = fleet_from_doc(new_doc)
+        if new_fleet is not None:
+            if base_fleet is None:
+                base_for_fleet = serve_from_doc(base_doc)
+                if base_for_fleet is None:
+                    raise ValueError(
+                        "a fleet-tier artifact gates against a serve-"
+                        "mode baseline (BENCH_SERVE.json) or another "
+                        "fleet artifact")
+            else:
+                base_for_fleet = base_fleet
+            rep = compare_fleet(base_for_fleet, new_fleet,
+                                args.threshold, args.fleet_scaling,
+                                args.fleet_p99_threshold)
+            if args.ignore_fleet:
+                rep["ignored"] = True
+                rep["regressed"] = False
+            if args.json == "-":
+                print(json.dumps(rep, indent=1))
+            else:
+                print(render_fleet_text(rep))
+                if args.json:
+                    with open(args.json, "w") as f:
+                        json.dump(rep, f, indent=1)
+            return 1 if rep["regressed"] else 0
+        if base_fleet is not None:
+            raise ValueError(
+                "cannot compare a fleet-tier baseline against a "
+                "non-fleet candidate artifact")
         # serve-mode artifacts (bench.py --concurrency) gate on
         # throughput instead of per-query speedups
         base_serve = serve_from_doc(base_doc)

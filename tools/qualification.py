@@ -43,6 +43,15 @@ Event-log rotations (``<path>.1`` ...) are folded in automatically when
 the base path is given. Failed queries report alongside successful ones
 (their flight-recorder dumps are counted), so a log mixing both still
 yields a complete report.
+
+Fleet mode (docs/fleet.md): pass MULTIPLE worker event logs — repeated
+paths or a shell/embedded glob (``'fleetdir/events-*.jsonl'`` is
+expanded here too, for quoting convenience) — and the report folds them
+into one workload view with **per-replica attribution**: each record
+carries its replica label (``events-<rid>.jsonl`` -> ``rid``), query
+names are prefixed ``<rid>:`` so the same process-local id on two
+workers never collides, and a per-replica rollup section compares the
+workers. A single log keeps today's output exactly.
 """
 
 from __future__ import annotations
@@ -97,9 +106,25 @@ def _load_any(path: str):
 # Per-query records from an event stream
 # ---------------------------------------------------------------------------
 
+def replica_label(path: str) -> str:
+    """Replica label of a worker event log, from its basename: the
+    fleet's ``events-<rid>.jsonl`` convention (serving/fleet/warmstate)
+    yields ``<rid>``; anything else yields the basename without its
+    extension. Shared with tools/history_server.py so both UIs
+    attribute identically."""
+    base = os.path.basename(path)
+    if base.endswith(".gz"):
+        base = base[:-3]
+    base = os.path.splitext(base)[0]
+    if base.startswith("events-") and len(base) > len("events-"):
+        return base[len("events-"):]
+    return base
+
+
 def _new_record(name: str, source: str) -> Dict[str, Any]:
     return {
-        "query": name, "source": source, "status": "unknown",
+        "query": name, "source": source, "replica": None,
+        "status": "unknown",
         "tenant": None, "rows_returned": 0,
         "wall_s": None, "tpu_ops": 0, "cpu_ops": 0, "coverage_pct": None,
         "time_coverage_pct": None, "fallbacks": [],
@@ -148,8 +173,9 @@ class QueryWindows:
         return self._live[qid]
 
 
-def records_from_events(events: List[Dict[str, Any]],
-                        source: str) -> List[Dict[str, Any]]:
+def records_from_events(events: List[Dict[str, Any]], source: str,
+                        replica: Optional[str] = None
+                        ) -> List[Dict[str, Any]]:
     windows = QueryWindows()
     recs: Dict[str, Dict[str, Any]] = {}
     out: List[Dict[str, Any]] = []
@@ -162,6 +188,7 @@ def records_from_events(events: List[Dict[str, Any]],
         r = recs.get(name)
         if r is None:
             r = recs[name] = _new_record(name, source)
+            r["replica"] = replica
             out.append(r)
         if kind == "queryStart":
             r["conf_fingerprint"] = ev.get("confFingerprint")
@@ -458,8 +485,35 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     compile_entries = [e for r in records
                        for e in r["compile"].get("entries", [])]
     warmup = analyze(compile_entries) if compile_entries else None
+    # fleet attribution: when records came from multiple worker logs,
+    # roll the workload up per replica so an uneven fleet (one worker
+    # eating the compiles, one shedding) is visible at a glance
+    replicas: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        if not r.get("replica"):
+            continue
+        agg = replicas.setdefault(r["replica"], {
+            "queries": 0, "succeeded": 0, "failed": 0, "cancelled": 0,
+            "timed_out": 0, "wall_s": 0.0, "compile_seconds": 0.0,
+            "spill_bytes": 0, "host_syncs": 0})
+        agg["queries"] += 1
+        if r["status"] == "success":
+            agg["succeeded"] += 1
+        elif r["status"] == "failed":
+            agg["failed"] += 1
+        elif r["status"] == "cancelled":
+            agg["cancelled"] += 1
+        elif r["status"] == "timeout":
+            agg["timed_out"] += 1
+        if r["wall_s"]:
+            agg["wall_s"] = round(agg["wall_s"] + r["wall_s"], 4)
+        agg["compile_seconds"] = round(
+            agg["compile_seconds"] + r["compile"]["seconds"], 4)
+        agg["spill_bytes"] += r["spill"]["bytes"]
+        agg["host_syncs"] += r["sync"]["syncs"]
     return {"version": 1, "totals": totals, "queries": records,
-            "fallback_reasons": ranked, "warmup": warmup}
+            "fallback_reasons": ranked, "warmup": warmup,
+            "replicas": replicas or None}
 
 
 def _fmt_bytes(n: int) -> str:
@@ -566,6 +620,22 @@ def render_text(report: Dict[str, Any], top_n: int = 15) -> str:
             lines.append(f"{share:>7} {sy['syncs']:>6} "
                          f"{sy['seconds']:>8.3f}  {r['query']}"
                          + (f": {sites}" if sites else ""))
+    reps = report.get("replicas")
+    if reps:
+        lines.append("")
+        lines.append(f"-- per-replica attribution ({len(reps)} worker "
+                     "event logs folded)")
+        lines.append(f"{'replica':<12} {'queries':>7} {'ok':>5} "
+                     f"{'failed':>6} {'wall_s':>9} {'compile_s':>9} "
+                     f"{'spill':>9} {'syncs':>6}")
+        for rid in sorted(reps):
+            a = reps[rid]
+            lines.append(
+                f"{rid[:12]:<12} {a['queries']:>7} {a['succeeded']:>5} "
+                f"{a['failed']:>6} {a['wall_s']:>9.3f} "
+                f"{a['compile_seconds']:>9.2f} "
+                f"{_fmt_bytes(a['spill_bytes']):>9} "
+                f"{a['host_syncs']:>6}")
     hot = {}
     for r in report["queries"]:
         for peer, n in r["fetch"]["by_peer"].items():
@@ -644,8 +714,10 @@ def main(argv=None) -> int:
         description="Workload qualification report from event logs "
                     "(obs/events.py JSONL) and/or profile JSONs")
     ap.add_argument("inputs", nargs="+",
-                    help="event-log files (rotations folded in) and/or "
-                         "*.profile.json files")
+                    help="event-log files (rotations folded in; globs "
+                         "expanded, so a quoted 'dir/events-*.jsonl' "
+                         "folds a whole fleet) and/or *.profile.json "
+                         "files")
     ap.add_argument("--json", metavar="OUT", default="",
                     help="also write the machine-shape report here "
                          "('-' for stdout)")
@@ -653,15 +725,32 @@ def main(argv=None) -> int:
                     help="rows per ranking section (default 15)")
     args = ap.parse_args(argv)
 
-    records: List[Dict[str, Any]] = []
-    for path in args.inputs:
+    import glob as _glob
+    paths: List[str] = []
+    for inp in args.inputs:
+        hits = sorted(_glob.glob(inp))
+        # no match: keep the literal so the open() error names it
+        paths.extend(hits or [inp])
+
+    loaded = []
+    for path in paths:
         try:
-            kind, data = _load_any(path)
+            loaded.append((path, *_load_any(path)))
         except (ValueError, OSError) as e:
             print(f"qualification: {e}", file=sys.stderr)
             return 2
+    # per-replica attribution engages only with MULTIPLE event logs —
+    # a single log keeps today's report byte-identical
+    n_event_logs = sum(1 for _, kind, _ in loaded if kind == "events")
+    records: List[Dict[str, Any]] = []
+    for path, kind, data in loaded:
         if kind == "events":
-            records.extend(records_from_events(data, source=path))
+            label = replica_label(path) if n_event_logs > 1 else None
+            recs = records_from_events(data, source=path, replica=label)
+            if label is not None:
+                for r in recs:
+                    r["query"] = f"{label}:{r['query']}"
+            records.extend(recs)
         else:
             name = os.path.basename(path).replace(".profile.json", "")
             records.append(record_from_profile(data, name))
