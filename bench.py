@@ -109,6 +109,10 @@ cache OFF — serial (prefetchDepth=0) vs pipelined (sql/scan_pipeline.py) —
 verified against the CPU oracle in both modes, written to BENCH_SCAN.json
 (BENCH_SCAN_FILE to override; BENCH_SCAN_DIR holds the parquet tables,
 BENCH_SCAN_TRACE_DIR additionally captures a Chrome trace per query).
+A third deviceDecode pass (spark.rapids.sql.scan.deviceDecode on;
+BENCH_DEVICE_DECODE=0 disables) records scan_device_s, the
+scan_decode_mode verdict, host/device decode seconds and the page-cache
+hit rate (docs/scan_device.md).
 """
 
 import json
@@ -559,6 +563,48 @@ def _worker():
             rec["scan_speedup"] = round(
                 rec["scan_serial_s"] / rec["scan_pipelined_s"], 3) \
                 if rec["scan_pipelined_s"] > 0 else float("inf")
+            # deviceDecode pass (BENCH_DEVICE_DECODE=0 rolls the record
+            # back to the host-decode-only shape above): timed like the
+            # pipelined mode, plus the decode-mode verdict and page-cache
+            # hit rate from registry deltas around the timed iterations
+            if os.environ.get("BENCH_DEVICE_DECODE", "1") != "0":
+                from spark_rapids_tpu.obs.metrics import REGISTRY
+                from spark_rapids_tpu.obs.profile import scan_decode_mode
+
+                def _scan_metrics():
+                    return {m.name: m.value for m in REGISTRY.metrics()
+                            if m.name.startswith(("scan.device.",
+                                                  "pagecache."))}
+                session.set_conf("spark.rapids.sql.scan.prefetchDepth",
+                                 depth0)
+                session.set_conf("spark.rapids.sql.scan.deviceDecode",
+                                 True)
+                session.clear_device_cache()
+                run_query(fn, True)  # warm compiles + encoded-page cache
+                it = []
+                out = None
+                m0 = _scan_metrics()
+                for _ in range(iters):
+                    t0 = time.perf_counter()
+                    out = run_query(fn, True)
+                    it.append(round(time.perf_counter() - t0, 4))
+                m1 = _scan_metrics()
+                d = {k: m1.get(k, 0) - m0.get(k, 0) for k in m1}
+                rec["scan_device_iters"] = it
+                rec["scan_device_s"] = min(it)
+                rec["verified_device"] = _results_match(out, cpu_out)
+                rec["scan_decode_mode"] = scan_decode_mode(d)
+                rec["host_decode_s"] = round(
+                    d.get("scan.device.hostDecodeTime", 0.0), 4)
+                rec["device_decode_s"] = round(
+                    d.get("scan.device.decodeTime", 0.0), 4)
+                hits = (d.get("pagecache.hits", 0)
+                        + d.get("pagecache.deviceHits", 0))
+                lookups = hits + d.get("pagecache.misses", 0)
+                rec["pagecache_hit_rate"] = round(hits / lookups, 4) \
+                    if lookups else None
+                session.set_conf("spark.rapids.sql.scan.deviceDecode",
+                                 False)
             trace_dir = os.environ.get("BENCH_SCAN_TRACE_DIR", "")
             if trace_dir:
                 # one extra traced (untimed) pipelined run: the Chrome
@@ -573,6 +619,7 @@ def _worker():
         finally:
             session.set_conf("spark.rapids.sql.scan.prefetchDepth", depth0)
             session.set_conf("spark.rapids.sql.cacheDeviceScans", True)
+            session.set_conf("spark.rapids.sql.scan.deviceDecode", False)
             session.set_conf("spark.rapids.tpu.trace.path", "")
         return rec
 

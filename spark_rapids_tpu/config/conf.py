@@ -411,6 +411,37 @@ SCAN_DIRECT_DECODE = register(
     "reader: ignored when spark.rapids.sql.scan.prefetchDepth is 0 (the "
     "legacy reader keeps the full conversion).")
 
+SCAN_DEVICE_DECODE = register(
+    "spark.rapids.sql.scan.deviceDecode", _to_bool, False,
+    "Device-resident Parquet decode (docs/scan_device.md): read raw "
+    "column-chunk bytes + page headers only (no host arrow "
+    "materialization), upload encoded page payloads as flat word "
+    "buffers, and decode PLAIN / RLE-dictionary / DELTA_BINARY_PACKED "
+    "pages with the ops/parquet_decode kernels straight into dictionary-"
+    "coded and char-slab device columns. Unsupported encodings/types "
+    "fall back per column to the host decode path (journaled as "
+    "scanDeviceFallback). Off by default: the legacy and pipelined host "
+    "readers are byte-identical to pre-deviceDecode behavior.")
+
+SCAN_PAGE_CACHE = register(
+    "spark.rapids.sql.scan.pageCache.enabled", _to_bool, True,
+    "Encoded-page cache tier for the deviceDecode path: column-chunk "
+    "decode plans (run tables + encoded page bytes) cached by (path, "
+    "mtime, row-group, column) so hot tables re-decode from cached — "
+    "and, budget permitting, device-resident — pages instead of "
+    "re-reading and re-uploading. Encoded pages are 5-20x smaller than "
+    "decoded slabs. No effect while deviceDecode is off.")
+
+SCAN_PAGE_CACHE_BYTES = register(
+    "spark.rapids.sql.scan.pageCache.maxBytes", _to_bytes, 256 << 20,
+    "Host-memory budget for the encoded-page cache (LRU past it).")
+
+SCAN_PAGE_CACHE_DEVICE_BYTES = register(
+    "spark.rapids.sql.scan.pageCache.deviceMaxBytes", _to_bytes, 64 << 20,
+    "Device (HBM) budget for page-cache entries PROMOTED to device "
+    "residency after their first upload; colder entries demote to the "
+    "host tier (encoded bytes dropped from HBM, host plan kept).")
+
 # --- gather-free execution (docs/gatherfree.md) ----------------------------
 DICT_ENABLED = register(
     "spark.rapids.sql.dict.enabled", _to_bool, True,

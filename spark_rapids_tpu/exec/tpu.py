@@ -1083,10 +1083,15 @@ class TpuScanExec(TpuExec):
                 f"|{','.join(self._schema.names)}")
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
-        if self.pushed_filters and hasattr(self.source, "prune_splits"):
-            cpu_parts = self.source.cpu_partitions(ctx, self.pushed_filters)
-        else:
-            cpu_parts = self.source.cpu_partitions(ctx)
+        from spark_rapids_tpu.exec.transitions import scan_raw_parts
+        cpu_parts = scan_raw_parts(ctx, self.source, self.pushed_filters)
+        if cpu_parts is None:
+            if self.pushed_filters and hasattr(self.source,
+                                               "prune_splits"):
+                cpu_parts = self.source.cpu_partitions(
+                    ctx, self.pushed_filters)
+            else:
+                cpu_parts = self.source.cpu_partitions(ctx)
         max_rows = ctx.conf.batch_size_rows
         schema = self._schema
 

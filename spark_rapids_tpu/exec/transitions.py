@@ -100,6 +100,21 @@ def scan_dict_numerics(ctx: ExecContext, source) -> bool:
                              False)
 
 
+def scan_raw_parts(ctx: ExecContext, source, pushed_filters):
+    """deviceDecode routing (spark.rapids.sql.scan.deviceDecode):
+    RawRowGroup partitions from the source's raw-page reader, or None
+    when the conf is off / the source has no raw path — callers then take
+    the classic cpu_partitions route, byte-identical to pre-deviceDecode
+    behavior."""
+    if not ctx.conf.get_bool("spark.rapids.sql.scan.deviceDecode", False):
+        return None
+    if not hasattr(source, "raw_partitions"):
+        return None
+    if pushed_filters and hasattr(source, "prune_splits"):
+        return source.raw_partitions(ctx, pushed_filters)
+    return source.raw_partitions(ctx)
+
+
 def upload_partition(ctx: ExecContext, part: Partition, schema: Schema,
                      max_rows: int, dict_state: dict, cache, i: int,
                      mesh_devs=None, is_scan: bool = True,
@@ -150,9 +165,33 @@ def upload_partition(ctx: ExecContext, part: Partition, schema: Schema,
 
     def uploads():
         for df in part():
+            fname = taskctx.input_file()
+            if getattr(df, "is_raw_rowgroup", False):
+                # deviceDecode path: the split is a RawRowGroup of
+                # encoded-page decode plans, not a pandas frame — decode
+                # on device (ops/parquet_decode.py). Owns its own
+                # sync_scope / transfer attribution / progress notes.
+                from spark_rapids_tpu.ops.parquet_decode import (
+                    decode_rowgroup,
+                )
+                if is_scan and df.fallback_df is not None:
+                    note_scan_stats(ctx.session, df.fallback_df)
+                dev_gen = decode_rowgroup(
+                    ctx, df, schema, max_rows, dict_state, i,
+                    device=(mesh_devs[i % len(mesh_devs)]
+                            if mesh_devs else None))
+                while True:
+                    # span scoped to the decode step only, not the
+                    # consumer compute between chunk yields
+                    with TRACER.span("scan.deviceDecode", partition=i,
+                                     rows=df.n):
+                        batch = next(dev_gen, None)
+                    if batch is None:
+                        break
+                    yield fname, batch
+                continue
             if is_scan:
                 note_scan_stats(ctx.session, df)
-            fname = taskctx.input_file()
             for lo in range(0, max(len(df), 1), max_rows):
                 if double_buffer and lo == 0 and len(df) <= max_rows:
                     # whole-frame chunk: decode already produced a fresh
@@ -269,7 +308,6 @@ class HostToDeviceExec(PhysicalPlan):
 
     def partitions(self, ctx: ExecContext) -> List[Partition]:
         child = self.children[0]
-        child_parts = child.executed_partitions(ctx)
         schema = child.output_schema()
         max_rows = ctx.conf.batch_size_rows
 
@@ -279,6 +317,15 @@ class HostToDeviceExec(PhysicalPlan):
         cache = None
         from spark_rapids_tpu.exec.cpu import CpuScanExec
         is_scan = isinstance(child, CpuScanExec)
+        child_parts = None
+        if is_scan:
+            # deviceDecode: build RawRowGroup partitions straight from
+            # the source (the child scan node's own wrapper expects
+            # pandas frames; decode attribution lands on this node)
+            child_parts = scan_raw_parts(ctx, child.source,
+                                         child.pushed_filters)
+        if child_parts is None:
+            child_parts = child.executed_partitions(ctx)
         if is_scan:
             cache = scan_cache_for(ctx, child.source, schema, max_rows,
                                    getattr(child, "pushed_filters", None))

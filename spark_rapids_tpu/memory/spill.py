@@ -510,3 +510,128 @@ class MemoryEventHandler:
             EVENTS.emit("memoryPressure", neededBytes=needed_bytes,
                         freedBytes=freed)
         return freed
+
+
+class EncodedPageCache:
+    """Encoded-page cache tier for the deviceDecode scan path
+    (docs/scan_device.md): entries keyed by (path, mtime, row-group,
+    column) hold a column chunk's DECODE PLAN — the run tables + encoded
+    page word buffers ops/parquet_decode.py built, NOT decoded values.
+    Encoded pages are 5-20x smaller than decoded slabs, so the same
+    budget caches far more table than the device-scan cache can.
+
+    Two budgets, LRU within each:
+
+      * host tier (``max_bytes``): the numpy plan buffers — a hit skips
+        the file read + page split + run-table build;
+      * device tier (``device_max_bytes``): the uploaded jax arrays a
+        decode PROMOTED after its device_put — a hit skips the upload
+        too (the re-decode itself is the cheap part). Device overflow
+        DEMOTES (drops the device refs, keeps the host plan); host
+        overflow drops the entry.
+
+    mtime lives in the key, so a rewritten file simply never hits again
+    (stale entries age out by LRU). Thread-safe: prepare runs on decode
+    workers, promotion on the consumer thread.
+    """
+
+    def __init__(self, max_bytes: int = 256 << 20,
+                 device_max_bytes: int = 64 << 20):
+        from collections import OrderedDict
+        self.max_bytes = int(max_bytes)
+        self.device_max_bytes = int(device_max_bytes)
+        # key -> [plan, nbytes, device_tree | None, device_nbytes]
+        self._entries: "OrderedDict[tuple, list]" = OrderedDict()
+        self._bytes = 0
+        self._dev_bytes = 0
+        self._lock = threading.Lock()
+        self._hits = REGISTRY.counter("pagecache.hits")
+        self._misses = REGISTRY.counter("pagecache.misses")
+        self._dev_hits = REGISTRY.counter("pagecache.deviceHits")
+        self._evictions = REGISTRY.counter("pagecache.evictions")
+        self._demotions = REGISTRY.counter("pagecache.demotions")
+        self._promotions = REGISTRY.counter("pagecache.promotions")
+        self._g_bytes = REGISTRY.gauge("pagecache.bytes")
+        self._g_dev = REGISTRY.gauge("pagecache.deviceBytes")
+
+    def get(self, key):
+        """Host-tier lookup (decode-worker side): the cached plan dict,
+        or None. Counts hit/miss."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self._misses.add(1)
+                return None
+            self._entries.move_to_end(key)
+            self._hits.add(1)
+            return ent[0]
+
+    def get_device(self, key):
+        """Device-tier lookup (consumer side): the promoted device
+        arrays, or None. Host hit/miss was already counted by ``get``."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None or ent[2] is None:
+                return None
+            self._entries.move_to_end(key)
+            self._dev_hits.add(1)
+            return ent[2]
+
+    def put(self, key, plan, nbytes: int) -> None:
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+                self._dev_bytes -= old[3]
+            self._entries[key] = [plan, int(nbytes), None, 0]
+            self._bytes += int(nbytes)
+            self._evict_locked()
+            self._publish_locked()
+
+    def promote(self, key, device_tree, nbytes: int) -> None:
+        """Attach a decode's freshly uploaded device arrays to the
+        entry; demotes colder device residents past the device budget."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None or ent[2] is not None:
+                return
+            if int(nbytes) > self.device_max_bytes:
+                return
+            ent[2] = device_tree
+            ent[3] = int(nbytes)
+            self._dev_bytes += int(nbytes)
+            self._promotions.add(1)
+            if self._dev_bytes > self.device_max_bytes:
+                for k in list(self._entries):
+                    if self._dev_bytes <= self.device_max_bytes:
+                        break
+                    e = self._entries[k]
+                    if k != key and e[2] is not None:
+                        self._dev_bytes -= e[3]
+                        e[2], e[3] = None, 0
+                        self._demotions.add(1)
+            self._publish_locked()
+
+    def _evict_locked(self) -> None:
+        while self._bytes > self.max_bytes and len(self._entries) > 1:
+            _k, ent = self._entries.popitem(last=False)
+            self._bytes -= ent[1]
+            self._dev_bytes -= ent[3]
+            self._evictions.add(1)
+
+    def _publish_locked(self) -> None:
+        self._g_bytes.set(self._bytes)
+        self._g_dev.set(self._dev_bytes)
+
+    @property
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "deviceBytes": self._dev_bytes}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self._dev_bytes = 0
+            self._publish_locked()

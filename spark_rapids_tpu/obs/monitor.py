@@ -243,6 +243,32 @@ def status_snapshot() -> Dict[str, Any]:
             "socketPeers": peers,
             "ici": ici_info,
         }
+        # deviceDecode scan state (docs/scan_device.md): cumulative
+        # device-vs-host decode counters + the encoded-page cache tier's
+        # occupancy/hit rates — the same series Prometheus reads as
+        # srt_scan_device_* / srt_pagecache_*
+        scan_dev: Dict[str, Any] = {}
+        page: Dict[str, Any] = {}
+        for m in REGISTRY.metrics():
+            if m.name.startswith("scan.device."):
+                v = m.value
+                scan_dev[m.name.split("scan.device.", 1)[1]] = \
+                    round(v, 6) if isinstance(v, float) else v
+            elif m.name.startswith("pagecache."):
+                v = m.value
+                page[m.name.split("pagecache.", 1)[1]] = \
+                    round(v, 6) if isinstance(v, float) else v
+        if scan_dev or page:
+            from spark_rapids_tpu.obs.profile import scan_decode_mode
+            out["scanDecode"] = {
+                "mode": scan_decode_mode(
+                    {f"scan.device.{k}": v for k, v in scan_dev.items()}),
+                "device": scan_dev,
+                "pageCache": page,
+            }
+        if getattr(s, "page_cache", None) is not None:
+            out.setdefault("scanDecode", {})["pageCacheState"] = \
+                s.page_cache.stats
     # zero-warm-up layer: AOT pre-warm progress (kernels warmed /
     # pending / skipped) and shared-compile-cache hit rates — the
     # serving fleet's "is this worker warm yet?" probe
@@ -442,6 +468,14 @@ class _Handler(JsonHandler):
                     sstats = SYNC_LEDGER.query_stats(qid)
                     if sstats["syncs"]:
                         doc["syncStats"] = sstats
+                    # per-query decode-mode verdict from the live scan
+                    # counters (docs/scan_device.md)
+                    sc = doc.get("scan") or {}
+                    dev_c = int(sc.get("deviceColumns", 0) or 0)
+                    host_c = int(sc.get("hostColumns", 0) or 0)
+                    doc["scanDecodeMode"] = \
+                        "device" if dev_c and not host_c else \
+                        ("mixed" if dev_c else "host")
                     self._send_json(doc)
             elif path == "/api/tenants":
                 self._send_json(tenants_snapshot())

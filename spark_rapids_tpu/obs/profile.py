@@ -90,6 +90,24 @@ def _node_breakdown(node, ctx) -> Optional[Dict[str, float]]:
             "total_s": round(device + transfer + dispatch, 6)}
 
 
+def scan_decode_mode(scan: Dict[str, Any]) -> str:
+    """Per-query decode-mode verdict from a scan counter delta
+    (docs/scan_device.md): ``device`` when every decoded column of every
+    split rode the deviceDecode kernels, ``mixed`` when any column (or
+    whole split) fell back to the host decode, ``host`` when no split
+    took the device path at all (deviceDecode off, or no parquet scan)."""
+    def n(key: str) -> int:
+        try:
+            return int(scan.get(key, 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+    if not n("scan.device.splits"):
+        return "host"
+    if n("scan.device.fallbackColumns") or n("scan.device.hostReads"):
+        return "mixed"
+    return "device"
+
+
 def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
                   wall_s: Optional[float] = None,
                   obs_before: Optional[tuple] = None) -> "ProfileReport":
@@ -122,6 +140,7 @@ def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
     summary["shuffle"] = take("shuffle.")
     summary["kernelCache"] = take("kernelCache.")
     summary["scan"] = take("scan.")
+    summary["pageCache"] = take("pagecache.")
     summary["compileCache"] = take("compileCache.")
     if summary["shuffleSkew"]:
         from spark_rapids_tpu.obs.metrics import REGISTRY
@@ -135,6 +154,13 @@ def build_profile(plan, ctx, global_delta: Optional[Dict[str, Any]] = None,
         for m in REGISTRY.metrics():
             if m.kind == "gauge" and m.name.startswith("scan.prefetch."):
                 summary["scan"].setdefault(m.name, m.value)
+        summary["scan"]["scan.decode.mode"] = scan_decode_mode(
+            summary["scan"])
+    if summary["pageCache"]:
+        from spark_rapids_tpu.obs.metrics import REGISTRY
+        for m in REGISTRY.metrics():
+            if m.kind == "gauge" and m.name.startswith("pagecache."):
+                summary["pageCache"].setdefault(m.name, m.value)
     if delta:
         summary["other"] = delta
     mem = op_metrics.get("memory")

@@ -105,6 +105,17 @@ class TpuSparkSession:
         self.capture_plans = False
         # device-resident scan batches (spark.rapids.sql.cacheDeviceScans)
         self.device_scan_cache: dict = {}
+        # encoded-page cache for the deviceDecode scan path
+        # (spark.rapids.sql.scan.pageCache.*): hot tables re-decode from
+        # cached encoded pages instead of re-reading + re-uploading
+        from spark_rapids_tpu.memory.spill import EncodedPageCache
+        self.page_cache = EncodedPageCache(
+            int(conf.get("spark.rapids.sql.scan.pageCache.maxBytes",
+                         256 << 20) or 0),
+            int(conf.get("spark.rapids.sql.scan.pageCache.deviceMaxBytes",
+                         64 << 20) or 0)) \
+            if conf.get_bool("spark.rapids.sql.scan.pageCache.enabled",
+                             True) else None
         # device mesh for distributed execution (None = single-device);
         # when set, TpuShuffleExchangeExec exchanges over it with an ICI
         # all_to_all instead of collapsing locally (parallel/distributed.py)

@@ -71,6 +71,18 @@ _BYTES = REGISTRY.counter("scan.prefetch.bytesDecoded")
 _BUDGET_STALLS = REGISTRY.counter("scan.prefetch.budgetStalls")
 
 
+def _nbytes(obj) -> int:
+    """Host bytes a decoded split retains in the prefetch queue: pandas
+    frames by column memory_usage, deviceDecode RawRowGroups (and
+    anything else plan-shaped) by their ``nbytes``."""
+    if obj is None:
+        return 0
+    mu = getattr(obj, "memory_usage", None)
+    if mu is not None:
+        return int(mu(deep=False).sum())
+    return int(getattr(obj, "nbytes", 0) or 0)
+
+
 def decode_pool(threads: int) -> ThreadPoolExecutor:
     """Shared daemon decode pool. One per process; rebuilt (old pool left
     to drain) if a session reconfigures the thread count."""
@@ -158,8 +170,7 @@ class ScanPrefetcher:
                 with TRACER.span("scan.decode", split=i,
                                  file=path or "<memory>"):
                     df = fn()
-            nbytes = int(df.memory_usage(deep=False).sum()) \
-                if df is not None else 0
+            nbytes = _nbytes(df)
             with self._lock:
                 if self._cancelled or i in self._skip:
                     # raced a cancel (or a skip of a never-consumed
@@ -241,8 +252,7 @@ class ScanPrefetcher:
                     except BaseException:
                         dfj = None
                     if dfj is not None:
-                        self._pending_bytes -= int(
-                            dfj.memory_usage(deep=False).sum())
+                        self._pending_bytes -= _nbytes(dfj)
                 else:
                     # running: drop its result on finish. The done
                     # callback reclaims the budget if the decode raced
@@ -288,8 +298,7 @@ class ScanPrefetcher:
             raise
         if df is not None:
             with self._lock:
-                self._pending_bytes -= int(
-                    df.memory_usage(deep=False).sum())
+                self._pending_bytes -= _nbytes(df)
         return df
 
     def _reclaim_skipped(self, j: int, fr) -> None:
@@ -306,8 +315,7 @@ class ScanPrefetcher:
                 return  # _decode saw the marker (or cancel reset budget)
             self._skip.discard(j)
             if df is not None:
-                self._pending_bytes -= int(
-                    df.memory_usage(deep=False).sum())
+                self._pending_bytes -= _nbytes(df)
 
     def cancel(self) -> None:
         """Early consumer exit: cancel unstarted decodes, drop every

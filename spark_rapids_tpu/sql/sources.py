@@ -198,8 +198,13 @@ class ParquetSource(DataSource):
             raise FileNotFoundError(f"no parquet files under {paths}")
         self.paths = [f for f, _ in self._files]
         self._pq = pq
-        pf = pq.ParquetFile(self.paths[0])
-        arrow_schema = pf.schema_arrow
+        # footer parses ride the shared (path, mtime)-keyed metadata
+        # cache (sql/parquet_raw.py) — split planning, _rg_stats and the
+        # deviceDecode page reader all reuse ONE parse per file instead
+        # of re-opening ParquetFile per consumer
+        from spark_rapids_tpu.sql import parquet_raw as praw
+        arrow_schema = praw.file_metadata(
+            self.paths[0]).schema.to_arrow_schema()
         names, dts = [], []
         from spark_rapids_tpu.columnar import dtypes as dtmod
         for field in arrow_schema:
@@ -222,8 +227,7 @@ class ParquetSource(DataSource):
         # partition plan: (path, row_group_index, partition_values)
         self.splits = []
         for p, pvals in self._files:
-            f = pq.ParquetFile(p)
-            for rg in range(f.metadata.num_row_groups):
+            for rg in range(praw.file_metadata(p).num_row_groups):
                 self.splits.append((p, rg, pvals))
 
     def describe(self) -> str:
@@ -256,19 +260,18 @@ class ParquetSource(DataSource):
         Keyed by (path, mtime, rg): a rewritten file's stale stats must
         not keep pruning row groups of its replacement. Insertion-ordered
         dict, oldest-half eviction past the cap."""
-        import os
+        from spark_rapids_tpu.sql import parquet_raw as praw
         base = getattr(self, "_base", self)
         cache = base.__dict__.setdefault("_stats_cache", {})
-        try:
-            mtime = os.path.getmtime(path)
-        except OSError:
-            mtime = None
+        mtime = praw.file_mtime(path)
         if (path, mtime, rg) not in cache:
             if len(cache) >= self._RG_STATS_CACHE_CAP:
                 for k in list(cache)[:len(cache)
                                      - self._RG_STATS_CACHE_CAP // 2]:
                     del cache[k]
-            md = self._pq.ParquetFile(path).metadata.row_group(rg)
+            # footer via the shared (path, mtime) metadata cache — no
+            # ParquetFile re-open per split
+            md = praw.file_metadata(path, mtime).row_group(rg)
             stats = {}
             for ci in range(md.num_columns):
                 col = md.column(ci)
@@ -351,6 +354,73 @@ class ParquetSource(DataSource):
                                       dtype=dt.pandas_nullable
                                       if not dt.is_string else object)
                 return _attach_dict_hints(df) if pipelined else df
+            return decode
+        if not splits:
+            def empty():
+                yield _empty_from_schema(self.schema)
+            return [empty]
+        return build_partitions(
+            ctx, [(p, decode_task(p, rg, pv)) for p, rg, pv in splits])
+
+    def raw_partitions(self, ctx: ExecContext,
+                       filters=None) -> List[Partition]:
+        """deviceDecode split plan (spark.rapids.sql.scan.deviceDecode):
+        decode workers produce RawRowGroup decode plans (raw page bytes +
+        run tables, ops/parquet_decode.py) instead of pandas frames; the
+        consumer decodes them ON DEVICE. Rides the same prefetch
+        machinery as cpu_partitions — bounded queue, backpressure,
+        prefetchDepth=0 serial rollback. Row groups where NO column can
+        ride the device path degrade to the classic pandas frame."""
+        splits = self.splits
+        if filters:
+            splits, pruned = self.prune_splits(filters)
+            if ctx.metrics_enabled:
+                ctx.metric_add(self.describe(), "numRowGroupsPruned",
+                               pruned)
+        from spark_rapids_tpu.exec.transitions import upload_blocked_chars
+        from spark_rapids_tpu.sql.scan_pipeline import (
+            build_partitions, pipeline_config,
+        )
+        pipelined = pipeline_config(ctx.conf)[0] > 0
+        direct = pipelined and ctx.conf.get_bool(
+            "spark.rapids.sql.scan.directDecode", True)
+        blocked = upload_blocked_chars(ctx)
+        page_cache = getattr(ctx.session, "page_cache", None) \
+            if ctx.session else None
+        columns = list(self.columns)
+        dtypes_by_name = dict(zip(self.schema.names, self.schema.dtypes))
+        pkeys, pkey_dtypes = list(self._pkeys), dict(self._pkey_dtypes)
+
+        def decode_task(path: str, rg: int, pvals):
+            def decode():
+                from spark_rapids_tpu.ops.parquet_decode import (
+                    prepare_rowgroup,
+                )
+                raw = prepare_rowgroup(path, rg, pvals, columns,
+                                       dtypes_by_name, blocked,
+                                       page_cache=page_cache,
+                                       direct=direct)
+                if getattr(raw, "is_raw_rowgroup", False):
+                    return raw
+                # whole-split host fallback: finish exactly like the
+                # classic decode_task (partition-value columns appended)
+                df = raw
+                if df is None:
+                    f = self._pq.ParquetFile(path)
+                    table = f.read_row_group(rg, columns=columns)
+                    df = _arrow_decode(table, direct)
+                for k in pkeys:
+                    v = (_infer_partition_value(pvals[k])
+                         if k in pvals else None)
+                    dt = pkey_dtypes[k]
+                    if v is not None and not dt.is_string:
+                        v = dt.np_dtype.type(v)
+                    elif v is not None:
+                        v = str(v)
+                    df[k] = pd.Series([v] * len(df),
+                                      dtype=dt.pandas_nullable
+                                      if not dt.is_string else object)
+                return df
             return decode
         if not splits:
             def empty():

@@ -307,6 +307,43 @@ class TestEndToEnd:
             + ", ".join(f"{e['site']}({e.get('op')})" for e in ents))
         pd.testing.assert_frame_equal(first, second)
 
+    def test_q6_device_decode_sync_budget(self, session, tmp_path):
+        """The deviceDecode twin of the q6 budget pin: raw-page uploads
+        ride ``sync_scope("scan.upload")`` / ``"scan.pagecache"`` (every
+        blocking point stays NAMED), and the page-cache-warm second run
+        stays inside the same 8-entry budget — the encoded-page cache
+        must not add steady-state syncs over the classic path."""
+        from spark_rapids_tpu.models import tpch_data
+        from spark_rapids_tpu.models.tpch import QUERIES
+        p = str(tmp_path / "lineitem.parquet")
+        li = tpch_data.gen_lineitem(0.002)
+        li.to_parquet(p, row_group_size=max(len(li) // 3, 1), index=False)
+        session.set_conf("spark.rapids.sql.scan.deviceDecode", True)
+        session.set_conf("spark.rapids.sql.cacheDeviceScans", False)
+        try:
+            def run():
+                tables = {"lineitem": session.read.parquet(p)}
+                return QUERIES["q6"](session, tables).collect()
+
+            seq_cold = SYNC_LEDGER.seq
+            first = run()
+            cold = SYNC_LEDGER.entries(since_seq=seq_cold)
+            sites = {e["site"] for e in cold}
+            assert sites & {"scan.upload", "scan.pagecache"}, sites
+            assert all(e["site"] for e in cold)
+            seq0 = SYNC_LEDGER.seq
+            second = run()
+            ents = SYNC_LEDGER.entries(since_seq=seq0)
+            budget = 8
+            assert len(ents) <= budget, (
+                f"deviceDecode host-sync budget regression: warm q6 "
+                f"blocked {len(ents)}x (budget {budget}): "
+                + ", ".join(f"{e['site']}({e.get('op')})" for e in ents))
+            pd.testing.assert_frame_equal(first, second)
+        finally:
+            session.set_conf("spark.rapids.sql.scan.deviceDecode", False)
+            session.set_conf("spark.rapids.sql.cacheDeviceScans", True)
+
 
 # ---------------------------------------------------------------------------
 # Transfer-guard coverage audit over a real query (slow tier)

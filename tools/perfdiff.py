@@ -186,6 +186,22 @@ def scan_from_doc(doc: Dict[str, Any]) -> Dict[str, float]:
     return {}
 
 
+def scan_modes_from_doc(doc: Dict[str, Any]) -> Dict[str, str]:
+    """Per-query scan decode-mode verdicts (``host``/``mixed``/``device``)
+    from a BENCH_DETAIL-shaped artifact's ``--include-scan`` records
+    (bench.py's deviceDecode pass, docs/scan_device.md). Empty for
+    artifact shapes without the scan sidecar."""
+    if isinstance(doc.get("queries"), dict):
+        out = {}
+        for name, rec in doc["queries"].items():
+            if isinstance(rec, dict):
+                mode = (rec.get("scan") or {}).get("scan_decode_mode")
+                if mode in ("host", "mixed", "device"):
+                    out[name] = mode
+        return out
+    return {}
+
+
 def syncs_from_doc(doc: Dict[str, Any]) -> Dict[str, Dict[str, float]]:
     """Per-query steady-state host-sync facts from a BENCH_DETAIL-shaped
     artifact (``bench.py`` records ``host_syncs`` — blocking device<->
@@ -565,7 +581,10 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
             gate_losers: bool = True,
             base_syncs: Optional[Dict[str, Dict[str, float]]] = None,
             new_syncs: Optional[Dict[str, Dict[str, float]]] = None,
-            sync_threshold: float = 0.25) -> Dict[str, Any]:
+            sync_threshold: float = 0.25,
+            base_scan_modes: Optional[Dict[str, str]] = None,
+            new_scan_modes: Optional[Dict[str, str]] = None) \
+        -> Dict[str, Any]:
     common = sorted(set(base) & set(new))
     deltas = []
     for q in common:
@@ -702,7 +721,26 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
             "regressed": (n - b) > sync_threshold})
     sync_share_regressions = [d["query"] for d in sync_share_deltas
                               if d["regressed"]]
+    # decode-mode gate (--ignore-scan-mode opts out): a query whose scan
+    # decode mode drops rank between sweeps (device -> mixed/host, or
+    # mixed -> host) silently fell off the device decode path — the scan
+    # may still pass its timing gates while every page quietly rides the
+    # pandas fallback again (docs/scan_device.md). Rank order:
+    # host < mixed < device; only a DROP regresses (host -> device is
+    # the improvement this gate exists to protect).
+    mode_rank = {"host": 0, "mixed": 1, "device": 2}
+    scan_mode_deltas = []
+    for q in sorted(set(base_scan_modes or {}) & set(new_scan_modes or {})):
+        b, n = base_scan_modes[q], new_scan_modes[q]
+        if b != n:
+            scan_mode_deltas.append({
+                "query": q, "base": b, "new": n,
+                "regressed": mode_rank.get(n, 0) < mode_rank.get(b, 0)})
+    scan_mode_regressions = [d["query"] for d in scan_mode_deltas
+                             if d["regressed"]]
     return {
+        "scan_mode_deltas": scan_mode_deltas,
+        "scan_mode_regressions": scan_mode_regressions,
         "sync_deltas": sync_deltas,
         "sync_regressions": sync_regressions,
         "sync_share_deltas": sync_share_deltas,
@@ -747,7 +785,7 @@ def compare(base: Dict[str, float], base_geo: Optional[float],
         or bool(warmup_regressions) or bool(first_query_regressions)
         or bool(scan_regressions) or scan_geo_regressed
         or losers_regressed or bool(sync_regressions)
-        or bool(sync_share_regressions),
+        or bool(sync_share_regressions) or bool(scan_mode_regressions),
     }
 
 
@@ -816,6 +854,11 @@ def render_text(rep: Dict[str, Any]) -> str:
                          f"{d['base']:.2f}x -> {d['new']:.2f}x "
                          f"({d['delta_pct']:+.1f}%) SCAN-INCLUSIVE "
                          "REGRESSION")
+    for d in rep.get("scan_mode_deltas", []):
+        mark = " DECODE-MODE REGRESSION" if d["regressed"] \
+            else " (improved)"
+        lines.append(f"-- scan decode mode {d['query']}: "
+                     f"{d['base']} -> {d['new']}{mark}")
     for d in rep.get("sync_deltas", []):
         if d["regressed"]:
             lines.append(f"-- host_syncs {d['query']}: "
@@ -901,6 +944,9 @@ def main(argv=None) -> int:
                          "0.10 = 10%%)")
     ap.add_argument("--ignore-scan", action="store_true",
                     help="do not gate on scan-inclusive drift")
+    ap.add_argument("--ignore-scan-mode", action="store_true",
+                    help="do not gate on scan decode-mode rank drops "
+                         "(device -> mixed/host between sweeps)")
     ap.add_argument("--sync-threshold", type=float, default=0.25,
                     help="host-sync growth bound (default 0.25): "
                          "relative for per-iteration sync COUNTS "
@@ -1019,6 +1065,10 @@ def main(argv=None) -> int:
             else warmup_from_doc(new_doc)
         base_s = {} if args.ignore_scan else scan_from_doc(base_doc)
         new_s = {} if args.ignore_scan else scan_from_doc(new_doc)
+        base_sm = {} if args.ignore_scan_mode \
+            else scan_modes_from_doc(base_doc)
+        new_sm = {} if args.ignore_scan_mode \
+            else scan_modes_from_doc(new_doc)
         base_sy = {"counts": {}, "shares": {}} if args.ignore_syncs \
             else syncs_from_doc(base_doc)
         new_sy = {"counts": {}, "shares": {}} if args.ignore_syncs \
@@ -1053,7 +1103,8 @@ def main(argv=None) -> int:
                   base_losers=base_l, new_losers=new_l,
                   gate_losers=not args.ignore_losers,
                   base_syncs=base_sy, new_syncs=new_sy,
-                  sync_threshold=args.sync_threshold)
+                  sync_threshold=args.sync_threshold,
+                  base_scan_modes=base_sm, new_scan_modes=new_sm)
     if roof is not None:
         rep["roofline_deltas"] = roof
         regressed = any(d["regressed"] for d in roof)

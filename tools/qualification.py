@@ -132,7 +132,8 @@ def _new_record(name: str, source: str) -> Dict[str, Any]:
         "fetch": {"retries": 0, "failures": 0, "by_peer": {}},
         "compile": {"compiles": 0, "seconds": 0.0, "cache_misses": 0,
                     "warmup_share_pct": None, "entries": []},
-        "scan": {"stalls": 0, "stall_s": 0.0, "budget_stalls": 0},
+        "scan": {"stalls": 0, "stall_s": 0.0, "budget_stalls": 0,
+                 "device_fallbacks": {}},
         "sync": {"syncs": 0, "seconds": 0.0, "bytes": 0,
                  "share_pct": None, "sites": {}},
         "shuffle_skew": {"shuffles": 0, "max_ratio": None,
@@ -263,6 +264,17 @@ def records_from_events(events: List[Dict[str, Any]], source: str,
                 r["scan"]["stall_s"] + float(ev.get("stall_s", 0.0)), 6)
         elif kind == "scanBudgetStall":
             r["scan"]["budget_stalls"] += 1
+        elif kind == "scanDeviceFallback":
+            # deviceDecode per-column host fallback (docs/scan_device.md):
+            # counted per reason, sample columns kept for the ranking
+            reason = str(ev.get("reason", "?"))
+            df = r["scan"]["device_fallbacks"].setdefault(
+                reason, {"count": 0, "columns": []})
+            df["count"] += 1
+            col = ev.get("column")
+            if col is not None and col not in df["columns"] \
+                    and len(df["columns"]) < 8:
+                df["columns"].append(col)
         elif kind == "hostSync":
             sy = r["sync"]
             sy["syncs"] += 1
@@ -511,8 +523,28 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             agg["compile_seconds"] + r["compile"]["seconds"], 4)
         agg["spill_bytes"] += r["spill"]["bytes"]
         agg["host_syncs"] += r["sync"]["syncs"]
+    # deviceDecode fallback reasons across the workload: which
+    # encodings/types kept columns on the host decode, ranked by count —
+    # the "what to build next" list for the device scan path
+    dev_fb: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        for reason, info in (r["scan"].get("device_fallbacks")
+                             or {}).items():
+            agg = dev_fb.setdefault(reason, {
+                "reason": reason, "count": 0, "queries": set(),
+                "columns": []})
+            agg["count"] += int(info.get("count", 0) or 0)
+            agg["queries"].add(r["query"])
+            for col in info.get("columns", []):
+                if col not in agg["columns"] and len(agg["columns"]) < 8:
+                    agg["columns"].append(col)
+    dev_ranked = sorted(dev_fb.values(),
+                        key=lambda a: (-a["count"], a["reason"]))
+    for a in dev_ranked:
+        a["queries"] = sorted(a["queries"])
     return {"version": 1, "totals": totals, "queries": records,
             "fallback_reasons": ranked, "warmup": warmup,
+            "scan_device_fallbacks": dev_ranked or None,
             "replicas": replicas or None}
 
 
@@ -570,6 +602,17 @@ def render_text(report: Dict[str, Any], top_n: int = 15) -> str:
         for a in ranked[:top_n]:
             lines.append(f"{a['impact_s']:>9.4f} {len(a['queries']):>7}  "
                          f"{a['reason'][:100]}")
+    dev_fb = report.get("scan_device_fallbacks")
+    if dev_fb:
+        lines.append("")
+        lines.append("-- device-decode fallback reasons "
+                     "(columns kept on host decode, ranked by count)")
+        lines.append(f"{'columns':>7} {'queries':>7}  reason (sample columns)")
+        for a in dev_fb[:top_n]:
+            cols = ",".join(str(c) for c in a["columns"][:4])
+            lines.append(f"{a['count']:>7} {len(a['queries']):>7}  "
+                         f"{a['reason'][:40]}"
+                         + (f" ({cols})" if cols else ""))
     warm = report.get("warmup")
     if warm and warm["groups"]:
         lines.append("")
